@@ -1,0 +1,143 @@
+"""OCI-distribution-style error model.
+
+Reference parity: pkg/errors/errors.go:12-107 — same codes, same HTTP status
+mapping, same JSON body shape ``{"code": ..., "message": ..., "detail": ...}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any
+
+# Error codes (errors.go:12-31) — mirrors the OCI distribution spec.
+ErrCodeBlobUnknown = "BLOB_UNKNOWN"
+ErrCodeBlobUploadInvalid = "BLOB_UPLOAD_INVALID"
+ErrCodeBlobUploadUnknown = "BLOB_UPLOAD_UNKNOWN"
+ErrCodeDigestInvalid = "DIGEST_INVALID"
+ErrCodeManifestBlobUnknown = "MANIFEST_BLOB_UNKNOWN"
+ErrCodeManifestInvalid = "MANIFEST_INVALID"
+ErrCodeManifestUnknown = "MANIFEST_UNKNOWN"
+ErrCodeNameInvalid = "NAME_INVALID"
+ErrCodeNameUnknown = "NAME_UNKNOWN"
+ErrCodeIndexUnknown = "INDEX_UNKNOWN"
+ErrCodeSizeInvalid = "SIZE_INVALID"
+ErrCodeUnauthorized = "UNAUTHORIZED"
+ErrCodeDenied = "DENIED"
+ErrCodeUnsupported = "UNSUPPORTED"
+ErrCodeTooManyRequests = "TOOMANYREQUESTS"
+ErrCodeConfigInvalid = "CONFIG_INVALID"
+ErrCodeInternal = "INTERNAL"
+ErrCodeUnknown = "UNKNOWN"
+
+
+@dataclasses.dataclass
+class ErrorInfo(Exception):
+    """errors.go:35-44 — carries HTTP status + machine code + message."""
+
+    http_status: int = 500
+    code: str = ErrCodeUnknown
+    message: str = ""
+    detail: str = ""
+
+    def __post_init__(self) -> None:
+        super().__init__(self.message or self.code)
+
+    def to_json(self) -> dict[str, Any]:
+        return {"code": self.code, "message": self.message, "detail": self.detail}
+
+    def encode(self) -> bytes:
+        return json.dumps(self.to_json()).encode()
+
+    @classmethod
+    def decode(cls, data: bytes, http_status: int = 500) -> "ErrorInfo":
+        try:
+            d = json.loads(data)
+            if not isinstance(d, dict):
+                raise ValueError
+        except (ValueError, UnicodeDecodeError):
+            return cls(http_status=http_status, code=ErrCodeUnknown, message=data.decode(errors="replace"))
+        return cls(
+            http_status=http_status,
+            code=d.get("code", ErrCodeUnknown),
+            message=d.get("message", ""),
+            detail=str(d.get("detail", "")),
+        )
+
+    def __str__(self) -> str:
+        s = f"{self.code}: {self.message}"
+        if self.detail:
+            s += f" ({self.detail})"
+        return s
+
+
+def is_err_code(err: BaseException, code: str) -> bool:
+    """errors.go:46-55 IsErrCode."""
+    return isinstance(err, ErrorInfo) and err.code == code
+
+
+# Constructors (errors.go:57-107)
+
+
+def blob_unknown(digest: str) -> ErrorInfo:
+    return ErrorInfo(404, ErrCodeBlobUnknown, f"blob unknown: {digest}")
+
+
+def blob_upload_invalid(detail: str = "") -> ErrorInfo:
+    return ErrorInfo(400, ErrCodeBlobUploadInvalid, "blob upload invalid", detail)
+
+
+def digest_invalid(digest: str, detail: str = "") -> ErrorInfo:
+    return ErrorInfo(400, ErrCodeDigestInvalid, f"digest invalid: {digest}", detail)
+
+
+def manifest_blob_unknown(digest: str) -> ErrorInfo:
+    return ErrorInfo(404, ErrCodeManifestBlobUnknown, f"manifest blob unknown: {digest}")
+
+
+def manifest_invalid(detail: str = "") -> ErrorInfo:
+    return ErrorInfo(400, ErrCodeManifestInvalid, "manifest invalid", detail)
+
+
+def manifest_unknown(reference: str) -> ErrorInfo:
+    return ErrorInfo(404, ErrCodeManifestUnknown, f"manifest unknown: {reference}")
+
+
+def name_invalid(name: str, detail: str = "") -> ErrorInfo:
+    return ErrorInfo(400, ErrCodeNameInvalid, f"name invalid: {name}", detail)
+
+
+def name_unknown(name: str) -> ErrorInfo:
+    return ErrorInfo(404, ErrCodeNameUnknown, f"repository name unknown: {name}")
+
+
+def index_unknown(name: str) -> ErrorInfo:
+    return ErrorInfo(404, ErrCodeIndexUnknown, f"index unknown: {name}")
+
+
+def size_invalid(detail: str = "") -> ErrorInfo:
+    return ErrorInfo(400, ErrCodeSizeInvalid, "size invalid", detail)
+
+
+def unauthorized(detail: str = "") -> ErrorInfo:
+    return ErrorInfo(401, ErrCodeUnauthorized, "authentication required", detail)
+
+
+def denied(detail: str = "") -> ErrorInfo:
+    return ErrorInfo(403, ErrCodeDenied, "requested access to the resource is denied", detail)
+
+
+def unsupported(detail: str = "") -> ErrorInfo:
+    return ErrorInfo(405, ErrCodeUnsupported, "the operation is unsupported", detail)
+
+
+def too_many_requests(detail: str = "") -> ErrorInfo:
+    return ErrorInfo(429, ErrCodeTooManyRequests, "too many requests", detail)
+
+
+def config_invalid(detail: str = "") -> ErrorInfo:
+    return ErrorInfo(400, ErrCodeConfigInvalid, "config invalid", detail)
+
+
+def internal(detail: str = "") -> ErrorInfo:
+    return ErrorInfo(500, ErrCodeInternal, "internal error", detail)

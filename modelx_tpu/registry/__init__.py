@@ -1,0 +1,36 @@
+"""Registry server side: storage providers, stores, HTTP server.
+
+Layering (mirrors SURVEY.md §1 / reference pkg/registry):
+
+    FSProvider (fs.py)          — raw object storage: memory / local / s3
+    RegistryStore (store.py)    — index/manifest/blob semantics + path scheme
+    FSRegistryStore (store_fs)  — store over any FSProvider, atomic indexes
+    S3RegistryStore (store_s3)  — presigned "load separation" layer
+    Registry + server (server)  — HTTP handlers, router, filters
+"""
+
+from modelx_tpu.registry.fs import FSProvider, FSContent, FSMeta, MemoryFSProvider, LocalFSProvider
+from modelx_tpu.registry.store import (
+    BlobContent,
+    BlobMeta,
+    RegistryStore,
+    blob_digest_path,
+    index_path,
+    manifest_path,
+)
+from modelx_tpu.registry.store_fs import FSRegistryStore
+
+__all__ = [
+    "FSProvider",
+    "FSContent",
+    "FSMeta",
+    "MemoryFSProvider",
+    "LocalFSProvider",
+    "BlobContent",
+    "BlobMeta",
+    "RegistryStore",
+    "FSRegistryStore",
+    "blob_digest_path",
+    "index_path",
+    "manifest_path",
+]

@@ -1,0 +1,93 @@
+"""RegistryStore interface + the on-storage path scheme.
+
+Reference parity: pkg/registry/store.go:34-74. Layout:
+
+    index.json                          — global index (repositories)
+    {repo}/index.json                   — per-repo index (versions)
+    {repo}/manifests/{reference}        — manifest JSON
+    {repo}/blobs/{algorithm}/{hex}      — blob bytes (content-addressed)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import posixpath
+from typing import BinaryIO, Protocol, runtime_checkable
+
+from modelx_tpu.types import BlobLocation, Index, Manifest
+
+REGISTRY_INDEX_FILENAME = "index.json"
+
+
+class StoreNotFound(KeyError):
+    """store.go:14 ErrRegistryStoreNotFound."""
+
+
+@dataclasses.dataclass
+class BlobContent:
+    """store.go:24-28."""
+
+    content: BinaryIO
+    content_length: int
+    content_type: str = ""
+
+
+@dataclasses.dataclass
+class BlobMeta:
+    """store.go:30-33."""
+
+    content_type: str
+    content_length: int
+
+
+@runtime_checkable
+class RegistryStore(Protocol):
+    """store.go:34-54 — the 13-method store contract."""
+
+    def get_global_index(self, search: str = "") -> Index: ...
+
+    def get_index(self, repository: str, search: str = "") -> Index: ...
+
+    def remove_index(self, repository: str) -> None: ...
+
+    def exists_manifest(self, repository: str, reference: str) -> bool: ...
+
+    def get_manifest(self, repository: str, reference: str) -> Manifest: ...
+
+    def put_manifest(
+        self, repository: str, reference: str, content_type: str, manifest: Manifest
+    ) -> None: ...
+
+    def delete_manifest(self, repository: str, reference: str) -> None: ...
+
+    def list_blobs(self, repository: str) -> list[str]: ...
+
+    def get_blob(self, repository: str, digest: str, offset: int = 0, length: int = -1) -> BlobContent: ...
+
+    def delete_blob(self, repository: str, digest: str) -> None: ...
+
+    def put_blob(self, repository: str, digest: str, content: BlobContent) -> None: ...
+
+    def exists_blob(self, repository: str, digest: str) -> bool: ...
+
+    def get_blob_meta(self, repository: str, digest: str) -> BlobMeta: ...
+
+    def get_blob_location(
+        self, repository: str, digest: str, purpose: str, properties: dict[str, str]
+    ) -> BlobLocation | None: ...
+
+
+def blob_digest_path(repository: str, digest: str) -> str:
+    """store.go:56-61."""
+    algo, _, hexpart = digest.partition(":")
+    return posixpath.join(repository, "blobs", algo, hexpart)
+
+
+def index_path(repository: str) -> str:
+    """store.go:63-65."""
+    return posixpath.join(repository, REGISTRY_INDEX_FILENAME)
+
+
+def manifest_path(repository: str, reference: str) -> str:
+    """store.go:67-69."""
+    return posixpath.join(repository, "manifests", reference)

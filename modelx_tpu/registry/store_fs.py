@@ -1,0 +1,336 @@
+"""FS-backed RegistryStore over any FSProvider.
+
+Reference parity: pkg/registry/store_fs.go:23-395, with the reference's
+catalogued bugs fixed (SURVEY.md §7):
+
+- ``list_blobs`` actually lists blobs (store_fs.go:366-378 returns nil,nil —
+  GC there is a no-op; here GC works).
+- Index rebuilds are serialized per repository and the global index rebuild is
+  single-writer (store_fs.go:185-238/287-330 race concurrent writers;
+  last-writer-wins corruption under concurrent manifest PUTs).
+- Index annotations come from the *newest* manifest by modified-time
+  (store_fs.go:150-157 takes the alphabetically-first and claims "latest").
+"""
+
+from __future__ import annotations
+
+import io
+import posixpath
+import re
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterable
+
+from modelx_tpu import errors
+from modelx_tpu.registry.fs import FSNotFound, FSProvider
+from modelx_tpu.registry.store import (
+    REGISTRY_INDEX_FILENAME,
+    BlobContent,
+    BlobMeta,
+    StoreNotFound,
+    blob_digest_path,
+    index_path,
+    manifest_path,
+)
+from modelx_tpu.types import (
+    BlobLocation,
+    Descriptor,
+    Index,
+    Manifest,
+    MediaTypeModelIndexJson,
+    MediaTypeModelManifestJson,
+    sort_descriptors,
+)
+
+_INDEX_REBUILD_CONCURRENCY = 16
+
+
+class FSRegistryStore:
+    """store_fs.go:23-28."""
+
+    def __init__(self, fs: FSProvider, refresh_on_init: bool = True) -> None:
+        self.fs = fs
+        self._index_locks: dict[str, threading.Lock] = {}
+        self._index_locks_guard = threading.Lock()
+        self._global_lock = threading.Lock()
+        if refresh_on_init:
+            # store_fs.go:56-58 — rebuild the global index at boot.
+            self.refresh_global_index()
+
+    # -- locks ----------------------------------------------------------------
+
+    def _repo_lock(self, repository: str) -> threading.Lock:
+        with self._index_locks_guard:
+            return self._index_locks.setdefault(repository, threading.Lock())
+
+    # -- index ----------------------------------------------------------------
+
+    def get_global_index(self, search: str = "") -> Index:
+        """store_fs.go GetGlobalIndex + regex search filter (114-143)."""
+        try:
+            data = self.fs.get(REGISTRY_INDEX_FILENAME).read_all()
+            idx = Index.decode(data)
+        except FSNotFound:
+            idx = self.refresh_global_index()
+        return _filter_index(idx, search)
+
+    def get_index(self, repository: str, search: str = "") -> Index:
+        try:
+            data = self.fs.get(index_path(repository)).read_all()
+            idx = Index.decode(data)
+        except FSNotFound:
+            # lazily rebuild; a repo with no manifests does not exist
+            idx = self.refresh_index(repository)
+            if not idx.manifests:
+                raise errors.index_unknown(repository) from None
+        return _filter_index(idx, search)
+
+    def remove_index(self, repository: str) -> None:
+        """store_fs.go RemoveIndex — delete the whole repository subtree."""
+        try:
+            self.fs.remove(repository)
+        except FSNotFound:
+            raise errors.index_unknown(repository) from None
+        self.refresh_global_index()
+
+    def refresh_index(self, repository: str) -> Index:
+        """Rebuild {repo}/index.json from manifests (store_fs.go:185-238).
+
+        Parallel manifest fetch; serialized per-repo so concurrent manifest
+        PUTs can't interleave a stale read-modify-write.
+        """
+        with self._repo_lock(repository):
+            manifests = self._list_manifest_refs(repository)
+
+            def fetch(ref: str) -> Descriptor | None:
+                try:
+                    m = self.get_manifest(repository, ref)
+                except (StoreNotFound, errors.ErrorInfo):
+                    return None
+                data = m.encode()
+                from modelx_tpu.types import Digest
+
+                return Descriptor(
+                    name=ref,
+                    media_type=MediaTypeModelManifestJson,
+                    digest=Digest.from_bytes(data),
+                    size=sum(b.size for b in m.blobs) + m.config.size,
+                    modified=_manifest_modified(m),
+                    annotations=dict(m.annotations),
+                )
+
+            with ThreadPoolExecutor(max_workers=_INDEX_REBUILD_CONCURRENCY) as ex:
+                descs = [d for d in ex.map(fetch, manifests) if d is not None]
+
+            idx = Index(
+                media_type=MediaTypeModelIndexJson,
+                manifests=sort_descriptors(descs),
+                annotations=_latest_annotations(descs),
+            )
+            data = idx.encode()
+            self.fs.put(index_path(repository), io.BytesIO(data), len(data), MediaTypeModelIndexJson)
+        self._refresh_global_entry(repository, idx)
+        return idx
+
+    def refresh_global_index(self) -> Index:
+        """Rebuild the root index.json over all repositories
+        (store_fs.go:287-330). Single-writer."""
+        with self._global_lock:
+            repos = self._list_repositories()
+
+            def fetch(repo: str) -> Descriptor | None:
+                try:
+                    data = self.fs.get(index_path(repo)).read_all()
+                    idx = Index.decode(data)
+                except (FSNotFound, ValueError):
+                    # repo has manifests but no index yet: build descriptor list lazily
+                    refs = self._list_manifest_refs(repo)
+                    if not refs:
+                        return None
+                    idx = Index(manifests=[Descriptor(name=r) for r in refs])
+                if not idx.manifests:
+                    return None
+                return Descriptor(
+                    name=repo,
+                    media_type=MediaTypeModelIndexJson,
+                    size=sum(m.size for m in idx.manifests),
+                    modified=max((m.modified for m in idx.manifests), default=""),
+                    annotations=dict(idx.annotations),
+                )
+
+            with ThreadPoolExecutor(max_workers=_INDEX_REBUILD_CONCURRENCY) as ex:
+                descs = [d for d in ex.map(fetch, repos) if d is not None]
+            gidx = Index(media_type=MediaTypeModelIndexJson, manifests=sort_descriptors(descs))
+            data = gidx.encode()
+            self.fs.put(REGISTRY_INDEX_FILENAME, io.BytesIO(data), len(data), MediaTypeModelIndexJson)
+            return gidx
+
+    def _refresh_global_entry(self, repository: str, idx: Index) -> None:
+        """Update one repo's entry in the global index without a full rebuild —
+        O(1) instead of the reference's O(repos) fan-out on every manifest PUT
+        (store_fs.go:287-330, flagged HOT in SURVEY.md §3.1)."""
+        with self._global_lock:
+            try:
+                gidx = Index.decode(self.fs.get(REGISTRY_INDEX_FILENAME).read_all())
+            except (FSNotFound, ValueError):
+                gidx = Index(media_type=MediaTypeModelIndexJson)
+            gidx.manifests = [m for m in gidx.manifests if m.name != repository]
+            if idx.manifests:
+                gidx.manifests.append(
+                    Descriptor(
+                        name=repository,
+                        media_type=MediaTypeModelIndexJson,
+                        size=sum(m.size for m in idx.manifests),
+                        modified=max((m.modified for m in idx.manifests), default=""),
+                        annotations=dict(idx.annotations),
+                    )
+                )
+            gidx.manifests = sort_descriptors(gidx.manifests)
+            data = gidx.encode()
+            self.fs.put(REGISTRY_INDEX_FILENAME, io.BytesIO(data), len(data), MediaTypeModelIndexJson)
+
+    # -- manifests ------------------------------------------------------------
+
+    def exists_manifest(self, repository: str, reference: str) -> bool:
+        return self.fs.exists(manifest_path(repository, reference))
+
+    def get_manifest(self, repository: str, reference: str) -> Manifest:
+        try:
+            data = self.fs.get(manifest_path(repository, reference)).read_all()
+        except FSNotFound:
+            raise errors.manifest_unknown(reference) from None
+        try:
+            return Manifest.decode(data)
+        except ValueError as e:
+            raise errors.manifest_invalid(str(e)) from None
+
+    def put_manifest(
+        self, repository: str, reference: str, content_type: str, manifest: Manifest
+    ) -> None:
+        """Manifest PUT is the commit point (store_fs.go:87-104): persist, then
+        rebuild the repo index."""
+        data = manifest.encode()
+        self.fs.put(
+            manifest_path(repository, reference),
+            io.BytesIO(data),
+            len(data),
+            content_type or MediaTypeModelManifestJson,
+        )
+        self.refresh_index(repository)
+
+    def delete_manifest(self, repository: str, reference: str) -> None:
+        try:
+            self.fs.remove(manifest_path(repository, reference))
+        except FSNotFound:
+            raise errors.manifest_unknown(reference) from None
+        self.refresh_index(repository)
+
+    # -- blobs ----------------------------------------------------------------
+
+    def list_blobs(self, repository: str) -> list[str]:
+        """All blob digests stored under a repository.
+
+        Fixes reference bug store_fs.go:366-378 (always returned nil,nil,
+        silently disabling GC)."""
+        out: list[str] = []
+        base = posixpath.join(repository, "blobs")
+        for algo_meta in self.fs.list(base, recursive=False):
+            algo = algo_meta.name
+            for blob_meta in self.fs.list(posixpath.join(base, algo), recursive=False):
+                out.append(f"{algo}:{blob_meta.name}")
+        return out
+
+    def get_blob(self, repository: str, digest: str, offset: int = 0, length: int = -1) -> BlobContent:
+        try:
+            c = self.fs.get(blob_digest_path(repository, digest), offset, length)
+        except FSNotFound:
+            raise errors.blob_unknown(digest) from None
+        return BlobContent(content=c.reader, content_length=c.size, content_type=c.content_type)
+
+    def delete_blob(self, repository: str, digest: str) -> None:
+        try:
+            self.fs.remove(blob_digest_path(repository, digest))
+        except FSNotFound:
+            pass  # idempotent delete
+
+    def put_blob(self, repository: str, digest: str, content: BlobContent) -> None:
+        self.fs.put(
+            blob_digest_path(repository, digest),
+            content.content,
+            content.content_length,
+            content.content_type,
+        )
+
+    def exists_blob(self, repository: str, digest: str) -> bool:
+        return self.fs.exists(blob_digest_path(repository, digest))
+
+    def get_blob_meta(self, repository: str, digest: str) -> BlobMeta:
+        try:
+            m = self.fs.stat(blob_digest_path(repository, digest))
+        except FSNotFound:
+            raise errors.blob_unknown(digest) from None
+        return BlobMeta(content_type=m.content_type, content_length=m.size)
+
+    def get_blob_location(
+        self, repository: str, digest: str, purpose: str, properties: dict[str, str]
+    ) -> BlobLocation | None:
+        """FS store does not support load separation (store_fs.go:380-386)."""
+        return None
+
+    # -- listing helpers ------------------------------------------------------
+
+    def _list_manifest_refs(self, repository: str) -> list[str]:
+        return [
+            m.name
+            for m in self.fs.list(posixpath.join(repository, "manifests"), recursive=False)
+            if m.size > 0 or not _looks_like_dir(m)
+        ]
+
+    def _list_repositories(self) -> list[str]:
+        """Repositories are two path levels deep ({project}/{name})."""
+        out: list[str] = []
+        for top in self.fs.list("", recursive=False):
+            if top.name == REGISTRY_INDEX_FILENAME:
+                continue
+            for sub in self.fs.list(top.name, recursive=False):
+                if sub.name == REGISTRY_INDEX_FILENAME:
+                    continue
+                repo = posixpath.join(top.name, sub.name)
+                if self.fs.list(posixpath.join(repo, "manifests"), recursive=False):
+                    out.append(repo)
+        return sorted(out)
+
+
+def _looks_like_dir(meta) -> bool:
+    return meta.size == 0 and "." not in meta.name and ":" not in meta.name
+
+
+def _filter_index(idx: Index, search: str) -> Index:
+    """Regex search filter (store_fs.go:114-143)."""
+    if not search:
+        return idx
+    try:
+        pat = re.compile(search)
+    except re.error:
+        raise errors.ErrorInfo(400, errors.ErrCodeUnknown, f"invalid search regexp: {search}")
+    return Index(
+        schema_version=idx.schema_version,
+        media_type=idx.media_type,
+        manifests=[m for m in idx.manifests if pat.search(m.name)],
+        annotations=idx.annotations,
+    )
+
+
+def _manifest_modified(m: Manifest) -> str:
+    times = [d.modified for d in m.all_descriptors() if d.modified]
+    return max(times) if times else ""
+
+
+def _latest_annotations(descs: Iterable[Descriptor]) -> dict[str, str]:
+    """Annotations of the newest manifest (fixes store_fs.go:150-157 which
+    takes the alphabetically first while claiming 'latest')."""
+    newest: Descriptor | None = None
+    for d in descs:
+        if newest is None or (d.modified or "") > (newest.modified or ""):
+            newest = d
+    return dict(newest.annotations) if newest else {}

@@ -1,0 +1,12 @@
+"""Test configuration.
+
+JAX tests run on a virtual 8-device CPU mesh (no TPU needed in CI) — the env
+vars must be set before jax is first imported anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

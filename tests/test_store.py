@@ -1,0 +1,230 @@
+"""Store-contract tests run against memory + local providers (SURVEY.md §4:
+'store-contract tests run against memory/local/S3 providers')."""
+
+import io
+import threading
+
+import pytest
+
+from modelx_tpu import errors
+from modelx_tpu.registry.fs import FaultInjectionFSProvider, FSNotFound, LocalFSProvider, MemoryFSProvider
+from modelx_tpu.registry.gc import gc_blobs, gc_blobs_all
+from modelx_tpu.registry.store import BlobContent, blob_digest_path, index_path, manifest_path
+from modelx_tpu.registry.store_fs import FSRegistryStore
+from modelx_tpu.types import Descriptor, Digest, Manifest
+
+
+@pytest.fixture(params=["memory", "local"])
+def fs(request, tmp_path):
+    if request.param == "memory":
+        return MemoryFSProvider()
+    return LocalFSProvider(str(tmp_path / "registry"))
+
+
+@pytest.fixture
+def store(fs):
+    return FSRegistryStore(fs)
+
+
+def put_blob(store, repo, data, name="blob.bin"):
+    digest = str(Digest.from_bytes(data))
+    store.put_blob(repo, digest, BlobContent(io.BytesIO(data), len(data), "application/octet-stream"))
+    return Descriptor(name=name, digest=digest, size=len(data), modified="2026-01-01T00:00:00Z")
+
+
+class TestFSProviderContract:
+    def test_put_get_roundtrip(self, fs):
+        fs.put("a/b/c.bin", io.BytesIO(b"hello"), 5, "text/plain")
+        got = fs.get("a/b/c.bin")
+        assert got.content_type == "text/plain"
+        assert got.read_all() == b"hello"
+
+    def test_ranged_get(self, fs):
+        fs.put("r.bin", io.BytesIO(b"0123456789"), 10)
+        assert fs.get("r.bin", offset=2, length=3).read_all() == b"234"
+        assert fs.get("r.bin", offset=8).read_all() == b"89"
+        assert fs.get("r.bin", offset=2, length=3).size == 3
+
+    def test_size_mismatch_rejected(self, fs):
+        with pytest.raises(ValueError):
+            fs.put("bad.bin", io.BytesIO(b"abc"), 99)
+        assert not fs.exists("bad.bin")
+
+    def test_stat_and_exists(self, fs):
+        assert not fs.exists("x")
+        fs.put("x", io.BytesIO(b"abcd"), 4, "ct")
+        meta = fs.stat("x")
+        assert meta.size == 4
+        assert meta.content_type == "ct"
+        assert fs.exists("x")
+
+    def test_remove(self, fs):
+        fs.put("d/f1", io.BytesIO(b"1"), 1)
+        fs.put("d/f2", io.BytesIO(b"2"), 1)
+        fs.remove("d")  # prefix remove
+        assert not fs.exists("d/f1") and not fs.exists("d/f2")
+        with pytest.raises(FSNotFound):
+            fs.get("d/f1")
+
+    def test_list_flat_and_recursive(self, fs):
+        fs.put("p/a.txt", io.BytesIO(b"1"), 1)
+        fs.put("p/sub/b.txt", io.BytesIO(b"2"), 1)
+        flat = {m.name for m in fs.list("p", recursive=False)}
+        assert flat == {"a.txt", "sub"}
+        rec = {m.name for m in fs.list("p", recursive=True)}
+        assert rec == {"a.txt", "sub/b.txt"}
+
+    def test_not_found(self, fs):
+        with pytest.raises(FSNotFound):
+            fs.get("nope")
+        with pytest.raises(FSNotFound):
+            fs.stat("nope")
+
+
+class TestPathScheme:
+    def test_paths(self):
+        assert blob_digest_path("proj/name", "sha256:abcd") == "proj/name/blobs/sha256/abcd"
+        assert index_path("proj/name") == "proj/name/index.json"
+        assert manifest_path("proj/name", "v1") == "proj/name/manifests/v1"
+
+
+class TestStoreContract:
+    REPO = "library/demo"
+
+    def test_blob_lifecycle(self, store):
+        desc = put_blob(store, self.REPO, b"payload")
+        assert store.exists_blob(self.REPO, desc.digest)
+        meta = store.get_blob_meta(self.REPO, desc.digest)
+        assert meta.content_length == 7
+        got = store.get_blob(self.REPO, desc.digest)
+        assert got.content.read() == b"payload"
+        # ranged read (TPU loader path)
+        assert store.get_blob(self.REPO, desc.digest, offset=3, length=2).content.read() == b"lo"
+        store.delete_blob(self.REPO, desc.digest)
+        assert not store.exists_blob(self.REPO, desc.digest)
+        with pytest.raises(errors.ErrorInfo):
+            store.get_blob(self.REPO, desc.digest)
+
+    def test_manifest_commit_updates_index(self, store):
+        blob = put_blob(store, self.REPO, b"weights")
+        m = Manifest(blobs=[blob])
+        store.put_manifest(self.REPO, "v1", "", m)
+        assert store.exists_manifest(self.REPO, "v1")
+        assert store.get_manifest(self.REPO, "v1") == m
+
+        idx = store.get_index(self.REPO)
+        assert [e.name for e in idx.manifests] == ["v1"]
+        assert idx.manifests[0].size == blob.size
+
+        gidx = store.get_global_index()
+        assert [e.name for e in gidx.manifests] == [self.REPO]
+
+    def test_index_search(self, store):
+        store.put_manifest(self.REPO, "v1", "", Manifest())
+        store.put_manifest(self.REPO, "v2-beta", "", Manifest())
+        idx = store.get_index(self.REPO, search="beta")
+        assert [e.name for e in idx.manifests] == ["v2-beta"]
+        with pytest.raises(errors.ErrorInfo):
+            store.get_index(self.REPO, search="[invalid")
+
+    def test_global_index_search(self, store):
+        store.put_manifest("library/alpha", "v1", "", Manifest())
+        store.put_manifest("library/beta", "v1", "", Manifest())
+        gidx = store.get_global_index(search="alp")
+        assert [e.name for e in gidx.manifests] == ["library/alpha"]
+
+    def test_delete_manifest_updates_index(self, store):
+        store.put_manifest(self.REPO, "v1", "", Manifest())
+        store.put_manifest(self.REPO, "v2", "", Manifest())
+        store.delete_manifest(self.REPO, "v1")
+        idx = store.get_index(self.REPO)
+        assert [e.name for e in idx.manifests] == ["v2"]
+        with pytest.raises(errors.ErrorInfo):
+            store.get_manifest(self.REPO, "v1")
+
+    def test_remove_index_removes_repo(self, store):
+        put_blob(store, self.REPO, b"junk")
+        store.put_manifest(self.REPO, "v1", "", Manifest())
+        store.remove_index(self.REPO)
+        assert store.get_global_index().manifests == []
+        with pytest.raises(errors.ErrorInfo):
+            store.get_index(self.REPO)
+
+    def test_unknown_lookups(self, store):
+        with pytest.raises(errors.ErrorInfo):
+            store.get_manifest(self.REPO, "missing")
+        with pytest.raises(errors.ErrorInfo):
+            store.get_blob_meta(self.REPO, "sha256:" + "0" * 64)
+        with pytest.raises(errors.ErrorInfo):
+            store.get_index("no/repo")
+
+    def test_list_blobs_actually_lists(self, store):
+        """Regression guard vs reference bug store_fs.go:366-378."""
+        d1 = put_blob(store, self.REPO, b"one")
+        d2 = put_blob(store, self.REPO, b"two")
+        digests = set(store.list_blobs(self.REPO))
+        assert digests == {d1.digest, d2.digest}
+
+    def test_fs_store_has_no_blob_location(self, store):
+        assert store.get_blob_location(self.REPO, "sha256:" + "0" * 64, "upload", {}) is None
+
+    def test_concurrent_manifest_puts_consistent_index(self, store):
+        """The reference races concurrent RefreshIndex writers (SURVEY §2.2)."""
+        n = 12
+        errs = []
+
+        def put(i):
+            try:
+                store.put_manifest(self.REPO, f"v{i}", "", Manifest())
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=put, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs
+        store.refresh_index(self.REPO)
+        idx = store.get_index(self.REPO)
+        assert {e.name for e in idx.manifests} == {f"v{i}" for i in range(n)}
+
+
+class TestGC:
+    REPO = "library/gcdemo"
+
+    def test_gc_deletes_unreferenced(self, store):
+        kept = put_blob(store, self.REPO, b"kept")
+        put_blob(store, self.REPO, b"orphan")
+        store.put_manifest(self.REPO, "v1", "", Manifest(blobs=[kept]))
+        result = gc_blobs(store, self.REPO)
+        assert result.deleted == 1
+        assert store.exists_blob(self.REPO, kept.digest)
+        assert set(store.list_blobs(self.REPO)) == {kept.digest}
+
+    def test_gc_keeps_config_blob(self, store):
+        cfg = put_blob(store, self.REPO, b"config", name="modelx.yaml")
+        store.put_manifest(self.REPO, "v1", "", Manifest(config=cfg))
+        result = gc_blobs(store, self.REPO)
+        assert result.deleted == 0
+
+    def test_gc_all(self, store):
+        put_blob(store, "library/a", b"orphan-a")
+        store.put_manifest("library/a", "v1", "", Manifest())
+        results = gc_blobs_all(store)
+        assert sum(r.deleted for r in results) == 1
+
+    def test_gc_empty_repo(self, store):
+        assert gc_blobs(store, "library/none").deleted == 0
+
+
+class TestFaultInjection:
+    def test_injected_failure_surfaces(self):
+        inner = MemoryFSProvider()
+        fs = FaultInjectionFSProvider(inner, should_fail=lambda op, path: op == "put")
+        with pytest.raises(OSError, match="injected"):
+            fs.put("x", io.BytesIO(b"1"), 1)
+        fs.should_fail = lambda op, path: False
+        fs.put("x", io.BytesIO(b"1"), 1)
+        assert fs.get("x").read_all() == b"1"
+        assert ("put", "x") in fs.ops

@@ -1,0 +1,118 @@
+"""Unit tests for the core data model (SURVEY.md §4 test pyramid: unit layer)."""
+
+import hashlib
+import io
+
+import pytest
+
+from modelx_tpu import errors
+from modelx_tpu.types import (
+    BlobLocation,
+    Descriptor,
+    Digest,
+    Index,
+    Manifest,
+    MediaTypeModelManifestJson,
+    canonical_json,
+    sort_descriptors,
+)
+
+
+class TestDigest:
+    def test_from_bytes(self):
+        d = Digest.from_bytes(b"hello")
+        assert d == "sha256:" + hashlib.sha256(b"hello").hexdigest()
+        assert d.algorithm == "sha256"
+        assert d.hex == hashlib.sha256(b"hello").hexdigest()
+
+    def test_from_reader_matches_from_bytes(self):
+        data = b"x" * (10 * 1024 * 1024 + 17)
+        assert Digest.from_reader(io.BytesIO(data)) == Digest.from_bytes(data)
+
+    def test_validate(self):
+        Digest.from_bytes(b"ok").validate()
+        with pytest.raises(ValueError):
+            Digest("not-a-digest").validate()
+        with pytest.raises(ValueError):
+            Digest("sha256:xyz").validate()
+
+    def test_is_a_plain_string(self):
+        d = Digest.from_bytes(b"a")
+        assert isinstance(d, str)
+
+
+class TestRoundTrip:
+    def make_manifest(self):
+        return Manifest(
+            config=Descriptor(name="modelx.yaml", digest=str(Digest.from_bytes(b"cfg")), size=3),
+            blobs=[
+                Descriptor(
+                    name="model.safetensors",
+                    media_type="application/vnd.modelx.model.file.v1",
+                    digest=str(Digest.from_bytes(b"blob")),
+                    size=4,
+                    mode=0o644,
+                    annotations={"modelx.shard.mesh": "dp=2,tp=4"},
+                ),
+                Descriptor(name="README.md", size=10),
+            ],
+            annotations={"framework": "jax"},
+        )
+
+    def test_manifest_roundtrip(self):
+        m = self.make_manifest()
+        assert Manifest.decode(m.encode()) == m
+
+    def test_index_roundtrip(self):
+        idx = Index(manifests=[Descriptor(name="v1", size=7)], annotations={"a": "b"})
+        assert Index.decode(idx.encode()) == idx
+
+    def test_blob_location_roundtrip(self):
+        loc = BlobLocation(provider="s3", purpose="upload", properties={"url": "http://x", "parts": [1, 2]})
+        assert BlobLocation.from_json(loc.to_json()) == loc
+
+    def test_canonical_json_deterministic(self):
+        m = self.make_manifest()
+        assert m.encode() == Manifest.decode(m.encode()).encode()
+        assert canonical_json({"b": 1, "a": 2}) == b'{"a":2,"b":1}'
+
+    def test_omitempty(self):
+        d = Descriptor(name="x").to_json()
+        assert d == {"name": "x"}  # empty fields dropped like Go omitempty
+
+    def test_media_type_default(self):
+        m = Manifest()
+        assert m.media_type == MediaTypeModelManifestJson
+
+    def test_sort_descriptors(self):
+        descs = [Descriptor(name="b"), Descriptor(name="a")]
+        assert [d.name for d in sort_descriptors(descs)] == ["a", "b"]
+
+    def test_all_descriptors_includes_config(self):
+        m = self.make_manifest()
+        names = [d.name for d in m.all_descriptors()]
+        assert names[0] == "modelx.yaml"
+        assert len(names) == 3
+
+
+class TestErrors:
+    def test_roundtrip(self):
+        e = errors.blob_unknown("sha256:abc")
+        decoded = errors.ErrorInfo.decode(e.encode(), e.http_status)
+        assert decoded.code == errors.ErrCodeBlobUnknown
+        assert decoded.http_status == 404
+
+    def test_is_err_code(self):
+        e = errors.manifest_unknown("v1")
+        assert errors.is_err_code(e, errors.ErrCodeManifestUnknown)
+        assert not errors.is_err_code(e, errors.ErrCodeBlobUnknown)
+        assert not errors.is_err_code(ValueError("x"), errors.ErrCodeManifestUnknown)
+
+    def test_decode_garbage(self):
+        e = errors.ErrorInfo.decode(b"<html>teapot</html>", 418)
+        assert e.code == errors.ErrCodeUnknown
+        assert e.http_status == 418
+
+    def test_is_exception(self):
+        with pytest.raises(errors.ErrorInfo):
+            raise errors.unauthorized("no token")
