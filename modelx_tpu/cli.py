@@ -1,0 +1,328 @@
+"""The modelx CLI: user commands, the registry daemon, and the deploy puller.
+
+Reference parity — all three binaries in one entrypoint:
+
+- ``modelx`` user CLI (cmd/modelx/model/model.go:15-28): init / login /
+  list / info / push / pull, repo management, shell completion (click's
+  built-in completion covers bash/zsh/fish).
+- ``modelx serve`` = modelxd (cmd/modelxd/modelxd.go:26-58) with the full
+  flag surface (listen / tls / s3 / auth / redirect).
+- ``modelx dl`` = modelxdl (cmd/modelxdl/modelxdl.go:30-98), the Seldon-style
+  storage initializer: ``modelx dl <uri> <dest>`` — extended with
+  ``--device-put`` to load straight into TPU HBM (the north-star path).
+
+Run as ``python -m modelx_tpu.cli`` or via the ``modelx`` console script.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+
+import click
+
+from modelx_tpu import errors
+from modelx_tpu.client.client import Client
+from modelx_tpu.client.model_config import MODEL_CONFIG_FILENAME, README_FILENAME, ModelConfig
+from modelx_tpu.client.reference import parse_reference
+from modelx_tpu.client.repo import RepoDetails, default_repo_manager
+from modelx_tpu.utils.units import human_size
+from modelx_tpu.version import get as get_version
+
+logger = logging.getLogger("modelx")
+
+
+@click.group(name="modelx")
+@click.option("--debug", is_flag=True, envvar="DEBUG", help="verbose logging (model.go:32-35)")
+def main(debug: bool) -> None:
+    """modelx — TPU-native model registry CLI."""
+    logging.basicConfig(
+        level=logging.DEBUG if debug else logging.WARNING,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+
+def _fail(e: BaseException) -> None:
+    click.secho(f"error: {e}", fg="red", err=True)
+    sys.exit(1)
+
+
+# -- init ---------------------------------------------------------------------
+
+
+INIT_README = """# {name}
+
+A model packaged with modelx. Edit `modelx.yaml` to describe the model, then:
+
+    modelx push <repo>/<project>/{name}@<version> .
+"""
+
+
+@main.command("init")
+@click.argument("directory", default=".")
+def cmd_init(directory: str) -> None:
+    """Scaffold modelx.yaml + README.md (init.go:39-104)."""
+    os.makedirs(directory, exist_ok=True)
+    cfg_path = os.path.join(directory, MODEL_CONFIG_FILENAME)
+    if os.path.exists(cfg_path):
+        _fail(FileExistsError(f"{cfg_path} already exists"))
+    cfg = ModelConfig(
+        description="my model description",
+        framework="jax",
+        task="text-generation",
+        tags=["llm"],
+        maintainers=["maintainer@example.com"],
+        model_files=[],
+        # TPU serving hints replace the reference's GPU resource template
+        # (init.go:64-76): declare a mesh, not an nvidia.com/gpu count.
+        resources={"tpu": {"topology": "v5e-8"}},
+    )
+    cfg.serving.mesh = "dp=1,tp=8"
+    cfg.serving.dtype = "bfloat16"
+    with open(cfg_path, "w") as f:
+        f.write(cfg.to_yaml())
+    readme = os.path.join(directory, README_FILENAME)
+    if not os.path.exists(readme):
+        with open(readme, "w") as f:
+            f.write(INIT_README.format(name=os.path.basename(os.path.abspath(directory))))
+    click.echo(f"initialized {cfg_path}")
+
+
+# -- login --------------------------------------------------------------------
+
+
+@main.command("login")
+@click.argument("registry")
+@click.option("--token", prompt=True, hide_input=True, help="bearer token")
+@click.option("--name", default="", help="alias name (defaults to host)")
+def cmd_login(registry: str, token: str, name: str) -> None:
+    """Verify token against the registry, then store it (login.go:51-62)."""
+    try:
+        Client(registry, "Bearer " + token, quiet=True).ping()
+    except errors.ErrorInfo as e:
+        _fail(e)
+    from urllib.parse import urlparse
+
+    alias = name or urlparse(registry).netloc
+    default_repo_manager().set(RepoDetails(name=alias, url=registry.rstrip("/"), token=token))
+    click.echo(f"login succeeded; saved as repo alias {alias!r}")
+
+
+# -- list / info --------------------------------------------------------------
+
+
+@main.command("list")
+@click.argument("ref")
+@click.option("--search", default="", help="regex filter")
+def cmd_list(ref: str, search: str) -> None:
+    """Three-mode list: repositories / versions / files (list.go:78-163)."""
+    try:
+        r = parse_reference(ref)
+        client = r.client(quiet=True)
+        if not r.repository:
+            idx = client.get_global_index(search)
+            _table(["NAME", "SIZE", "MODIFIED"], [[m.name, human_size(m.size), m.modified] for m in idx.manifests])
+        elif not r.version:
+            idx = client.get_index(r.repository, search)
+            _table(["VERSION", "SIZE", "MODIFIED"], [[m.name, human_size(m.size), m.modified] for m in idx.manifests])
+        else:
+            m = client.get_manifest(r.repository, r.version)
+            rows = [[d.name, d.media_type.rsplit(".", 1)[-1], human_size(d.size), d.digest[:19]] for d in m.all_descriptors()]
+            _table(["FILE", "TYPE", "SIZE", "DIGEST"], rows)
+    except (errors.ErrorInfo, ValueError) as e:
+        _fail(e)
+
+
+@main.command("info")
+@click.argument("ref")
+def cmd_info(ref: str) -> None:
+    """Print a version's config blob, i.e. modelx.yaml (info.go:47-65)."""
+    try:
+        r = parse_reference(ref)
+        content = r.client(quiet=True).get_config_content(r.repository, r.version)
+        click.echo(content.decode(errors="replace"))
+    except (errors.ErrorInfo, ValueError) as e:
+        _fail(e)
+
+
+def _table(headers: list[str], rows: list[list[str]]) -> None:
+    from rich.console import Console
+    from rich.table import Table
+
+    t = Table(show_edge=False, pad_edge=False, box=None)
+    for h in headers:
+        t.add_column(h)
+    for row in rows:
+        t.add_row(*[str(c) for c in row])
+    Console().print(t)
+
+
+# -- push / pull --------------------------------------------------------------
+
+
+@main.command("push")
+@click.argument("ref")
+@click.argument("directory", default=".")
+def cmd_push(ref: str, directory: str) -> None:
+    """Push a model directory (push.go:43-80). Requires modelx.yaml."""
+    cfg_path = os.path.join(directory, MODEL_CONFIG_FILENAME)
+    if not os.path.isfile(cfg_path):
+        _fail(FileNotFoundError(f"{cfg_path} not found — run `modelx init` first"))
+    try:
+        ModelConfig.load(cfg_path)  # validate before pushing (push.go:61-80)
+        r = parse_reference(ref)
+        if not r.repository:
+            _fail(ValueError("reference must include a repository"))
+        r.client().push(r.repository, r.version or "latest", directory)
+        click.echo(f"pushed {r}")
+    except (errors.ErrorInfo, ValueError) as e:
+        _fail(e)
+
+
+@main.command("pull")
+@click.argument("ref")
+@click.argument("directory", default="")
+def cmd_pull(ref: str, directory: str) -> None:
+    """Pull a model version into a directory (pull.go:41-69)."""
+    try:
+        r = parse_reference(ref)
+        target = directory or r.repository.rsplit("/", 1)[-1]
+        r.client().pull(r.repository, r.version or "latest", target)
+        click.echo(f"pulled {r} -> {target}")
+    except (errors.ErrorInfo, ValueError) as e:
+        _fail(e)
+
+
+# -- repo management (cmd/modelx/repo) ---------------------------------------
+
+
+@main.group("repo")
+def cmd_repo() -> None:
+    """Repository alias management (~/.modelx/repos.json)."""
+
+
+@cmd_repo.command("add")
+@click.argument("name")
+@click.argument("url")
+@click.option("--token", default="")
+def cmd_repo_add(name: str, url: str, token: str) -> None:
+    try:
+        default_repo_manager().set(RepoDetails(name=name, url=url, token=token))
+        click.echo(f"added repo {name} -> {url}")
+    except ValueError as e:
+        _fail(e)
+
+
+@cmd_repo.command("list")
+def cmd_repo_list() -> None:
+    rows = [[r.name, r.url, "yes" if r.token else ""] for r in default_repo_manager().list()]
+    _table(["NAME", "URL", "TOKEN"], rows)
+
+
+@cmd_repo.command("remove")
+@click.argument("name")
+def cmd_repo_remove(name: str) -> None:
+    if default_repo_manager().remove(name):
+        click.echo(f"removed repo {name}")
+    else:
+        _fail(KeyError(f"no such repo alias: {name}"))
+
+
+# -- gc -----------------------------------------------------------------------
+
+
+@main.command("gc")
+@click.argument("ref")
+def cmd_gc(ref: str) -> None:
+    """Trigger server-side garbage collection for a repository."""
+    try:
+        r = parse_reference(ref)
+        result = r.client(quiet=True).remote.garbage_collect(r.repository)
+        click.echo(json.dumps(result))
+    except (errors.ErrorInfo, ValueError) as e:
+        _fail(e)
+
+
+# -- serve (modelxd) ----------------------------------------------------------
+
+
+@main.command("serve")
+@click.option("--listen", default=":8080", help="listen address")
+@click.option("--data", "data_dir", default="data/registry", help="local FS store path")
+@click.option("--tls-cert", default="")
+@click.option("--tls-key", default="")
+@click.option("--s3-url", default="", help="S3 endpoint; presence selects the S3 store")
+@click.option("--s3-access-key", default="", envvar="S3_ACCESS_KEY")
+@click.option("--s3-secret-key", default="", envvar="S3_SECRET_KEY")
+@click.option("--s3-bucket", default="registry")
+@click.option("--s3-region", default="us-east-1")
+@click.option("--enable-redirect", is_flag=True, help="presigned load separation")
+@click.option("--auth-token", multiple=True, help="accepted bearer token (repeatable)")
+def cmd_serve(
+    listen, data_dir, tls_cert, tls_key, s3_url, s3_access_key, s3_secret_key,
+    s3_bucket, s3_region, enable_redirect, auth_token,
+) -> None:
+    """Run the registry daemon (cmd/modelxd/modelxd.go:26-58)."""
+    from modelx_tpu.registry.server import Options, RegistryServer
+
+    logging.getLogger("modelx.registry").setLevel(logging.INFO)
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(message)s")
+    opts = Options(
+        listen=listen,
+        data_dir=data_dir,
+        tls_cert=tls_cert,
+        tls_key=tls_key,
+        s3_url=s3_url,
+        s3_access_key=s3_access_key,
+        s3_secret_key=s3_secret_key,
+        s3_bucket=s3_bucket,
+        s3_region=s3_region,
+        enable_redirect=enable_redirect,
+        auth_tokens=tuple(auth_token),
+    )
+    RegistryServer(opts).serve_forever()
+
+
+# -- dl (modelxdl, deploy-time puller) ----------------------------------------
+
+
+@main.command("dl")
+@click.argument("uri")
+@click.argument("dest")
+@click.option("--device-put", is_flag=True, help="after pulling, load safetensors onto the local TPU mesh and report timings")
+@click.option("--mesh", default="", help='mesh override, e.g. "dp=1,tp=8"')
+def cmd_dl(uri: str, dest: str, device_put: bool, mesh: str) -> None:
+    """Deploy-time puller (cmd/modelxdl/modelxdl.go:30-98): pull (a subset of)
+    a model into DEST. With --device-put, continue into TPU HBM."""
+    try:
+        from modelx_tpu.dl.initializer import run_initializer
+
+        run_initializer(uri, dest, device_put=device_put, mesh_spec=mesh)
+    except (errors.ErrorInfo, ValueError) as e:
+        _fail(e)
+
+
+# -- version ------------------------------------------------------------------
+
+
+@main.command("version")
+def cmd_version() -> None:
+    click.echo(str(get_version()))
+
+
+# -- completion ---------------------------------------------------------------
+
+
+@main.command("completion")
+@click.argument("shell", type=click.Choice(["bash", "zsh", "fish"]))
+def cmd_completion(shell: str) -> None:
+    """Emit shell completion script (cmd/modelx/completion)."""
+    var = "_MODELX_COMPLETE"
+    prog = "modelx"
+    click.echo(f'eval "$({var}={shell}_source {prog})"')
+
+
+if __name__ == "__main__":
+    main()

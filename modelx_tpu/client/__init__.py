@@ -1,0 +1,8 @@
+"""Client side: push/pull engine, typed registry HTTP client, data-plane
+extensions, progress UI. Mirrors reference pkg/client (SURVEY.md §2.1 #13-21).
+"""
+
+from modelx_tpu.client.client import Client
+from modelx_tpu.client.remote import RegistryClient
+
+__all__ = ["Client", "RegistryClient"]

@@ -1,0 +1,40 @@
+"""Client facade. Reference parity: pkg/client/client.go:9-42."""
+
+from __future__ import annotations
+
+from modelx_tpu.client.pull import Puller
+from modelx_tpu.client.push import Pusher
+from modelx_tpu.client.remote import RegistryClient
+from modelx_tpu.types import Index, Manifest
+
+
+class Client:
+    def __init__(self, registry: str, authorization: str = "", quiet: bool = False):
+        self.remote = RegistryClient(registry, authorization)
+        self.quiet = quiet
+
+    def ping(self) -> Index:
+        """client.go:21-26 — Ping = GET global index."""
+        return self.remote.get_global_index()
+
+    def push(self, repository: str, version: str, directory: str) -> None:
+        Pusher(self.remote, quiet=self.quiet).push(repository, version, directory)
+
+    def pull(self, repository: str, version: str, directory: str) -> Manifest:
+        return Puller(self.remote, quiet=self.quiet).pull(repository, version, directory)
+
+    def get_manifest(self, repository: str, version: str = "") -> Manifest:
+        return self.remote.get_manifest(repository, version)
+
+    def get_index(self, repository: str, search: str = "") -> Index:
+        return self.remote.get_index(repository, search)
+
+    def get_global_index(self, search: str = "") -> Index:
+        return self.remote.get_global_index(search)
+
+    def get_config_content(self, repository: str, version: str = "") -> bytes:
+        """Fetch the config blob (modelx.yaml) of a version (info.go:47-65)."""
+        manifest = self.remote.get_manifest(repository, version)
+        if not manifest.config.digest:
+            return b""
+        return b"".join(self.remote.get_blob_content(repository, manifest.config.digest))

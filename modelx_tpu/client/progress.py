@@ -1,0 +1,105 @@
+"""Multi-bar transfer progress + bounded worker pool.
+
+Reference parity: pkg/client/progress/ (mbar.go/bar.go/bar-io.go) — the
+reference hand-rolls an ANSI multi-bar renderer with a worker pool whose
+first failure cancels the rest (mbar.go:95-120). Here rich provides the
+rendering; the pool semantics (concurrency limit, fail-fast cancellation)
+are preserved, and per-transfer byte callbacks feed both the bars and the
+transfer metrics SURVEY.md §5 asks to promote.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from concurrent.futures import FIRST_EXCEPTION, Future, ThreadPoolExecutor, wait
+from typing import Callable, Iterable
+
+# Blob-level transfer parallelism. The reference fixes this at 3
+# (push.go:27); we default higher — object stores and the local registry
+# sustain more parallel streams, and TTFT is won by filling the pipe.
+PULL_PUSH_CONCURRENCY = 8
+
+
+class _NullBar:
+    def update(self, n: int) -> None:
+        pass
+
+    def set_total(self, total: int) -> None:
+        pass
+
+    def done(self, note: str = "") -> None:
+        pass
+
+
+class MultiBar:
+    """A bounded worker pool with optional rich progress rendering."""
+
+    def __init__(self, concurrency: int = PULL_PUSH_CONCURRENCY, quiet: bool = False) -> None:
+        self.concurrency = concurrency
+        self.quiet = quiet
+        self._progress = None
+        self._lock = threading.Lock()
+        if not quiet:
+            try:
+                from rich.progress import (
+                    BarColumn,
+                    DownloadColumn,
+                    Progress,
+                    TextColumn,
+                    TransferSpeedColumn,
+                )
+
+                self._progress = Progress(
+                    TextColumn("[progress.description]{task.description}"),
+                    BarColumn(),
+                    DownloadColumn(),
+                    TransferSpeedColumn(),
+                    transient=False,
+                )
+            except Exception:  # no tty / rich unavailable: stay quiet
+                self._progress = None
+
+    def bar(self, name: str, total: int):
+        if self._progress is None:
+            return _NullBar()
+        progress = self._progress
+        with self._lock:
+            task_id = progress.add_task(name[-40:], total=total or None)
+
+        class _Bar:
+            def update(self, n: int) -> None:
+                progress.update(task_id, advance=n)
+
+            def set_total(self, total: int) -> None:
+                progress.update(task_id, total=total)
+
+            def done(self, note: str = "") -> None:
+                desc = name[-40:] + (f" [{note}]" if note else "")
+                progress.update(task_id, description=desc)
+                task = progress.tasks[task_id]
+                progress.update(task_id, completed=task.total or 0)
+
+        return _Bar()
+
+    def run(self, jobs: Iterable[Callable[[], None]]) -> None:
+        """mbar.go:95-120 — schedule all jobs, ≤concurrency in flight, first
+        failure cancels the remainder and re-raises."""
+        ctx = contextlib.nullcontext() if self._progress is None else self._progress
+        with ctx:
+            with ThreadPoolExecutor(max_workers=self.concurrency) as pool:
+                futures: list[Future] = [pool.submit(j) for j in jobs]
+                done, not_done = wait(futures, return_when=FIRST_EXCEPTION)
+                first_error: BaseException | None = None
+                for f in done:
+                    if f.exception() is not None:
+                        first_error = f.exception()
+                        break
+                if first_error is not None:
+                    for f in not_done:
+                        f.cancel()
+                    raise first_error
+                # surface errors from any remaining (all completed) futures
+                for f in futures:
+                    if not f.cancelled() and f.exception() is not None:
+                        raise f.exception()  # type: ignore[misc]

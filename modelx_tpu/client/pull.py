@@ -1,0 +1,161 @@
+"""Pull engine: manifest -> concurrent blob downloads with hash-skip.
+
+Reference parity: pkg/client/pull.go:19-223. Semantics preserved:
+
+- per-file content-address skip: local file re-hashed, download skipped when
+  equal (pull.go:111-127) — "the best idea in the reference" (SURVEY.md §5),
+  it makes every pull an incremental resume;
+- directory blobs: compare deterministic tgz digest, then download+extract
+  with a streaming pipe (no intermediate file) — the reference's no-cache
+  path (pull.go:183-203) made the default;
+- location+extension download with direct-GET fallback (pull.go:206-215).
+
+Upgrade: ranged multi-stream download for large blobs (the reference's S3
+extension only ever reads Parts[0] — extension_s3.go:28-36 — so multipart
+download never actually happened there).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+from typing import Callable
+
+from modelx_tpu.client import helper
+from modelx_tpu.client.extension import get_extension
+from modelx_tpu.client.progress import MultiBar
+from modelx_tpu.client.remote import RegistryClient
+from modelx_tpu.types import (
+    BlobLocationPurposeDownload,
+    Descriptor,
+    Digest,
+    Manifest,
+    MediaTypeModelDirectoryTarGz,
+)
+
+
+class Puller:
+    def __init__(self, remote: RegistryClient, quiet: bool = False, concurrency: int | None = None):
+        self.remote = remote
+        self.quiet = quiet
+        self.concurrency = concurrency
+
+    def pull(self, repository: str, version: str, directory: str) -> Manifest:
+        """pull.go:19-39."""
+        manifest = self.remote.get_manifest(repository, version)
+        os.makedirs(directory, exist_ok=True)
+        self.pull_blobs(repository, manifest, directory)
+        return manifest
+
+    def pull_blobs(self, repository: str, manifest: Manifest, directory: str) -> None:
+        """pull.go:41-50 — bounded-concurrency fan-out over blobs."""
+        bars = MultiBar(quiet=self.quiet, **({"concurrency": self.concurrency} if self.concurrency else {}))
+
+        def job(desc: Descriptor) -> Callable[[], None]:
+            def run() -> None:
+                if desc.media_type == MediaTypeModelDirectoryTarGz:
+                    self._pull_directory(repository, desc, directory, bars)
+                else:
+                    self._pull_file(repository, desc, directory, bars)
+
+            return run
+
+        descs = [d for d in manifest.all_descriptors() if d.digest]
+        bars.run([job(d) for d in descs])
+
+    # -- files ----------------------------------------------------------------
+
+    def _pull_file(self, repository: str, desc: Descriptor, directory: str, bars: MultiBar) -> None:
+        """pull.go:111-143."""
+        target = os.path.join(directory, desc.name)
+        bar = bars.bar(desc.name, desc.size)
+        if os.path.isfile(target) and str(Digest.from_file(target)) == desc.digest:
+            bar.done("up-to-date")  # hash-skip (pull.go:111-127)
+            return
+        os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
+        # download to a temp path, verify digest, then atomic rename
+        fd, tmp = tempfile.mkstemp(dir=directory, prefix=".pull-")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                hasher = hashlib.sha256()
+
+                class _Verify:
+                    def write(self, data: bytes) -> int:
+                        hasher.update(data)
+                        return f.write(data)
+
+                self._download_blob(repository, desc, _Verify(), bar.update)
+            got = "sha256:" + hasher.hexdigest()
+            if got != desc.digest:
+                raise ValueError(f"digest mismatch for {desc.name}: got {got}, want {desc.digest}")
+            os.chmod(tmp, desc.mode or 0o644)  # mkstemp gives 0600; don't keep it
+            os.replace(tmp, target)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        bar.done()
+
+    # -- directories -----------------------------------------------------------
+
+    def _pull_directory(self, repository: str, desc: Descriptor, directory: str, bars: MultiBar) -> None:
+        """pull.go:145-204 — tgz-digest compare, then streaming download+extract."""
+        target = os.path.join(directory, desc.name)
+        bar = bars.bar(desc.name, desc.size)
+        if os.path.isdir(target):
+            local = helper.tgz(target, None)  # hash without writing
+            if local.digest == desc.digest:
+                bar.done("up-to-date")
+                return
+        # stream download straight into the tar extractor via a pipe
+        import threading
+
+        rfd, wfd = os.pipe()
+        reader = os.fdopen(rfd, "rb")
+        writer = os.fdopen(wfd, "wb")
+        errs: list[BaseException] = []
+
+        def extract() -> None:
+            try:
+                helper.untgz(reader, target)
+            except BaseException as e:  # surfaced after join
+                errs.append(e)
+                try:
+                    reader.close()
+                except OSError:
+                    pass
+
+        t = threading.Thread(target=extract, daemon=True)
+        t.start()
+        try:
+            self._download_blob(repository, desc, writer, bar.update)
+        except BrokenPipeError:
+            # extractor died and closed the pipe; its error (in errs) is the
+            # real cause — don't let the pipe write mask it
+            pass
+        finally:
+            try:
+                writer.close()
+            except OSError:
+                pass
+            t.join()
+        if errs:
+            raise errs[0]
+        bar.done()
+
+    # -- shared download path --------------------------------------------------
+
+    def _download_blob(self, repository: str, desc: Descriptor, writer, progress) -> None:
+        """pull.go:206-215 — presigned location first, direct GET fallback."""
+        location = self.remote.get_blob_location(repository, desc, BlobLocationPurposeDownload)
+        if location is not None:
+            ext = get_extension(location.provider)
+            ext.download(location, desc, writer, progress=progress)
+            return
+        for chunk in self.remote.get_blob_content(repository, desc.digest):
+            writer.write(chunk)
+            if progress:
+                progress(len(chunk))

@@ -1,0 +1,189 @@
+"""Typed HTTP client for the registry REST API.
+
+Reference parity: pkg/client/registry.go:28-191 — same endpoints, same
+error-body decoding into ErrorInfo, ``latest`` version defaulting
+(registry.go:34-36), and the blob-location query carrying size/name/
+media-type/annotations (registry.go:92-107).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, BinaryIO, Iterator
+
+import requests
+
+from modelx_tpu import errors
+from modelx_tpu.types import BlobLocation, Descriptor, Index, Manifest
+
+
+class RegistryClient:
+    def __init__(self, registry: str, authorization: str = "") -> None:
+        self.registry = registry.rstrip("/")
+        self.authorization = authorization
+        self.session = requests.Session()
+
+    # -- plumbing -------------------------------------------------------------
+
+    def _headers(self, extra: dict[str, str] | None = None) -> dict[str, str]:
+        h: dict[str, str] = {}
+        if self.authorization:
+            h["Authorization"] = self.authorization
+        if extra:
+            h.update(extra)
+        return h
+
+    def _request(
+        self,
+        method: str,
+        path: str,
+        params: dict[str, str] | None = None,
+        data: Any = None,
+        headers: dict[str, str] | None = None,
+        stream: bool = False,
+    ) -> requests.Response:
+        """registry.go:146-191 — raise typed ErrorInfo from error bodies."""
+        url = self.registry + path
+        try:
+            resp = self.session.request(
+                method, url, params=params, data=data, headers=self._headers(headers), stream=stream
+            )
+        except requests.RequestException as e:
+            raise errors.ErrorInfo(502, errors.ErrCodeUnknown, f"request failed: {e}") from e
+        if resp.status_code >= 400:
+            if resp.content:
+                err = errors.ErrorInfo.decode(resp.content, resp.status_code)
+            else:
+                # HEAD responses carry no body — synthesize from status
+                code = {
+                    401: errors.ErrCodeUnauthorized,
+                    403: errors.ErrCodeDenied,
+                    404: errors.ErrCodeUnknown,
+                    405: errors.ErrCodeUnsupported,
+                    429: errors.ErrCodeTooManyRequests,
+                }.get(resp.status_code, errors.ErrCodeUnknown)
+                err = errors.ErrorInfo(resp.status_code, code, f"{method} {path}: HTTP {resp.status_code}")
+            resp.close()
+            raise err
+        return resp
+
+    # -- index ----------------------------------------------------------------
+
+    def get_global_index(self, search: str = "") -> Index:
+        params = {"search": search} if search else None
+        return Index.from_json(self._request("GET", "/", params=params).json())
+
+    def get_index(self, repository: str, search: str = "") -> Index:
+        params = {"search": search} if search else None
+        return Index.from_json(self._request("GET", f"/{repository}/index", params=params).json())
+
+    def delete_index(self, repository: str) -> None:
+        self._request("DELETE", f"/{repository}/index")
+
+    # -- manifests -------------------------------------------------------------
+
+    @staticmethod
+    def _version(version: str) -> str:
+        return version or "latest"  # registry.go:34-36
+
+    def get_manifest(self, repository: str, version: str = "") -> Manifest:
+        r = self._request("GET", f"/{repository}/manifests/{self._version(version)}")
+        return Manifest.from_json(r.json())
+
+    def put_manifest(self, repository: str, version: str, manifest: Manifest) -> None:
+        self._request(
+            "PUT",
+            f"/{repository}/manifests/{self._version(version)}",
+            data=manifest.encode(),
+            headers={"Content-Type": manifest.media_type},
+        )
+
+    def delete_manifest(self, repository: str, version: str = "") -> None:
+        self._request("DELETE", f"/{repository}/manifests/{self._version(version)}")
+
+    def exists_manifest(self, repository: str, version: str = "") -> bool:
+        try:
+            self._request("HEAD", f"/{repository}/manifests/{self._version(version)}")
+            return True
+        except errors.ErrorInfo as e:
+            if e.http_status == 404:
+                return False
+            raise
+
+    # -- blobs -----------------------------------------------------------------
+
+    def head_blob(self, repository: str, digest: str) -> bool:
+        """registry.go:78-85."""
+        try:
+            self._request("HEAD", f"/{repository}/blobs/{digest}")
+            return True
+        except errors.ErrorInfo as e:
+            if e.http_status == 404:
+                return False
+            raise
+
+    def get_blob_content(self, repository: str, digest: str, offset: int = 0, length: int = -1) -> Iterator[bytes]:
+        """Streaming GET; optional Range for ranged/resumed reads."""
+        headers = {}
+        if offset or length >= 0:
+            end = "" if length < 0 else str(offset + length - 1)
+            headers["Range"] = f"bytes={offset}-{end}"
+        resp = self._request("GET", f"/{repository}/blobs/{digest}", headers=headers, stream=True)
+        return resp.iter_content(chunk_size=1024 * 1024)
+
+    def get_blob_size(self, repository: str, digest: str) -> int:
+        r = self._request("HEAD", f"/{repository}/blobs/{digest}")
+        return int(r.headers.get("Content-Length", 0))
+
+    def upload_blob_content(
+        self, repository: str, desc: Descriptor, content: BinaryIO | bytes
+    ) -> None:
+        """registry.go:109-120 — direct PUT through the server."""
+        if isinstance(content, bytes):
+            content = io.BytesIO(content)
+        self._request(
+            "PUT",
+            f"/{repository}/blobs/{desc.digest}",
+            data=_sized_iter(content, desc.size),
+            headers={
+                "Content-Type": desc.media_type or "application/octet-stream",
+                "Content-Length": str(desc.size),
+            },
+        )
+
+    def get_blob_location(
+        self, repository: str, desc: Descriptor, purpose: str
+    ) -> BlobLocation | None:
+        """registry.go:92-107 — returns None when the server answers
+        UNSUPPORTED (FS-backed store) so callers fall back to direct PUT/GET.
+        The reference's missing-return fallback bug (push.go:196-207) is
+        avoided by making absence explicit."""
+        params = {
+            "size": str(desc.size),
+            "name": desc.name,
+            "mediaType": desc.media_type,
+        }
+        for k, v in desc.annotations.items():
+            params[f"annotation-{k}"] = v
+        try:
+            r = self._request(
+                "GET", f"/{repository}/blobs/{desc.digest}/locations/{purpose}", params=params
+            )
+        except errors.ErrorInfo as e:
+            if e.code == errors.ErrCodeUnsupported or e.http_status == 405:
+                return None
+            raise
+        return BlobLocation.from_json(r.json())
+
+    def garbage_collect(self, repository: str) -> dict:
+        return self._request("POST", f"/{repository}/garbage-collect").json()
+
+
+def _sized_iter(f: BinaryIO, size: int, chunk: int = 1024 * 1024) -> Iterator[bytes]:
+    remaining = size
+    while remaining > 0:
+        data = f.read(min(chunk, remaining))
+        if not data:
+            break
+        remaining -= len(data)
+        yield data

@@ -1,0 +1,491 @@
+"""Registry HTTP server: router, handlers, filters.
+
+Reference parity: pkg/registry/{registry.go,route.go,server.go,helper.go} and
+the protocol spec in docs/api.md:13-30. Route table (identical paths):
+
+    GET     /healthz
+    GET     /metrics                                     (new: prometheus text)
+    GET     /                                            global index (?search=)
+    GET     /{repository}/index                          repo index (?search=)
+    DELETE  /{repository}/index
+    GET     /{repository}/manifests/{reference}
+    PUT     /{repository}/manifests/{reference}          (body capped 1 MiB)
+    DELETE  /{repository}/manifests/{reference}
+    HEAD    /{repository}/blobs/{digest}
+    GET     /{repository}/blobs/{digest}                 (supports Range)
+    PUT     /{repository}/blobs/{digest}
+    POST    /{repository}/garbage-collect
+    GET     /{repository}/blobs/{digest}/locations/{purpose}
+
+Upgrades over the reference: HTTP Range on blob GET (feeds the TPU loader's
+per-shard ranged reads when no presign layer exists), a /metrics endpoint
+(SURVEY.md §5 observability gap), double-write bug of registry.go:172-175
+fixed, and the auth context actually propagated (helper.go:93 discards it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import logging
+import re
+import socket
+import ssl
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import BinaryIO, Callable
+from urllib.parse import parse_qs, unquote, urlparse
+
+from modelx_tpu import errors
+from modelx_tpu.registry import gc as gcmod
+from modelx_tpu.registry.fs import LocalFSProvider
+from modelx_tpu.registry.store import BlobContent, RegistryStore
+from modelx_tpu.registry.store_fs import FSRegistryStore
+from modelx_tpu.types import Manifest
+
+logger = logging.getLogger("modelx.registry")
+
+# route regexes (route.go:10-13)
+NAME_REGEXP = r"[a-zA-Z0-9]+(?:[._-][a-zA-Z0-9]+)*/(?:[a-zA-Z0-9]+(?:[._-][a-zA-Z0-9]+)*)"
+REFERENCE_REGEXP = r"[a-zA-Z0-9_][a-zA-Z0-9._-]{0,127}"
+DIGEST_REGEXP = r"[A-Za-z][A-Za-z0-9]*(?:[-_+.][A-Za-z][A-Za-z0-9]*)*:[0-9a-fA-F]{32,}"
+
+MAX_BYTES_READ = 1 << 20  # 1 MiB manifest cap (helper.go:19)
+
+
+@dataclasses.dataclass
+class Options:
+    """pkg/registry/options.go:16-25 + cmd/modelxd/modelxd.go:44-56 flag surface."""
+
+    listen: str = ":8080"
+    data_dir: str = "data/registry"
+    tls_cert: str = ""
+    tls_key: str = ""
+    # S3 backend (presence of s3_url selects the S3 store, server.go:46-68)
+    s3_url: str = ""
+    s3_access_key: str = ""
+    s3_secret_key: str = ""
+    s3_bucket: str = "registry"
+    s3_region: str = "us-east-1"
+    s3_presign_expire_s: int = 3600
+    enable_redirect: bool = False
+    # auth: static bearer token(s); empty = anonymous (pkg/auth is an empty stub
+    # in the reference; OIDC filter lives in helper.go:63-96)
+    auth_tokens: tuple[str, ...] = ()
+    oidc_issuer: str = ""
+
+
+class Metrics:
+    """Minimal process-local counters exposed at /metrics (prometheus text)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.counters: dict[str, float] = {}
+
+    def inc(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0.0) + value
+
+    def render(self) -> str:
+        with self._lock:
+            lines = [f"modelx_{k} {v}" for k, v in sorted(self.counters.items())]
+        return "\n".join(lines) + "\n"
+
+
+class Registry:
+    """The handler set (registry.go:18-227) bound to a RegistryStore."""
+
+    def __init__(self, store: RegistryStore, opts: Options | None = None) -> None:
+        self.store = store
+        self.opts = opts or Options()
+        self.metrics = Metrics()
+        # method, compiled path regex, handler(req, **groups)
+        name, ref, dig = NAME_REGEXP, REFERENCE_REGEXP, DIGEST_REGEXP
+        self.routes: list[tuple[str, re.Pattern, Callable]] = [
+            ("GET", re.compile(r"^/healthz$"), self.healthz),
+            ("GET", re.compile(r"^/metrics$"), self.get_metrics),
+            ("GET", re.compile(r"^/$"), self.get_global_index),
+            ("POST", re.compile(rf"^/(?P<name>{name})/garbage-collect$"), self.garbage_collect),
+            ("GET", re.compile(rf"^/(?P<name>{name})/index$"), self.get_index),
+            ("DELETE", re.compile(rf"^/(?P<name>{name})/index$"), self.delete_index),
+            ("GET", re.compile(rf"^/(?P<name>{name})/manifests/(?P<reference>{ref})$"), self.get_manifest),
+            ("PUT", re.compile(rf"^/(?P<name>{name})/manifests/(?P<reference>{ref})$"), self.put_manifest),
+            ("DELETE", re.compile(rf"^/(?P<name>{name})/manifests/(?P<reference>{ref})$"), self.delete_manifest),
+            ("HEAD", re.compile(rf"^/(?P<name>{name})/blobs/(?P<digest>{dig})$"), self.head_blob),
+            ("HEAD", re.compile(rf"^/(?P<name>{name})/manifests/(?P<reference>{ref})$"), self.head_manifest),
+            ("GET", re.compile(rf"^/(?P<name>{name})/blobs/(?P<digest>{dig})/locations/(?P<purpose>\w+)$"), self.get_blob_location),
+            ("GET", re.compile(rf"^/(?P<name>{name})/blobs/(?P<digest>{dig})$"), self.get_blob),
+            ("PUT", re.compile(rf"^/(?P<name>{name})/blobs/(?P<digest>{dig})$"), self.put_blob),
+        ]
+
+    # -- handlers (each returns (status, headers, body|reader)) ---------------
+
+    def healthz(self, req: "Request") -> "Response":
+        return Response(200, body=b"ok")
+
+    def get_metrics(self, req: "Request") -> "Response":
+        return Response(200, body=self.metrics.render().encode(), content_type="text/plain; version=0.0.4")
+
+    def get_global_index(self, req: "Request") -> "Response":
+        idx = self.store.get_global_index(req.query_one("search"))
+        return Response.json(200, idx.to_json())
+
+    def get_index(self, req: "Request", name: str) -> "Response":
+        idx = self.store.get_index(name, req.query_one("search"))
+        return Response.json(200, idx.to_json())
+
+    def delete_index(self, req: "Request", name: str) -> "Response":
+        self.store.remove_index(name)
+        return Response(200)
+
+    def get_manifest(self, req: "Request", name: str, reference: str) -> "Response":
+        m = self.store.get_manifest(name, reference)
+        return Response.json(200, m.to_json())
+
+    def put_manifest(self, req: "Request", name: str, reference: str) -> "Response":
+        if req.content_length > MAX_BYTES_READ:
+            raise errors.manifest_invalid(f"manifest exceeds {MAX_BYTES_READ} bytes")
+        body = req.read_body(MAX_BYTES_READ)
+        try:
+            manifest = Manifest.decode(body)
+        except (ValueError, KeyError, AttributeError, TypeError) as e:
+            raise errors.manifest_invalid(str(e)) from None
+        self.store.put_manifest(name, reference, req.content_type, manifest)
+        self.metrics.inc("manifest_put_total")
+        return Response(201)
+
+    def delete_manifest(self, req: "Request", name: str, reference: str) -> "Response":
+        self.store.delete_manifest(name, reference)
+        return Response(200)
+
+    def head_manifest(self, req: "Request", name: str, reference: str) -> "Response":
+        if not self.store.exists_manifest(name, reference):
+            raise errors.manifest_unknown(reference)
+        return Response(200, head_only=True)
+
+    def head_blob(self, req: "Request", name: str, digest: str) -> "Response":
+        if not self.store.exists_blob(name, digest):
+            raise errors.blob_unknown(digest)
+        meta = self.store.get_blob_meta(name, digest)
+        return Response(
+            200,
+            headers={"Content-Length": str(meta.content_length), "Content-Type": meta.content_type or "application/octet-stream"},
+            head_only=True,
+        )
+
+    def get_blob(self, req: "Request", name: str, digest: str) -> "Response":
+        offset, length, is_range = 0, -1, False
+        rng = req.headers.get("Range", "")
+        total = None
+        if rng:
+            m = re.match(r"^bytes=(\d+)-(\d*)$", rng)
+            if not m:
+                raise errors.ErrorInfo(416, errors.ErrCodeUnknown, f"unsupported range: {rng}")
+            total = self.store.get_blob_meta(name, digest).content_length
+            offset = int(m.group(1))
+            end = int(m.group(2)) if m.group(2) else total - 1
+            if offset >= total or end < offset:
+                raise errors.ErrorInfo(416, errors.ErrCodeUnknown, f"range not satisfiable: {rng} of {total}")
+            length = end - offset + 1
+            is_range = True
+        blob = self.store.get_blob(name, digest, offset=offset, length=length)
+        headers = {
+            "Content-Type": blob.content_type or "application/octet-stream",
+            "Accept-Ranges": "bytes",
+        }
+        status = 200
+        if is_range:
+            status = 206
+            headers["Content-Range"] = f"bytes {offset}-{offset + blob.content_length - 1}/{total}"
+        self.metrics.inc("blob_get_total")
+        self.metrics.inc("blob_get_bytes", blob.content_length)
+        return Response(status, headers=headers, body=blob.content, body_length=blob.content_length)
+
+    def put_blob(self, req: "Request", name: str, digest: str) -> "Response":
+        content = BlobContent(
+            content=req.body_stream(),
+            content_length=req.content_length,
+            content_type=req.content_type or "application/octet-stream",
+        )
+        self.store.put_blob(name, digest, content)
+        self.metrics.inc("blob_put_total")
+        self.metrics.inc("blob_put_bytes", max(req.content_length, 0))
+        return Response(201)
+
+    def get_blob_location(self, req: "Request", name: str, digest: str, purpose: str) -> "Response":
+        properties = {k: v[0] for k, v in req.query.items()}
+        location = self.store.get_blob_location(name, digest, purpose, properties)
+        if location is None:
+            raise errors.unsupported("blob location not supported by this store")
+        self.metrics.inc("presign_issued_total")
+        return Response.json(200, location.to_json())
+
+    def garbage_collect(self, req: "Request", name: str) -> "Response":
+        result = gcmod.gc_blobs(self.store, name)
+        self.metrics.inc("gc_blobs_deleted_total", result.deleted)
+        return Response.json(200, result.to_json())
+
+    # -- dispatch -------------------------------------------------------------
+
+    def dispatch(self, req: "Request") -> "Response":
+        path_matched = False
+        for m, pattern, handler in self.routes:
+            match = pattern.match(req.path)
+            if not match:
+                continue
+            path_matched = True
+            if m == req.method:
+                return handler(req, **match.groupdict())
+        if path_matched:
+            raise errors.unsupported(f"{req.method} not allowed on {req.path}")
+        raise errors.ErrorInfo(404, errors.ErrCodeUnknown, f"no route: {req.method} {req.path}")
+
+
+@dataclasses.dataclass
+class Request:
+    method: str
+    path: str
+    query: dict[str, list[str]]
+    headers: dict[str, str]
+    rfile: BinaryIO
+    content_length: int = 0
+    username: str = ""  # set by the auth filter (fixes helper.go:93)
+
+    consumed: int = 0
+
+    def query_one(self, key: str, default: str = "") -> str:
+        vals = self.query.get(key)
+        return vals[0] if vals else default
+
+    @property
+    def content_type(self) -> str:
+        return self.headers.get("Content-Type", "")
+
+    def read_body(self, limit: int) -> bytes:
+        n = min(self.content_length, limit) if self.content_length >= 0 else limit
+        data = self.rfile.read(n)
+        self.consumed += len(data)
+        return data
+
+    def body_stream(self) -> BinaryIO:
+        if self.content_length >= 0:
+            return _Limited(self.rfile, self.content_length, self)
+        return self.rfile
+
+    def drain(self, cap: int = 4 * 1024 * 1024) -> bool:
+        """Discard the unread request body so HTTP/1.1 keep-alive stays in
+        sync after an error response. Returns False (caller should close the
+        connection) when more than ``cap`` bytes remain."""
+        remaining = self.content_length - self.consumed
+        if remaining <= 0:
+            return True
+        if remaining > cap:
+            return False
+        while remaining > 0:
+            chunk = self.rfile.read(min(remaining, 1 << 20))
+            if not chunk:
+                break
+            remaining -= len(chunk)
+        return True
+
+
+class _Limited(io.RawIOBase):
+    def __init__(self, f: BinaryIO, limit: int, req: "Request | None" = None) -> None:
+        self._f, self._remaining, self._req = f, limit, req
+
+    def read(self, n: int = -1) -> bytes:  # type: ignore[override]
+        if self._remaining <= 0:
+            return b""
+        if n < 0 or n > self._remaining:
+            n = self._remaining
+        data = self._f.read(n)
+        self._remaining -= len(data)
+        if self._req is not None:
+            self._req.consumed += len(data)
+        return data
+
+    def readable(self) -> bool:
+        return True
+
+
+@dataclasses.dataclass
+class Response:
+    status: int
+    headers: dict[str, str] = dataclasses.field(default_factory=dict)
+    body: bytes | BinaryIO = b""
+    body_length: int | None = None
+    content_type: str = ""
+    head_only: bool = False
+
+    @classmethod
+    def json(cls, status: int, obj) -> "Response":
+        return cls(status, body=json.dumps(obj).encode(), content_type="application/json")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    registry: Registry  # set on subclass
+
+    # -- filters chain: logging -> auth -> dispatch (server.go:25-31) ---------
+
+    def _serve(self) -> None:
+        start = time.monotonic()
+        parsed = urlparse(self.path)
+        req = Request(
+            method=self.command,
+            path=unquote(parsed.path) or "/",
+            query=parse_qs(parsed.query),
+            headers={k: v for k, v in self.headers.items()},
+            rfile=self.rfile,
+            content_length=int(self.headers.get("Content-Length", 0) or 0),
+        )
+        status = 500
+        try:
+            self._auth(req)
+            resp = self.registry.dispatch(req)
+            status = resp.status
+            self._write(resp, head_only=req.method == "HEAD" or resp.head_only)
+        except errors.ErrorInfo as e:
+            status = e.http_status
+            # keep-alive stays usable only if the unread body is drained;
+            # huge leftovers mean closing is cheaper than draining
+            if not req.drain():
+                self.close_connection = True
+            self._write_error(e, head_only=req.method == "HEAD")
+        except (BrokenPipeError, ConnectionResetError):
+            status = 499
+            self.close_connection = True
+        except Exception as e:  # internal error
+            logger.exception("internal error on %s %s", req.method, req.path)
+            status = 500
+            if not req.drain():
+                self.close_connection = True
+            self._write_error(errors.internal(str(e)), head_only=req.method == "HEAD")
+        finally:
+            # LoggingFilter (helper.go:98-113): method, path, status, cost
+            cost_ms = (time.monotonic() - start) * 1000
+            logger.info("%s %s %d %.1fms", self.command, self.path, status, cost_ms)
+
+    def _auth(self, req: Request) -> None:
+        """Bearer-token auth; token also accepted via ?token=/?access_token=
+        query (helper.go:75-82). Sets req.username (fixes helper.go:93)."""
+        tokens = self.registry.opts.auth_tokens
+        if not tokens:
+            return
+        if req.path == "/healthz":
+            return
+        presented = ""
+        authz = req.headers.get("Authorization", "")
+        if authz.startswith("Bearer "):
+            presented = authz[len("Bearer ") :]
+        if not presented:
+            presented = req.query_one("token") or req.query_one("access_token")
+        if presented not in tokens:
+            raise errors.unauthorized("invalid or missing bearer token")
+        req.username = "token"
+
+    def _write(self, resp: Response, head_only: bool = False) -> None:
+        self.send_response(resp.status)
+        headers = dict(resp.headers)
+        if resp.content_type:
+            headers.setdefault("Content-Type", resp.content_type)
+        body = resp.body
+        if isinstance(body, bytes):
+            headers.setdefault("Content-Length", str(len(body)))
+        elif resp.body_length is not None:
+            headers.setdefault("Content-Length", str(resp.body_length))
+        for k, v in headers.items():
+            self.send_header(k, v)
+        self.end_headers()
+        if head_only:
+            if not isinstance(body, bytes):
+                body.close()
+            return
+        if isinstance(body, bytes):
+            self.wfile.write(body)
+        else:
+            try:
+                while chunk := body.read(1024 * 1024):
+                    self.wfile.write(chunk)
+            finally:
+                body.close()
+
+    def _write_error(self, e: errors.ErrorInfo, head_only: bool = False) -> None:
+        try:
+            body = e.encode()
+            self.send_response(e.http_status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            if not head_only:
+                self.wfile.write(body)
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+    def log_message(self, fmt: str, *args) -> None:  # quiet default stderr log
+        pass
+
+    do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _serve
+
+
+class RegistryServer:
+    """server.go:12-44 — bootstrap, serve, graceful shutdown."""
+
+    def __init__(self, opts: Options, store: RegistryStore | None = None) -> None:
+        self.opts = opts
+        if store is None:
+            store = new_store(opts)
+        self.registry = Registry(store, opts)
+        handler = type("BoundHandler", (_Handler,), {"registry": self.registry})
+        host, _, port = opts.listen.rpartition(":")
+        self.httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)), handler)
+        self.httpd.daemon_threads = True
+        if opts.tls_cert and opts.tls_key:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(opts.tls_cert, opts.tls_key)
+            self.httpd.socket = ctx.wrap_socket(self.httpd.socket, server_side=True)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def address(self) -> str:
+        host, port = self.httpd.server_address[:2]
+        if isinstance(host, bytes):
+            host = host.decode()
+        return f"http://{host if host != '0.0.0.0' else '127.0.0.1'}:{port}"
+
+    def serve_background(self) -> str:
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self.address
+
+    def serve_forever(self) -> None:
+        logger.info("registry listening on %s", self.opts.listen)
+        try:
+            self.httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.shutdown()
+
+    def shutdown(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def new_store(opts: Options) -> RegistryStore:
+    """server.go:46-68 — S3 store iff s3_url set, else local FS."""
+    if opts.s3_url:
+        from modelx_tpu.registry.store_s3 import S3RegistryStore
+
+        return S3RegistryStore(opts)
+    return FSRegistryStore(LocalFSProvider(opts.data_dir))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

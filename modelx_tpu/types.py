@@ -129,6 +129,8 @@ class Descriptor:
 
     @classmethod
     def from_json(cls, d: dict[str, Any]) -> "Descriptor":
+        if not isinstance(d, dict):
+            raise ValueError(f"descriptor must be an object, got {type(d).__name__}")
         return cls(
             name=d.get("name", ""),
             media_type=d.get("mediaType", ""),
@@ -195,11 +197,21 @@ class Manifest:
 
     @classmethod
     def from_json(cls, d: dict[str, Any]) -> "Manifest":
+        if not isinstance(d, dict):
+            raise ValueError(f"manifest must be an object, got {type(d).__name__}")
+        config = d.get("config")
+        if config is None:
+            config = {}
+        blobs = d.get("blobs")
+        if blobs is None:
+            blobs = []
+        if not isinstance(blobs, list):
+            raise ValueError("manifest blobs must be a list")
         return cls(
             schema_version=int(d.get("schemaVersion", 1) or 1),
             media_type=d.get("mediaType", "") or "",
-            config=Descriptor.from_json(d.get("config", {}) or {}),
-            blobs=[Descriptor.from_json(b) for b in d.get("blobs", []) or []],
+            config=Descriptor.from_json(config),
+            blobs=[Descriptor.from_json(b) for b in blobs],
             annotations=dict(d.get("annotations", {}) or {}),
         )
 

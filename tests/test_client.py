@@ -1,0 +1,240 @@
+"""Client library tests: reference grammar, repos.json, tgz determinism, and
+the hermetic push/pull e2e round-trip (SURVEY.md §4: 'client push/pull can be
+tested hermetically against an in-process handler' — preserved)."""
+
+import os
+
+import pytest
+
+from modelx_tpu import errors
+from modelx_tpu.client import helper
+from modelx_tpu.client.client import Client
+from modelx_tpu.client.reference import parse_reference
+from modelx_tpu.client.repo import RepoDetails, RepoManager
+from modelx_tpu.registry.fs import MemoryFSProvider
+from modelx_tpu.registry.server import Options, RegistryServer, free_port
+from modelx_tpu.registry.store_fs import FSRegistryStore
+from modelx_tpu.types import Digest, MediaTypeModelDirectoryTarGz
+
+
+@pytest.fixture
+def server():
+    srv = RegistryServer(
+        Options(listen=f"127.0.0.1:{free_port()}"), store=FSRegistryStore(MemoryFSProvider())
+    )
+    base = srv.serve_background()
+    yield base
+    srv.shutdown()
+
+
+@pytest.fixture
+def model_dir(tmp_path):
+    d = tmp_path / "model"
+    d.mkdir()
+    (d / "modelx.yaml").write_text("description: test\nframework: jax\n")
+    (d / "weights.bin").write_bytes(b"W" * 4096)
+    (d / "README.md").write_text("# readme\n")
+    (d / ".hidden").write_text("skipme")
+    (d / "empty.txt").write_text("")
+    sub = d / "tokenizer"
+    sub.mkdir()
+    (sub / "vocab.txt").write_text("a\nb\nc\n")
+    (sub / "merges.txt").write_text("a b\n")
+    return str(d)
+
+
+class TestParseReference:
+    """Mirrors cmd/modelx/model/reference_test.go — against *current* grammar
+    (the reference's own test is stale, SURVEY.md §4)."""
+
+    def test_full_url(self):
+        r = parse_reference("https://registry.example.com/org/model@v1")
+        assert r.registry == "https://registry.example.com"
+        assert r.repository == "org/model"
+        assert r.version == "v1"
+
+    def test_bare_name_gets_library(self):
+        r = parse_reference("https://host/model@v1")
+        assert r.repository == "library/model"  # reference.go:75-77
+
+    def test_no_version(self):
+        r = parse_reference("https://host/org/model")
+        assert r.version == ""  # defaulting happens client-side later
+
+    def test_token_query(self):
+        r = parse_reference("https://host/org/model@v1?token=tok123")
+        assert r.authorization == "Bearer tok123"
+
+    def test_modelx_scheme(self):
+        r = parse_reference("modelx://host/org/model@v1")
+        assert r.registry == "https://host"
+        assert r.repository == "org/model"
+
+    def test_alias_resolution(self, tmp_path):
+        mgr = RepoManager(str(tmp_path / "repos.json"))
+        mgr.set(RepoDetails(name="mylab", url="https://reg.lab", token="sek"))
+        r = parse_reference("mylab/org/model@v2", repo_manager=mgr)
+        assert r.registry == "https://reg.lab"
+        assert r.repository == "org/model"
+        assert r.version == "v2"
+        assert r.authorization == "Bearer sek"
+
+    def test_unknown_alias(self, tmp_path):
+        mgr = RepoManager(str(tmp_path / "repos.json"))
+        with pytest.raises(ValueError, match="unknown repo alias"):
+            parse_reference("nope/org/model", repo_manager=mgr)
+
+    def test_env_auth_override(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MODELX_AUTH", "Bearer envtok")
+        r = parse_reference("https://host/org/model")
+        assert r.authorization == "Bearer envtok"
+
+    def test_str_roundtrip(self):
+        r = parse_reference("https://host/org/model@v1")
+        assert str(r) == "https://host/org/model@v1"
+
+
+class TestRepoManager:
+    def test_crud(self, tmp_path):
+        mgr = RepoManager(str(tmp_path / "repos.json"))
+        mgr.set(RepoDetails(name="a", url="https://a.example", token="t"))
+        mgr.set(RepoDetails(name="b", url="https://b.example"))
+        assert {r.name for r in mgr.list()} == {"a", "b"}
+        assert mgr.get("a").token == "t"
+        assert mgr.get("https://b.example").name == "b"  # lookup by URL
+        mgr.set(RepoDetails(name="a", url="https://a2.example"))  # update
+        assert mgr.get("a").url == "https://a2.example"
+        assert mgr.remove("a")
+        assert not mgr.remove("a")
+        assert mgr.get("a") is None
+
+    def test_invalid_url(self, tmp_path):
+        mgr = RepoManager(str(tmp_path / "repos.json"))
+        with pytest.raises(ValueError):
+            mgr.set(RepoDetails(name="x", url="not-a-url"))
+
+
+class TestTgz:
+    def test_deterministic_digest(self, tmp_path):
+        src = tmp_path / "dir"
+        src.mkdir()
+        (src / "a.txt").write_text("aaa")
+        (src / "b.txt").write_text("bbb")
+        d1 = helper.tgz(str(src), str(tmp_path / "one.tar.gz"))
+        os.utime(src / "a.txt", (0, 0))  # touch mtimes — digest must not move
+        d2 = helper.tgz(str(src), str(tmp_path / "two.tar.gz"))
+        assert d1.digest == d2.digest
+        assert d1.media_type == MediaTypeModelDirectoryTarGz
+
+    def test_hash_only_mode_matches(self, tmp_path):
+        src = tmp_path / "dir"
+        src.mkdir()
+        (src / "f").write_text("data")
+        with_file = helper.tgz(str(src), str(tmp_path / "x.tar.gz"))
+        hash_only = helper.tgz(str(src), None)
+        assert with_file.digest == hash_only.digest
+        assert not (tmp_path / "y.tar.gz").exists()
+
+    def test_untgz_roundtrip(self, tmp_path):
+        src = tmp_path / "dir"
+        (src / "nested").mkdir(parents=True)
+        (src / "nested" / "f.txt").write_text("hello")
+        (src / "x.bin").write_bytes(b"\x00\x01")
+        arc = str(tmp_path / "a.tar.gz")
+        helper.tgz(str(src), arc)
+        out = tmp_path / "out"
+        helper.untgz(arc, str(out))
+        assert (out / "nested" / "f.txt").read_text() == "hello"
+        assert (out / "x.bin").read_bytes() == b"\x00\x01"
+
+
+class TestPushPull:
+    def test_round_trip(self, server, model_dir, tmp_path):
+        """BASELINE config #1 shape: init -> push -> pull round-trip, local FS."""
+        client = Client(server, quiet=True)
+        client.push("library/demo", "v1", model_dir)
+
+        manifest = client.get_manifest("library/demo", "v1")
+        blob_names = {b.name for b in manifest.blobs}
+        assert blob_names == {"weights.bin", "README.md", "tokenizer"}
+        assert manifest.config.name == "modelx.yaml"
+        assert ".hidden" not in blob_names and "empty.txt" not in blob_names
+
+        out = tmp_path / "pulled"
+        client.pull("library/demo", "v1", str(out))
+        assert (out / "weights.bin").read_bytes() == b"W" * 4096
+        assert (out / "modelx.yaml").read_text().startswith("description: test")
+        assert (out / "tokenizer" / "vocab.txt").read_text() == "a\nb\nc\n"
+
+    def test_incremental_push_skips_existing(self, server, model_dir):
+        client = Client(server, quiet=True)
+        client.push("library/demo", "v1", model_dir)
+        # second push of identical content: every blob HEAD-dedups; must succeed
+        client.push("library/demo", "v2", model_dir)
+        idx = client.get_index("library/demo")
+        assert [m.name for m in idx.manifests] == ["v1", "v2"]
+
+    def test_incremental_pull_skips_up_to_date(self, server, model_dir, tmp_path):
+        client = Client(server, quiet=True)
+        client.push("library/demo", "v1", model_dir)
+        out = str(tmp_path / "pulled")
+        client.pull("library/demo", "v1", out)
+        # corrupt one file; re-pull must restore only it
+        with open(os.path.join(out, "weights.bin"), "wb") as f:
+            f.write(b"corrupted")
+        client.pull("library/demo", "v1", out)
+        with open(os.path.join(out, "weights.bin"), "rb") as f:
+            assert f.read() == b"W" * 4096
+
+    def test_pull_verifies_digest(self, server, model_dir, tmp_path):
+        client = Client(server, quiet=True)
+        client.push("library/demo", "v1", model_dir)
+        manifest = client.get_manifest("library/demo", "v1")
+        # tamper server-side: overwrite a blob with wrong bytes
+        weights = next(b for b in manifest.blobs if b.name == "weights.bin")
+        import io as _io
+
+        from modelx_tpu.registry.store import BlobContent
+
+        # reach into the server's store via a direct upload of wrong content
+        client.remote.upload_blob_content(
+            "library/demo",
+            weights,
+            _io.BytesIO(b"X" * weights.size),
+        )
+        with pytest.raises(ValueError, match="digest mismatch"):
+            client.pull("library/demo", "v1", str(tmp_path / "bad"))
+
+    def test_latest_defaulting(self, server, model_dir):
+        client = Client(server, quiet=True)
+        client.push("library/demo", "latest", model_dir)
+        m = client.get_manifest("library/demo", "")  # registry.go:34-36
+        assert m.config.name == "modelx.yaml"
+
+    def test_ping_and_config_content(self, server, model_dir):
+        client = Client(server, quiet=True)
+        client.push("library/demo", "v1", model_dir)
+        assert [m.name for m in client.ping().manifests] == ["library/demo"]
+        cfg = client.get_config_content("library/demo", "v1")
+        assert b"framework: jax" in cfg
+
+    def test_pull_unknown_version(self, server, tmp_path):
+        client = Client(server, quiet=True)
+        with pytest.raises(errors.ErrorInfo) as ei:
+            client.pull("library/demo", "ghost", str(tmp_path / "x"))
+        assert ei.value.http_status == 404
+
+
+class TestCorruptDirectoryBlob:
+    def test_tar_error_not_masked_by_broken_pipe(self, server, model_dir, tmp_path):
+        """A corrupt tgz must surface the tar error, not BrokenPipeError."""
+        import tarfile
+        client = Client(server, quiet=True)
+        client.push("library/demo", "v1", model_dir)
+        manifest = client.get_manifest("library/demo", "v1")
+        dirblob = next(b for b in manifest.blobs if b.name == "tokenizer")
+        # corrupt the directory blob server-side (big enough to overflow the pipe buffer)
+        client.remote.upload_blob_content("library/demo", dirblob, b"\x1f\x8b" + b"Z" * max(dirblob.size - 2, 1 << 20))
+        with pytest.raises(Exception) as ei:
+            client.pull("library/demo", "v1", str(tmp_path / "broken"))
+        assert not isinstance(ei.value, BrokenPipeError)

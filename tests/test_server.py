@@ -1,0 +1,198 @@
+"""Registry HTTP server tests over a real socket (SURVEY.md §4: handler tests;
+the reference's design keeps client/server testable in-process — preserved)."""
+
+import json
+
+import pytest
+import requests
+
+from modelx_tpu.registry.fs import MemoryFSProvider
+from modelx_tpu.registry.server import Options, RegistryServer, free_port
+from modelx_tpu.registry.store_fs import FSRegistryStore
+from modelx_tpu.types import Descriptor, Digest, Index, Manifest
+
+
+@pytest.fixture
+def server():
+    store = FSRegistryStore(MemoryFSProvider())
+    srv = RegistryServer(Options(listen=f"127.0.0.1:{free_port()}"), store=store)
+    base = srv.serve_background()
+    yield base
+    srv.shutdown()
+
+
+@pytest.fixture
+def auth_server():
+    store = FSRegistryStore(MemoryFSProvider())
+    srv = RegistryServer(
+        Options(listen=f"127.0.0.1:{free_port()}", auth_tokens=("sekrit",)), store=store
+    )
+    base = srv.serve_background()
+    yield base
+    srv.shutdown()
+
+
+REPO = "library/demo"
+
+
+def push_model(base, repo=REPO, tag="v1", data=b"some model weights"):
+    digest = str(Digest.from_bytes(data))
+    r = requests.put(f"{base}/{repo}/blobs/{digest}", data=data)
+    assert r.status_code == 201, r.text
+    manifest = Manifest(blobs=[Descriptor(name="model.bin", digest=digest, size=len(data))])
+    r = requests.put(f"{base}/{repo}/manifests/{tag}", data=manifest.encode())
+    assert r.status_code == 201, r.text
+    return digest, manifest
+
+
+class TestRoutes:
+    def test_healthz(self, server):
+        r = requests.get(f"{server}/healthz")
+        assert (r.status_code, r.text) == (200, "ok")
+
+    def test_full_push_pull_cycle(self, server):
+        digest, manifest = push_model(server)
+
+        # HEAD blob
+        r = requests.head(f"{server}/{REPO}/blobs/{digest}")
+        assert r.status_code == 200
+        assert r.headers["Content-Length"] == "18"
+
+        # GET blob
+        r = requests.get(f"{server}/{REPO}/blobs/{digest}")
+        assert r.content == b"some model weights"
+
+        # GET manifest
+        r = requests.get(f"{server}/{REPO}/manifests/v1")
+        assert Manifest.from_json(r.json()) == manifest
+
+        # repo index + global index
+        idx = Index.from_json(requests.get(f"{server}/{REPO}/index").json())
+        assert [m.name for m in idx.manifests] == ["v1"]
+        gidx = Index.from_json(requests.get(f"{server}/").json())
+        assert [m.name for m in gidx.manifests] == [REPO]
+
+    def test_ranged_blob_get(self, server):
+        digest, _ = push_model(server)
+        r = requests.get(f"{server}/{REPO}/blobs/{digest}", headers={"Range": "bytes=5-9"})
+        assert r.status_code == 206
+        assert r.content == b"model"
+        assert r.headers["Content-Range"] == "bytes 5-9/18"
+        # open-ended range
+        r = requests.get(f"{server}/{REPO}/blobs/{digest}", headers={"Range": "bytes=13-"})
+        assert r.content == b"ights"
+        # bad range
+        r = requests.get(f"{server}/{REPO}/blobs/{digest}", headers={"Range": "bytes=nope"})
+        assert r.status_code == 416
+
+    def test_search_params(self, server):
+        push_model(server, tag="v1")
+        push_model(server, tag="v2-rc")
+        idx = requests.get(f"{server}/{REPO}/index", params={"search": "rc"}).json()
+        assert [m["name"] for m in idx["manifests"]] == ["v2-rc"]
+        gidx = requests.get(f"{server}/", params={"search": "nothere"}).json()
+        assert gidx["manifests"] == []
+
+    def test_manifest_errors(self, server):
+        r = requests.get(f"{server}/{REPO}/manifests/missing")
+        assert r.status_code == 404
+        assert r.json()["code"] == "MANIFEST_UNKNOWN"
+        r = requests.put(f"{server}/{REPO}/manifests/bad", data=b"not json{{{")
+        assert r.status_code == 400
+        assert r.json()["code"] == "MANIFEST_INVALID"
+
+    def test_manifest_body_cap(self, server):
+        huge = json.dumps({"schemaVersion": 1, "config": {"name": "x" * (2 << 20)}, "blobs": []})
+        r = requests.put(f"{server}/{REPO}/manifests/big", data=huge.encode())
+        assert r.status_code == 400
+
+    def test_blob_errors(self, server):
+        missing = "sha256:" + "0" * 64
+        assert requests.head(f"{server}/{REPO}/blobs/{missing}").status_code == 404
+        r = requests.get(f"{server}/{REPO}/blobs/{missing}")
+        assert r.status_code == 404
+        assert r.json()["code"] == "BLOB_UNKNOWN"
+
+    def test_delete_manifest_and_index(self, server):
+        push_model(server, tag="v1")
+        push_model(server, tag="v2")
+        assert requests.delete(f"{server}/{REPO}/manifests/v1").status_code == 200
+        idx = requests.get(f"{server}/{REPO}/index").json()
+        assert [m["name"] for m in idx["manifests"]] == ["v2"]
+        assert requests.delete(f"{server}/{REPO}/index").status_code == 200
+        assert requests.get(f"{server}/{REPO}/index").status_code == 404
+
+    def test_garbage_collect_endpoint(self, server):
+        digest, _ = push_model(server)
+        orphan = b"orphan data"
+        odg = str(Digest.from_bytes(orphan))
+        requests.put(f"{server}/{REPO}/blobs/{odg}", data=orphan)
+        r = requests.post(f"{server}/{REPO}/garbage-collect")
+        assert r.status_code == 200
+        body = r.json()
+        assert body["deleted"] == 1 and body["deleted_digests"] == [odg]
+        assert requests.head(f"{server}/{REPO}/blobs/{digest}").status_code == 200
+
+    def test_blob_location_unsupported_on_fs(self, server):
+        digest = "sha256:" + "a" * 64
+        r = requests.get(f"{server}/{REPO}/blobs/{digest}/locations/upload")
+        assert r.status_code == 405
+        assert r.json()["code"] == "UNSUPPORTED"
+
+    def test_unknown_route_and_method(self, server):
+        assert requests.get(f"{server}/not a route").status_code == 404
+        r = requests.post(f"{server}/{REPO}/index")
+        assert r.status_code == 405
+
+    def test_metrics(self, server):
+        push_model(server)
+        requests.get(f"{server}/{REPO}/blobs/" + "sha256:" + "0" * 64)
+        text = requests.get(f"{server}/metrics").text
+        assert "modelx_manifest_put_total 1" in text
+        assert "modelx_blob_put_total 1" in text
+
+
+class TestAuth:
+    def test_rejects_anonymous(self, auth_server):
+        assert requests.get(f"{auth_server}/").status_code == 401
+        assert requests.get(f"{auth_server}/").json()["code"] == "UNAUTHORIZED"
+
+    def test_healthz_open(self, auth_server):
+        assert requests.get(f"{auth_server}/healthz").status_code == 200
+
+    def test_bearer_header(self, auth_server):
+        r = requests.get(f"{auth_server}/", headers={"Authorization": "Bearer sekrit"})
+        assert r.status_code == 200
+
+    def test_token_query_param(self, auth_server):
+        # helper.go:75-82 — token via query for presigned-style access
+        assert requests.get(f"{auth_server}/?token=sekrit").status_code == 200
+        assert requests.get(f"{auth_server}/?access_token=sekrit").status_code == 200
+        assert requests.get(f"{auth_server}/?token=wrong").status_code == 401
+
+
+class TestRangeEdgeCases:
+    def test_unsatisfiable_range(self, server):
+        digest, _ = push_model(server)
+        r = requests.get(f"{server}/{REPO}/blobs/{digest}", headers={"Range": "bytes=18-"})
+        assert r.status_code == 416
+        r = requests.get(f"{server}/{REPO}/blobs/{digest}", headers={"Range": "bytes=5-3"})
+        assert r.status_code == 416
+
+    def test_error_then_reuse_connection(self, server):
+        """Errors close the connection instead of desyncing keep-alive."""
+        s = requests.Session()
+        digest, _ = push_model(server)
+        # oversized manifest PUT -> 400 with body left unread
+        huge = b"x" * (2 << 20)
+        r = s.put(f"{server}/{REPO}/manifests/huge", data=huge)
+        assert r.status_code == 400
+        # next request on the same session must still work
+        r = s.get(f"{server}/{REPO}/blobs/{digest}")
+        assert r.status_code == 200 and r.content == b"some model weights"
+
+    def test_manifest_wrong_json_shape_is_400(self, server):
+        for body in (b"[1,2]", b'{"blobs": 5}', b'{"config": []}'):
+            r = requests.put(f"{server}/{REPO}/manifests/bad", data=body)
+            assert r.status_code == 400, body
+            assert r.json()["code"] == "MANIFEST_INVALID"
