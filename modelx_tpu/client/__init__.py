@@ -5,4 +5,7 @@ extensions, progress UI. Mirrors reference pkg/client (SURVEY.md §2.1 #13-21).
 from modelx_tpu.client.client import Client
 from modelx_tpu.client.remote import RegistryClient
 
+# register data-plane extensions (extension.go init() side effect parity)
+from modelx_tpu.client import extension_s3 as _extension_s3  # noqa: F401
+
 __all__ = ["Client", "RegistryClient"]

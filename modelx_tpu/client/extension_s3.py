@@ -1,0 +1,146 @@
+"""S3 client extension: the presigned data plane.
+
+Reference parity: pkg/client/extension_s3.go:17-148, with its two gaps fixed:
+
+- upload: part ranges come from the server's location properties (explicit
+  offset/length per part), uploaded in parallel with per-part retry; already-
+  uploaded parts (resume) are skipped;
+- download: true parallel *ranged* GETs against the presigned URL — the
+  reference only ever read Parts[0] (extension_s3.go:28-36), so large-blob
+  download parallelism never actually existed there.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import BinaryIO, Callable
+
+import requests
+
+from modelx_tpu import errors
+from modelx_tpu.client.extension import http_upload, register_extension
+from modelx_tpu.types import BlobLocation, Descriptor
+
+# extension_s3.go:17-20 fixes these at 3; larger keeps the pipe full on
+# object stores that shard by range
+UPLOAD_PART_CONCURRENCY = 8
+DOWNLOAD_PART_CONCURRENCY = 8
+DOWNLOAD_RANGE_SIZE = 32 * 1024 * 1024
+_RANGED_THRESHOLD = 64 * 1024 * 1024
+
+
+class S3Extension:
+    def upload(
+        self,
+        location: BlobLocation,
+        desc: Descriptor,
+        reader: BinaryIO,
+        progress: Callable[[int], None] | None = None,
+    ) -> None:
+        props = location.properties
+        parts = props.get("parts")
+        if not parts:
+            # single presigned PUT
+            http_upload(props["url"], reader, method="PUT", progress=progress)
+            return
+        lock = threading.Lock()
+
+        def upload_part(part: dict) -> None:
+            if part.get("done"):
+                if progress:
+                    progress(part["length"])
+                return  # resume: server already has this part
+            with lock:
+                reader.seek(part["offset"])
+                data = reader.read(part["length"])
+            http_upload(part["url"], data, method="PUT", retries=3)
+            if progress:
+                progress(len(data))
+
+        with ThreadPoolExecutor(max_workers=UPLOAD_PART_CONCURRENCY) as pool:
+            list(pool.map(upload_part, parts))  # propagates first error
+
+    def download(
+        self,
+        location: BlobLocation,
+        desc: Descriptor,
+        writer: BinaryIO,
+        progress: Callable[[int], None] | None = None,
+    ) -> None:
+        url = location.properties["url"]
+        size = int(location.properties.get("size", 0) or desc.size or 0)
+        seekable = hasattr(writer, "seek") and _is_seekable(writer)
+        if size < _RANGED_THRESHOLD or not seekable:
+            _stream_get(url, writer, progress)
+            return
+        # parallel ranged GETs into a preallocated file
+        writer.seek(size - 1)
+        writer.write(b"\0")
+        lock = threading.Lock()
+        ranges = [
+            (off, min(DOWNLOAD_RANGE_SIZE, size - off))
+            for off in range(0, size, DOWNLOAD_RANGE_SIZE)
+        ]
+
+        range_ignored = threading.Event()
+
+        def fetch(rng: tuple[int, int]) -> None:
+            off, ln = rng
+            last: Exception | None = None
+            for _ in range(3):
+                if range_ignored.is_set():
+                    return
+                try:
+                    r = requests.get(
+                        url, headers={"Range": f"bytes={off}-{off + ln - 1}"}, timeout=300
+                    )
+                    if r.status_code == 200:
+                        # endpoint ignored Range (plain file server / stripping
+                        # proxy): bail out and re-download via streaming
+                        r.close()
+                        range_ignored.set()
+                        return
+                    if r.status_code >= 400:
+                        raise errors.ErrorInfo.decode(r.content, r.status_code)
+                    data = r.content
+                    if len(data) != ln:
+                        raise OSError(f"range {off}-{off + ln - 1}: got {len(data)} bytes")
+                    with lock:
+                        writer.seek(off)
+                        writer.write(data)
+                    if progress:
+                        progress(len(data))
+                    return
+                except (errors.ErrorInfo, requests.RequestException, OSError) as e:
+                    last = e
+            assert last is not None
+            raise last
+
+        with ThreadPoolExecutor(max_workers=DOWNLOAD_PART_CONCURRENCY) as pool:
+            list(pool.map(fetch, ranges))
+        if range_ignored.is_set():
+            writer.seek(0)
+            writer.truncate()
+            _stream_get(url, writer, progress)
+
+
+def _is_seekable(writer) -> bool:
+    try:
+        return writer.seekable()
+    except AttributeError:
+        return False
+
+
+def _stream_get(url: str, writer, progress) -> None:
+    with requests.get(url, stream=True, timeout=300) as r:
+        if r.status_code >= 400:
+            raise errors.ErrorInfo.decode(r.content, r.status_code)
+        for chunk in r.iter_content(chunk_size=1024 * 1024):
+            writer.write(chunk)
+            if progress:
+                progress(len(chunk))
+
+
+register_extension("s3", S3Extension())

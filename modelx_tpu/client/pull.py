@@ -17,7 +17,6 @@ download never actually happened there).
 
 from __future__ import annotations
 
-import hashlib
 import os
 import tempfile
 from typing import Callable
@@ -33,6 +32,42 @@ from modelx_tpu.types import (
     Manifest,
     MediaTypeModelDirectoryTarGz,
 )
+
+
+class _HashingFile:
+    """Seekable file wrapper that hashes writes as long as they stay
+    sequential; any seek/truncate invalidates the running hash (the ranged
+    downloader will seek, sequential streams will not)."""
+
+    def __init__(self, f) -> None:
+        self._f = f
+        self._hasher = __import__("hashlib").sha256()
+        self._pos = 0
+        self._dirty = False
+
+    def write(self, data: bytes) -> int:
+        if not self._dirty:
+            self._hasher.update(data)
+            self._pos += len(data)
+        return self._f.write(data)
+
+    def seek(self, offset: int, whence: int = 0):
+        if not (whence == 0 and offset == self._pos):
+            self._dirty = True
+        return self._f.seek(offset, whence)
+
+    def truncate(self, *a):
+        self._dirty = True
+        return self._f.truncate(*a)
+
+    def seekable(self) -> bool:
+        return True
+
+    def tell(self) -> int:
+        return self._f.tell()
+
+    def digest(self) -> str | None:
+        return None if self._dirty else "sha256:" + self._hasher.hexdigest()
 
 
 class Puller:
@@ -74,19 +109,16 @@ class Puller:
             bar.done("up-to-date")  # hash-skip (pull.go:111-127)
             return
         os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
-        # download to a temp path, verify digest, then atomic rename
+        # download to a temp path (seekable, so the s3 extension can fan out
+        # ranged GETs), verify digest, then atomic rename
         fd, tmp = tempfile.mkstemp(dir=directory, prefix=".pull-")
         try:
             with os.fdopen(fd, "wb") as f:
-                hasher = hashlib.sha256()
-
-                class _Verify:
-                    def write(self, data: bytes) -> int:
-                        hasher.update(data)
-                        return f.write(data)
-
-                self._download_blob(repository, desc, _Verify(), bar.update)
-            got = "sha256:" + hasher.hexdigest()
+                hf = _HashingFile(f)
+                self._download_blob(repository, desc, hf, bar.update)
+            # sequential downloads hashed inline for free; out-of-order
+            # (ranged) downloads need a post-hoc re-read
+            got = hf.digest() or str(Digest.from_file(tmp))
             if got != desc.digest:
                 raise ValueError(f"digest mismatch for {desc.name}: got {got}, want {desc.digest}")
             os.chmod(tmp, desc.mode or 0o644)  # mkstemp gives 0600; don't keep it

