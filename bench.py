@@ -1,0 +1,170 @@
+"""Benchmark: registry -> TPU HBM load throughput (the BASELINE metric).
+
+Stands up a local registry, pushes a synthetic llama-shaped bf16 checkpoint,
+then measures:
+
+- baseline: the reference's deployment shape — download the blob to a pod
+  volume as one sequential stream (modelxdl semantics), then read it and
+  device_put tensor-by-tensor;
+- modelx-tpu: the loader path — parallel ranged HTTP reads planned from the
+  manifest's tensor index, streamed straight into device memory.
+
+Prints ONE JSON line: {"metric", "value" (GB/s into HBM), "unit",
+"vs_baseline" (speedup over the sequential path), ...extras}.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def build_checkpoint(path: str, target_bytes: int) -> int:
+    """Synthetic llama-shaped checkpoint (bf16) of roughly target_bytes."""
+    import ml_dtypes
+
+    from modelx_tpu.dl import safetensors as st
+
+    rng = np.random.RandomState(0)
+    hidden, inter, vocab = 2048, 5632, 32000
+    tensors: dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": rng.rand(vocab, hidden).astype(ml_dtypes.bfloat16),
+        "model.norm.weight": np.ones((hidden,), ml_dtypes.bfloat16),
+    }
+    layer_bytes = 2 * (4 * hidden * hidden + 3 * hidden * inter + 2 * hidden)
+    base = 2 * vocab * hidden
+    layers = max(1, (target_bytes - base) // layer_bytes)
+    for i in range(layers):
+        p = f"model.layers.{i}."
+        tensors[p + "self_attn.q_proj.weight"] = rng.rand(hidden, hidden).astype(ml_dtypes.bfloat16)
+        tensors[p + "self_attn.k_proj.weight"] = rng.rand(hidden, hidden).astype(ml_dtypes.bfloat16)
+        tensors[p + "self_attn.v_proj.weight"] = rng.rand(hidden, hidden).astype(ml_dtypes.bfloat16)
+        tensors[p + "self_attn.o_proj.weight"] = rng.rand(hidden, hidden).astype(ml_dtypes.bfloat16)
+        tensors[p + "mlp.gate_proj.weight"] = rng.rand(inter, hidden).astype(ml_dtypes.bfloat16)
+        tensors[p + "mlp.up_proj.weight"] = rng.rand(inter, hidden).astype(ml_dtypes.bfloat16)
+        tensors[p + "mlp.down_proj.weight"] = rng.rand(hidden, inter).astype(ml_dtypes.bfloat16)
+        tensors[p + "input_layernorm.weight"] = np.ones((hidden,), ml_dtypes.bfloat16)
+        tensors[p + "post_attention_layernorm.weight"] = np.ones((hidden,), ml_dtypes.bfloat16)
+    st.write_safetensors(path, tensors)
+    return os.path.getsize(path)
+
+
+def main() -> None:
+    import jax
+
+    from modelx_tpu.client.client import Client
+    from modelx_tpu.client.helper import descriptor_for_file
+    from modelx_tpu.client.push import _annotate_safetensors
+    from modelx_tpu.dl import safetensors as st
+    from modelx_tpu.dl.loader import HTTPSource, LocalFileSource, load_safetensors
+    from modelx_tpu.dl.sharding import LLAMA_RULES
+    from modelx_tpu.parallel.mesh import make_mesh
+    from modelx_tpu.registry.server import free_port
+    from modelx_tpu.types import Manifest
+
+    devices = jax.devices()
+    workdir = tempfile.mkdtemp(prefix="modelx-bench-")
+    try:
+        # -- build + push ------------------------------------------------------
+        ckpt = os.path.join(workdir, "model.safetensors")
+        target = int(os.environ.get("BENCH_BYTES", 512 * 1024 * 1024))
+        size = build_checkpoint(ckpt, target)
+
+        import subprocess
+
+        port = free_port()
+        base = f"http://127.0.0.1:{port}"
+        env = dict(os.environ, PYTHONPATH=os.path.dirname(os.path.abspath(__file__)), JAX_PLATFORMS="cpu")
+        srv = subprocess.Popen(
+            [sys.executable, "-m", "modelx_tpu.cli", "serve",
+             "--listen", f"127.0.0.1:{port}",
+             "--data", os.path.join(workdir, "registry")],
+            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        import requests as _rq
+
+        for _ in range(50):
+            try:
+                _rq.get(base + "/healthz", timeout=1)
+                break
+            except Exception:
+                time.sleep(0.2)
+        client = Client(base, quiet=True)
+
+        desc = descriptor_for_file(ckpt, "model.safetensors", "application/vnd.modelx.model.file.v1")
+        _annotate_safetensors(ckpt, desc)
+        with open(ckpt, "rb") as f:
+            client.remote.upload_blob_content("library/bench", desc, f)
+        client.remote.put_manifest("library/bench", "v1", Manifest(blobs=[desc]))
+
+        url = f"{base}/library/bench/blobs/{desc.digest}"
+        mesh = make_mesh(f"dp={len(devices)}")
+        tensors, data_offset = st.read_header_from_file(ckpt)
+
+        # warm up the device transfer path so neither side pays setup costs
+        warm = jax.device_put(np.zeros(8 << 20, np.uint8), devices[0])
+        warm.block_until_ready()
+        del warm
+
+        # -- baseline: sequential download to volume, then load ---------------
+        t0 = time.monotonic()
+        vol = os.path.join(workdir, "volume.safetensors")
+        import requests
+
+        with requests.get(url, stream=True) as r, open(vol, "wb") as f:
+            for chunk in r.iter_content(chunk_size=1024 * 1024):
+                f.write(chunk)
+        arrays = []
+        with open(vol, "rb") as f:
+            infos, off = st.read_header(f)
+            for name, info in infos.items():
+                f.seek(off + info.start)
+                raw = f.read(info.nbytes)
+                arr = np.frombuffer(raw, info.np_dtype()).reshape(info.shape)
+                arrays.append(jax.device_put(arr, devices[0]))
+        jax.block_until_ready(arrays)
+        baseline_s = time.monotonic() - t0
+        del arrays
+
+        # -- modelx-tpu loader: ranged parallel -> HBM ------------------------
+        t0 = time.monotonic()
+        loaded, stats = load_safetensors(
+            HTTPSource(url, total=size), mesh, LLAMA_RULES,
+            tensors=tensors, data_offset=data_offset,
+        )
+        ours_s = time.monotonic() - t0
+        del loaded
+
+        ours_gbps = size / ours_s / 1e9
+        baseline_gbps = size / baseline_s / 1e9
+        srv.terminate()
+
+        print(
+            json.dumps(
+                {
+                    "metric": "registry_to_hbm_gbps",
+                    "value": round(ours_gbps, 3),
+                    "unit": "GB/s",
+                    "vs_baseline": round(ours_gbps / baseline_gbps, 3),
+                    "baseline_gbps": round(baseline_gbps, 3),
+                    "bytes": size,
+                    "seconds": round(ours_s, 3),
+                    "baseline_seconds": round(baseline_s, 3),
+                    "device": str(devices[0]),
+                    "n_devices": len(devices),
+                }
+            )
+        )
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

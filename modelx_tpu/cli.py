@@ -299,7 +299,10 @@ def cmd_dl(uri: str, dest: str, device_put: bool, mesh: str) -> None:
     try:
         from modelx_tpu.dl.initializer import run_initializer
 
-        run_initializer(uri, dest, device_put=device_put, mesh_spec=mesh)
+        summary = run_initializer(uri, dest, device_put=device_put, mesh_spec=mesh)
+        if "load" in summary:
+            summary["load"] = {k: v for k, v in summary["load"].items() if k != "arrays"}
+        click.echo(json.dumps(summary))
     except (errors.ErrorInfo, ValueError) as e:
         _fail(e)
 

@@ -85,6 +85,13 @@ class S3Extension:
         ]
 
         range_ignored = threading.Event()
+        reported = [0]
+
+        def report(n: int) -> None:
+            if progress:
+                with lock:
+                    reported[0] += n
+                progress(n)
 
         def fetch(rng: tuple[int, int]) -> None:
             off, ln = rng
@@ -93,25 +100,25 @@ class S3Extension:
                 if range_ignored.is_set():
                     return
                 try:
-                    r = requests.get(
-                        url, headers={"Range": f"bytes={off}-{off + ln - 1}"}, timeout=300
-                    )
-                    if r.status_code == 200:
-                        # endpoint ignored Range (plain file server / stripping
-                        # proxy): bail out and re-download via streaming
-                        r.close()
-                        range_ignored.set()
-                        return
-                    if r.status_code >= 400:
-                        raise errors.ErrorInfo.decode(r.content, r.status_code)
-                    data = r.content
+                    # stream=True: inspect the status BEFORE buffering the
+                    # body — a Range-ignoring endpoint answers 200 with the
+                    # whole blob, which must not be read into RAM here
+                    with requests.get(
+                        url, headers={"Range": f"bytes={off}-{off + ln - 1}"},
+                        timeout=300, stream=True,
+                    ) as r:
+                        if r.status_code == 200:
+                            range_ignored.set()
+                            return
+                        if r.status_code >= 400:
+                            raise errors.ErrorInfo.decode(r.content, r.status_code)
+                        data = r.content
                     if len(data) != ln:
                         raise OSError(f"range {off}-{off + ln - 1}: got {len(data)} bytes")
                     with lock:
                         writer.seek(off)
                         writer.write(data)
-                    if progress:
-                        progress(len(data))
+                    report(len(data))
                     return
                 except (errors.ErrorInfo, requests.RequestException, OSError) as e:
                     last = e
@@ -121,6 +128,8 @@ class S3Extension:
         with ThreadPoolExecutor(max_workers=DOWNLOAD_PART_CONCURRENCY) as pool:
             list(pool.map(fetch, ranges))
         if range_ignored.is_set():
+            if progress and reported[0]:
+                progress(-reported[0])  # rewind the bar; re-streaming from 0
             writer.seek(0)
             writer.truncate()
             _stream_get(url, writer, progress)

@@ -17,6 +17,7 @@ download never actually happened there).
 
 from __future__ import annotations
 
+import hashlib
 import os
 import tempfile
 from typing import Callable
@@ -41,7 +42,7 @@ class _HashingFile:
 
     def __init__(self, f) -> None:
         self._f = f
-        self._hasher = __import__("hashlib").sha256()
+        self._hasher = hashlib.sha256()
         self._pos = 0
         self._dirty = False
 

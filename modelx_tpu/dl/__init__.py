@@ -1,0 +1,10 @@
+"""Deploy-time loading: registry -> TPU HBM.
+
+The TPU-native replacement for the reference's modelxdl (cmd/modelxdl) and
+the north-star surface of this framework (BASELINE.md): manifests carry
+shard-layout annotations; the loader plans per-shard byte ranges from the
+safetensors tensor index, fetches exactly those bytes (ranged HTTP GETs or
+local preads), and materializes `jax.Array`s directly on a
+`jax.sharding.Mesh` via `jax.make_array_from_callback` — each device shard
+reads only its own bytes, so a multi-host pull moves each byte once.
+"""

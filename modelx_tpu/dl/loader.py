@@ -1,0 +1,333 @@
+"""The registry -> TPU HBM loader (the BASELINE metric lives here).
+
+Pipeline: tensor index (from the ``modelx.tensor.index`` manifest annotation
+or the safetensors header) -> per-tensor shard plan against the target
+`Mesh` + partition rules -> parallel ranged reads (HTTP Range against the
+registry/presigned URL, or local pread) -> `jax.Array` assembly via
+`jax.make_array_from_single_device_arrays`, so each device shard is built
+from exactly the bytes it needs and host->device copies overlap the fetches.
+
+Fetch planning:
+
+- tensors sharded on their leading axis (the common case for the big
+  matmul weights) fetch **only each shard's rows** — a host never pulls
+  bytes for devices it doesn't own (SURVEY.md §7 'aligning blob byte-ranges
+  with shard slices so each host fetches exactly its bytes once');
+- tensors sharded on inner axes or replicated fetch once per host and are
+  sliced in memory (an inner-axis shard is byte-strided; one contiguous read
+  beats thousands of tiny ranged reads).
+
+Reference parity: this replaces cmd/modelxdl's "download files into a pod
+volume, let a GPU container mmap them" with "bytes land in HBM, laid out for
+GSPMD" (BASELINE.json north_star).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Protocol
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from modelx_tpu.dl import safetensors as st
+from modelx_tpu.dl.sharding import Rules, sharding_for
+
+DEFAULT_FETCH_CONCURRENCY = 16
+
+
+class ByteSource(Protocol):
+    """Anything that serves ranged reads of a safetensors blob.
+
+    ``read_range(offset, length, out=None)``: when ``out`` (a writable
+    length-sized memoryview) is given, bytes land directly in it — the
+    loader passes views over numpy-owned allocations, because jax's
+    host->device fast path wants aligned, array-owned buffers (device_put
+    from bytearray-backed arrays measured 3.5x slower on the TPU tunnel).
+    """
+
+    def read_range(self, offset: int, length: int, out: memoryview | None = None): ...
+
+    def size(self) -> int: ...
+
+
+class LocalFileSource:
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._size = os.path.getsize(path)
+        self._fd = os.open(path, os.O_RDONLY)
+
+    def read_range(self, offset: int, length: int, out: memoryview | None = None):
+        if out is None:
+            buf = np.empty(length, np.uint8)
+            out = memoryview(buf)
+        else:
+            buf = out
+        n = 0
+        while n < length:
+            got = os.preadv(self._fd, [out[n:]], offset + n)
+            if got <= 0:
+                break
+            n += got
+        if n != length:
+            raise OSError(f"short read: want {length}, got {n}")
+        return buf
+
+    def size(self) -> int:
+        return self._size
+
+    def close(self) -> None:
+        os.close(self._fd)
+
+
+class HTTPSource:
+    """Ranged GETs against a URL (registry blob endpoint or presigned S3).
+
+    Built on raw ``http.client`` with ``readinto`` and one persistent
+    connection per thread: the requests/urllib3 stack tops out around
+    0.1-0.4 GB/s because it shuttles 10 KB chunks through Python, which
+    would throttle the whole registry->HBM path (measured: this
+    implementation sustains >1 GB/s per stream against the local registry).
+    """
+
+    def __init__(self, url: str, headers: dict[str, str] | None = None, total: int = -1) -> None:
+        import urllib.parse
+
+        self.url = url
+        self.headers = headers or {}
+        u = urllib.parse.urlsplit(url)
+        self._scheme = u.scheme
+        self._host = u.hostname or ""
+        self._port = u.port
+        self._path = u.path + (f"?{u.query}" if u.query else "")
+        self._netloc = u.netloc
+        self._local = threading.local()
+        self._size = total
+
+    def _conn(self):
+        import http.client
+
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            cls = http.client.HTTPSConnection if self._scheme == "https" else http.client.HTTPConnection
+            conn = cls(self._host, self._port, timeout=300)
+            self._local.conn = conn
+        return conn
+
+    def _request(self, method: str, headers: dict[str, str]):
+        conn = self._conn()
+        try:
+            conn.request(method, self._path, headers=headers)
+            return conn.getresponse()
+        except (OSError, __import__("http.client", fromlist=["HTTPException"]).HTTPException):
+            # stale keep-alive connection: rebuild once
+            conn.close()
+            self._local.conn = None
+            conn = self._conn()
+            conn.request(method, self._path, headers=headers)
+            return conn.getresponse()
+
+    def read_range(self, offset: int, length: int, out: memoryview | None = None):
+        h = dict(self.headers)
+        h["Range"] = f"bytes={offset}-{offset + length - 1}"
+        resp = self._request("GET", h)
+        try:
+            if resp.status not in (200, 206):
+                body = resp.read(4096)
+                raise OSError(f"ranged read failed: HTTP {resp.status}: {body[:200]!r}")
+            if resp.status == 200:  # server ignored Range
+                data = resp.read()
+                data = data[offset : offset + length]
+                if out is not None:
+                    out[:] = data
+                    return out
+                return data
+            if out is None:
+                buf = np.empty(length, np.uint8)
+                view = memoryview(buf)
+            else:
+                buf, view = out, out
+            n = 0
+            while n < length:
+                got = resp.readinto(view[n:])
+                if not got:
+                    break
+                n += got
+            if n != length:
+                raise OSError(f"ranged read short: want {length}, got {n}")
+            return buf
+        finally:
+            # drain so the keep-alive connection stays usable
+            resp.read()
+
+    def size(self) -> int:
+        if self._size < 0:
+            resp = self._request("HEAD", dict(self.headers))
+            resp.read()
+            self._size = int(resp.headers.get("Content-Length", -1))
+        return self._size
+
+
+@dataclasses.dataclass
+class LoadStats:
+    bytes_fetched: int = 0
+    bytes_to_device: int = 0
+    tensors: int = 0
+    fetch_seconds: float = 0.0
+    total_seconds: float = 0.0
+
+    @property
+    def gbps(self) -> float:
+        return self.bytes_to_device / max(self.total_seconds, 1e-9) / 1e9
+
+
+def _leading_axis_only(spec: PartitionSpec) -> bool:
+    if len(spec) == 0 or spec[0] is None:
+        return False
+    return all(s is None for s in spec[1:])
+
+
+def load_safetensors(
+    source: ByteSource,
+    mesh: Mesh,
+    rules: Rules,
+    tensors: dict[str, st.TensorInfo] | None = None,
+    data_offset: int | None = None,
+    concurrency: int = DEFAULT_FETCH_CONCURRENCY,
+    dtype=None,
+    progress: Callable[[int], None] | None = None,
+) -> tuple[dict[str, jax.Array], LoadStats]:
+    """Load every tensor of a safetensors blob onto ``mesh`` per ``rules``.
+
+    ``tensors``/``data_offset`` come from the manifest annotation when
+    available; otherwise the header is fetched with two small ranged reads.
+    ``dtype`` optionally casts on the host before transfer (halves PCIe bytes
+    when serving bf16 from an f32 checkpoint).
+    """
+    t0 = time.monotonic()
+    if tensors is None or data_offset is None:
+        head = bytes(source.read_range(0, 8))
+        import struct
+
+        (hlen,) = struct.unpack("<Q", head)
+        tensors = st.parse_header(bytes(source.read_range(8, hlen)))
+        data_offset = 8 + hlen
+
+    stats = LoadStats()
+    lock = threading.Lock()
+    devices_by_shard: dict[str, list] = {}
+    results: dict[str, jax.Array] = {}
+
+    # plan: one job per (tensor, shard-group). A shard-group is the set of
+    # devices that receive identical bytes (replicas); bytes are fetched once
+    # per group and device_put to each member.
+    jobs: list[tuple[st.TensorInfo, NamedSharding, int, tuple]] = []
+    plans: dict[str, tuple[NamedSharding, list]] = {}
+    for name, info in tensors.items():
+        sharding = sharding_for(name, rules, mesh)
+        # index per device: mapping device -> tuple of slices
+        dev_indices = sharding.addressable_devices_indices_map(info.shape)
+        groups: dict[tuple, list] = {}
+        for dev, idx in dev_indices.items():
+            key = _index_key(idx, info.shape)
+            groups.setdefault(key, []).append((dev, idx))
+        plans[name] = (sharding, list(groups.values()))
+
+    # whole-tensor fetches are deduped across shard-groups of the same tensor
+    _full_cache: dict[str, bytes] = {}
+    _full_lock = threading.Lock()
+
+    def _cached_full_tensor(info: st.TensorInfo) -> bytes:
+        with _full_lock:
+            cached = _full_cache.get(info.name)
+        if cached is not None:
+            return cached
+        raw = source.read_range(data_offset + info.start, info.nbytes)
+        with _full_lock:
+            _full_cache[info.name] = raw
+        return raw
+
+    def fetch_group(info: st.TensorInfo, group: list) -> list:
+        """Fetch one shard-group's bytes and start the host->device copy in
+        this worker thread (transfers overlap other groups' fetches).
+        Returns [(device, on-device shard), ...]."""
+        _dev0, idx0 = group[0]
+        np_dtype = info.np_dtype()
+        full_spec = _normalize_index(idx0, info.shape)
+        # inner dims full => the shard is a contiguous row block, fetchable
+        # with one ranged read of exactly its bytes
+        inner_full = all(
+            s.start == 0 and s.stop == dim
+            for s, dim in zip(full_spec[1:], info.shape[1:])
+        )
+        tf0 = time.monotonic()
+        if info.shape and inner_full:
+            lead = full_spec[0]
+            start, stop = lead.start, lead.stop
+            b0, b1 = st.row_range(info, start, stop)
+            raw = source.read_range(data_offset + b0, b1 - b0)
+            shard_shape = (stop - start, *info.shape[1:])
+            arr = _as_np(raw, np_dtype, shard_shape)
+        else:
+            # inner-axis shard (byte-strided): fetch whole tensor once, slice
+            raw = _cached_full_tensor(info)
+            arr = _as_np(raw, np_dtype, info.shape)
+            arr = np.ascontiguousarray(arr[idx0]) if info.shape else arr.reshape(())
+        with lock:
+            stats.bytes_fetched += len(raw)
+            stats.fetch_seconds += time.monotonic() - tf0
+        if dtype is not None and arr.dtype != np.dtype(dtype):
+            arr = arr.astype(dtype)
+        if progress:
+            progress(arr.nbytes * len(group))
+        return [(dev, jax.device_put(arr, dev)) for dev, _ in group]
+
+    with ThreadPoolExecutor(max_workers=concurrency) as pool:
+        futures = {}
+        # big tensors first: their fetch+transfer dominates the critical path
+        for name, info in sorted(tensors.items(), key=lambda kv: -kv[1].nbytes):
+            _sharding, groups = plans[name]
+            futures[name] = [pool.submit(fetch_group, info, g) for g in groups]
+        for name, info in tensors.items():
+            sharding, _groups = plans[name]
+            shards = []
+            for fut in futures[name]:
+                shards.extend(arr for _dev, arr in fut.result())
+            global_shape = info.shape if info.shape else ()
+            target_dtype = np.dtype(dtype) if dtype is not None else info.np_dtype()
+            results[name] = jax.make_array_from_single_device_arrays(
+                global_shape, sharding, shards
+            )
+            stats.tensors += 1
+            stats.bytes_to_device += int(np.prod(info.shape or (1,))) * target_dtype.itemsize
+        _full_cache.clear()
+
+    for arr in results.values():
+        arr.block_until_ready()
+    stats.total_seconds = time.monotonic() - t0
+    return results, stats
+
+
+def _as_np(raw, np_dtype, shape) -> np.ndarray:
+    """View raw bytes (np.uint8 array or bytes) as a typed array, zero-copy."""
+    if isinstance(raw, np.ndarray):
+        return raw.view(np_dtype).reshape(shape)
+    return np.frombuffer(raw, dtype=np_dtype).reshape(shape)
+
+
+def _normalize_index(idx: tuple, shape: tuple) -> tuple:
+    out = []
+    for s, dim in zip(idx, shape):
+        start = s.start or 0
+        stop = s.stop if s.stop is not None else dim
+        out.append(slice(start, stop))
+    return tuple(out)
+
+
+def _index_key(idx: tuple, shape: tuple) -> tuple:
+    return tuple((s.start or 0, s.stop if s.stop is not None else dim) for s, dim in zip(idx, shape))

@@ -1,0 +1,84 @@
+"""Deploy-spec generation: TPU serving pods with zero GPU containers.
+
+The reference deploys models by injecting modelxdl as an init-container next
+to a GPU serving container (docs/setup.md; charts/modelx). The TPU-native
+replacement generates a pod spec whose init-container is `modelx dl` and
+whose serving container is the JAX/PJRT sidecar — resource requests name TPU
+topology (``google.com/tpu``), never ``nvidia.com/gpu`` (BASELINE.json
+north_star: 'zero GPU containers in the generated pod spec').
+"""
+
+from __future__ import annotations
+
+import yaml
+
+from modelx_tpu.client.model_config import ModelConfig
+
+# topology -> (chips per host, k8s accelerator selector)
+TPU_TOPOLOGIES = {
+    "v5e-1": (1, "tpu-v5-lite-podslice"),
+    "v5e-4": (4, "tpu-v5-lite-podslice"),
+    "v5e-8": (8, "tpu-v5-lite-podslice"),
+    "v5e-16": (8, "tpu-v5-lite-podslice"),
+    "v5p-8": (4, "tpu-v5p-slice"),
+    "v5p-32": (4, "tpu-v5p-slice"),
+}
+
+
+def generate_pod_spec(
+    name: str,
+    uri: str,
+    config: ModelConfig,
+    image: str = "modelx-tpu:latest",
+    volume_size: str = "100Gi",
+) -> dict:
+    topology = config.serving.topology or "v5e-8"
+    chips, selector = TPU_TOPOLOGIES.get(topology, (8, "tpu-v5-lite-podslice"))
+    mesh = config.serving.mesh or f"dp=1,tp={chips}"
+    spec = {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {"name": name, "labels": {"app": name, "modelx.io/model": name}},
+        "spec": {
+            "nodeSelector": {"cloud.google.com/gke-tpu-accelerator": selector},
+            "initContainers": [
+                {
+                    "name": "modelx-dl",
+                    "image": image,
+                    "command": ["modelx", "dl", uri, "/mnt/models"],
+                    "volumeMounts": [{"name": "model", "mountPath": "/mnt/models"}],
+                }
+            ],
+            "containers": [
+                {
+                    "name": "serve",
+                    "image": image,
+                    "command": [
+                        "modelx-serve",
+                        "--model-dir", "/mnt/models",
+                        "--mesh", mesh,
+                        "--dtype", config.serving.dtype or "bfloat16",
+                    ],
+                    "ports": [{"containerPort": 8000, "name": "http"}],
+                    "resources": {
+                        "limits": {"google.com/tpu": str(chips)},
+                        "requests": {"google.com/tpu": str(chips)},
+                    },
+                    "volumeMounts": [{"name": "model", "mountPath": "/mnt/models"}],
+                    "readinessProbe": {
+                        "httpGet": {"path": "/healthz", "port": 8000},
+                        "initialDelaySeconds": 5,
+                    },
+                }
+            ],
+            "volumes": [{"name": "model", "emptyDir": {"sizeLimit": volume_size}}],
+        },
+    }
+    return spec
+
+
+def assert_no_gpu(spec: dict) -> None:
+    """The north-star invariant, checkable in tests and CI."""
+    text = yaml.safe_dump(spec)
+    if "nvidia.com/gpu" in text or "gpu" in str(spec.get("spec", {}).get("nodeSelector", {})):
+        raise AssertionError("generated pod spec references GPUs")

@@ -1,0 +1,160 @@
+"""safetensors format: header parsing, range planning, and writing.
+
+The format is trivially range-friendly — 8-byte LE header length, JSON header
+mapping tensor name -> {dtype, shape, data_offsets:[start,end]} (offsets
+relative to the end of the header), then raw little-endian tensor bytes.
+That property is what makes "stream shards straight into HBM" possible: a
+tensor's bytes (or any slice of rows) live at a computable byte range.
+
+Implemented directly (no safetensors-library dependency on the load path) so
+reads can be planned and fetched rangewise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import struct
+from typing import Any, BinaryIO
+
+import numpy as np
+
+try:  # bundled with jax; needed for bfloat16/fp8 numpy views
+    import ml_dtypes
+except ImportError:  # pragma: no cover
+    ml_dtypes = None
+
+_DTYPES: dict[str, Any] = {
+    "F64": np.float64,
+    "F32": np.float32,
+    "F16": np.float16,
+    "I64": np.int64,
+    "I32": np.int32,
+    "I16": np.int16,
+    "I8": np.int8,
+    "U8": np.uint8,
+    "BOOL": np.bool_,
+}
+if ml_dtypes is not None:
+    _DTYPES["BF16"] = ml_dtypes.bfloat16
+    _DTYPES["F8_E4M3"] = ml_dtypes.float8_e4m3fn
+    _DTYPES["F8_E5M2"] = ml_dtypes.float8_e5m2
+
+_DTYPE_NAMES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+@dataclasses.dataclass
+class TensorInfo:
+    name: str
+    dtype: str  # safetensors dtype tag, e.g. "BF16"
+    shape: tuple[int, ...]
+    start: int  # byte offsets relative to data section start
+    end: int
+
+    @property
+    def nbytes(self) -> int:
+        return self.end - self.start
+
+    def np_dtype(self):
+        try:
+            return np.dtype(_DTYPES[self.dtype])
+        except KeyError:
+            raise ValueError(f"unsupported safetensors dtype {self.dtype!r} for {self.name}") from None
+
+
+def parse_header(header_bytes: bytes) -> dict[str, TensorInfo]:
+    d = json.loads(header_bytes)
+    out: dict[str, TensorInfo] = {}
+    for name, info in d.items():
+        if name == "__metadata__":
+            continue
+        out[name] = TensorInfo(
+            name=name,
+            dtype=info["dtype"],
+            shape=tuple(info["shape"]),
+            start=info["data_offsets"][0],
+            end=info["data_offsets"][1],
+        )
+    return out
+
+
+def read_header(reader: BinaryIO) -> tuple[dict[str, TensorInfo], int]:
+    """Returns (tensors, data_offset) — data_offset is the absolute file
+    offset where tensor data begins."""
+    prefix = reader.read(8)
+    if len(prefix) != 8:
+        raise ValueError("truncated safetensors file")
+    (header_len,) = struct.unpack("<Q", prefix)
+    if header_len > 512 * 1024 * 1024:
+        raise ValueError(f"implausible safetensors header length {header_len}")
+    header = reader.read(header_len)
+    return parse_header(header), 8 + header_len
+
+
+def read_header_from_file(path: str) -> tuple[dict[str, TensorInfo], int]:
+    with open(path, "rb") as f:
+        return read_header(f)
+
+
+def write_safetensors(path: str, tensors: dict[str, np.ndarray], metadata: dict[str, str] | None = None) -> None:
+    """Write a safetensors file (used by push-side conversion, tests, bench)."""
+    header: dict[str, Any] = {}
+    if metadata:
+        header["__metadata__"] = metadata
+    offset = 0
+    arrays = []
+    for name, arr in tensors.items():
+        arr = np.ascontiguousarray(arr)
+        tag = _DTYPE_NAMES.get(arr.dtype)
+        if tag is None:
+            raise ValueError(f"unsupported numpy dtype {arr.dtype} for {name}")
+        n = arr.nbytes
+        header[name] = {
+            "dtype": tag,
+            "shape": list(arr.shape),
+            "data_offsets": [offset, offset + n],
+        }
+        arrays.append(arr)
+        offset += n
+    hjson = json.dumps(header, separators=(",", ":")).encode()
+    # pad header to 8-byte alignment (spec recommendation)
+    pad = (8 - len(hjson) % 8) % 8
+    hjson += b" " * pad
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(hjson)))
+        f.write(hjson)
+        for arr in arrays:
+            f.write(arr.tobytes())
+
+
+def tensor_index_annotation(tensors: dict[str, TensorInfo], data_offset: int) -> str:
+    """Serialize the header as the ``modelx.tensor.index`` blob annotation."""
+    index = {
+        name: {"dtype": t.dtype, "shape": list(t.shape), "data_offsets": [t.start, t.end]}
+        for name, t in tensors.items()
+    }
+    return json.dumps({"data_offset": data_offset, "tensors": index}, sort_keys=True)
+
+
+def parse_index_annotation(payload: str) -> tuple[dict[str, TensorInfo], int]:
+    d = json.loads(payload)
+    tensors = {}
+    for name, info in d["tensors"].items():
+        tensors[name] = TensorInfo(
+            name=name,
+            dtype=info["dtype"],
+            shape=tuple(info["shape"]),
+            start=info["data_offsets"][0],
+            end=info["data_offsets"][1],
+        )
+    return tensors, int(d["data_offset"])
+
+
+def row_range(t: TensorInfo, row_start: int, row_stop: int) -> tuple[int, int]:
+    """Byte range (relative to data section) covering rows [row_start,row_stop)
+    of the tensor's leading axis — the unit of shard-aligned fetching."""
+    if not t.shape:
+        return t.start, t.end
+    rows = t.shape[0]
+    row_bytes = (t.end - t.start) // max(rows, 1)
+    return t.start + row_start * row_bytes, t.start + row_stop * row_bytes

@@ -1,0 +1,182 @@
+"""JAX serving sidecar: the container the pod spec runs next to the volume.
+
+Replaces the reference deployment's GPU serving container (BASELINE.json
+north_star). Loads a checkpoint (local dir or registry URI) onto a mesh,
+compiles the forward/decode functions, and serves:
+
+    GET  /healthz          readiness (200 once compiled)
+    GET  /metrics          load + inference counters
+    POST /v1/forward       {"tokens": [[...]]} -> {"logits_argmax": [[...]]}
+    POST /v1/generate      {"tokens": [[...]], "max_new_tokens": N}
+                           -> {"tokens": [[prompt+generated...]]}
+
+Token IDs in, token IDs out — tokenization is the caller's concern (the
+registry stores tokenizer files alongside weights; wiring a tokenizer in is
+deployment glue, not framework).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from modelx_tpu.dl.sharding import LLAMA_RULES
+from modelx_tpu.models import llama
+from modelx_tpu.parallel.mesh import make_mesh
+
+logger = logging.getLogger("modelx.serve")
+
+
+class ModelServer:
+    def __init__(
+        self,
+        model_dir: str,
+        mesh_spec: str = "",
+        dtype: str = "bfloat16",
+        config: llama.LlamaConfig | None = None,
+        max_seq_len: int = 2048,
+    ) -> None:
+        self.model_dir = model_dir
+        self.mesh = make_mesh(mesh_spec) if mesh_spec else make_mesh(f"dp={len(jax.devices())}")
+        self.dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
+        self.max_seq_len = max_seq_len
+        self.ready = False
+        self.stats: dict = {"requests": 0, "tokens_generated": 0}
+        self.cfg = config
+        self.params: dict | None = None
+
+    def load(self) -> dict:
+        """Load every *.safetensors under model_dir onto the mesh."""
+        from modelx_tpu.dl.loader import LocalFileSource, load_safetensors
+
+        t0 = time.monotonic()
+        paths = sorted(glob.glob(os.path.join(self.model_dir, "*.safetensors")))
+        if not paths:
+            raise FileNotFoundError(f"no safetensors under {self.model_dir}")
+        params: dict = {}
+        total = 0
+        for path in paths:
+            arrays, stats = load_safetensors(LocalFileSource(path), self.mesh, LLAMA_RULES)
+            params.update(arrays)
+            total += stats.bytes_to_device
+        self.params = params
+        if self.cfg is None:
+            self.cfg = infer_llama_config(params)
+        seconds = time.monotonic() - t0
+        self.stats["load_seconds"] = round(seconds, 3)
+        self.stats["load_bytes"] = total
+        self.stats["load_gbps"] = round(total / max(seconds, 1e-9) / 1e9, 3)
+        self._compile()
+        self.ready = True
+        return dict(self.stats)
+
+    def _compile(self) -> None:
+        cfg, mesh = self.cfg, self.mesh
+        self._forward = jax.jit(
+            lambda p, t: llama.forward(p, t, cfg, mesh=mesh)[0]
+        )
+
+    def forward_argmax(self, tokens: np.ndarray) -> np.ndarray:
+        logits = self._forward(self.params, jnp.asarray(tokens, jnp.int32))
+        return np.asarray(jnp.argmax(logits, axis=-1))
+
+    def generate(self, tokens: np.ndarray, max_new_tokens: int = 16) -> np.ndarray:
+        out = llama.greedy_generate(
+            self.params, jnp.asarray(tokens, jnp.int32), self.cfg,
+            max_new_tokens=max_new_tokens, mesh=self.mesh,
+        )
+        self.stats["tokens_generated"] += int(out.shape[0] * max_new_tokens)
+        return np.asarray(out)
+
+
+def infer_llama_config(params: dict) -> llama.LlamaConfig:
+    """Recover the architecture from checkpoint tensor shapes."""
+    embed = params["model.embed_tokens.weight"]
+    vocab, hidden = embed.shape
+    layers = 0
+    while f"model.layers.{layers}.self_attn.q_proj.weight" in params:
+        layers += 1
+    q = params["model.layers.0.self_attn.q_proj.weight"].shape[0]
+    kv = params["model.layers.0.self_attn.k_proj.weight"].shape[0]
+    inter = params["model.layers.0.mlp.gate_proj.weight"].shape[0]
+    # head_dim heuristics: llama uses 128 for big models; fall back to h/32
+    head_dim = 128 if q % 128 == 0 and q // 128 >= 8 else max(q // 32, 32)
+    if hidden <= 512:  # toy checkpoints
+        head_dim = 32
+    return llama.LlamaConfig(
+        vocab_size=vocab,
+        hidden_size=hidden,
+        intermediate_size=inter,
+        num_layers=layers,
+        num_heads=q // head_dim,
+        num_kv_heads=kv // head_dim,
+        head_dim=head_dim,
+        tie_embeddings="lm_head.weight" not in params,
+    )
+
+
+def serve(server: ModelServer, listen: str = ":8000") -> ThreadingHTTPServer:
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _json(self, status: int, obj) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                if server.ready:
+                    self._json(200, {"status": "ok"})
+                else:
+                    self._json(503, {"status": "loading"})
+            elif self.path == "/metrics":
+                self._json(200, server.stats)
+            else:
+                self._json(404, {"error": "not found"})
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            try:
+                req = json.loads(self.rfile.read(length))
+                tokens = np.asarray(req["tokens"], np.int32)
+            except (ValueError, KeyError) as e:
+                return self._json(400, {"error": f"bad request: {e}"})
+            if not server.ready:
+                return self._json(503, {"error": "still loading"})
+            server.stats["requests"] += 1
+            try:
+                if self.path == "/v1/forward":
+                    out = server.forward_argmax(tokens)
+                    self._json(200, {"logits_argmax": out.tolist()})
+                elif self.path == "/v1/generate":
+                    n = int(req.get("max_new_tokens", 16))
+                    out = server.generate(tokens, max_new_tokens=n)
+                    self._json(200, {"tokens": out.tolist()})
+                else:
+                    self._json(404, {"error": "not found"})
+            except Exception as e:  # surface inference errors as 500 JSON
+                logger.exception("inference error")
+                self._json(500, {"error": str(e)})
+
+    host, _, port = listen.rpartition(":")
+    httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)), Handler)
+    httpd.daemon_threads = True
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    return httpd

@@ -1,0 +1,117 @@
+"""Shard-layout annotations: tensor-name -> PartitionSpec rules.
+
+The manifest's ``modelx.shard.spec`` annotation carries a JSON list of
+``[regex, partition_spec]`` rules (first match wins), where partition_spec is
+a list with one entry per tensor dimension: an axis name ("tp"), a list of
+axis names, or null for replicated. This is the registry-storable form of a
+GSPMD layout — the t5x/maxtext logical-axis-rules idea flattened onto
+checkpoint tensor names.
+
+Default rule sets for the model families live here too, so a checkpoint
+pushed without annotations still loads sharded.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Sequence
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+Rules = list[tuple[str, list]]
+
+
+def encode_rules(rules: Rules) -> str:
+    return json.dumps([[pattern, spec] for pattern, spec in rules])
+
+
+def decode_rules(payload: str) -> Rules:
+    return [(pattern, spec) for pattern, spec in json.loads(payload)]
+
+
+def spec_for(name: str, rules: Rules) -> PartitionSpec:
+    """First-match-wins lookup of a tensor's PartitionSpec."""
+    for pattern, spec in rules:
+        if re.search(pattern, name):
+            return PartitionSpec(*[tuple(s) if isinstance(s, list) else s for s in spec])
+    return PartitionSpec()
+
+
+def sharding_for(name: str, rules: Rules, mesh: Mesh) -> NamedSharding:
+    spec = spec_for(name, rules)
+    # drop axis names the mesh doesn't have (e.g. tp rules on a dp-only mesh)
+    cleaned = []
+    for entry in spec:
+        if entry is None:
+            cleaned.append(None)
+        elif isinstance(entry, tuple):
+            kept = tuple(a for a in entry if a in mesh.axis_names)
+            cleaned.append(kept if kept else None)
+        else:
+            cleaned.append(entry if entry in mesh.axis_names else None)
+    return NamedSharding(mesh, PartitionSpec(*cleaned))
+
+
+# -- default rule sets --------------------------------------------------------
+
+# Llama-family (HF safetensors names). Megatron-style: attention q/k/v and
+# ffn up/gate column-parallel (shard dim 0, the output features), o_proj and
+# down_proj row-parallel (shard dim 1), embeddings sharded over vocab.
+LLAMA_RULES: Rules = [
+    (r"embed_tokens\.weight$", ["tp", None]),
+    (r"lm_head\.weight$", ["tp", None]),
+    (r"(q|k|v)_proj\.weight$", ["tp", None]),
+    (r"o_proj\.weight$", [None, "tp"]),
+    (r"(gate|up)_proj\.weight$", ["tp", None]),
+    (r"down_proj\.weight$", [None, "tp"]),
+    (r"norm\.weight$", [None]),
+    (r".*", []),
+]
+
+# GPT-2 (HF names; Conv1D weights are [in, out] so column-parallel = dim 1).
+GPT2_RULES: Rules = [
+    (r"wte\.weight$", ["tp", None]),
+    (r"wpe\.weight$", [None, None]),
+    (r"c_attn\.weight$", [None, "tp"]),
+    (r"c_attn\.bias$", ["tp"]),
+    (r"attn\.c_proj\.weight$", ["tp", None]),
+    (r"c_fc\.weight$", [None, "tp"]),
+    (r"c_fc\.bias$", ["tp"]),
+    (r"mlp\.c_proj\.weight$", ["tp", None]),
+    (r".*", []),
+]
+
+# BERT (HF names).
+BERT_RULES: Rules = [
+    (r"word_embeddings\.weight$", ["tp", None]),
+    (r"(query|key|value)\.weight$", ["tp", None]),
+    (r"(query|key|value)\.bias$", ["tp"]),
+    (r"attention\.output\.dense\.weight$", [None, "tp"]),
+    (r"intermediate\.dense\.weight$", ["tp", None]),
+    (r"intermediate\.dense\.bias$", ["tp"]),
+    (r"output\.dense\.weight$", [None, "tp"]),
+    (r".*", []),
+]
+
+DEFAULT_RULES: dict[str, Rules] = {
+    "llama": LLAMA_RULES,
+    "gpt2": GPT2_RULES,
+    "bert": BERT_RULES,
+}
+
+
+def rules_for_family(family: str) -> Rules:
+    return DEFAULT_RULES.get(family, [(r".*", [])])
+
+
+def infer_family(tensor_names: Sequence[str]) -> str:
+    names = list(tensor_names)
+    joined = "\n".join(names)
+    if "q_proj" in joined or "gate_proj" in joined:
+        return "llama"
+    if "c_attn" in joined or "wte" in joined:
+        return "gpt2"
+    if "word_embeddings" in joined:
+        return "bert"
+    return ""

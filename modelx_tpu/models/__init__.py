@@ -1,0 +1,7 @@
+"""Model families for the serve path. Params are flat dicts keyed by the
+family's native safetensors tensor names, so registry checkpoints load
+directly (no renaming pass)."""
+
+from modelx_tpu.models.llama import LlamaConfig
+
+__all__ = ["LlamaConfig"]

@@ -1,0 +1,310 @@
+"""Llama-family decoder (the flagship serve/train model).
+
+Pure-functional JAX: params are a flat dict keyed by HF safetensors names
+("model.layers.N.self_attn.q_proj.weight", ...) so checkpoints pulled from
+the registry load directly onto a mesh (dl/loader.py + dl/sharding.py
+LLAMA_RULES) with no renaming.
+
+TPU-first choices:
+
+- everything runs in bfloat16 with fp32 accumulation in the matmuls
+  (preferred_element_type) — MXU-native;
+- attention dispatches to the pallas flash kernel on TPU, ring attention
+  when a sequence-parallel axis is present, reference jnp otherwise;
+- activation shardings are asserted with with_sharding_constraint using the
+  standard megatron layout: batch over dp, sequence over sp, heads/ffn over
+  tp — XLA inserts the all-reduces (psum over tp after o_proj/down_proj)
+  itself, which is exactly the GSPMD contract (scaling-book recipe);
+- no data-dependent Python control flow in the forward; decode uses a
+  static-shape KV cache updated with dynamic_update_slice.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from modelx_tpu.ops import attention as attn_ops
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rope_theta: float = 500000.0
+    rms_eps: float = 1e-5
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @classmethod
+    def llama3_8b(cls) -> "LlamaConfig":
+        return cls()
+
+    @classmethod
+    def llama3_70b(cls) -> "LlamaConfig":
+        return cls(
+            hidden_size=8192, intermediate_size=28672, num_layers=80,
+            num_heads=64, num_kv_heads=8,
+        )
+
+    @classmethod
+    def tiny(cls, vocab_size: int = 512) -> "LlamaConfig":
+        """Test/dry-run config: real structure, toy sizes."""
+        return cls(
+            vocab_size=vocab_size, hidden_size=128, intermediate_size=256,
+            num_layers=2, num_heads=4, num_kv_heads=2, head_dim=32,
+            rope_theta=10000.0,
+        )
+
+
+# -- params -------------------------------------------------------------------
+
+
+def param_names(cfg: LlamaConfig) -> list[str]:
+    names = ["model.embed_tokens.weight", "model.norm.weight"]
+    if not cfg.tie_embeddings:
+        names.append("lm_head.weight")
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        names += [
+            p + "self_attn.q_proj.weight",
+            p + "self_attn.k_proj.weight",
+            p + "self_attn.v_proj.weight",
+            p + "self_attn.o_proj.weight",
+            p + "mlp.gate_proj.weight",
+            p + "mlp.up_proj.weight",
+            p + "mlp.down_proj.weight",
+            p + "input_layernorm.weight",
+            p + "post_attention_layernorm.weight",
+        ]
+    return names
+
+
+def param_shapes(cfg: LlamaConfig) -> dict[str, tuple[int, ...]]:
+    """HF layout: linear weights are [out_features, in_features]."""
+    e, q = cfg.hidden_size, cfg.num_heads * cfg.head_dim
+    kv = cfg.num_kv_heads * cfg.head_dim
+    f = cfg.intermediate_size
+    shapes: dict[str, tuple[int, ...]] = {
+        "model.embed_tokens.weight": (cfg.vocab_size, e),
+        "model.norm.weight": (e,),
+    }
+    if not cfg.tie_embeddings:
+        shapes["lm_head.weight"] = (cfg.vocab_size, e)
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        shapes.update(
+            {
+                p + "self_attn.q_proj.weight": (q, e),
+                p + "self_attn.k_proj.weight": (kv, e),
+                p + "self_attn.v_proj.weight": (kv, e),
+                p + "self_attn.o_proj.weight": (e, q),
+                p + "mlp.gate_proj.weight": (f, e),
+                p + "mlp.up_proj.weight": (f, e),
+                p + "mlp.down_proj.weight": (e, f),
+                p + "input_layernorm.weight": (e,),
+                p + "post_attention_layernorm.weight": (e,),
+            }
+        )
+    return shapes
+
+
+def init_params(cfg: LlamaConfig, key: jax.Array, dtype=None) -> dict[str, jax.Array]:
+    dtype = dtype or cfg.dtype
+    shapes = param_shapes(cfg)
+    params: dict[str, jax.Array] = {}
+    keys = jax.random.split(key, len(shapes))
+    for (name, shape), k in zip(sorted(shapes.items()), keys):
+        if name.endswith("layernorm.weight") or name.endswith("norm.weight"):
+            params[name] = jnp.ones(shape, dtype)
+        else:
+            fan_in = shape[-1]
+            params[name] = (jax.random.normal(k, shape) / math.sqrt(fan_in)).astype(dtype)
+    return params
+
+
+# -- forward ------------------------------------------------------------------
+
+
+def _rms_norm(x, weight, eps: float):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight
+
+
+def _rope(x, positions, theta: float):
+    """Rotary embeddings. x: [B, S, H, D], positions: [B, S]."""
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _linear(x, w):
+    """x @ w.T with fp32 accumulation (HF weight layout [out, in])."""
+    return jax.lax.dot_general(
+        x, w, (((x.ndim - 1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingCtx:
+    """Activation-sharding constraints; None mesh = no constraints."""
+
+    mesh: Mesh | None = None
+
+    def constrain(self, x, *spec):
+        if self.mesh is None:
+            return x
+        names = set(self.mesh.axis_names)
+        cleaned = []
+        for dim, s in zip(x.shape, spec):
+            # drop axes the mesh lacks or that don't divide the dim (e.g. GQA
+            # kv heads smaller than tp)
+            if s in names and dim % self.mesh.shape[s] == 0:
+                cleaned.append(s)
+            else:
+                cleaned.append(None)
+        return jax.lax.with_sharding_constraint(x, NamedSharding(self.mesh, P(*cleaned)))
+
+
+def forward(
+    params: dict[str, jax.Array],
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    positions: jax.Array | None = None,
+    kv_cache: dict | None = None,
+    cache_offset: int | jax.Array = 0,
+    mesh: Mesh | None = None,
+    attention_impl: str = "auto",
+) -> tuple[jax.Array, dict | None]:
+    """Returns (logits [B,S,V], updated kv_cache).
+
+    Prefill: kv_cache=None. Decode: pass the cache and the current offset;
+    tokens is [B, 1].
+    """
+    ctx = ShardingCtx(mesh)
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.arange(s)[None, :] + (
+            cache_offset if kv_cache is not None else 0
+        )
+        positions = jnp.broadcast_to(positions, (b, s))
+
+    x = jnp.take(params["model.embed_tokens.weight"], tokens, axis=0).astype(cfg.dtype)
+    x = ctx.constrain(x, "dp", "sp", None)
+
+    new_cache: dict | None = {} if kv_cache is not None else None
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        h = _rms_norm(x, params[p + "input_layernorm.weight"], cfg.rms_eps)
+        q = _linear(h, params[p + "self_attn.q_proj.weight"])
+        k = _linear(h, params[p + "self_attn.k_proj.weight"])
+        v = _linear(h, params[p + "self_attn.v_proj.weight"])
+        q = ctx.constrain(q.reshape(b, s, cfg.num_heads, cfg.head_dim), "dp", "sp", "tp", None)
+        k = ctx.constrain(k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim), "dp", "sp", "tp", None)
+        v = ctx.constrain(v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim), "dp", "sp", "tp", None)
+        q = ctx.constrain(_rope(q, positions, cfg.rope_theta), "dp", "sp", "tp", None)
+        k = ctx.constrain(_rope(k, positions, cfg.rope_theta), "dp", "sp", "tp", None)
+
+        if kv_cache is not None:
+            ck, cv = kv_cache[f"k{i}"], kv_cache[f"v{i}"]
+            ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_offset, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_offset, 0, 0))
+            new_cache[f"k{i}"], new_cache[f"v{i}"] = ck, cv
+            attn_out = _attend(q, ck, cv, cfg, causal=True,
+                               q_offset=cache_offset, mesh=mesh, impl="reference")
+        else:
+            attn_out = _attend(q, k, v, cfg, causal=True, q_offset=0, mesh=mesh, impl=attention_impl)
+
+        attn_out = attn_out.reshape(b, s, cfg.num_heads * cfg.head_dim)
+        x = x + _linear(attn_out, params[p + "self_attn.o_proj.weight"])
+        x = ctx.constrain(x, "dp", "sp", None)
+
+        h = _rms_norm(x, params[p + "post_attention_layernorm.weight"], cfg.rms_eps)
+        gate = _linear(h, params[p + "mlp.gate_proj.weight"])
+        up = _linear(h, params[p + "mlp.up_proj.weight"])
+        ff = ctx.constrain(jax.nn.silu(gate) * up, "dp", "sp", "tp")
+        x = x + _linear(ff, params[p + "mlp.down_proj.weight"])
+        x = ctx.constrain(x, "dp", "sp", None)
+
+    x = _rms_norm(x, params["model.norm.weight"], cfg.rms_eps)
+    head = params.get("lm_head.weight", params["model.embed_tokens.weight"])
+    logits = _linear(x, head)
+    return ctx.constrain(logits, "dp", "sp", None), new_cache
+
+
+def _attend(q, k, v, cfg: LlamaConfig, causal: bool, q_offset, mesh, impl: str):
+    """q: [B,S,H,D], k/v: [B,S(,kv)...]. Transposes to [B,H,S,D] and picks
+    the attention implementation."""
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    if impl == "auto":
+        if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+            impl = "ring"
+        elif jax.default_backend() == "tpu":
+            impl = "flash"
+        else:
+            impl = "reference"
+    if impl == "ring":
+        out = attn_ops.ring_attention(qt, kt, vt, mesh, axis="sp", causal=causal)
+    elif impl == "flash":
+        out = attn_ops.flash_attention(qt, kt, vt, causal=causal)
+    else:
+        out = attn_ops.attention_reference(qt, kt, vt, causal=causal, q_offset=q_offset)
+    return out.transpose(0, 2, 1, 3)
+
+
+# -- kv cache + greedy decode -------------------------------------------------
+
+
+def init_kv_cache(cfg: LlamaConfig, batch: int, max_len: int, dtype=None) -> dict:
+    dtype = dtype or cfg.dtype
+    cache = {}
+    for i in range(cfg.num_layers):
+        shape = (batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+        cache[f"k{i}"] = jnp.zeros(shape, dtype)
+        cache[f"v{i}"] = jnp.zeros(shape, dtype)
+    return cache
+
+
+def greedy_generate(
+    params: dict[str, jax.Array],
+    prompt: jax.Array,  # [B, S]
+    cfg: LlamaConfig,
+    max_new_tokens: int = 16,
+    mesh: Mesh | None = None,
+) -> jax.Array:
+    """Greedy decode with a static-shape KV cache (lax.scan over steps)."""
+    b, s = prompt.shape
+    max_len = s + max_new_tokens
+    cache = init_kv_cache(cfg, b, max_len)
+    logits, cache = forward(params, prompt, cfg, kv_cache=cache, cache_offset=0, mesh=mesh)
+    next_tok = jnp.argmax(logits[:, -1, :], axis=-1)[:, None]  # [B,1]
+
+    def step(carry, i):
+        cache, tok, offset = carry
+        logits, cache = forward(params, tok, cfg, kv_cache=cache, cache_offset=offset, mesh=mesh)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1)[:, None]
+        return (cache, nxt, offset + 1), tok[:, 0]
+
+    (_, last, _), toks = jax.lax.scan(
+        step, (cache, next_tok, jnp.int32(s)), jnp.arange(max_new_tokens - 1)
+    )
+    generated = jnp.concatenate([toks.T, last], axis=1)  # [B, max_new_tokens]
+    return jnp.concatenate([prompt, generated], axis=1)

@@ -1,0 +1,1 @@
+"""TPU compute ops: attention kernels (reference, pallas flash, ring)."""

@@ -1,0 +1,207 @@
+"""Attention: reference implementation, a Pallas TPU flash kernel, and ring
+attention for sequence/context parallelism.
+
+TPU-first design notes (pallas_guide.md):
+
+- the flash kernel tiles q into VMEM blocks and streams k/v blocks,
+  carrying the online-softmax (m, l, acc) state so HBM traffic is O(n)
+  per q block instead of materializing the n×n score matrix;
+- block sizes are multiples of the (8/16, 128) tile constraints, and the
+  matmuls are shaped to land on the 128×128 MXU in fp32 accumulation;
+- ring attention (long-context, first-class per the build brief) shards
+  the sequence across the ``sp`` mesh axis with `shard_map`; each step
+  computes local flash statistics against the resident k/v block and
+  `ppermute`s k/v around the ring, so peak memory per device is
+  O(seq/sp_devices) and comms ride ICI neighbor links.
+
+All three paths compute the same math; tests cross-check them (CPU uses
+interpret mode for the pallas kernel).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+# -- reference (jnp) ----------------------------------------------------------
+
+
+def attention_reference(q, k, v, causal: bool = True, q_offset: int = 0):
+    """Plain softmax(QK^T/sqrt(d))V. Shapes: [B, H, S, D] (kv may have fewer
+    heads than q — GQA — as long as H % Hkv == 0)."""
+    q, k, v = _repeat_kv_heads(q, k, v)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        qlen, klen = q.shape[2], k.shape[2]
+        qpos = jnp.arange(qlen)[:, None] + q_offset
+        kpos = jnp.arange(klen)[None, :]
+        logits = jnp.where(kpos <= qpos, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
+
+
+def _repeat_kv_heads(q, k, v):
+    if k.shape[1] != q.shape[1]:
+        rep = q.shape[1] // k.shape[1]
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    return q, k, v
+
+
+# -- pallas flash kernel ------------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sm_scale: float):
+    """One (batch*head, q-block) program: online softmax over k/v blocks.
+
+    q_ref: [block_q, d], k_ref/v_ref: [seq_k, d], o_ref: [block_q, d].
+    """
+    block_q, d = q_ref.shape
+    seq_k = k_ref.shape[0]
+    q_idx = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * sm_scale
+
+    def body(start_k, carry):
+        acc, m_prev, l_prev = carry
+        k_blk = k_ref[pl.ds(start_k * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[pl.ds(start_k * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [block_q, block_k]
+        if causal:
+            qpos = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            kpos = start_k * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return acc, m_new, l_new
+
+    num_k = seq_k // block_k
+    if causal:
+        # skip fully-masked k blocks beyond this q block
+        num_k = jnp.minimum(num_k, (q_idx + 1) * block_q // block_k + (block_q // block_k > 0))
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc, _m, l = jax.lax.fori_loop(0, num_k, body, (acc0, m0, l0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128, interpret: bool | None = None):
+    """Flash attention via pallas. q/k/v: [B, H, S, D] (GQA allowed).
+
+    Falls back to interpret mode automatically off-TPU so the same call site
+    works in CPU tests (pallas_guide.md: interpret=True for debugging).
+    """
+    q, k, v = _repeat_kv_heads(q, k, v)
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        return attention_reference(q, k, v, causal=causal)  # ragged fallback
+    sm_scale = 1.0 / math.sqrt(d)
+
+    qr = q.reshape(b * h, sq, d)
+    kr = k.reshape(b * h, sk, d)
+    vr = v.reshape(b * h, sk, d)
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale),
+        grid=(b * h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(b, h, sq, d)
+
+
+# -- ring attention (sequence parallelism) ------------------------------------
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True):
+    """Ring attention over a sequence-sharded mesh axis.
+
+    q/k/v: [B, H, S, D] *globally*; S is sharded over ``axis``. Each device
+    holds S/n local tokens, computes flash statistics against its resident
+    k/v shard, then rotates k/v around the ring with ppermute (n-1 hops),
+    merging online-softmax partials — numerically identical to full
+    attention but with O(S/n) memory and neighbor-only ICI traffic.
+    """
+    n = mesh.shape[axis]
+
+    def local_fn(q_blk, k_blk, v_blk):
+        idx = jax.lax.axis_index(axis)
+        s_local = q_blk.shape[2]
+        q_start = idx * s_local
+
+        def step(i, carry):
+            acc, m_prev, l_prev, k_cur, v_cur = carry
+            src = jax.lax.rem(idx - i + n, n)  # whose kv block we hold now
+            k_start = src * s_local
+            acc, m_prev, l_prev = _merge_block(
+                q_blk, k_cur, v_cur, acc, m_prev, l_prev,
+                q_offset=q_start, k_offset=k_start, causal=causal,
+            )
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            k_nxt = jax.lax.ppermute(k_cur, axis, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis, perm)
+            return acc, m_prev, l_prev, k_nxt, v_nxt
+
+        b, h, _s, d = q_blk.shape
+        hq = q_blk.shape[1]
+        acc0 = jnp.zeros((b, hq, s_local, d), jnp.float32)
+        m0 = jnp.full((b, hq, s_local), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, hq, s_local), jnp.float32)
+        acc, m, l, _k, _v = jax.lax.fori_loop(
+            0, n, step, (acc0, m0, l0, k_blk, v_blk), unroll=False
+        )
+        return (acc / jnp.maximum(l, 1e-30)[..., None]).astype(q_blk.dtype)
+
+    spec = P(None, None, axis, None)
+    return shard_map(
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)
+
+
+def _merge_block(q, k, v, acc, m_prev, l_prev, q_offset, k_offset, causal):
+    """Merge one k/v block into running flash statistics. All [B,H,S,D]."""
+    q32, k32, v32 = (x.astype(jnp.float32) for x in _repeat_kv_heads(q, k, v))
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q32, k32, preferred_element_type=jnp.float32) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(q.shape[2])[:, None]
+        kpos = k_offset + jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(kpos <= qpos, s, NEG_INF)
+    m_cur = jnp.max(s, axis=-1)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[..., None])
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+    acc_new = acc * alpha[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v32, preferred_element_type=jnp.float32
+    )
+    return acc_new, m_new, l_new

@@ -1,0 +1,29 @@
+"""Device-mesh construction and sharding conventions.
+
+The registry side of the framework stores mesh/layout *metadata*
+(SURVEY.md §2.2: DP/TP/... become mesh-axis metadata the registry stores and
+the loader honors); this package is where that metadata becomes a live
+`jax.sharding.Mesh` and `NamedSharding`s.
+"""
+
+from modelx_tpu.parallel.mesh import (
+    AXIS_BATCH,
+    AXIS_EXPERT,
+    AXIS_MODEL,
+    AXIS_SEQUENCE,
+    AXIS_STAGE,
+    MeshSpec,
+    make_mesh,
+    parse_mesh_spec,
+)
+
+__all__ = [
+    "AXIS_BATCH",
+    "AXIS_EXPERT",
+    "AXIS_MODEL",
+    "AXIS_SEQUENCE",
+    "AXIS_STAGE",
+    "MeshSpec",
+    "make_mesh",
+    "parse_mesh_spec",
+]

@@ -1,0 +1,97 @@
+"""Mesh spec parsing and `jax.sharding.Mesh` construction.
+
+Mesh specs are the string form stored in manifests / modelx.yaml
+(``modelx.shard.mesh`` annotation), e.g. ``"dp=2,tp=4"`` or
+``"dp=1,sp=2,tp=4"``. Axis-name conventions (scaling-book vocabulary):
+
+    dp — data parallel (batch)           ep — expert parallel (MoE)
+    tp — tensor/model parallel           pp — pipeline stage parallel
+    sp — sequence/context parallel
+
+A size of -1 means "absorb the remaining devices" (like a reshape).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_BATCH = "dp"
+AXIS_MODEL = "tp"
+AXIS_SEQUENCE = "sp"
+AXIS_EXPERT = "ep"
+AXIS_STAGE = "pp"
+
+KNOWN_AXES = (AXIS_BATCH, AXIS_STAGE, AXIS_EXPERT, AXIS_SEQUENCE, AXIS_MODEL)
+
+
+@dataclasses.dataclass
+class MeshSpec:
+    axes: dict[str, int]  # ordered: outermost (DCN-ish) first, tp innermost
+
+    def __str__(self) -> str:
+        return ",".join(f"{k}={v}" for k, v in self.axes.items())
+
+    @property
+    def size(self) -> int:
+        return math.prod(self.axes.values())
+
+
+def parse_mesh_spec(spec: str) -> MeshSpec:
+    """``"dp=2,tp=4"`` -> MeshSpec. Order in the string is mesh order; put
+    the most communication-hungry axis (tp) last so it lands on the
+    fastest/nearest ICI neighbors."""
+    axes: dict[str, int] = {}
+    if not spec.strip():
+        raise ValueError("empty mesh spec")
+    for part in spec.split(","):
+        name, _, size = part.strip().partition("=")
+        if not name or not size:
+            raise ValueError(f"bad mesh spec segment {part!r} (want name=size)")
+        try:
+            n = int(size)
+        except ValueError:
+            raise ValueError(f"bad mesh axis size {size!r}") from None
+        if n == 0 or n < -1:
+            raise ValueError(f"bad mesh axis size {n} for {name}")
+        if name in axes:
+            raise ValueError(f"duplicate mesh axis {name!r}")
+        axes[name] = n
+    if sum(1 for v in axes.values() if v == -1) > 1:
+        raise ValueError("at most one axis may be -1")
+    return MeshSpec(axes=axes)
+
+
+def make_mesh(spec: str | MeshSpec, devices=None) -> Mesh:
+    """Build a Mesh over the given (default: all) devices.
+
+    Axes with -1 absorb remaining devices; total must divide evenly.
+    """
+    if isinstance(spec, str):
+        spec = parse_mesh_spec(spec)
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    axes = dict(spec.axes)
+    fixed = math.prod(v for v in axes.values() if v != -1)
+    for name, v in axes.items():
+        if v == -1:
+            if n % fixed:
+                raise ValueError(f"{n} devices not divisible by {fixed} for axis {name!r}")
+            axes[name] = n // fixed
+            fixed = math.prod(axes.values())
+    total = math.prod(axes.values())
+    if total > n:
+        raise ValueError(f"mesh {spec} needs {total} devices, have {n}")
+    if total < n:
+        devices = devices[:total]  # smaller meshes use a device prefix
+    arr = np.array(devices).reshape(tuple(axes.values()))
+    return Mesh(arr, axis_names=tuple(axes.keys()))
+
+
+def single_device_mesh() -> Mesh:
+    """A 1×... mesh over one device (CPU tests / single-chip serve)."""
+    return Mesh(np.array(jax.devices()[:1]).reshape((1,)), axis_names=(AXIS_BATCH,))

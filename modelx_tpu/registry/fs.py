@@ -278,11 +278,17 @@ class LocalFSProvider:
 
 
 class _LimitedReader(io.RawIOBase):
-    """Read at most ``limit`` bytes from an underlying file, then EOF."""
+    """Read at most ``limit`` bytes from an underlying file, then EOF.
+
+    Exposes ``raw_file`` so the HTTP server can sendfile() the range."""
 
     def __init__(self, f: BinaryIO, limit: int) -> None:
         self._f = f
         self._remaining = limit
+
+    @property
+    def raw_file(self) -> BinaryIO:
+        return self._f
 
     def read(self, n: int = -1) -> bytes:  # type: ignore[override]
         if self._remaining <= 0:

@@ -407,10 +407,34 @@ class _Handler(BaseHTTPRequestHandler):
             self.wfile.write(body)
         else:
             try:
-                while chunk := body.read(1024 * 1024):
-                    self.wfile.write(chunk)
+                if not self._try_sendfile(body, resp.body_length):
+                    while chunk := body.read(4 * 1024 * 1024):
+                        self.wfile.write(chunk)
             finally:
                 body.close()
+
+    def _try_sendfile(self, body, length: int | None) -> bool:
+        """Zero-copy blob streaming: kernel sendfile from the store file to
+        the socket. Python write loops top out near ~1 GB/s per stream; the
+        registry->HBM path (BASELINE metric) needs better."""
+        if length is None or isinstance(self.connection, ssl.SSLSocket):
+            return False
+        f = getattr(body, "raw_file", body)
+        try:
+            fd = f.fileno()
+            offset = f.tell()
+        except (AttributeError, OSError, ValueError):
+            return False
+        self.wfile.flush()
+        import os as _os
+
+        sent_total = 0
+        while sent_total < length:
+            sent = _os.sendfile(self.connection.fileno(), fd, offset + sent_total, length - sent_total)
+            if sent == 0:
+                break
+            sent_total += sent
+        return True
 
     def _write_error(self, e: errors.ErrorInfo, head_only: bool = False) -> None:
         try:

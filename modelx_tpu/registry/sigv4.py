@@ -60,13 +60,17 @@ def _canonical_request(
     signed_headers: list[str],
     payload_hash: str,
 ) -> str:
+    """For S3, the canonical URI is the path exactly as sent on the wire
+    (already single-percent-encoded by the caller) — re-encoding here would
+    double-encode '%' and produce SignatureDoesNotMatch for any key with an
+    encodable character."""
     canon_headers = "".join(
         f"{h}:{' '.join(headers[h].split())}\n" for h in signed_headers
     )
     return "\n".join(
         [
             method,
-            _quote(path, safe="/-_.~"),
+            path or "/",
             canonical_query(query),
             canon_headers,
             ";".join(signed_headers),

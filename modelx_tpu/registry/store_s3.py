@@ -72,8 +72,9 @@ class S3RegistryStore(FSRegistryStore):
     """store_s3.go:26-29 — FSRegistryStore + presign. Accepts either a
     registry ``Options`` (server bootstrap) or an ``S3Options``."""
 
-    def __init__(self, opts, refresh_on_init: bool = True) -> None:
+    def __init__(self, opts, refresh_on_init: bool = True, enable_redirect: bool = True) -> None:
         if not isinstance(opts, S3Options):
+            enable_redirect = bool(getattr(opts, "enable_redirect", True))
             opts = S3Options(
                 url=opts.s3_url,
                 access_key=opts.s3_access_key,
@@ -82,6 +83,7 @@ class S3RegistryStore(FSRegistryStore):
                 region=opts.s3_region,
                 presign_expire_s=getattr(opts, "s3_presign_expire_s", 3600),
             )
+        self.enable_redirect = enable_redirect
         self.s3 = S3FSProvider(opts)
         self.client = self.s3.client
         super().__init__(self.s3, refresh_on_init=refresh_on_init)
@@ -94,7 +96,11 @@ class S3RegistryStore(FSRegistryStore):
     def get_blob_location(
         self, repository: str, digest: str, purpose: str, properties: dict[str, str]
     ) -> BlobLocation | None:
-        """store_s3.go:122-134."""
+        """store_s3.go:122-134. Returns None (client falls back to proxying
+        bytes through the registry) unless redirect is enabled — the
+        reference gates this the same way (store_fs.go:40, options.go:23)."""
+        if not self.enable_redirect:
+            return None
         key = self._blob_key(repository, digest)
         size = int(properties.get("size", 0) or 0)
         content_type = properties.get("mediaType", "") or "application/octet-stream"
@@ -151,7 +157,11 @@ class S3RegistryStore(FSRegistryStore):
         self, repository: str, reference: str, content_type: str, manifest: Manifest
     ) -> None:
         """store_s3.go:68-92 — before committing, finish multipart uploads and
-        verify blob sizes; a size mismatch deletes the bad blob and fails."""
+        verify blob sizes; a size mismatch quarantine-deletes the bad blob and
+        fails. Unlike the reference, a blob already referenced by a committed
+        manifest is never deleted — otherwise one bad descriptor from any
+        client with push rights could destroy blobs other versions depend on."""
+        in_use: set[str] | None = None
         for desc in manifest.all_descriptors():
             if not desc.digest:
                 continue
@@ -166,11 +176,29 @@ class S3RegistryStore(FSRegistryStore):
                 raise errors.manifest_blob_unknown(desc.digest) from None
             actual = int(head.get("Content-Length", 0) or 0)
             if desc.size and actual != desc.size:
-                self.client.delete_object(key)  # quarantine (store_s3.go:77-89)
+                if in_use is None:
+                    in_use = self._referenced_digests(repository)
+                if desc.digest not in in_use:
+                    self.client.delete_object(key)  # quarantine (store_s3.go:77-89)
                 raise errors.size_invalid(
                     f"blob {desc.digest}: expected {desc.size} bytes, stored {actual}"
                 )
         super().put_manifest(repository, reference, content_type, manifest)
+
+    def _referenced_digests(self, repository: str) -> set[str]:
+        """Digests referenced by any committed manifest of the repository."""
+        out: set[str] = set()
+        try:
+            idx = self.get_index(repository)
+        except errors.ErrorInfo:
+            return out
+        for entry in idx.manifests:
+            try:
+                m = self.get_manifest(repository, entry.name)
+            except errors.ErrorInfo:
+                continue
+            out.update(d.digest for d in m.all_descriptors() if d.digest)
+        return out
 
     def _complete_multipart(self, key: str, upload_id: str, expected_size: int, digest: str) -> None:
         """store_s3.go:136-190."""

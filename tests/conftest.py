@@ -6,7 +6,15 @@ vars must be set before jax is first imported anywhere in the test process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU even when the ambient env selects the TPU platform (bench.py is
+# the only TPU consumer; tests always run on the virtual 8-device CPU mesh).
+# The env var alone does not displace an already-registered TPU plugin in
+# this image, so also pin it via jax.config before any devices are created.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,206 @@
+"""TPU loader tests on a virtual 8-device CPU mesh (SURVEY.md §4: 'CPU-backend
+JAX tests with shard-layout fixtures, so no TPU is needed in CI')."""
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import PartitionSpec
+
+from modelx_tpu.dl import safetensors as st
+from modelx_tpu.dl.loader import LocalFileSource, load_safetensors
+from modelx_tpu.dl.sharding import (
+    LLAMA_RULES,
+    decode_rules,
+    encode_rules,
+    infer_family,
+    sharding_for,
+    spec_for,
+)
+from modelx_tpu.parallel.mesh import make_mesh, parse_mesh_spec
+
+
+class TestSafetensors:
+    def test_write_read_roundtrip(self, tmp_path):
+        path = str(tmp_path / "m.safetensors")
+        tensors = {
+            "a": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": np.ones((2,), dtype=np.int8),
+        }
+        st.write_safetensors(path, tensors, metadata={"format": "pt"})
+        infos, data_offset = st.read_header_from_file(path)
+        assert set(infos) == {"a", "b"}
+        assert infos["a"].shape == (3, 4)
+        assert infos["a"].dtype == "F32"
+        with open(path, "rb") as f:
+            f.seek(data_offset + infos["a"].start)
+            raw = f.read(infos["a"].nbytes)
+        assert np.frombuffer(raw, np.float32).reshape(3, 4).tolist() == tensors["a"].tolist()
+
+    def test_bf16(self, tmp_path):
+        import ml_dtypes
+
+        path = str(tmp_path / "bf.safetensors")
+        arr = np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16).reshape(2, 4)
+        st.write_safetensors(path, {"w": arr})
+        infos, _ = st.read_header_from_file(path)
+        assert infos["w"].dtype == "BF16"
+        assert infos["w"].nbytes == 16
+
+    def test_matches_official_safetensors_lib(self, tmp_path):
+        """Cross-check our writer against the official parser."""
+        from safetensors.numpy import load_file
+
+        path = str(tmp_path / "x.safetensors")
+        tensors = {"t": np.random.rand(4, 5).astype(np.float32)}
+        st.write_safetensors(path, tensors)
+        loaded = load_file(path)
+        np.testing.assert_array_equal(loaded["t"], tensors["t"])
+
+    def test_row_range(self):
+        info = st.TensorInfo(name="x", dtype="F32", shape=(10, 4), start=100, end=100 + 160)
+        b0, b1 = st.row_range(info, 2, 5)
+        assert (b0, b1) == (100 + 2 * 16, 100 + 5 * 16)
+
+    def test_index_annotation_roundtrip(self, tmp_path):
+        path = str(tmp_path / "m.safetensors")
+        st.write_safetensors(path, {"a": np.zeros((2, 2), np.float32)})
+        infos, off = st.read_header_from_file(path)
+        payload = st.tensor_index_annotation(infos, off)
+        infos2, off2 = st.parse_index_annotation(payload)
+        assert off2 == off and infos2["a"].shape == (2, 2)
+
+
+class TestMesh:
+    def test_parse(self):
+        spec = parse_mesh_spec("dp=2,tp=4")
+        assert spec.axes == {"dp": 2, "tp": 4}
+        assert spec.size == 8
+        assert str(spec) == "dp=2,tp=4"
+
+    def test_parse_errors(self):
+        for bad in ("", "dp", "dp=x", "dp=0", "dp=2,dp=2", "dp=-1,tp=-1"):
+            with pytest.raises(ValueError):
+                parse_mesh_spec(bad)
+
+    def test_make_mesh_8_devices(self):
+        mesh = make_mesh("dp=2,tp=4")
+        assert mesh.shape == {"dp": 2, "tp": 4}
+
+    def test_make_mesh_wildcard(self):
+        mesh = make_mesh("dp=2,tp=-1")
+        assert mesh.shape == {"dp": 2, "tp": 4}
+
+    def test_make_mesh_wrong_size(self):
+        with pytest.raises(ValueError):
+            make_mesh("dp=3,tp=3")
+
+
+class TestShardingRules:
+    def test_llama_rules(self):
+        assert spec_for("model.layers.0.self_attn.q_proj.weight", LLAMA_RULES) == PartitionSpec("tp", None)
+        assert spec_for("model.layers.3.self_attn.o_proj.weight", LLAMA_RULES) == PartitionSpec(None, "tp")
+        assert spec_for("model.layers.0.input_layernorm.weight", LLAMA_RULES) == PartitionSpec(None)
+        assert spec_for("model.norm.weight", LLAMA_RULES) == PartitionSpec(None)
+        assert spec_for("lm_head.weight", LLAMA_RULES) == PartitionSpec("tp", None)
+
+    def test_encode_decode(self):
+        rules = [("q_proj", ["tp", None]), (".*", [])]
+        assert decode_rules(encode_rules(rules)) == [("q_proj", ["tp", None]), (".*", [])]
+
+    def test_unknown_axis_dropped(self):
+        mesh = make_mesh("dp=8")
+        s = sharding_for("model.layers.0.self_attn.q_proj.weight", LLAMA_RULES, mesh)
+        assert s.spec == PartitionSpec(None, None)
+
+    def test_infer_family(self):
+        assert infer_family(["model.layers.0.self_attn.q_proj.weight"]) == "llama"
+        assert infer_family(["h.0.attn.c_attn.weight", "wte.weight"]) == "gpt2"
+        assert infer_family(["bert.embeddings.word_embeddings.weight"]) == "bert"
+        assert infer_family(["mystery"]) == ""
+
+
+class TestLoader:
+    @pytest.fixture
+    def checkpoint(self, tmp_path):
+        rng = np.random.RandomState(0)
+        tensors = {
+            "model.layers.0.self_attn.q_proj.weight": rng.rand(32, 16).astype(np.float32),
+            "model.layers.0.self_attn.o_proj.weight": rng.rand(16, 32).astype(np.float32),
+            "model.norm.weight": rng.rand(16).astype(np.float32),
+            "scalar_step": np.array(7, dtype=np.int64),
+        }
+        path = str(tmp_path / "ckpt.safetensors")
+        st.write_safetensors(path, tensors)
+        return path, tensors
+
+    def test_load_onto_tp_mesh(self, checkpoint):
+        path, tensors = checkpoint
+        mesh = make_mesh("dp=2,tp=4")
+        arrays, stats = load_safetensors(LocalFileSource(path), mesh, LLAMA_RULES)
+        assert stats.tensors == 4
+        for name, expected in tensors.items():
+            got = np.asarray(arrays[name])
+            np.testing.assert_array_equal(got, expected)
+        # q_proj is column-parallel: each tp shard holds 32/4 rows
+        q = arrays["model.layers.0.self_attn.q_proj.weight"]
+        shard_shapes = {s.data.shape for s in q.addressable_shards}
+        assert shard_shapes == {(8, 16)}
+        # o_proj is row-parallel: shards split dim 1
+        o = arrays["model.layers.0.self_attn.o_proj.weight"]
+        assert {s.data.shape for s in o.addressable_shards} == {(16, 8)}
+
+    def test_leading_axis_fetches_only_shard_bytes(self, checkpoint):
+        """The multi-host story: row-sharded tensors read only their rows."""
+        path, tensors = checkpoint
+        mesh = make_mesh("tp=8")
+
+        reads = []
+
+        class SpySource(LocalFileSource):
+            def read_range(self, offset, length):
+                reads.append((offset, length))
+                return super().read_range(offset, length)
+
+        arrays, stats = load_safetensors(SpySource(path), mesh, LLAMA_RULES)
+        q = tensors["model.layers.0.self_attn.q_proj.weight"]
+        # q_proj (32x16 f32, 2048B) sharded 8-way -> 8 reads of 256B
+        q_reads = [l for _o, l in reads if l == 2048 // 8]
+        assert len(q_reads) == 8
+        np.testing.assert_array_equal(np.asarray(arrays["model.layers.0.self_attn.q_proj.weight"]), q)
+
+    def test_dtype_cast_on_host(self, checkpoint):
+        import ml_dtypes
+
+        path, tensors = checkpoint
+        mesh = make_mesh("dp=8")
+        arrays, _ = load_safetensors(LocalFileSource(path), mesh, LLAMA_RULES, dtype=ml_dtypes.bfloat16)
+        assert arrays["model.norm.weight"].dtype == jax.numpy.bfloat16.dtype
+
+    def test_http_source(self, checkpoint):
+        """Loader over the registry's ranged blob GET."""
+        from modelx_tpu.client.client import Client
+        from modelx_tpu.dl.loader import HTTPSource
+        from modelx_tpu.registry.fs import MemoryFSProvider
+        from modelx_tpu.registry.server import Options, RegistryServer, free_port
+        from modelx_tpu.registry.store_fs import FSRegistryStore
+        from modelx_tpu.types import Digest
+
+        path, tensors = checkpoint
+        srv = RegistryServer(Options(listen=f"127.0.0.1:{free_port()}"), store=FSRegistryStore(MemoryFSProvider()))
+        base = srv.serve_background()
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            digest = str(Digest.from_bytes(data))
+            import requests
+
+            requests.put(f"{base}/library/l/blobs/{digest}", data=data)
+            mesh = make_mesh("dp=2,tp=4")
+            src = HTTPSource(f"{base}/library/l/blobs/{digest}")
+            arrays, stats = load_safetensors(src, mesh, LLAMA_RULES)
+            for name, expected in tensors.items():
+                np.testing.assert_array_equal(np.asarray(arrays[name]), expected)
+            assert stats.gbps > 0
+        finally:
+            srv.shutdown()

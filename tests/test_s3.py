@@ -296,3 +296,46 @@ class TestTrueMultipart:
         with open(target, "wb") as f:
             ext.download(loc, Descriptor(name="x", digest=digest, size=len(data)), f)
         assert target.read_bytes() == data
+
+
+class TestReviewRegressions:
+    def test_canonical_path_not_double_encoded(self):
+        """Keys with encodable chars must be signed over the single-encoded
+        wire path (sigv4 canonical URI), not a double-encoded one."""
+        from modelx_tpu.registry.sigv4 import _canonical_request
+
+        creq = _canonical_request(
+            "GET", "/bucket/manifests/v1.0%2Brc1", {}, {"host": "h"}, ["host"], "UNSIGNED-PAYLOAD"
+        )
+        assert "/bucket/manifests/v1.0%2Brc1" in creq
+        assert "%252B" not in creq
+
+    def test_size_mismatch_never_deletes_referenced_blob(self, s3_opts):
+        """A bad descriptor in a new manifest must not destroy a blob that a
+        committed manifest depends on."""
+        from modelx_tpu.types import Manifest
+
+        store = S3RegistryStore(s3_opts)
+        data = b"shared blob content"
+        digest = str(Digest.from_bytes(data))
+        loc = store.get_blob_location(
+            "library/shared", digest, BlobLocationPurposeUpload, {"size": str(len(data))}
+        )
+        requests.put(loc.properties["url"], data=data)
+        good = Manifest(blobs=[Descriptor(name="w", digest=digest, size=len(data))])
+        store.put_manifest("library/shared", "v1", "", good)
+
+        from modelx_tpu import errors
+
+        bad = Manifest(blobs=[Descriptor(name="w", digest=digest, size=12345)])
+        with pytest.raises(errors.ErrorInfo):
+            store.put_manifest("library/shared", "v2", "", bad)
+        # v1's blob survives
+        assert store.exists_blob("library/shared", digest)
+        assert store.get_blob("library/shared", digest).content.read() == data
+
+    def test_redirect_gate(self, s3_opts):
+        store = S3RegistryStore(s3_opts, enable_redirect=False)
+        data = b"x" * 10
+        digest = str(Digest.from_bytes(data))
+        assert store.get_blob_location("library/g", digest, BlobLocationPurposeUpload, {"size": "10"}) is None
