@@ -1,0 +1,15 @@
+# Registry daemon image (reference parity: Dockerfile — scratch+binary there,
+# slim python + wheel here).
+FROM python:3.12-slim AS build
+WORKDIR /src
+COPY . .
+RUN pip install --no-cache-dir build && python -m build --wheel
+
+FROM python:3.12-slim
+RUN pip install --no-cache-dir requests click rich pyyaml
+COPY --from=build /src/dist/*.whl /tmp/
+# registry/client only — the jax stack is needed in the serving image, not here
+RUN pip install --no-cache-dir --no-deps /tmp/*.whl && rm /tmp/*.whl
+EXPOSE 8080
+ENTRYPOINT ["modelx", "serve"]
+CMD ["--listen", ":8080", "--data", "/data/registry"]

@@ -1,0 +1,37 @@
+# Build/test/package targets (reference parity: Makefile — build matrix is
+# replaced by a wheel + container images since the rebuild is Python).
+
+PY ?= python
+IMAGE ?= modelx-tpu
+TAG ?= $(shell git describe --tags --always 2>/dev/null || echo dev)
+
+.PHONY: all test lint wheel image image-dl compose-up compose-down clean
+
+all: test wheel
+
+test:
+	$(PY) -m pytest tests/ -q
+
+lint:
+	$(PY) -m compileall -q modelx_tpu
+
+wheel:
+	$(PY) -m pip wheel --no-deps -w dist .
+
+image:
+	docker build -t $(IMAGE):$(TAG) -f Dockerfile .
+
+image-dl:
+	docker build -t $(IMAGE)-dl:$(TAG) -f Dockerfile.dl .
+
+compose-up:
+	docker compose up -d
+
+compose-down:
+	docker compose down -v
+
+bench:
+	$(PY) bench.py
+
+clean:
+	rm -rf dist build *.egg-info

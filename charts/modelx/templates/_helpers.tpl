@@ -1,0 +1,13 @@
+{{- define "modelx.name" -}}
+{{- default .Chart.Name .Values.nameOverride | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "modelx.fullname" -}}
+{{- printf "%s-%s" .Release.Name (include "modelx.name" .) | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "modelx.labels" -}}
+app.kubernetes.io/name: {{ include "modelx.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion }}
+{{- end -}}

@@ -49,6 +49,34 @@ def _fail(e: BaseException) -> None:
     sys.exit(1)
 
 
+def _complete_ref(ctx, param, incomplete):
+    """Dynamic remote completion (cmd/modelx/repo/list.go:42-106): complete
+    ``alias/repository[@version]`` by live-querying the registry indexes."""
+    try:
+        mgr = default_repo_manager()
+        if "/" not in incomplete:
+            return [r.name + "/" for r in mgr.list() if r.name.startswith(incomplete)]
+        alias, _, rest = incomplete.partition("/")
+        details = mgr.get(alias)
+        if details is None:
+            return []
+        client = Client(details.url, "Bearer " + details.token if details.token else "", quiet=True)
+        client.remote.timeout = 2  # Tab completion must never hang the shell
+        if "@" in rest:
+            repo, _, ver = rest.partition("@")
+            idx = client.get_index(repo)
+            return [f"{alias}/{repo}@{m.name}" for m in idx.manifests if m.name.startswith(ver)]
+        gidx = client.get_global_index()
+        out = []
+        for m in gidx.manifests:
+            cand = f"{alias}/{m.name}"
+            if cand.startswith(incomplete):
+                out.append(cand)
+        return out
+    except Exception:
+        return []  # completion must never crash the shell
+
+
 # -- init ---------------------------------------------------------------------
 
 
@@ -114,7 +142,7 @@ def cmd_login(registry: str, token: str, name: str) -> None:
 
 
 @main.command("list")
-@click.argument("ref")
+@click.argument("ref", shell_complete=_complete_ref)
 @click.option("--search", default="", help="regex filter")
 def cmd_list(ref: str, search: str) -> None:
     """Three-mode list: repositories / versions / files (list.go:78-163)."""
@@ -136,7 +164,7 @@ def cmd_list(ref: str, search: str) -> None:
 
 
 @main.command("info")
-@click.argument("ref")
+@click.argument("ref", shell_complete=_complete_ref)
 def cmd_info(ref: str) -> None:
     """Print a version's config blob, i.e. modelx.yaml (info.go:47-65)."""
     try:
@@ -163,7 +191,7 @@ def _table(headers: list[str], rows: list[list[str]]) -> None:
 
 
 @main.command("push")
-@click.argument("ref")
+@click.argument("ref", shell_complete=_complete_ref)
 @click.argument("directory", default=".")
 def cmd_push(ref: str, directory: str) -> None:
     """Push a model directory (push.go:43-80). Requires modelx.yaml."""
@@ -182,7 +210,7 @@ def cmd_push(ref: str, directory: str) -> None:
 
 
 @main.command("pull")
-@click.argument("ref")
+@click.argument("ref", shell_complete=_complete_ref)
 @click.argument("directory", default="")
 def cmd_pull(ref: str, directory: str) -> None:
     """Pull a model version into a directory (pull.go:41-69)."""
@@ -234,7 +262,7 @@ def cmd_repo_remove(name: str) -> None:
 
 
 @main.command("gc")
-@click.argument("ref")
+@click.argument("ref", shell_complete=_complete_ref)
 def cmd_gc(ref: str) -> None:
     """Trigger server-side garbage collection for a repository."""
     try:
@@ -260,9 +288,11 @@ def cmd_gc(ref: str) -> None:
 @click.option("--s3-region", default="us-east-1")
 @click.option("--enable-redirect", is_flag=True, help="presigned load separation")
 @click.option("--auth-token", multiple=True, help="accepted bearer token (repeatable)")
+@click.option("--oidc-issuer", default="", help="OIDC issuer URL for JWT bearer auth")
+@click.option("--gc-interval", default=0.0, type=float, help="seconds between GC sweeps (0=off)")
 def cmd_serve(
     listen, data_dir, tls_cert, tls_key, s3_url, s3_access_key, s3_secret_key,
-    s3_bucket, s3_region, enable_redirect, auth_token,
+    s3_bucket, s3_region, enable_redirect, auth_token, oidc_issuer, gc_interval,
 ) -> None:
     """Run the registry daemon (cmd/modelxd/modelxd.go:26-58)."""
     from modelx_tpu.registry.server import Options, RegistryServer
@@ -281,6 +311,8 @@ def cmd_serve(
         s3_region=s3_region,
         enable_redirect=enable_redirect,
         auth_tokens=tuple(auth_token),
+        oidc_issuer=oidc_issuer,
+        gc_interval_s=gc_interval,
     )
     RegistryServer(opts).serve_forever()
 

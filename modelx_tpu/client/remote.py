@@ -18,9 +18,14 @@ from modelx_tpu.types import BlobLocation, Descriptor, Index, Manifest
 
 
 class RegistryClient:
-    def __init__(self, registry: str, authorization: str = "") -> None:
+    # (connect, read) defaults: generous read for blob streams, bounded
+    # connect so unreachable hosts fail instead of hanging
+    DEFAULT_TIMEOUT = (10, 300)
+
+    def __init__(self, registry: str, authorization: str = "", timeout=None) -> None:
         self.registry = registry.rstrip("/")
         self.authorization = authorization
+        self.timeout = timeout or self.DEFAULT_TIMEOUT
         self.session = requests.Session()
 
     # -- plumbing -------------------------------------------------------------
@@ -46,7 +51,8 @@ class RegistryClient:
         url = self.registry + path
         try:
             resp = self.session.request(
-                method, url, params=params, data=data, headers=self._headers(headers), stream=stream
+                method, url, params=params, data=data, headers=self._headers(headers),
+                stream=stream, timeout=self.timeout,
             )
         except requests.RequestException as e:
             raise errors.ErrorInfo(502, errors.ErrCodeUnknown, f"request failed: {e}") from e

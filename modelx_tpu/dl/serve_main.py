@@ -1,0 +1,35 @@
+"""`modelx-serve` console entrypoint: the serving container's command
+(referenced by dl/podspec.py's generated pod spec)."""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+
+import click
+
+from modelx_tpu.dl.serve import ModelServer, serve
+
+
+@click.command("modelx-serve")
+@click.option("--model-dir", required=True, help="volume with *.safetensors (from modelx dl)")
+@click.option("--mesh", default="", help='mesh spec, e.g. "dp=1,tp=8" (default: dp over all devices)')
+@click.option("--dtype", default="bfloat16", type=click.Choice(["bfloat16", "float32"]))
+@click.option("--listen", default=":8000")
+@click.option("--max-seq-len", default=2048, type=int)
+def main(model_dir: str, mesh: str, dtype: str, listen: str, max_seq_len: int) -> None:
+    logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
+    server = ModelServer(model_dir, mesh_spec=mesh, dtype=dtype, max_seq_len=max_seq_len)
+    httpd = serve(server, listen=listen)  # starts serving 503s while loading
+    stats = server.load()
+    logging.getLogger("modelx.serve").info("model loaded: %s", stats)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    httpd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,152 @@
+"""BERT family (BASELINE config #2: BERT-base single-host serve).
+
+Params keyed by HF safetensors names (``bert.embeddings.word_embeddings.weight``,
+``bert.encoder.layer.N.attention.self.query.weight``, ...). Linear weights
+are [out, in] like llama. Sharding rules: dl/sharding.py BERT_RULES.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from modelx_tpu.ops import attention as attn_ops
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.float32
+
+    @classmethod
+    def bert_base(cls) -> "BertConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "BertConfig":
+        return cls(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                   intermediate_size=128, max_position_embeddings=64)
+
+
+def param_shapes(cfg: BertConfig) -> dict[str, tuple[int, ...]]:
+    e, f = cfg.hidden_size, cfg.intermediate_size
+    shapes: dict[str, tuple[int, ...]] = {
+        "bert.embeddings.word_embeddings.weight": (cfg.vocab_size, e),
+        "bert.embeddings.position_embeddings.weight": (cfg.max_position_embeddings, e),
+        "bert.embeddings.token_type_embeddings.weight": (cfg.type_vocab_size, e),
+        "bert.embeddings.LayerNorm.weight": (e,),
+        "bert.embeddings.LayerNorm.bias": (e,),
+        "bert.pooler.dense.weight": (e, e),
+        "bert.pooler.dense.bias": (e,),
+    }
+    for i in range(cfg.num_layers):
+        p = f"bert.encoder.layer.{i}."
+        shapes.update(
+            {
+                p + "attention.self.query.weight": (e, e),
+                p + "attention.self.query.bias": (e,),
+                p + "attention.self.key.weight": (e, e),
+                p + "attention.self.key.bias": (e,),
+                p + "attention.self.value.weight": (e, e),
+                p + "attention.self.value.bias": (e,),
+                p + "attention.output.dense.weight": (e, e),
+                p + "attention.output.dense.bias": (e,),
+                p + "attention.output.LayerNorm.weight": (e,),
+                p + "attention.output.LayerNorm.bias": (e,),
+                p + "intermediate.dense.weight": (f, e),
+                p + "intermediate.dense.bias": (f,),
+                p + "output.dense.weight": (e, f),
+                p + "output.dense.bias": (e,),
+                p + "output.LayerNorm.weight": (e,),
+                p + "output.LayerNorm.bias": (e,),
+            }
+        )
+    return shapes
+
+
+def init_params(cfg: BertConfig, key: jax.Array) -> dict[str, jax.Array]:
+    shapes = param_shapes(cfg)
+    keys = jax.random.split(key, len(shapes))
+    params = {}
+    for (name, shape), k in zip(sorted(shapes.items()), keys):
+        if name.endswith(".bias"):
+            params[name] = jnp.zeros(shape, cfg.dtype)
+        elif "LayerNorm" in name:
+            params[name] = jnp.ones(shape, cfg.dtype)
+        else:
+            params[name] = (jax.random.normal(k, shape) * 0.02).astype(cfg.dtype)
+    return params
+
+
+def _layer_norm(x, weight, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight + bias
+
+
+def _linear(x, w, b):
+    return (
+        jax.lax.dot_general(x, w, (((x.ndim - 1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    ).astype(x.dtype) + b
+
+
+def forward(
+    params: dict[str, jax.Array],
+    tokens: jax.Array,
+    cfg: BertConfig,
+    token_type_ids: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (sequence_output [B,S,E], pooled_output [B,E])."""
+    b, s = tokens.shape
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros_like(tokens)
+    positions = jnp.arange(s)[None, :]
+    x = (
+        jnp.take(params["bert.embeddings.word_embeddings.weight"], tokens, axis=0)
+        + jnp.take(params["bert.embeddings.position_embeddings.weight"], positions, axis=0)
+        + jnp.take(params["bert.embeddings.token_type_embeddings.weight"], token_type_ids, axis=0)
+    ).astype(cfg.dtype)
+    x = _layer_norm(
+        x, params["bert.embeddings.LayerNorm.weight"], params["bert.embeddings.LayerNorm.bias"],
+        cfg.layer_norm_eps,
+    )
+    head_dim = cfg.hidden_size // cfg.num_heads
+    for i in range(cfg.num_layers):
+        p = f"bert.encoder.layer.{i}."
+        q = _linear(x, params[p + "attention.self.query.weight"], params[p + "attention.self.query.bias"])
+        k = _linear(x, params[p + "attention.self.key.weight"], params[p + "attention.self.key.bias"])
+        v = _linear(x, params[p + "attention.self.value.weight"], params[p + "attention.self.value.bias"])
+        q = q.reshape(b, s, cfg.num_heads, head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(b, s, cfg.num_heads, head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(b, s, cfg.num_heads, head_dim).transpose(0, 2, 1, 3)
+        out = attn_ops.attention_reference(q, k, v, causal=False)  # bidirectional
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.hidden_size)
+        out = _linear(out, params[p + "attention.output.dense.weight"], params[p + "attention.output.dense.bias"])
+        x = _layer_norm(
+            x + out, params[p + "attention.output.LayerNorm.weight"],
+            params[p + "attention.output.LayerNorm.bias"], cfg.layer_norm_eps,
+        )
+        h = jax.nn.gelu(
+            _linear(x, params[p + "intermediate.dense.weight"], params[p + "intermediate.dense.bias"]),
+            approximate=False,
+        )
+        h = _linear(h, params[p + "output.dense.weight"], params[p + "output.dense.bias"])
+        x = _layer_norm(
+            x + h, params[p + "output.LayerNorm.weight"], params[p + "output.LayerNorm.bias"],
+            cfg.layer_norm_eps,
+        )
+    pooled = jnp.tanh(
+        _linear(x[:, 0], params["bert.pooler.dense.weight"], params["bert.pooler.dense.bias"])
+    )
+    return x, pooled

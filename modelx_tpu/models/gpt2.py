@@ -1,0 +1,123 @@
+"""GPT-2 family (BASELINE config #1 checkpoints are GPT-2 125M safetensors).
+
+Params keyed by HF safetensors names (``wte.weight``, ``h.N.attn.c_attn.weight``,
+...). HF GPT-2 uses Conv1D layers whose weights are stored [in, out] — note
+the transposed layout vs llama's [out, in] Linear. Sharding rules:
+dl/sharding.py GPT2_RULES.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from modelx_tpu.ops import attention as attn_ops
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @classmethod
+    def gpt2_125m(cls) -> "GPT2Config":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "GPT2Config":
+        return cls(vocab_size=256, n_positions=64, hidden_size=64, num_layers=2, num_heads=4)
+
+
+def param_shapes(cfg: GPT2Config) -> dict[str, tuple[int, ...]]:
+    e = cfg.hidden_size
+    shapes: dict[str, tuple[int, ...]] = {
+        "wte.weight": (cfg.vocab_size, e),
+        "wpe.weight": (cfg.n_positions, e),
+        "ln_f.weight": (e,),
+        "ln_f.bias": (e,),
+    }
+    for i in range(cfg.num_layers):
+        p = f"h.{i}."
+        shapes.update(
+            {
+                p + "ln_1.weight": (e,),
+                p + "ln_1.bias": (e,),
+                p + "attn.c_attn.weight": (e, 3 * e),  # Conv1D: [in, out]
+                p + "attn.c_attn.bias": (3 * e,),
+                p + "attn.c_proj.weight": (e, e),
+                p + "attn.c_proj.bias": (e,),
+                p + "ln_2.weight": (e,),
+                p + "ln_2.bias": (e,),
+                p + "mlp.c_fc.weight": (e, 4 * e),
+                p + "mlp.c_fc.bias": (4 * e,),
+                p + "mlp.c_proj.weight": (4 * e, e),
+                p + "mlp.c_proj.bias": (e,),
+            }
+        )
+    return shapes
+
+
+def init_params(cfg: GPT2Config, key: jax.Array) -> dict[str, jax.Array]:
+    shapes = param_shapes(cfg)
+    keys = jax.random.split(key, len(shapes))
+    params = {}
+    for (name, shape), k in zip(sorted(shapes.items()), keys):
+        if name.endswith(".bias") or "ln_" in name:
+            params[name] = (
+                jnp.zeros(shape, cfg.dtype) if name.endswith(".bias") else jnp.ones(shape, cfg.dtype)
+            )
+        else:
+            params[name] = (jax.random.normal(k, shape) * 0.02).astype(cfg.dtype)
+    return params
+
+
+def _layer_norm(x, weight, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight + bias
+
+
+def _conv1d(x, w, b):
+    """HF Conv1D: y = x @ w + b with w [in, out]."""
+    return (
+        jax.lax.dot_general(x, w, (((x.ndim - 1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    ).astype(x.dtype) + b
+
+
+def forward(params: dict[str, jax.Array], tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
+    """Returns logits [B, S, V]."""
+    b, s = tokens.shape
+    positions = jnp.arange(s)[None, :]
+    x = jnp.take(params["wte.weight"], tokens, axis=0) + jnp.take(
+        params["wpe.weight"], positions, axis=0
+    )
+    x = x.astype(cfg.dtype)
+    head_dim = cfg.hidden_size // cfg.num_heads
+    for i in range(cfg.num_layers):
+        p = f"h.{i}."
+        h = _layer_norm(x, params[p + "ln_1.weight"], params[p + "ln_1.bias"], cfg.layer_norm_eps)
+        qkv = _conv1d(h, params[p + "attn.c_attn.weight"], params[p + "attn.c_attn.bias"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, cfg.num_heads, head_dim).transpose(0, 2, 1, 3)
+        k = k.reshape(b, s, cfg.num_heads, head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(b, s, cfg.num_heads, head_dim).transpose(0, 2, 1, 3)
+        out = attn_ops.attention_reference(q, k, v, causal=True)
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.hidden_size)
+        x = x + _conv1d(out, params[p + "attn.c_proj.weight"], params[p + "attn.c_proj.bias"])
+        h = _layer_norm(x, params[p + "ln_2.weight"], params[p + "ln_2.bias"], cfg.layer_norm_eps)
+        h = jax.nn.gelu(_conv1d(h, params[p + "mlp.c_fc.weight"], params[p + "mlp.c_fc.bias"]), approximate=True)
+        x = x + _conv1d(h, params[p + "mlp.c_proj.weight"], params[p + "mlp.c_proj.bias"])
+    x = _layer_norm(x, params["ln_f.weight"], params["ln_f.bias"], cfg.layer_norm_eps)
+    return jax.lax.dot_general(
+        x, params["wte.weight"], (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )

@@ -1,0 +1,135 @@
+"""OIDC bearer-token verification.
+
+Reference parity: pkg/registry/helper.go:63-96 (go-oidc issuer-based
+verification with ``SkipClientIDCheck`` — i.e. no audience check) — without a
+JWT library: the token is parsed and its RS256 signature verified against the
+issuer's JWKS (discovered via ``/.well-known/openid-configuration``) using
+``cryptography``. The verified username actually reaches handlers (the
+reference discards it, helper.go:93).
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+from typing import Any
+
+import requests
+
+from modelx_tpu import errors
+
+
+def _b64url_decode(s: str) -> bytes:
+    return base64.urlsafe_b64decode(s + "=" * (-len(s) % 4))
+
+
+def _b64url_to_int(s: str) -> int:
+    return int.from_bytes(_b64url_decode(s), "big")
+
+
+class OIDCVerifier:
+    """Verifies RS256 JWTs against an issuer's JWKS. Keys are cached and
+    refreshed on unknown-kid (standard rotation behavior)."""
+
+    # minimum seconds between JWKS refreshes: bounds unknown-kid outbound
+    # amplification against the IdP (one cheap inbound request must not buy
+    # an outbound HTTPS fetch every time)
+    MIN_REFRESH_INTERVAL_S = 30.0
+
+    def __init__(self, issuer: str, jwks_uri: str = "", leeway_s: int = 30) -> None:
+        self.issuer = issuer.rstrip("/")
+        self._jwks_uri = jwks_uri
+        self._keys: dict[str, Any] = {}
+        self._lock = threading.Lock()
+        self._last_refresh = 0.0
+        self.leeway_s = leeway_s
+
+    def _discover(self) -> str:
+        if self._jwks_uri:
+            return self._jwks_uri
+        r = requests.get(f"{self.issuer}/.well-known/openid-configuration", timeout=10)
+        r.raise_for_status()
+        self._jwks_uri = r.json()["jwks_uri"]
+        return self._jwks_uri
+
+    def _refresh_keys(self) -> None:
+        try:
+            r = requests.get(self._discover(), timeout=10)
+            r.raise_for_status()
+            body = r.json()
+        except (requests.RequestException, ValueError) as e:
+            # IdP unreachable is a service problem, not a client one
+            raise errors.ErrorInfo(503, errors.ErrCodeUnknown, f"OIDC keys unavailable: {e}") from e
+        from cryptography.hazmat.primitives.asymmetric import rsa
+
+        keys = {}
+        for jwk in body.get("keys", []):
+            if jwk.get("kty") != "RSA":
+                continue
+            try:
+                pub = rsa.RSAPublicNumbers(
+                    e=_b64url_to_int(jwk["e"]), n=_b64url_to_int(jwk["n"])
+                ).public_key()
+            except (KeyError, ValueError):
+                continue
+            keys[jwk.get("kid", "")] = pub
+        with self._lock:
+            self._keys = keys
+            self._last_refresh = time.monotonic()
+
+    def _key_for(self, kid: str):
+        with self._lock:
+            key = self._keys.get(kid)
+            stale = time.monotonic() - self._last_refresh > self.MIN_REFRESH_INTERVAL_S
+        if key is None and stale:
+            self._refresh_keys()
+            with self._lock:
+                key = self._keys.get(kid)
+        return key
+
+    def verify(self, token: str) -> dict:
+        """Returns the claims dict; raises errors.unauthorized on any failure."""
+        try:
+            header_b64, payload_b64, sig_b64 = token.split(".")
+            header = json.loads(_b64url_decode(header_b64))
+            claims = json.loads(_b64url_decode(payload_b64))
+            signature = _b64url_decode(sig_b64)
+            if not isinstance(header, dict) or not isinstance(claims, dict):
+                raise ValueError("header/payload must be objects")
+        except (ValueError, KeyError, TypeError):
+            raise errors.unauthorized("malformed token") from None
+        if header.get("alg") != "RS256":
+            raise errors.unauthorized(f"unsupported alg {header.get('alg')!r}")
+        key = self._key_for(header.get("kid", ""))
+        if key is None:
+            raise errors.unauthorized("unknown signing key")
+        from cryptography.exceptions import InvalidSignature
+        from cryptography.hazmat.primitives import hashes
+        from cryptography.hazmat.primitives.asymmetric import padding
+
+        try:
+            key.verify(
+                signature, f"{header_b64}.{payload_b64}".encode(), padding.PKCS1v15(), hashes.SHA256()
+            )
+        except InvalidSignature:
+            raise errors.unauthorized("invalid signature") from None
+        now = time.time()
+        try:
+            exp = None if claims.get("exp") is None else float(claims["exp"])
+            nbf = None if claims.get("nbf") is None else float(claims["nbf"])
+        except (TypeError, ValueError):
+            raise errors.unauthorized("malformed exp/nbf claim") from None
+        if exp is not None and now > exp + self.leeway_s:
+            raise errors.unauthorized("token expired")
+        if nbf is not None and now < nbf - self.leeway_s:
+            raise errors.unauthorized("token not yet valid")
+        iss = str(claims.get("iss", "")).rstrip("/")
+        if iss != self.issuer:
+            raise errors.unauthorized(f"issuer mismatch: {iss!r}")
+        # SkipClientIDCheck parity: audience deliberately not checked
+        return claims
+
+    def username(self, claims: dict) -> str:
+        return claims.get("preferred_username") or claims.get("name") or claims.get("sub", "")

@@ -240,10 +240,19 @@ class S3FSProvider:
 
     def stat(self, path: str) -> FSMeta:
         h = self.client.head_object(self._key(path))
+        mtime = 0.0
+        if h.get("Last-Modified"):
+            from email.utils import parsedate_to_datetime
+
+            try:
+                mtime = parsedate_to_datetime(h["Last-Modified"]).timestamp()
+            except (TypeError, ValueError):
+                pass
         return FSMeta(
             name=path.strip("/"),
             size=int(h.get("Content-Length", 0) or 0),
             content_type=h.get("Content-Type", ""),
+            last_modified=mtime,
         )
 
     def remove(self, path: str) -> None:

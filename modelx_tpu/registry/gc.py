@@ -29,8 +29,16 @@ class GCResult:
         return dataclasses.asdict(self)
 
 
-def gc_blobs(store: RegistryStore, repository: str) -> GCResult:
-    """gc.go:23-68 — delete blobs referenced by no manifest of the repo."""
+DEFAULT_GRACE_S = 600.0
+
+
+def gc_blobs(store: RegistryStore, repository: str, grace_s: float = DEFAULT_GRACE_S) -> GCResult:
+    """gc.go:23-68 — delete blobs referenced by no manifest of the repo.
+
+    Blobs younger than ``grace_s`` are skipped: a push uploads blobs first and
+    commits the manifest last, so a sweep landing inside that window would
+    otherwise delete the new version's blobs out from under it.
+    """
     in_use: set[str] = set()
     try:
         idx = store.get_index(repository)
@@ -47,20 +55,36 @@ def gc_blobs(store: RegistryStore, repository: str) -> GCResult:
             if d.digest:
                 in_use.add(d.digest)
 
+    import time
+
+    now = time.time()
     result = GCResult(repository=repository)
     for digest in store.list_blobs(repository):
         result.checked += 1
-        if digest not in in_use:
-            store.delete_blob(repository, digest)
-            result.deleted += 1
-            result.deleted_digests.append(digest)
-            logger.info("gc: deleted %s/%s", repository, digest)
+        if digest in in_use:
+            continue
+        if grace_s > 0:
+            age = now - _blob_mtime(store, repository, digest)
+            if age < grace_s:
+                continue  # possibly an in-flight push; next sweep gets it
+        store.delete_blob(repository, digest)
+        result.deleted += 1
+        result.deleted_digests.append(digest)
+        logger.info("gc: deleted %s/%s", repository, digest)
     return result
 
 
-def gc_blobs_all(store: RegistryStore) -> list[GCResult]:
+def _blob_mtime(store: RegistryStore, repository: str, digest: str) -> float:
+    try:
+        meta = store.get_blob_meta(repository, digest)
+        return getattr(meta, "last_modified", 0.0) or 0.0
+    except errors.ErrorInfo:
+        return 0.0
+
+
+def gc_blobs_all(store: RegistryStore, grace_s: float = DEFAULT_GRACE_S) -> list[GCResult]:
     """gc.go:10-21 — GC every repository in the global index."""
     results = []
     for repo in store.get_global_index().manifests:
-        results.append(gc_blobs(store, repo.name))
+        results.append(gc_blobs(store, repo.name, grace_s=grace_s))
     return results

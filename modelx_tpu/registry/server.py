@@ -71,10 +71,15 @@ class Options:
     s3_region: str = "us-east-1"
     s3_presign_expire_s: int = 3600
     enable_redirect: bool = False
-    # auth: static bearer token(s); empty = anonymous (pkg/auth is an empty stub
-    # in the reference; OIDC filter lives in helper.go:63-96)
+    # auth: static bearer token(s) and/or OIDC issuer; both empty = anonymous
+    # (reference: OIDC filter in helper.go:63-96, pkg/auth otherwise empty)
     auth_tokens: tuple[str, ...] = ()
     oidc_issuer: str = ""
+    # periodic mark-sweep over all repositories; 0 disables (the reference
+    # defines GCBlobsAll but never calls it, gc.go:10-21). Blobs younger than
+    # gc_grace_s survive a sweep so in-flight pushes aren't corrupted.
+    gc_interval_s: float = 0.0
+    gc_grace_s: float = 600.0
 
 
 class Metrics:
@@ -101,6 +106,11 @@ class Registry:
         self.store = store
         self.opts = opts or Options()
         self.metrics = Metrics()
+        self.oidc_verifier = None
+        if self.opts.oidc_issuer:
+            from modelx_tpu.registry.auth import OIDCVerifier
+
+            self.oidc_verifier = OIDCVerifier(self.opts.oidc_issuer)
         # method, compiled path regex, handler(req, **groups)
         name, ref, dig = NAME_REGEXP, REFERENCE_REGEXP, DIGEST_REGEXP
         self.routes: list[tuple[str, re.Pattern, Callable]] = [
@@ -223,7 +233,13 @@ class Registry:
         return Response.json(200, location.to_json())
 
     def garbage_collect(self, req: "Request", name: str) -> "Response":
-        result = gcmod.gc_blobs(self.store, name)
+        # manual trigger defaults to immediate (reference semantics); the
+        # cron path uses the grace window to avoid racing in-flight pushes
+        try:
+            grace = float(req.query_one("grace", "0"))
+        except ValueError:
+            raise errors.ErrorInfo(400, errors.ErrCodeUnknown, "bad grace value")
+        result = gcmod.gc_blobs(self.store, name, grace_s=grace)
         self.metrics.inc("gc_blobs_deleted_total", result.deleted)
         return Response.json(200, result.to_json())
 
@@ -369,10 +385,11 @@ class _Handler(BaseHTTPRequestHandler):
             logger.info("%s %s %d %.1fms", self.command, self.path, status, cost_ms)
 
     def _auth(self, req: Request) -> None:
-        """Bearer-token auth; token also accepted via ?token=/?access_token=
-        query (helper.go:75-82). Sets req.username (fixes helper.go:93)."""
-        tokens = self.registry.opts.auth_tokens
-        if not tokens:
+        """Bearer-token / OIDC auth; token also accepted via
+        ?token=/?access_token= query (helper.go:75-82). Sets req.username
+        (fixes helper.go:93)."""
+        opts = self.registry.opts
+        if not opts.auth_tokens and not opts.oidc_issuer:
             return
         if req.path == "/healthz":
             return
@@ -382,9 +399,15 @@ class _Handler(BaseHTTPRequestHandler):
             presented = authz[len("Bearer ") :]
         if not presented:
             presented = req.query_one("token") or req.query_one("access_token")
-        if presented not in tokens:
-            raise errors.unauthorized("invalid or missing bearer token")
-        req.username = "token"
+        if presented in opts.auth_tokens:
+            req.username = "token"
+            return
+        verifier = self.registry.oidc_verifier
+        if verifier is not None and presented:
+            claims = verifier.verify(presented)  # raises unauthorized
+            req.username = verifier.username(claims)
+            return
+        raise errors.unauthorized("invalid or missing bearer token")
 
     def _write(self, resp: Response, head_only: bool = False) -> None:
         self.send_response(resp.status)
@@ -471,6 +494,24 @@ class RegistryServer:
             ctx.load_cert_chain(opts.tls_cert, opts.tls_key)
             self.httpd.socket = ctx.wrap_socket(self.httpd.socket, server_side=True)
         self._thread: threading.Thread | None = None
+        self._gc_stop = threading.Event()
+        if opts.gc_interval_s > 0:
+            threading.Thread(target=self._gc_loop, daemon=True).start()
+
+    def _gc_loop(self) -> None:
+        """Periodic GC over all repositories (the GC cron SURVEY.md §5 calls
+        for; gives gc_blobs_all a caller, unlike the reference)."""
+        from modelx_tpu.registry.gc import gc_blobs_all
+
+        while not self._gc_stop.wait(self.opts.gc_interval_s):
+            try:
+                results = gc_blobs_all(self.registry.store, grace_s=self.opts.gc_grace_s)
+                deleted = sum(r.deleted for r in results)
+                if deleted:
+                    self.registry.metrics.inc("gc_blobs_deleted_total", deleted)
+                    logger.info("gc cron: deleted %d unreferenced blobs", deleted)
+            except Exception:
+                logger.exception("gc cron failed")
 
     @property
     def address(self) -> str:
@@ -494,6 +535,7 @@ class RegistryServer:
             self.shutdown()
 
     def shutdown(self) -> None:
+        self._gc_stop.set()
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread:

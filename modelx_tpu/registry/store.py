@@ -34,10 +34,11 @@ class BlobContent:
 
 @dataclasses.dataclass
 class BlobMeta:
-    """store.go:30-33."""
+    """store.go:30-33 (+ mtime for the GC grace window)."""
 
     content_type: str
     content_length: int
+    last_modified: float = 0.0
 
 
 @runtime_checkable

@@ -269,7 +269,9 @@ class FSRegistryStore:
             m = self.fs.stat(blob_digest_path(repository, digest))
         except FSNotFound:
             raise errors.blob_unknown(digest) from None
-        return BlobMeta(content_type=m.content_type, content_length=m.size)
+        return BlobMeta(
+            content_type=m.content_type, content_length=m.size, last_modified=m.last_modified
+        )
 
     def get_blob_location(
         self, repository: str, digest: str, purpose: str, properties: dict[str, str]

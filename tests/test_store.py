@@ -197,7 +197,7 @@ class TestGC:
         kept = put_blob(store, self.REPO, b"kept")
         put_blob(store, self.REPO, b"orphan")
         store.put_manifest(self.REPO, "v1", "", Manifest(blobs=[kept]))
-        result = gc_blobs(store, self.REPO)
+        result = gc_blobs(store, self.REPO, grace_s=0)
         assert result.deleted == 1
         assert store.exists_blob(self.REPO, kept.digest)
         assert set(store.list_blobs(self.REPO)) == {kept.digest}
@@ -205,17 +205,17 @@ class TestGC:
     def test_gc_keeps_config_blob(self, store):
         cfg = put_blob(store, self.REPO, b"config", name="modelx.yaml")
         store.put_manifest(self.REPO, "v1", "", Manifest(config=cfg))
-        result = gc_blobs(store, self.REPO)
+        result = gc_blobs(store, self.REPO, grace_s=0)
         assert result.deleted == 0
 
     def test_gc_all(self, store):
         put_blob(store, "library/a", b"orphan-a")
         store.put_manifest("library/a", "v1", "", Manifest())
-        results = gc_blobs_all(store)
+        results = gc_blobs_all(store, grace_s=0)
         assert sum(r.deleted for r in results) == 1
 
     def test_gc_empty_repo(self, store):
-        assert gc_blobs(store, "library/none").deleted == 0
+        assert gc_blobs(store, "library/none", grace_s=0).deleted == 0
 
 
 class TestFaultInjection:
