@@ -6,7 +6,7 @@ COPY . .
 RUN pip install --no-cache-dir build && python -m build --wheel
 
 FROM python:3.12-slim
-RUN pip install --no-cache-dir requests click rich pyyaml
+RUN pip install --no-cache-dir requests click rich pyyaml cryptography
 COPY --from=build /src/dist/*.whl /tmp/
 # registry/client only — the jax stack is needed in the serving image, not here
 RUN pip install --no-cache-dir --no-deps /tmp/*.whl && rm /tmp/*.whl

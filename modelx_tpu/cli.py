@@ -263,11 +263,18 @@ def cmd_repo_remove(name: str) -> None:
 
 @main.command("gc")
 @click.argument("ref", shell_complete=_complete_ref)
-def cmd_gc(ref: str) -> None:
+@click.option(
+    "--grace",
+    type=float,
+    default=None,
+    help="Skip blobs younger than this many seconds (default: server's "
+    "configured window; 0 sweeps immediately and may race in-flight pushes).",
+)
+def cmd_gc(ref: str, grace: float | None) -> None:
     """Trigger server-side garbage collection for a repository."""
     try:
         r = parse_reference(ref)
-        result = r.client(quiet=True).remote.garbage_collect(r.repository)
+        result = r.client(quiet=True).remote.garbage_collect(r.repository, grace_s=grace)
         click.echo(json.dumps(result))
     except (errors.ErrorInfo, ValueError) as e:
         _fail(e)

@@ -181,8 +181,11 @@ class RegistryClient:
             raise
         return BlobLocation.from_json(r.json())
 
-    def garbage_collect(self, repository: str) -> dict:
-        return self._request("POST", f"/{repository}/garbage-collect").json()
+    def garbage_collect(self, repository: str, grace_s: float | None = None) -> dict:
+        path = f"/{repository}/garbage-collect"
+        if grace_s is not None:
+            path += f"?grace={grace_s}"
+        return self._request("POST", path).json()
 
 
 def _sized_iter(f: BinaryIO, size: int, chunk: int = 1024 * 1024) -> Iterator[bytes]:

@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 
 from modelx_tpu.ops import attention as attn_ops
+from modelx_tpu.ops.nn import conv1d as _conv1d
+from modelx_tpu.ops.nn import layer_norm as _layer_norm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -78,20 +80,6 @@ def init_params(cfg: GPT2Config, key: jax.Array) -> dict[str, jax.Array]:
         else:
             params[name] = (jax.random.normal(k, shape) * 0.02).astype(cfg.dtype)
     return params
-
-
-def _layer_norm(x, weight, bias, eps):
-    x32 = x.astype(jnp.float32)
-    mu = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.var(x32, axis=-1, keepdims=True)
-    return ((x32 - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight + bias
-
-
-def _conv1d(x, w, b):
-    """HF Conv1D: y = x @ w + b with w [in, out]."""
-    return (
-        jax.lax.dot_general(x, w, (((x.ndim - 1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    ).astype(x.dtype) + b
 
 
 def forward(params: dict[str, jax.Array], tokens: jax.Array, cfg: GPT2Config) -> jax.Array:

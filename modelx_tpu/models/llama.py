@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from modelx_tpu.ops import attention as attn_ops
+from modelx_tpu.ops.nn import linear as _linear
 
 
 @dataclasses.dataclass(frozen=True)
@@ -152,13 +153,6 @@ def _rope(x, positions, theta: float):
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)
-
-
-def _linear(x, w):
-    """x @ w.T with fp32 accumulation (HF weight layout [out, in])."""
-    return jax.lax.dot_general(
-        x, w, (((x.ndim - 1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ).astype(x.dtype)
 
 
 @dataclasses.dataclass(frozen=True)

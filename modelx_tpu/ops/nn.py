@@ -1,0 +1,35 @@
+"""Shared dense-layer primitives used by the model families.
+
+All matmuls go through ``lax.dot_general`` with a float32 accumulator
+(``preferred_element_type``) so bf16 params still accumulate at full
+precision on the MXU; layer norm statistics are likewise computed in
+float32 regardless of the activation dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def layer_norm(x, weight, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    return ((x32 - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype) * weight + bias
+
+
+def linear(x, w, b=None):
+    """y = x @ w.T (+ b) with w stored [out, in] (torch Linear layout)."""
+    y = jax.lax.dot_general(
+        x, w, (((x.ndim - 1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+    return y if b is None else y + b
+
+
+def conv1d(x, w, b=None):
+    """y = x @ w (+ b) with w stored [in, out] (HF GPT-2 Conv1D layout)."""
+    y = jax.lax.dot_general(
+        x, w, (((x.ndim - 1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    ).astype(x.dtype)
+    return y if b is None else y + b

@@ -59,7 +59,7 @@ class OIDCVerifier:
             r = requests.get(self._discover(), timeout=10)
             r.raise_for_status()
             body = r.json()
-        except (requests.RequestException, ValueError) as e:
+        except (requests.RequestException, ValueError, KeyError) as e:
             # IdP unreachable is a service problem, not a client one
             raise errors.ErrorInfo(503, errors.ErrCodeUnknown, f"OIDC keys unavailable: {e}") from e
         from cryptography.hazmat.primitives.asymmetric import rsa
@@ -121,7 +121,11 @@ class OIDCVerifier:
             nbf = None if claims.get("nbf") is None else float(claims["nbf"])
         except (TypeError, ValueError):
             raise errors.unauthorized("malformed exp/nbf claim") from None
-        if exp is not None and now > exp + self.leeway_s:
+        if exp is None:
+            # go-oidc parity: a token without an expiry is rejected (missing
+            # exp unmarshals to zero time there and fails the expiry check)
+            raise errors.unauthorized("token missing exp claim")
+        if now > exp + self.leeway_s:
             raise errors.unauthorized("token expired")
         if nbf is not None and now < nbf - self.leeway_s:
             raise errors.unauthorized("token not yet valid")

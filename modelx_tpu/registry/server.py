@@ -233,10 +233,10 @@ class Registry:
         return Response.json(200, location.to_json())
 
     def garbage_collect(self, req: "Request", name: str) -> "Response":
-        # manual trigger defaults to immediate (reference semantics); the
-        # cron path uses the grace window to avoid racing in-flight pushes
+        # default to the configured grace window so a manual trigger can't
+        # sweep blobs of an in-flight push; ?grace=0 forces immediate
         try:
-            grace = float(req.query_one("grace", "0"))
+            grace = float(req.query_one("grace", str(self.opts.gc_grace_s)))
         except ValueError:
             raise errors.ErrorInfo(400, errors.ErrCodeUnknown, "bad grace value")
         result = gcmod.gc_blobs(self.store, name, grace_s=grace)

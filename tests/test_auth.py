@@ -97,6 +97,13 @@ class TestOIDCVerifier:
         with pytest.raises(errors.ErrorInfo, match="expired"):
             v.verify(tok)
 
+    def test_missing_exp_rejected(self, issuer):
+        # go-oidc parity: no exp claim -> rejected, not immortal
+        v = OIDCVerifier(issuer.url)
+        tok = issuer.mint({"iss": issuer.url, "sub": "alice"})
+        with pytest.raises(errors.ErrorInfo, match="missing exp"):
+            v.verify(tok)
+
     def test_wrong_issuer(self, issuer):
         v = OIDCVerifier(issuer.url)
         tok = issuer.mint({"iss": "https://evil.example", "exp": time.time() + 300})

@@ -127,7 +127,14 @@ class TestRoutes:
         orphan = b"orphan data"
         odg = str(Digest.from_bytes(orphan))
         requests.put(f"{server}/{REPO}/blobs/{odg}", data=orphan)
+        # default grace window: the just-uploaded orphan is treated as a
+        # possibly in-flight push and survives
         r = requests.post(f"{server}/{REPO}/garbage-collect")
+        assert r.status_code == 200
+        assert r.json()["deleted"] == 0
+        assert requests.head(f"{server}/{REPO}/blobs/{odg}").status_code == 200
+        # explicit grace=0 sweeps immediately
+        r = requests.post(f"{server}/{REPO}/garbage-collect?grace=0")
         assert r.status_code == 200
         body = r.json()
         assert body["deleted"] == 1 and body["deleted_digests"] == [odg]
