@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import re
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -186,6 +187,44 @@ class LoadStats:
         return self.bytes_to_device / max(self.total_seconds, 1e-9) / 1e9
 
 
+_EXPERT_NAME = re.compile(r"^(.+\.experts)\.(\d+)\.(.+)$")
+
+
+def fuse_expert_tensors(tensors: dict[str, st.TensorInfo]) -> dict[str, st.TensorInfo]:
+    """Fold HF per-expert tensor entries (``...experts.<i>.w1.weight``) into
+    virtual stacked tensors (``...experts.w1.weight`` with shape [E, ...])
+    so MoE checkpoints pushed in stock HF layout load directly onto an
+    ``ep``-sharded mesh (MIXTRAL_RULES target the stacked names, and
+    models/mixtral.py consumes the stacked layout). Each device still
+    fetches only the expert rows it owns — the stacked tensor's shards are
+    assembled from the member tensors' byte ranges."""
+    groups: dict[str, dict[int, st.TensorInfo]] = {}
+    out: dict[str, st.TensorInfo] = {}
+    for name, info in tensors.items():
+        m = _EXPERT_NAME.match(name)
+        if m:
+            groups.setdefault(f"{m.group(1)}.{m.group(3)}", {})[int(m.group(2))] = info
+        else:
+            out[name] = info
+    for key, members in groups.items():
+        idxs = sorted(members)
+        first = members[idxs[0]]
+        uniform = idxs == list(range(len(idxs))) and all(
+            m.shape == first.shape and m.dtype == first.dtype for m in members.values()
+        )
+        if not uniform:  # unexpected layout: pass the originals through
+            for info in members.values():
+                out[info.name] = info
+            continue
+        ms = [members[i] for i in idxs]
+        out[key] = st.TensorInfo(
+            name=key, dtype=first.dtype, shape=(len(ms), *first.shape),
+            start=first.start, end=first.start + sum(m.nbytes for m in ms),
+            members=ms,
+        )
+    return out
+
+
 def _leading_axis_only(spec: PartitionSpec) -> bool:
     if len(spec) == 0 or spec[0] is None:
         return False
@@ -217,6 +256,7 @@ def load_safetensors(
         (hlen,) = struct.unpack("<Q", head)
         tensors = st.parse_header(bytes(source.read_range(8, hlen)))
         data_offset = 8 + hlen
+    tensors = fuse_expert_tensors(tensors)
 
     stats = LoadStats()
     lock = threading.Lock()
@@ -252,34 +292,47 @@ def load_safetensors(
             _full_cache[info.name] = raw
         return raw
 
+    def _fetch_slice(info: st.TensorInfo, full_spec: tuple) -> tuple[np.ndarray, int]:
+        """Fetch one tensor's slice. Contiguous row blocks (inner dims full)
+        are fetched with one exact ranged read; byte-strided inner-axis
+        slices fetch the whole tensor once (cached) and slice in memory.
+        Returns (array, bytes_read)."""
+        np_dtype = info.np_dtype()
+        inner_full = all(
+            s.start == 0 and s.stop == dim
+            for s, dim in zip(full_spec[1:], info.shape[1:])
+        )
+        if info.shape and inner_full:
+            lead = full_spec[0]
+            b0, b1 = st.row_range(info, lead.start, lead.stop)
+            raw = source.read_range(data_offset + b0, b1 - b0)
+            return _as_np(raw, np_dtype, (lead.stop - lead.start, *info.shape[1:])), b1 - b0
+        raw = _cached_full_tensor(info)
+        arr = _as_np(raw, np_dtype, info.shape)
+        sliced = np.ascontiguousarray(arr[full_spec]) if info.shape else arr.reshape(())
+        return sliced, len(raw)
+
     def fetch_group(info: st.TensorInfo, group: list) -> list:
         """Fetch one shard-group's bytes and start the host->device copy in
         this worker thread (transfers overlap other groups' fetches).
         Returns [(device, on-device shard), ...]."""
         _dev0, idx0 = group[0]
-        np_dtype = info.np_dtype()
         full_spec = _normalize_index(idx0, info.shape)
-        # inner dims full => the shard is a contiguous row block, fetchable
-        # with one ranged read of exactly its bytes
-        inner_full = all(
-            s.start == 0 and s.stop == dim
-            for s, dim in zip(full_spec[1:], info.shape[1:])
-        )
         tf0 = time.monotonic()
-        if info.shape and inner_full:
+        if info.members is not None:
+            # virtual stacked tensor: assemble the shard from the member
+            # tensors (per-expert ranges) this group owns
             lead = full_spec[0]
-            start, stop = lead.start, lead.stop
-            b0, b1 = st.row_range(info, start, stop)
-            raw = source.read_range(data_offset + b0, b1 - b0)
-            shard_shape = (stop - start, *info.shape[1:])
-            arr = _as_np(raw, np_dtype, shard_shape)
+            parts, nread = [], 0
+            for e in range(lead.start, lead.stop):
+                part, nb = _fetch_slice(info.members[e], full_spec[1:])
+                parts.append(part)
+                nread += nb
+            arr = np.stack(parts)
         else:
-            # inner-axis shard (byte-strided): fetch whole tensor once, slice
-            raw = _cached_full_tensor(info)
-            arr = _as_np(raw, np_dtype, info.shape)
-            arr = np.ascontiguousarray(arr[idx0]) if info.shape else arr.reshape(())
+            arr, nread = _fetch_slice(info, full_spec)
         with lock:
-            stats.bytes_fetched += len(raw)
+            stats.bytes_fetched += nread
             stats.fetch_seconds += time.monotonic() - tf0
         if dtype is not None and arr.dtype != np.dtype(dtype):
             arr = arr.astype(dtype)

@@ -50,6 +50,9 @@ class TensorInfo:
     shape: tuple[int, ...]
     start: int  # byte offsets relative to data section start
     end: int
+    # virtual stacked tensor (loader.fuse_expert_tensors): the byte ranges
+    # live in these member tensors, one per leading-axis slot
+    members: "list[TensorInfo] | None" = None
 
     @property
     def nbytes(self) -> int:

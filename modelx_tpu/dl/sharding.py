@@ -94,10 +94,25 @@ BERT_RULES: Rules = [
     (r".*", []),
 ]
 
+# Mixtral (llama attention + stacked-expert MoE FFN; models/mixtral.py).
+# Expert axis over ep, expert ffn features over tp within each expert.
+MIXTRAL_RULES: Rules = [
+    (r"embed_tokens\.weight$", ["tp", None]),
+    (r"lm_head\.weight$", ["tp", None]),
+    (r"(q|k|v)_proj\.weight$", ["tp", None]),
+    (r"o_proj\.weight$", [None, "tp"]),
+    (r"block_sparse_moe\.gate\.weight$", [None, None]),
+    (r"experts\.(w1|w3)\.weight$", ["ep", "tp", None]),
+    (r"experts\.w2\.weight$", ["ep", None, "tp"]),
+    (r"norm\.weight$", [None]),
+    (r".*", []),
+]
+
 DEFAULT_RULES: dict[str, Rules] = {
     "llama": LLAMA_RULES,
     "gpt2": GPT2_RULES,
     "bert": BERT_RULES,
+    "mixtral": MIXTRAL_RULES,
 }
 
 
@@ -108,6 +123,8 @@ def rules_for_family(family: str) -> Rules:
 def infer_family(tensor_names: Sequence[str]) -> str:
     names = list(tensor_names)
     joined = "\n".join(names)
+    if "block_sparse_moe" in joined:
+        return "mixtral"
     if "q_proj" in joined or "gate_proj" in joined:
         return "llama"
     if "c_attn" in joined or "wte" in joined:

@@ -3,5 +3,6 @@ family's native safetensors tensor names, so registry checkpoints load
 directly (no renaming pass)."""
 
 from modelx_tpu.models.llama import LlamaConfig
+from modelx_tpu.models.mixtral import MixtralConfig
 
-__all__ = ["LlamaConfig"]
+__all__ = ["LlamaConfig", "MixtralConfig"]

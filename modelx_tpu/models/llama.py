@@ -176,6 +176,75 @@ class ShardingCtx:
         return jax.lax.with_sharding_constraint(x, NamedSharding(self.mesh, P(*cleaned)))
 
 
+def decoder_layer(
+    lp: dict[str, jax.Array],
+    x: jax.Array,
+    positions: jax.Array,
+    cfg: LlamaConfig,
+    ctx: "ShardingCtx",
+    cache: tuple[jax.Array, jax.Array] | None = None,
+    cache_offset: int | jax.Array = 0,
+    mesh: Mesh | None = None,
+    attention_impl: str = "auto",
+    mlp_fn=None,
+) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None]:
+    """One transformer block. ``lp`` holds the layer's params keyed by the
+    unprefixed HF suffix ("self_attn.q_proj.weight", ...). Returns
+    (x, updated (k,v) cache or None).
+
+    ``mlp_fn(h)`` replaces the dense SwiGLU FFN when given (the post-norm
+    hidden states go in, the FFN output comes out) — Mixtral passes its
+    sparse-MoE block here so the attention half stays shared."""
+    b, s = x.shape[:2]
+    h = _rms_norm(x, lp["input_layernorm.weight"], cfg.rms_eps)
+    q = _linear(h, lp["self_attn.q_proj.weight"])
+    k = _linear(h, lp["self_attn.k_proj.weight"])
+    v = _linear(h, lp["self_attn.v_proj.weight"])
+    q = ctx.constrain(q.reshape(b, s, cfg.num_heads, cfg.head_dim), "dp", "sp", "tp", None)
+    k = ctx.constrain(k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim), "dp", "sp", "tp", None)
+    v = ctx.constrain(v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim), "dp", "sp", "tp", None)
+    q = ctx.constrain(_rope(q, positions, cfg.rope_theta), "dp", "sp", "tp", None)
+    k = ctx.constrain(_rope(k, positions, cfg.rope_theta), "dp", "sp", "tp", None)
+
+    new_cache: tuple[jax.Array, jax.Array] | None = None
+    if cache is not None:
+        ck, cv = cache
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_offset, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_offset, 0, 0))
+        new_cache = (ck, cv)
+        attn_out = _attend(q, ck, cv, cfg, causal=True,
+                           q_offset=cache_offset, mesh=mesh, impl="reference")
+    else:
+        attn_out = _attend(q, k, v, cfg, causal=True, q_offset=0, mesh=mesh, impl=attention_impl)
+
+    attn_out = attn_out.reshape(b, s, cfg.num_heads * cfg.head_dim)
+    x = x + _linear(attn_out, lp["self_attn.o_proj.weight"])
+    x = ctx.constrain(x, "dp", "sp", None)
+
+    h = _rms_norm(x, lp["post_attention_layernorm.weight"], cfg.rms_eps)
+    if mlp_fn is not None:
+        x = x + mlp_fn(h)
+    else:
+        gate = _linear(h, lp["mlp.gate_proj.weight"])
+        up = _linear(h, lp["mlp.up_proj.weight"])
+        ff = ctx.constrain(jax.nn.silu(gate) * up, "dp", "sp", "tp")
+        x = x + _linear(ff, lp["mlp.down_proj.weight"])
+    return ctx.constrain(x, "dp", "sp", None), new_cache
+
+
+LAYER_PARAM_SUFFIXES = (
+    "self_attn.q_proj.weight",
+    "self_attn.k_proj.weight",
+    "self_attn.v_proj.weight",
+    "self_attn.o_proj.weight",
+    "mlp.gate_proj.weight",
+    "mlp.up_proj.weight",
+    "mlp.down_proj.weight",
+    "input_layernorm.weight",
+    "post_attention_layernorm.weight",
+)
+
+
 def forward(
     params: dict[str, jax.Array],
     tokens: jax.Array,
@@ -205,36 +274,14 @@ def forward(
     new_cache: dict | None = {} if kv_cache is not None else None
     for i in range(cfg.num_layers):
         p = f"model.layers.{i}."
-        h = _rms_norm(x, params[p + "input_layernorm.weight"], cfg.rms_eps)
-        q = _linear(h, params[p + "self_attn.q_proj.weight"])
-        k = _linear(h, params[p + "self_attn.k_proj.weight"])
-        v = _linear(h, params[p + "self_attn.v_proj.weight"])
-        q = ctx.constrain(q.reshape(b, s, cfg.num_heads, cfg.head_dim), "dp", "sp", "tp", None)
-        k = ctx.constrain(k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim), "dp", "sp", "tp", None)
-        v = ctx.constrain(v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim), "dp", "sp", "tp", None)
-        q = ctx.constrain(_rope(q, positions, cfg.rope_theta), "dp", "sp", "tp", None)
-        k = ctx.constrain(_rope(k, positions, cfg.rope_theta), "dp", "sp", "tp", None)
-
-        if kv_cache is not None:
-            ck, cv = kv_cache[f"k{i}"], kv_cache[f"v{i}"]
-            ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_offset, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_offset, 0, 0))
-            new_cache[f"k{i}"], new_cache[f"v{i}"] = ck, cv
-            attn_out = _attend(q, ck, cv, cfg, causal=True,
-                               q_offset=cache_offset, mesh=mesh, impl="reference")
-        else:
-            attn_out = _attend(q, k, v, cfg, causal=True, q_offset=0, mesh=mesh, impl=attention_impl)
-
-        attn_out = attn_out.reshape(b, s, cfg.num_heads * cfg.head_dim)
-        x = x + _linear(attn_out, params[p + "self_attn.o_proj.weight"])
-        x = ctx.constrain(x, "dp", "sp", None)
-
-        h = _rms_norm(x, params[p + "post_attention_layernorm.weight"], cfg.rms_eps)
-        gate = _linear(h, params[p + "mlp.gate_proj.weight"])
-        up = _linear(h, params[p + "mlp.up_proj.weight"])
-        ff = ctx.constrain(jax.nn.silu(gate) * up, "dp", "sp", "tp")
-        x = x + _linear(ff, params[p + "mlp.down_proj.weight"])
-        x = ctx.constrain(x, "dp", "sp", None)
+        lp = {suffix: params[p + suffix] for suffix in LAYER_PARAM_SUFFIXES}
+        cache = (kv_cache[f"k{i}"], kv_cache[f"v{i}"]) if kv_cache is not None else None
+        x, updated = decoder_layer(
+            lp, x, positions, cfg, ctx, cache=cache, cache_offset=cache_offset,
+            mesh=mesh, attention_impl=attention_impl,
+        )
+        if updated is not None:
+            new_cache[f"k{i}"], new_cache[f"v{i}"] = updated
 
     x = _rms_norm(x, params["model.norm.weight"], cfg.rms_eps)
     head = params.get("lm_head.weight", params["model.embed_tokens.weight"])

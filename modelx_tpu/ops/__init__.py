@@ -1,1 +1,2 @@
-"""TPU compute ops: attention kernels (reference, pallas flash, ring)."""
+"""TPU compute ops: attention kernels (reference, pallas flash, ring) and
+mixture-of-experts dispatch (GShard-style dense einsum formulation)."""

@@ -1,0 +1,112 @@
+"""Mixture-of-experts ops: top-k router + capacity-based expert dispatch.
+
+TPU-first design (GShard/Switch recipe, the GSPMD-native MoE formulation):
+expert weights are *stacked* along a leading E axis sharded over the ``ep``
+mesh axis; token->expert dispatch is expressed as dense one-hot einsums with
+a fixed per-expert capacity C, so every shape is static and XLA lowers the
+dispatch/combine einsums to all-to-alls over ``ep`` while keeping each
+expert's FFN matmuls local to its shard (and further tp-sharded within it).
+No data-dependent control flow, no gather/scatter with dynamic shapes.
+
+With ``capacity_factor`` large enough that C >= S*k/E at the observed
+routing (tests use drop-free capacity), the math is exactly Mixtral's
+renormalized top-k MoE; under pressure, overflow tokens are dropped
+(combine weight 0) which is the standard capacity trade.
+
+Reference parity note: the reference registry (kubegems/modelx) has no
+models at all (SURVEY §2.2); this module exists for the TPU serving/training
+path the build brief makes first-class.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def router_topk(router_logits: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
+    """Mixtral-style routing: softmax over experts, take top-k, renormalize.
+
+    router_logits: [..., E]. Returns (probs [..., E] with zeros off the
+    top-k and the top-k entries renormalized to sum 1, mask [..., E]).
+    """
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    top_vals, _ = jax.lax.top_k(probs, k)
+    threshold = top_vals[..., k - 1 : k]
+    mask = (probs >= threshold).astype(probs.dtype)
+    # ties could admit >k experts; keep the formulation dense and renormalize
+    kept = probs * mask
+    return kept / jnp.maximum(kept.sum(-1, keepdims=True), 1e-9), mask
+
+
+def expert_capacity(seq: int, num_experts: int, k: int, capacity_factor: float) -> int:
+    """Static per-expert token budget C."""
+    c = int(capacity_factor * seq * k / num_experts + 0.5)
+    return max(1, min(seq, c))
+
+
+def moe_ffn(
+    x: jax.Array,
+    gate_w: jax.Array,
+    w1: jax.Array,
+    w2: jax.Array,
+    w3: jax.Array,
+    *,
+    top_k: int,
+    capacity_factor: float = 0.0,
+    constrain=None,
+) -> jax.Array:
+    """Sparse MoE FFN (SwiGLU experts), dense-dispatch formulation.
+
+    x: [B, S, D]; gate_w: [E, D] (router, torch Linear layout);
+    w1/w3: [E, F, D] (gate/up), w2: [E, D, F] (down) — stacked expert
+    weights, E sharded over ``ep`` and F over ``tp`` by MIXTRAL_RULES.
+    capacity_factor <= 0 means drop-free (C = S, exact Mixtral math).
+    ``constrain(x, *axes)`` is ShardingCtx.constrain or None.
+    """
+    b, s, d = x.shape
+    e = gate_w.shape[0]
+    c = s if capacity_factor <= 0 else expert_capacity(s, e, top_k, capacity_factor)
+    cons = constrain if constrain is not None else (lambda arr, *spec: arr)
+
+    router_logits = jax.lax.dot_general(
+        x, gate_w, (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [B, S, E]
+    probs, mask = router_topk(router_logits, top_k)
+
+    # position of each token within its expert's capacity buffer
+    pos = jnp.cumsum(mask, axis=1) * mask - 1.0  # [B, S, E], -1 where unrouted
+    in_cap = (pos >= 0) & (pos < c)
+    combine = jnp.where(in_cap, probs, 0.0)  # [B, S, E]
+    # one-hot over the capacity slot: [B, S, E, C]
+    slot = jax.nn.one_hot(jnp.where(in_cap, pos, -1).astype(jnp.int32), c, dtype=x.dtype)
+    dispatch = slot * mask.astype(x.dtype)[..., None]
+
+    # scatter tokens to expert buffers: [E, B, C, D] — the all-to-all edge
+    expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x, preferred_element_type=jnp.float32).astype(x.dtype)
+    expert_in = cons(expert_in, "ep", "dp", None, None)
+
+    # per-expert SwiGLU, batched over E (local to each ep shard, tp inside)
+    gate = jnp.einsum("ebcd,efd->ebcf", expert_in, w1, preferred_element_type=jnp.float32).astype(x.dtype)
+    up = jnp.einsum("ebcd,efd->ebcf", expert_in, w3, preferred_element_type=jnp.float32).astype(x.dtype)
+    h = cons(jax.nn.silu(gate) * up, "ep", "dp", None, "tp")
+    expert_out = jnp.einsum("ebcf,edf->ebcd", h, w2, preferred_element_type=jnp.float32).astype(x.dtype)
+    expert_out = cons(expert_out, "ep", "dp", None, None)
+
+    # gather back with the combine weights: [B, S, D]
+    out = jnp.einsum(
+        "bsec,ebcd->bsd", (combine[..., None] * slot).astype(x.dtype), expert_out,
+        preferred_element_type=jnp.float32,
+    ).astype(x.dtype)
+    return cons(out, "dp", "sp", None)
+
+
+def load_balancing_loss(router_logits: jax.Array, mask: jax.Array) -> jax.Array:
+    """Switch-style auxiliary load-balancing loss: E * sum_e f_e * p_e,
+    where f_e = fraction of tokens routed to expert e, p_e = mean router
+    probability. router_logits/mask: [..., E]."""
+    e = router_logits.shape[-1]
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    probs = probs.reshape(-1, e)
+    frac = mask.reshape(-1, e).astype(jnp.float32)
+    return e * jnp.sum(jnp.mean(frac, 0) * jnp.mean(probs, 0))
