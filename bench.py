@@ -113,6 +113,15 @@ def main() -> None:
         warm.block_until_ready()
         del warm
 
+        # -- modelx-tpu loader: ranged parallel -> HBM ------------------------
+        t0 = time.monotonic()
+        loaded, stats = load_safetensors(
+            HTTPSource(url, total=size), mesh, LLAMA_RULES,
+            tensors=tensors, data_offset=data_offset,
+        )
+        ours_s = time.monotonic() - t0
+        del loaded
+
         # -- baseline: sequential download to volume, then load ---------------
         t0 = time.monotonic()
         vol = os.path.join(workdir, "volume.safetensors")
@@ -132,15 +141,6 @@ def main() -> None:
         jax.block_until_ready(arrays)
         baseline_s = time.monotonic() - t0
         del arrays
-
-        # -- modelx-tpu loader: ranged parallel -> HBM ------------------------
-        t0 = time.monotonic()
-        loaded, stats = load_safetensors(
-            HTTPSource(url, total=size), mesh, LLAMA_RULES,
-            tensors=tensors, data_offset=data_offset,
-        )
-        ours_s = time.monotonic() - t0
-        del loaded
 
         ours_gbps = size / ours_s / 1e9
         baseline_gbps = size / baseline_s / 1e9

@@ -38,9 +38,8 @@ def spec_for(name: str, rules: Rules) -> PartitionSpec:
     return PartitionSpec()
 
 
-def sharding_for(name: str, rules: Rules, mesh: Mesh) -> NamedSharding:
-    spec = spec_for(name, rules)
-    # drop axis names the mesh doesn't have (e.g. tp rules on a dp-only mesh)
+def clean_spec(spec: PartitionSpec, mesh: Mesh) -> PartitionSpec:
+    """Drop axis names the mesh doesn't have (e.g. tp rules on a dp-only mesh)."""
     cleaned = []
     for entry in spec:
         if entry is None:
@@ -50,7 +49,11 @@ def sharding_for(name: str, rules: Rules, mesh: Mesh) -> NamedSharding:
             cleaned.append(kept if kept else None)
         else:
             cleaned.append(entry if entry in mesh.axis_names else None)
-    return NamedSharding(mesh, PartitionSpec(*cleaned))
+    return PartitionSpec(*cleaned)
+
+
+def sharding_for(name: str, rules: Rules, mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, clean_spec(spec_for(name, rules), mesh))
 
 
 # -- default rule sets --------------------------------------------------------

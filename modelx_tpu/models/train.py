@@ -36,15 +36,25 @@ def param_shardings(params_shapes: dict, rules: Rules, mesh: Mesh) -> dict:
     return {name: sharding_for(name, rules, mesh) for name in params_shapes}
 
 
-def make_train_step(cfg: llama.LlamaConfig, optimizer: optax.GradientTransformation, mesh: Mesh | None = None):
+def make_train_step(
+    cfg: llama.LlamaConfig,
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh | None = None,
+    forward_fn=None,
+):
     """Returns train_step(params, opt_state, batch) -> (params, opt_state, loss).
 
     ``batch`` = {"tokens": [B,S] int32, "targets": [B,S] int32}.
+    ``forward_fn(params, tokens) -> logits`` overrides the default llama
+    forward (the pp pipeline reuses this step with its own forward).
     """
+    if forward_fn is None:
+        def forward_fn(params, tokens):
+            logits, _ = llama.forward(params, tokens, cfg, mesh=mesh)
+            return logits
 
     def loss_fn(params, batch):
-        logits, _ = llama.forward(params, batch["tokens"], cfg, mesh=mesh)
-        return cross_entropy_loss(logits, batch["targets"])
+        return cross_entropy_loss(forward_fn(params, batch["tokens"]), batch["targets"])
 
     def train_step(params, opt_state, batch):
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)

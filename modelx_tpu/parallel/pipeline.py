@@ -50,27 +50,16 @@ def unstack_layer_params(stacked: dict[str, jax.Array], num_layers: int) -> dict
 
 
 def stacked_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
-    """Shardings for a stacked param dict: layers over pp, megatron tp
-    within each layer (column/row parallel as in LLAMA_RULES)."""
+    """Shardings for a stacked param dict: layers over pp, per-layer specs
+    derived from the canonical LLAMA_RULES (so tp layout can't drift)."""
+    from modelx_tpu.dl.sharding import LLAMA_RULES, clean_spec, spec_for
 
-    def ns(*spec):
-        cleaned = [s if (s in mesh.axis_names) else None for s in spec]
-        return NamedSharding(mesh, P(*cleaned))
-
-    sh = {
-        "model.embed_tokens.weight": ns("tp", None),
-        "model.norm.weight": ns(None),
-        "lm_head.weight": ns("tp", None),
-        "self_attn.q_proj.weight": ns("pp", "tp", None),
-        "self_attn.k_proj.weight": ns("pp", "tp", None),
-        "self_attn.v_proj.weight": ns("pp", "tp", None),
-        "self_attn.o_proj.weight": ns("pp", None, "tp"),
-        "mlp.gate_proj.weight": ns("pp", "tp", None),
-        "mlp.up_proj.weight": ns("pp", "tp", None),
-        "mlp.down_proj.weight": ns("pp", None, "tp"),
-        "input_layernorm.weight": ns("pp", None),
-        "post_attention_layernorm.weight": ns("pp", None),
-    }
+    sh = {}
+    for name in ("model.embed_tokens.weight", "model.norm.weight", "lm_head.weight"):
+        sh[name] = NamedSharding(mesh, clean_spec(spec_for(name, LLAMA_RULES), mesh))
+    for suffix in llama.LAYER_PARAM_SUFFIXES:
+        spec = P("pp", *spec_for(suffix, LLAMA_RULES))
+        sh[suffix] = NamedSharding(mesh, clean_spec(spec, mesh))
     return sh
 
 
@@ -159,18 +148,13 @@ def make_pipeline_train_step(cfg: llama.LlamaConfig, optimizer, mesh: Mesh, num_
     """train_step(stacked_params, opt_state, batch) -> (params, opt_state, loss)
     where the forward is the pp pipeline above and grads flow back through
     the ppermute ring (fori_loop lowers to scan, so reverse-mode works)."""
-    import optax
+    from modelx_tpu.models.train import make_train_step
 
-    from modelx_tpu.models.train import cross_entropy_loss
-
-    def loss_fn(stacked, batch):
-        logits = pipeline_forward(stacked, batch["tokens"], cfg, mesh, num_microbatches)
-        return cross_entropy_loss(logits, batch["targets"])
-
-    def train_step(stacked, opt_state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(stacked, batch)
-        updates, opt_state = optimizer.update(grads, opt_state, stacked)
-        stacked = optax.apply_updates(stacked, updates)
-        return stacked, opt_state, loss
-
-    return train_step
+    return make_train_step(
+        cfg,
+        optimizer,
+        mesh=mesh,
+        forward_fn=lambda stacked, tokens: pipeline_forward(
+            stacked, tokens, cfg, mesh, num_microbatches
+        ),
+    )

@@ -204,3 +204,71 @@ class TestLoader:
             assert stats.gbps > 0
         finally:
             srv.shutdown()
+
+
+class TestLoaderFailure:
+    def test_fetch_error_propagates_without_deadlock(self, tmp_path):
+        """A mid-load fetch failure must raise, not deadlock the fetch pool
+        on the transfer backpressure semaphore (regression: permits leaked
+        when transfer_pool.submit refused work after shutdown)."""
+        import ml_dtypes
+
+        path = str(tmp_path / "m.safetensors")
+        t = {
+            f"model.layers.{i}.mlp.gate_proj.weight": np.ones((64, 32), ml_dtypes.bfloat16)
+            for i in range(40)
+        }
+        st.write_safetensors(path, t)
+        tensors, off = st.read_header_from_file(path)
+
+        class FlakySource(LocalFileSource):
+            calls = 0
+
+            def read_range(self, offset, length, out=None):
+                FlakySource.calls += 1
+                if FlakySource.calls == 3:
+                    raise OSError("injected fetch failure")
+                return super().read_range(offset, length, out)
+
+        mesh = make_mesh("dp=1")
+        with pytest.raises(OSError, match="injected"):
+            load_safetensors(
+                FlakySource(path), mesh, LLAMA_RULES, tensors=tensors, data_offset=off
+            )
+
+
+class TestExpertFusionGate:
+    def _experts(self):
+        infos = {}
+        start = 0
+        for e in range(4):
+            name = f"model.layers.0.block_sparse_moe.experts.{e}.w1.weight"
+            infos[name] = st.TensorInfo(name=name, dtype="BF16", shape=(8, 4), start=start, end=start + 64)
+            start += 64
+        return infos
+
+    def test_fuses_under_family_rules(self):
+        from modelx_tpu.dl.loader import fuse_expert_tensors
+        from modelx_tpu.dl.sharding import MIXTRAL_RULES
+
+        fused = fuse_expert_tensors(self._experts(), MIXTRAL_RULES)
+        assert list(fused) == ["model.layers.0.block_sparse_moe.experts.w1.weight"]
+        assert fused["model.layers.0.block_sparse_moe.experts.w1.weight"].shape == (4, 8, 4)
+
+    def test_fuses_on_catch_all_tie(self):
+        """Catch-all-only rules (checkpoint pushed without annotations) must
+        still fuse — models/mixtral.py consumes the stacked layout."""
+        from modelx_tpu.dl.loader import fuse_expert_tensors
+
+        fused = fuse_expert_tensors(self._experts(), [(r".*", [])])
+        assert list(fused) == ["model.layers.0.block_sparse_moe.experts.w1.weight"]
+
+    def test_user_rules_targeting_hf_names_disable_fusion(self):
+        """A shard-spec annotation written against the on-disk per-expert
+        names wins: tensors keep their HF names and specs apply."""
+        from modelx_tpu.dl.loader import fuse_expert_tensors
+
+        rules = [(r"experts\.\d+\.w1\.weight$", ["tp", None]), (r".*", [])]
+        out = fuse_expert_tensors(self._experts(), rules)
+        assert len(out) == 4
+        assert all("experts." in n for n in out)

@@ -2,6 +2,7 @@
 HuggingFace reference implementations (torch CPU) through the full
 checkpoint->safetensors->loader->forward path."""
 
+import dataclasses
 import numpy as np
 import pytest
 
@@ -194,7 +195,7 @@ class TestMixtral:
         from modelx_tpu.models import mixtral
 
         cfg = mixtral.MixtralConfig.tiny(vocab_size=64)
-        cfg = mixtral.MixtralConfig(**{**cfg.__dict__, "dtype": jnp.float32})
+        cfg = dataclasses.replace(cfg, dtype=jnp.float32)
         params = mixtral.init_params(cfg, jax.random.PRNGKey(1))
         tokens = jnp.array([[7, 3, 9, 1, 4, 2, 8, 6]], jnp.int32)
         want, _ = mixtral.forward(params, tokens, cfg)
@@ -213,7 +214,7 @@ class TestMixtral:
         from modelx_tpu.models import mixtral
 
         cfg = mixtral.MixtralConfig.tiny(vocab_size=64)
-        cfg = mixtral.MixtralConfig(**{**cfg.__dict__, "dtype": jnp.float32})
+        cfg = dataclasses.replace(cfg, dtype=jnp.float32)
         params = mixtral.init_params(cfg, jax.random.PRNGKey(2))
         tokens = jnp.array([[5, 11, 23, 42]], jnp.int32)
         full, _ = mixtral.forward(params, tokens, cfg)

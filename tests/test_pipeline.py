@@ -2,6 +2,7 @@
 must match the plain GSPMD forward bit-for-bit in fp32, and the pipelined
 train step must be differentiable end-to-end."""
 
+import dataclasses
 import numpy as np
 
 import jax
@@ -21,7 +22,7 @@ from modelx_tpu.parallel.pipeline import (
 
 def _tiny_fp32(num_layers=4):
     cfg = llama.LlamaConfig.tiny(vocab_size=64)
-    return llama.LlamaConfig(**{**cfg.__dict__, "num_layers": num_layers, "dtype": jnp.float32})
+    return dataclasses.replace(cfg, num_layers=num_layers, dtype=jnp.float32)
 
 
 class TestStacking:
