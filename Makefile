@@ -5,9 +5,14 @@ PY ?= python
 IMAGE ?= modelx-tpu
 TAG ?= $(shell git describe --tags --always 2>/dev/null || echo dev)
 
-.PHONY: all test lint wheel image image-dl compose-up compose-down clean
+.PHONY: all native test lint wheel image image-dl compose-up compose-down clean
 
-all: test wheel
+all: native test wheel
+
+# native IO engine (ranged HTTP fetch / scatter pread / sha256); auto-built
+# on first use too — this target just prebuilds it
+native:
+	$(PY) -c "from modelx_tpu import native; print(native.build(force=True))"
 
 test:
 	$(PY) -m pytest tests/ -q

@@ -62,6 +62,12 @@ class LocalFileSource:
         self.path = path
         self._size = os.path.getsize(path)
         self._fd = os.open(path, os.O_RDONLY)
+        try:
+            from modelx_tpu import native
+
+            self._native = native if native.available() else None
+        except ImportError:
+            self._native = None
 
     def read_range(self, offset: int, length: int, out: memoryview | None = None):
         if out is None:
@@ -69,6 +75,10 @@ class LocalFileSource:
             out = memoryview(buf)
         else:
             buf = out
+        if self._native is not None and length > 0:
+            # GIL-free positional read (modelx_io.cc mx_pread_scatter)
+            self._native.pread_scatter(self.path, [(offset, length, out)], threads=1)
+            return buf
         n = 0
         while n < length:
             got = os.preadv(self._fd, [out[n:]], offset + n)
@@ -104,11 +114,15 @@ class HTTPSource:
         u = urllib.parse.urlsplit(url)
         self._scheme = u.scheme
         self._host = u.hostname or ""
-        self._port = u.port
+        self._port = u.port or (443 if u.scheme == "https" else 80)
         self._path = u.path + (f"?{u.query}" if u.query else "")
         self._netloc = u.netloc
         self._local = threading.local()
         self._size = total
+        # native engine: raw-socket ranged GETs with the GIL released for the
+        # whole transfer (http only; TLS stays on the python path)
+        self._use_native = u.scheme == "http"
+        self._native_headers = "".join(f"{k}: {v}\r\n" for k, v in self.headers.items())
 
     def _conn(self):
         import http.client
@@ -133,7 +147,47 @@ class HTTPSource:
             conn.request(method, self._path, headers=headers)
             return conn.getresponse()
 
+    def _native_conn(self):
+        """Thread-local native keep-alive connection (None once disabled)."""
+        conn = getattr(self._local, "native", None)
+        if conn is None:
+            try:
+                from modelx_tpu import native
+            except ImportError:
+                self._use_native = False
+                return None
+            if not native.available():
+                self._use_native = False
+                return None
+            conn = native.NativeHTTPConnection(self._host, self._port)
+            self._local.native = conn
+        return conn
+
     def read_range(self, offset: int, length: int, out: memoryview | None = None):
+        if self._use_native:
+            try:
+                conn = self._native_conn()
+            except OSError:
+                conn = None
+                self._use_native = False
+            if conn is not None:
+                if out is None:
+                    buf = np.empty(length, np.uint8)
+                    view = memoryview(buf)
+                else:
+                    buf, view = out, memoryview(out)
+                try:
+                    status = conn.get_range(self._path, offset, length, view, self._native_headers)
+                except OSError:
+                    # transport/protocol trouble (e.g. server ignored Range):
+                    # drop to the python path for this source
+                    self._local.native = None
+                    conn.close()
+                    self._use_native = False
+                else:
+                    if status in (200, 206):
+                        return buf
+                    raise OSError(f"ranged read failed: HTTP {status}")
         h = dict(self.headers)
         h["Range"] = f"bytes={offset}-{offset + length - 1}"
         resp = self._request("GET", h)

@@ -1,0 +1,196 @@
+"""ctypes bindings for the native IO engine (modelx_io.cc).
+
+The reference's data plane is a compiled Go binary; here the byte-moving hot
+loops (ranged HTTP fetch, positional file scatter reads, sha256 content
+addressing) are C++ compiled on demand with the baked-in g++ and loaded via
+ctypes — every call releases the GIL for its full duration, so loader fetch
+threads don't contend with the jax.device_put dispatch thread.
+
+Degrades gracefully: if the toolchain or a prebuilt .so is unavailable,
+``lib()`` returns None and callers keep their pure-Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+logger = logging.getLogger("modelx.native")
+
+_SRC = os.path.join(os.path.dirname(__file__), "modelx_io.cc")
+_SO = os.path.join(os.path.dirname(__file__), "_build", "libmodelx_io.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+class MxRange(ctypes.Structure):
+    _fields_ = [
+        ("offset", ctypes.c_int64),
+        ("length", ctypes.c_int64),
+        ("buf", ctypes.c_void_p),
+    ]
+
+
+def build(force: bool = False) -> str | None:
+    """Compile modelx_io.cc -> _build/libmodelx_io.so. Returns the path, or
+    None when no toolchain is available. Cached: skips when the .so is newer
+    than the source."""
+    if (
+        not force
+        and os.path.exists(_SO)
+        and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+    ):
+        return _SO
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    cmd = [
+        "g++", "-O2", "-shared", "-fPIC", "-pthread",
+        "-o", _SO + ".tmp", _SRC, "-ldl",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.debug("native build unavailable: %s", e)
+        return None
+    os.replace(_SO + ".tmp", _SO)
+    return _SO
+
+
+def lib() -> ctypes.CDLL | None:
+    """The loaded native library, building it on first use; None if the
+    native engine is unavailable (callers fall back to pure Python)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        path = build()  # no-op when the .so is newer than the source
+        if path is None:
+            return None
+        try:
+            l = ctypes.CDLL(path)
+        except OSError as e:
+            logger.debug("native load failed: %s", e)
+            return None
+        l.mx_pread_scatter.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(MxRange), ctypes.c_int, ctypes.c_int,
+        ]
+        l.mx_pread_scatter.restype = ctypes.c_int
+        l.mx_sha256_file.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+        l.mx_sha256_file.restype = ctypes.c_int
+        l.mx_sha256_buf.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p]
+        l.mx_sha256_buf.restype = ctypes.c_int
+        l.mx_http_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+        l.mx_http_connect.restype = ctypes.c_void_p
+        l.mx_http_close.argtypes = [ctypes.c_void_p]
+        l.mx_http_close.restype = None
+        l.mx_http_get_range.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+        ]
+        l.mx_http_get_range.restype = ctypes.c_int
+        _lib = l
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+# -- high-level wrappers ------------------------------------------------------
+
+
+def sha256_file(path: str) -> str | None:
+    """Hex sha256 of a file, GIL-free; None if the engine is unavailable."""
+    l = lib()
+    if l is None:
+        return None
+    out = ctypes.create_string_buffer(65)
+    rc = l.mx_sha256_file(path.encode(), out)
+    if rc != 0:
+        raise OSError(-rc, f"mx_sha256_file({path}): {os.strerror(-rc)}")
+    return out.value.decode()
+
+
+def sha256_buffer(view) -> str | None:
+    """Hex sha256 of a bytes-like object; None if unavailable."""
+    l = lib()
+    if l is None:
+        return None
+    mv = memoryview(view)
+    if not mv.c_contiguous:
+        mv = memoryview(bytes(mv))
+    out = ctypes.create_string_buffer(65)
+    addr = ctypes.addressof(ctypes.c_char.from_buffer(mv)) if not mv.readonly else None
+    if addr is None:
+        buf = (ctypes.c_char * len(mv)).from_buffer_copy(mv)
+        addr = ctypes.addressof(buf)
+    l.mx_sha256_buf(addr, len(mv), out)
+    return out.value.decode()
+
+
+def pread_scatter(path: str, ranges: list[tuple[int, int, memoryview]], threads: int = 8) -> None:
+    """Parallel positional reads: each (offset, length, writable buffer)."""
+    l = lib()
+    if l is None:
+        raise RuntimeError("native engine unavailable")
+    arr = (MxRange * len(ranges))()
+    _keep = []
+    for i, (off, ln, mv) in enumerate(ranges):
+        c = ctypes.c_char.from_buffer(mv)
+        _keep.append(c)
+        arr[i] = MxRange(off, ln, ctypes.addressof(c))
+    rc = l.mx_pread_scatter(path.encode(), arr, len(ranges), threads)
+    if rc != 0:
+        raise OSError(-rc, f"mx_pread_scatter({path}): {os.strerror(-rc)}")
+
+
+class NativeHTTPConnection:
+    """One keep-alive connection to an http:// origin; ranged GETs land
+    straight in caller buffers with the GIL released."""
+
+    def __init__(self, host: str, port: int, timeout_ms: int = 300_000) -> None:
+        l = lib()
+        if l is None:
+            raise RuntimeError("native engine unavailable")
+        self._lib = l
+        self._conn = l.mx_http_connect(host.encode(), port, timeout_ms)
+        if not self._conn:
+            raise OSError(f"connect {host}:{port} failed")
+        self._host = host
+        self._port = port
+
+    def get_range(self, path: str, offset: int, length: int, out: memoryview,
+                  headers: str = "") -> int:
+        """Returns the HTTP status; raises on transport errors. ``out`` must
+        be exactly ``length`` bytes."""
+        if len(out) != length:
+            raise ValueError(f"buffer {len(out)} != length {length}")
+        c = ctypes.c_char.from_buffer(out)
+        # bracket IPv6 literals (urlsplit strips the brackets)
+        host = f"[{self._host}]" if ":" in self._host else self._host
+        host_hdr = f"{host}:{self._port}"
+        rc = self._lib.mx_http_get_range(
+            self._conn, host_hdr.encode(), path.encode(), headers.encode(),
+            offset, length, ctypes.addressof(c),
+        )
+        if rc < 0:
+            raise OSError(f"native ranged GET failed (code {rc}) for {path}")
+        return rc
+
+    def close(self) -> None:
+        if getattr(self, "_conn", None):
+            self._lib.mx_http_close(self._conn)
+            self._conn = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass

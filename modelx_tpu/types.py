@@ -85,6 +85,16 @@ class Digest(str):
 
     @classmethod
     def from_file(cls, path: str, chunk_size: int = 4 * 1024 * 1024) -> "Digest":
+        try:
+            # GIL-free native hashing so concurrent blob pushes/pulls don't
+            # serialize on the interpreter (modelx_tpu/native/modelx_io.cc)
+            from modelx_tpu import native
+
+            hexdigest = native.sha256_file(path)
+            if hexdigest is not None:
+                return cls("sha256:" + hexdigest)
+        except (OSError, ImportError):
+            pass  # engine unavailable/unreadable: surface the python path's error
         with open(path, "rb") as f:
             return cls.from_reader(f, chunk_size)
 
