@@ -104,6 +104,12 @@ class Puller:
 
     def _pull_file(self, repository: str, desc: Descriptor, directory: str, bars: MultiBar) -> None:
         """pull.go:111-143."""
+        from modelx_tpu.utils import trace
+
+        with trace.span("pull.blob", blob=desc.name, bytes=desc.size):
+            self._pull_file_inner(repository, desc, directory, bars)
+
+    def _pull_file_inner(self, repository: str, desc: Descriptor, directory: str, bars: MultiBar) -> None:
         target = os.path.join(directory, desc.name)
         bar = bars.bar(desc.name, desc.size)
         if os.path.isfile(target) and str(Digest.from_file(target)) == desc.digest:

@@ -120,6 +120,12 @@ class Pusher:
 
     def push_blob(self, repository: str, desc: Descriptor, path: str, bars: MultiBar) -> None:
         """push.go:163-207."""
+        from modelx_tpu.utils import trace
+
+        with trace.span("push.blob", blob=desc.name, bytes=desc.size):
+            self._push_blob(repository, desc, path, bars)
+
+    def _push_blob(self, repository: str, desc: Descriptor, path: str, bars: MultiBar) -> None:
         bar = bars.bar(desc.name, desc.size)
         if self.remote.head_blob(repository, desc.digest):
             bar.done("exists")  # dedup skip (push.go:169-177)

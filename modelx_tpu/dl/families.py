@@ -1,0 +1,227 @@
+"""Model-family registry for the serving path: checkpoint tensor names ->
+(config inference, partition rules, forward/generate adapters).
+
+The reference stores models without understanding them; the TPU serving
+sidecar has to *execute* them, so each supported family contributes:
+
+- ``infer_config(params)``: recover the architecture from tensor shapes
+  (no config.json required — the checkpoint is self-describing);
+- ``rules``: GSPMD partition rules (dl/sharding.py);
+- ``forward(params, tokens, cfg, mesh)`` -> logits/features;
+- ``generate`` (causal families only).
+
+``detect(params)`` picks the family from tensor names, mirroring
+dl/sharding.infer_family but over loaded params.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from modelx_tpu.dl.sharding import (
+    BERT_RULES,
+    GPT2_RULES,
+    LLAMA_RULES,
+    MIXTRAL_RULES,
+    Rules,
+    infer_family,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Family:
+    name: str
+    rules: Rules
+    infer_config: Callable[[dict], Any]
+    forward: Callable[..., jax.Array]  # (params, tokens, cfg, mesh) -> logits
+    generate: Callable[..., jax.Array] | None = None  # causal LMs only
+
+
+def _shape(params: dict, name: str) -> tuple[int, ...]:
+    return tuple(params[name].shape)
+
+
+# -- llama --------------------------------------------------------------------
+
+
+def infer_llama_config(params: dict):
+    """Recover the architecture from checkpoint tensor shapes."""
+    from modelx_tpu.models import llama
+
+    vocab, hidden = _shape(params, "model.embed_tokens.weight")
+    layers = 0
+    while f"model.layers.{layers}.self_attn.q_proj.weight" in params:
+        layers += 1
+    q = _shape(params, "model.layers.0.self_attn.q_proj.weight")[0]
+    kv = _shape(params, "model.layers.0.self_attn.k_proj.weight")[0]
+    inter = _shape(params, "model.layers.0.mlp.gate_proj.weight")[0]
+    # head_dim heuristics: llama uses 128 for big models; fall back to h/32
+    head_dim = 128 if q % 128 == 0 and q // 128 >= 8 else max(q // 32, 32)
+    if hidden <= 512:  # toy checkpoints
+        head_dim = 32
+    return llama.LlamaConfig(
+        vocab_size=vocab,
+        hidden_size=hidden,
+        intermediate_size=inter,
+        num_layers=layers,
+        num_heads=q // head_dim,
+        num_kv_heads=kv // head_dim,
+        head_dim=head_dim,
+        tie_embeddings="lm_head.weight" not in params,
+    )
+
+
+def _llama_forward(params, tokens, cfg, mesh=None):
+    from modelx_tpu.models import llama
+
+    return llama.forward(params, tokens, cfg, mesh=mesh)[0]
+
+
+def _llama_generate(params, tokens, cfg, mesh=None, max_new_tokens=16):
+    from modelx_tpu.models import llama
+
+    return llama.greedy_generate(params, tokens, cfg, max_new_tokens=max_new_tokens, mesh=mesh)
+
+
+# -- mixtral ------------------------------------------------------------------
+
+
+def infer_mixtral_config(params: dict):
+    from modelx_tpu.models import mixtral
+
+    vocab, hidden = _shape(params, "model.embed_tokens.weight")
+    layers = 0
+    while f"model.layers.{layers}.self_attn.q_proj.weight" in params:
+        layers += 1
+    q = _shape(params, "model.layers.0.self_attn.q_proj.weight")[0]
+    kv = _shape(params, "model.layers.0.self_attn.k_proj.weight")[0]
+    w1 = "model.layers.0.block_sparse_moe.experts.w1.weight"
+    num_experts, inter, _ = _shape(params, w1)
+    head_dim = 128 if q % 128 == 0 and q // 128 >= 8 else max(q // 32, 32)
+    if hidden <= 512:
+        head_dim = 32
+    return mixtral.MixtralConfig(
+        vocab_size=vocab,
+        hidden_size=hidden,
+        intermediate_size=inter,
+        num_layers=layers,
+        num_heads=q // head_dim,
+        num_kv_heads=kv // head_dim,
+        head_dim=head_dim,
+        num_experts=num_experts,
+    )
+
+
+def _mixtral_forward(params, tokens, cfg, mesh=None):
+    from modelx_tpu.models import mixtral
+
+    return mixtral.forward(params, tokens, cfg, mesh=mesh)[0]
+
+
+def _mixtral_generate(params, tokens, cfg, mesh=None, max_new_tokens=16):
+    """Greedy decode via full re-forward per step (cacheless reference
+    path); fine for the sidecar's correctness surface."""
+    import jax.numpy as jnp
+
+    from modelx_tpu.models import mixtral
+
+    out = tokens
+    for _ in range(max_new_tokens):
+        logits = mixtral.forward(params, out, cfg, mesh=mesh)[0]
+        nxt = jnp.argmax(logits[:, -1:, :], axis=-1).astype(out.dtype)
+        out = jnp.concatenate([out, nxt], axis=1)
+    return out
+
+
+# -- gpt2 ---------------------------------------------------------------------
+
+
+def infer_gpt2_config(params: dict):
+    from modelx_tpu.models import gpt2
+
+    vocab, hidden = _shape(params, "wte.weight")
+    n_pos = _shape(params, "wpe.weight")[0]
+    layers = 0
+    while f"h.{layers}.attn.c_attn.weight" in params:
+        layers += 1
+    # head count: standard gpt2 uses hidden/64 heads
+    num_heads = max(hidden // 64, 1)
+    if hidden <= 128:  # toy checkpoints
+        num_heads = 4
+    return gpt2.GPT2Config(
+        vocab_size=vocab, n_positions=n_pos, hidden_size=hidden,
+        num_layers=layers, num_heads=num_heads,
+    )
+
+
+def _gpt2_forward(params, tokens, cfg, mesh=None):
+    from modelx_tpu.models import gpt2
+
+    return gpt2.forward(params, tokens, cfg)
+
+
+def _gpt2_generate(params, tokens, cfg, mesh=None, max_new_tokens=16):
+    import jax.numpy as jnp
+
+    from modelx_tpu.models import gpt2
+
+    out = tokens
+    for _ in range(max_new_tokens):
+        logits = gpt2.forward(params, out, cfg)
+        nxt = jnp.argmax(logits[:, -1:, :], axis=-1).astype(out.dtype)
+        out = jnp.concatenate([out, nxt], axis=1)
+    return out
+
+
+# -- bert ---------------------------------------------------------------------
+
+
+def infer_bert_config(params: dict):
+    from modelx_tpu.models import bert
+
+    vocab, hidden = _shape(params, "bert.embeddings.word_embeddings.weight")
+    max_pos = _shape(params, "bert.embeddings.position_embeddings.weight")[0]
+    type_vocab = _shape(params, "bert.embeddings.token_type_embeddings.weight")[0]
+    layers = 0
+    while f"bert.encoder.layer.{layers}.attention.self.query.weight" in params:
+        layers += 1
+    inter = _shape(params, "bert.encoder.layer.0.intermediate.dense.weight")[0]
+    num_heads = max(hidden // 64, 1)
+    if hidden <= 128:
+        num_heads = 4
+    return bert.BertConfig(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_heads=num_heads, intermediate_size=inter,
+        max_position_embeddings=max_pos, type_vocab_size=type_vocab,
+    )
+
+
+def _bert_forward(params, tokens, cfg, mesh=None):
+    """Returns the sequence output [B,S,E] (encoder family: 'logits' are
+    features, argmax over E is not meaningful but harmless for probes)."""
+    from modelx_tpu.models import bert
+
+    return bert.forward(params, tokens, cfg)[0]
+
+
+FAMILIES: dict[str, Family] = {
+    "llama": Family("llama", LLAMA_RULES, infer_llama_config, _llama_forward, _llama_generate),
+    "mixtral": Family("mixtral", MIXTRAL_RULES, infer_mixtral_config, _mixtral_forward, _mixtral_generate),
+    "gpt2": Family("gpt2", GPT2_RULES, infer_gpt2_config, _gpt2_forward, _gpt2_generate),
+    "bert": Family("bert", BERT_RULES, infer_bert_config, _bert_forward, None),
+}
+
+
+def detect(tensor_names) -> Family:
+    """Family from tensor names; raises for unrecognized checkpoints."""
+    name = infer_family(list(tensor_names))
+    if not name or name not in FAMILIES:
+        raise ValueError(
+            f"cannot determine model family from tensors ({list(tensor_names)[:4]}...); "
+            f"supported: {sorted(FAMILIES)}"
+        )
+    return FAMILIES[name]

@@ -61,21 +61,25 @@ def run_initializer(
     quiet: bool = False,
 ) -> dict:
     """modelxdl.go:50-98 Run. Returns a summary dict (timings, GB/s)."""
+    from modelx_tpu.utils import trace
+
     t0 = time.monotonic()
     ref = parse_reference(uri)
     client = ref.client(quiet=quiet)
-    manifest = client.get_manifest(ref.repository, ref.version)
+    with trace.span("dl.manifest", uri=uri):
+        manifest = client.get_manifest(ref.repository, ref.version)
 
-    config = ModelConfig()
-    if manifest.config.digest:
-        raw = client.get_config_content(ref.repository, ref.version)
-        try:
-            config = ModelConfig.from_yaml(raw)
-        except ValueError:
-            logger.warning("invalid modelx.yaml in %s; pulling everything", uri)
+        config = ModelConfig()
+        if manifest.config.digest:
+            raw = client.get_config_content(ref.repository, ref.version)
+            try:
+                config = ModelConfig.from_yaml(raw)
+            except ValueError:
+                logger.warning("invalid modelx.yaml in %s; pulling everything", uri)
 
     selected = filter_blobs(manifest, config.model_files)
-    Puller(client.remote, quiet=quiet).pull_blobs(ref.repository, selected, dest)
+    with trace.span("dl.pull", blobs=len(selected.blobs)):
+        Puller(client.remote, quiet=quiet).pull_blobs(ref.repository, selected, dest)
     pull_seconds = time.monotonic() - t0
     summary: dict = {
         "uri": uri,

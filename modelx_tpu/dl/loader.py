@@ -76,8 +76,8 @@ class LocalFileSource:
         else:
             buf = out
         if self._native is not None and length > 0:
-            # GIL-free positional read (modelx_io.cc mx_pread_scatter)
-            self._native.pread_scatter(self.path, [(offset, length, out)], threads=1)
+            # GIL-free positional read on the open fd (modelx_io.cc mx_pread_fd)
+            self._native.pread_fd(self._fd, offset, length, out)
             return buf
         n = 0
         while n < length:
@@ -465,6 +465,18 @@ def load_safetensors(
     for arr in results.values():
         arr.block_until_ready()
     stats.total_seconds = time.monotonic() - t0
+    from modelx_tpu.utils import trace
+
+    trace.tracer().record({
+        "path": "dl.load",
+        "start_s": t0,
+        "duration_s": stats.total_seconds,
+        "tensors": stats.tensors,
+        "bytes_fetched": stats.bytes_fetched,
+        "bytes_to_device": stats.bytes_to_device,
+        "fetch_thread_s": round(stats.fetch_seconds, 3),
+        "gbps": round(stats.gbps, 3),
+    })
     return results, stats
 
 

@@ -1,18 +1,31 @@
 """JAX serving sidecar: the container the pod spec runs next to the volume.
 
 Replaces the reference deployment's GPU serving container (BASELINE.json
-north_star). Loads a checkpoint (local dir or registry URI) onto a mesh,
-compiles the forward/decode functions, and serves:
+north_star). Loads one or more checkpoints (multi-tenant: BASELINE config #5
+is concurrent pull+serve of 4 models) onto a mesh, compiles the
+forward/decode functions, and serves:
 
-    GET  /healthz          readiness (200 once compiled)
-    GET  /metrics          load + inference counters
-    POST /v1/forward       {"tokens": [[...]]} -> {"logits_argmax": [[...]]}
-    POST /v1/generate      {"tokens": [[...]], "max_new_tokens": N}
-                           -> {"tokens": [[prompt+generated...]]}
+    GET  /healthz               readiness (200 once every model is compiled)
+    GET  /metrics               load + inference counters (all models)
+    GET  /v1/models             model inventory + per-model stats
+    GET  /v1/trace              span summary (utils/trace.py)
+    POST /v1/profile            {"seconds": N} -> device-level jax profiler
+                                trace written to trace_dir
+    POST /v1/forward            default model      {"tokens": [[...]]}
+    POST /v1/generate           default model      + {"max_new_tokens": N}
+    POST /v1/{model}/forward    named model
+    POST /v1/{model}/generate   named model
 
-Token IDs in, token IDs out — tokenization is the caller's concern (the
-registry stores tokenizer files alongside weights; wiring a tokenizer in is
-deployment glue, not framework).
+Model family (llama / mixtral / gpt2 / bert) is detected from checkpoint
+tensor names (dl/families.py) — the checkpoint is self-describing, no
+config.json needed. Token IDs in, token IDs out — tokenization is the
+caller's concern (the registry stores tokenizer files alongside weights;
+wiring a tokenizer in is deployment glue, not framework).
+
+Compile latency: a persistent XLA compilation cache can be enabled
+(MODELX_COMPILE_CACHE or ~/.cache/modelx-tpu/xla) so a sidecar restart
+skips recompilation — the TTFT budget (BASELINE: p50 < 500 ms) has no room
+for a cold pjit.
 """
 
 from __future__ import annotations
@@ -21,6 +34,7 @@ import glob
 import json
 import logging
 import os
+import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -29,102 +43,178 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from modelx_tpu.dl.sharding import LLAMA_RULES
-from modelx_tpu.models import llama
+from modelx_tpu.dl import families as fam
 from modelx_tpu.parallel.mesh import make_mesh
+from modelx_tpu.utils import trace
 
 logger = logging.getLogger("modelx.serve")
 
 
+def enable_compile_cache(path: str = "") -> None:
+    """Persistent XLA compilation cache (idempotent)."""
+    path = path or os.environ.get(
+        "MODELX_COMPILE_CACHE", os.path.expanduser("~/.cache/modelx-tpu/xla")
+    )
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception as e:  # cache is an optimization, never fatal
+        logger.warning("compile cache unavailable: %s", e)
+
+
 class ModelServer:
+    """One loaded model: params on the mesh + compiled entry points."""
+
     def __init__(
         self,
         model_dir: str,
         mesh_spec: str = "",
         dtype: str = "bfloat16",
-        config: llama.LlamaConfig | None = None,
+        config=None,
         max_seq_len: int = 2048,
+        mesh=None,
+        name: str = "default",
     ) -> None:
+        self.name = name
         self.model_dir = model_dir
-        self.mesh = make_mesh(mesh_spec) if mesh_spec else make_mesh(f"dp={len(jax.devices())}")
+        self.mesh = mesh if mesh is not None else (
+            make_mesh(mesh_spec) if mesh_spec else make_mesh(f"dp={len(jax.devices())}")
+        )
         self.dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
         self.max_seq_len = max_seq_len
         self.ready = False
         self.stats: dict = {"requests": 0, "tokens_generated": 0}
         self.cfg = config
+        self.family: fam.Family | None = None
         self.params: dict | None = None
 
     def load(self) -> dict:
         """Load every *.safetensors under model_dir onto the mesh."""
         from modelx_tpu.dl.loader import LocalFileSource, load_safetensors
+        from modelx_tpu.dl.safetensors import read_header_from_file
 
-        t0 = time.monotonic()
-        paths = sorted(glob.glob(os.path.join(self.model_dir, "*.safetensors")))
-        if not paths:
-            raise FileNotFoundError(f"no safetensors under {self.model_dir}")
-        params: dict = {}
-        total = 0
-        for path in paths:
-            arrays, stats = load_safetensors(LocalFileSource(path), self.mesh, LLAMA_RULES)
-            params.update(arrays)
-            total += stats.bytes_to_device
-        self.params = params
-        if self.cfg is None:
-            self.cfg = infer_llama_config(params)
-        seconds = time.monotonic() - t0
-        self.stats["load_seconds"] = round(seconds, 3)
-        self.stats["load_bytes"] = total
-        self.stats["load_gbps"] = round(total / max(seconds, 1e-9) / 1e9, 3)
-        self._compile()
-        self.ready = True
+        with trace.span("serve.load", model=self.name, dir=self.model_dir):
+            t0 = time.monotonic()
+            paths = sorted(glob.glob(os.path.join(self.model_dir, "*.safetensors")))
+            if not paths:
+                raise FileNotFoundError(f"no safetensors under {self.model_dir}")
+            # detect the family from the headers so the right partition rules
+            # apply from the first byte fetched
+            names: list[str] = []
+            for path in paths:
+                infos, _ = read_header_from_file(path)
+                names.extend(infos)
+            self.family = fam.detect(names)
+            params: dict = {}
+            total = 0
+            for path in paths:
+                arrays, stats = load_safetensors(
+                    LocalFileSource(path), self.mesh, self.family.rules
+                )
+                params.update(arrays)
+                total += stats.bytes_to_device
+            self.params = params
+            if self.cfg is None:
+                self.cfg = self.family.infer_config(params)
+            seconds = time.monotonic() - t0
+            self.stats["family"] = self.family.name
+            self.stats["load_seconds"] = round(seconds, 3)
+            self.stats["load_bytes"] = total
+            self.stats["load_gbps"] = round(total / max(seconds, 1e-9) / 1e9, 3)
+            self._compile()
+            self.ready = True
         return dict(self.stats)
 
     def _compile(self) -> None:
-        cfg, mesh = self.cfg, self.mesh
-        self._forward = jax.jit(
-            lambda p, t: llama.forward(p, t, cfg, mesh=mesh)[0]
-        )
+        cfg, mesh, family = self.cfg, self.mesh, self.family
+        with trace.span("serve.compile", model=self.name, family=family.name):
+            self._forward = jax.jit(
+                lambda p, t: family.forward(p, t, cfg, mesh=mesh)
+            )
 
     def forward_argmax(self, tokens: np.ndarray) -> np.ndarray:
-        logits = self._forward(self.params, jnp.asarray(tokens, jnp.int32))
-        return np.asarray(jnp.argmax(logits, axis=-1))
+        with trace.span("serve.forward", model=self.name, batch=int(tokens.shape[0])):
+            out = self._forward(self.params, jnp.asarray(tokens, jnp.int32))
+            return np.asarray(jnp.argmax(out, axis=-1))
 
     def generate(self, tokens: np.ndarray, max_new_tokens: int = 16) -> np.ndarray:
-        out = llama.greedy_generate(
-            self.params, jnp.asarray(tokens, jnp.int32), self.cfg,
-            max_new_tokens=max_new_tokens, mesh=self.mesh,
-        )
-        self.stats["tokens_generated"] += int(out.shape[0] * max_new_tokens)
-        return np.asarray(out)
+        if self.family.generate is None:
+            raise ValueError(f"family {self.family.name} is not generative")
+        with trace.span("serve.generate", model=self.name, new_tokens=max_new_tokens):
+            out = self.family.generate(
+                self.params, jnp.asarray(tokens, jnp.int32), self.cfg,
+                mesh=self.mesh, max_new_tokens=max_new_tokens,
+            )
+            self.stats["tokens_generated"] += int(out.shape[0] * max_new_tokens)
+            return np.asarray(out)
 
 
-def infer_llama_config(params: dict) -> llama.LlamaConfig:
-    """Recover the architecture from checkpoint tensor shapes."""
-    embed = params["model.embed_tokens.weight"]
-    vocab, hidden = embed.shape
-    layers = 0
-    while f"model.layers.{layers}.self_attn.q_proj.weight" in params:
-        layers += 1
-    q = params["model.layers.0.self_attn.q_proj.weight"].shape[0]
-    kv = params["model.layers.0.self_attn.k_proj.weight"].shape[0]
-    inter = params["model.layers.0.mlp.gate_proj.weight"].shape[0]
-    # head_dim heuristics: llama uses 128 for big models; fall back to h/32
-    head_dim = 128 if q % 128 == 0 and q // 128 >= 8 else max(q // 32, 32)
-    if hidden <= 512:  # toy checkpoints
-        head_dim = 32
-    return llama.LlamaConfig(
-        vocab_size=vocab,
-        hidden_size=hidden,
-        intermediate_size=inter,
-        num_layers=layers,
-        num_heads=q // head_dim,
-        num_kv_heads=kv // head_dim,
-        head_dim=head_dim,
-        tie_embeddings="lm_head.weight" not in params,
-    )
+def infer_llama_config(params: dict):
+    """Back-compat alias (dl/families.py owns config inference now)."""
+    return fam.infer_llama_config(params)
 
 
-def serve(server: ModelServer, listen: str = ":8000") -> ThreadingHTTPServer:
+_MODEL_ROUTE = re.compile(r"^/v1/(?P<model>[A-Za-z0-9._-]+)/(?P<verb>forward|generate)$")
+
+
+class ServerSet:
+    """Named ModelServers behind one HTTP front (multi-tenant serving)."""
+
+    def __init__(self, servers: dict[str, ModelServer], default: str | None = None,
+                 trace_dir: str = "") -> None:
+        if not servers:
+            raise ValueError("no models")
+        self.servers = servers
+        self.default = default or next(iter(servers))
+        self.trace_dir = trace_dir or os.path.join(os.getcwd(), "jax-trace")
+        self._profiling = threading.Lock()
+
+    @property
+    def ready(self) -> bool:
+        return all(s.ready for s in self.servers.values())
+
+    def load_all(self, concurrent: bool = False) -> dict:
+        """Load every model; ``concurrent`` overlaps the fetch phases (device
+        transfers already funnel through the loader's transfer pool)."""
+        if concurrent and len(self.servers) > 1:
+            errs: dict[str, BaseException] = {}
+
+            def _load(s: ModelServer) -> None:
+                try:
+                    s.load()
+                except BaseException as e:  # re-raised on the caller thread
+                    errs[s.name] = e
+
+            threads = [
+                threading.Thread(target=_load, args=(s,), daemon=True)
+                for s in self.servers.values()
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errs:
+                name, err = next(iter(errs.items()))
+                raise RuntimeError(f"loading {name} failed: {err}") from err
+        else:
+            for s in self.servers.values():
+                s.load()
+        return {name: dict(s.stats) for name, s in self.servers.items()}
+
+    def resolve(self, path: str) -> tuple[ModelServer | None, str | None]:
+        """(server, verb) for a POST path; (None, None) if unroutable."""
+        if path in ("/v1/forward", "/v1/generate"):
+            return self.servers[self.default], path.rsplit("/", 1)[1]
+        m = _MODEL_ROUTE.match(path)
+        if m and m.group("model") in self.servers:
+            return self.servers[m.group("model")], m.group("verb")
+        return None, None
+
+
+def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingHTTPServer:
+    sset = servers if isinstance(servers, ServerSet) else ServerSet({servers.name: servers})
+
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
@@ -141,19 +231,51 @@ def serve(server: ModelServer, listen: str = ":8000") -> ThreadingHTTPServer:
 
         def do_GET(self):
             if self.path == "/healthz":
-                if server.ready:
+                if sset.ready:
                     self._json(200, {"status": "ok"})
                 else:
                     self._json(503, {"status": "loading"})
             elif self.path == "/metrics":
-                self._json(200, server.stats)
+                self._json(200, {n: dict(s.stats) for n, s in sset.servers.items()})
+            elif self.path == "/v1/models":
+                self._json(200, {
+                    "default": sset.default,
+                    "models": {
+                        n: {"ready": s.ready, **s.stats} for n, s in sset.servers.items()
+                    },
+                })
+            elif self.path == "/v1/trace":
+                self._json(200, trace.tracer().summary())
             else:
                 self._json(404, {"error": "not found"})
 
         def do_POST(self):
             length = int(self.headers.get("Content-Length", 0) or 0)
             try:
-                req = json.loads(self.rfile.read(length))
+                req = json.loads(self.rfile.read(length)) if length else {}
+            except ValueError as e:
+                return self._json(400, {"error": f"bad request: {e}"})
+
+            if self.path == "/v1/profile":
+                try:
+                    seconds = float(req.get("seconds", 3)) if isinstance(req, dict) else -1.0
+                except (TypeError, ValueError):
+                    seconds = -1.0
+                if not (0 <= seconds <= 300):
+                    return self._json(400, {"error": "seconds must be a number in [0, 300]"})
+                if not sset._profiling.acquire(blocking=False):
+                    return self._json(409, {"error": "profile already running"})
+                try:
+                    with trace.jax_profile(sset.trace_dir):
+                        time.sleep(min(seconds, 60))
+                finally:
+                    sset._profiling.release()
+                return self._json(200, {"trace_dir": sset.trace_dir})
+
+            server, verb = sset.resolve(self.path)
+            if server is None:
+                return self._json(404, {"error": "not found"})
+            try:
                 tokens = np.asarray(req["tokens"], np.int32)
             except (ValueError, KeyError) as e:
                 return self._json(400, {"error": f"bad request: {e}"})
@@ -161,15 +283,15 @@ def serve(server: ModelServer, listen: str = ":8000") -> ThreadingHTTPServer:
                 return self._json(503, {"error": "still loading"})
             server.stats["requests"] += 1
             try:
-                if self.path == "/v1/forward":
+                if verb == "forward":
                     out = server.forward_argmax(tokens)
                     self._json(200, {"logits_argmax": out.tolist()})
-                elif self.path == "/v1/generate":
+                else:
                     n = int(req.get("max_new_tokens", 16))
                     out = server.generate(tokens, max_new_tokens=n)
                     self._json(200, {"tokens": out.tolist()})
-                else:
-                    self._json(404, {"error": "not found"})
+            except ValueError as e:  # e.g. generate on a non-generative family
+                self._json(400, {"error": str(e)})
             except Exception as e:  # surface inference errors as 500 JSON
                 logger.exception("inference error")
                 self._json(500, {"error": str(e)})

@@ -1,5 +1,10 @@
 """`modelx-serve` console entrypoint: the serving container's command
-(referenced by dl/podspec.py's generated pod spec)."""
+(referenced by dl/podspec.py's generated pod spec).
+
+Single model:      modelx-serve --model-dir /mnt/model
+Multi-tenant:      modelx-serve --model a=/mnt/a --model b=/mnt/b
+                   (BASELINE config #5: concurrent pull+serve of N models)
+"""
 
 from __future__ import annotations
 
@@ -9,21 +14,53 @@ import threading
 
 import click
 
-from modelx_tpu.dl.serve import ModelServer, serve
+from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, serve
 
 
 @click.command("modelx-serve")
-@click.option("--model-dir", required=True, help="volume with *.safetensors (from modelx dl)")
+@click.option("--model-dir", default="", help="volume with *.safetensors (from modelx dl)")
+@click.option("--model", "models", multiple=True,
+              help="name=dir; repeatable for multi-tenant serving")
 @click.option("--mesh", default="", help='mesh spec, e.g. "dp=1,tp=8" (default: dp over all devices)')
 @click.option("--dtype", default="bfloat16", type=click.Choice(["bfloat16", "float32"]))
 @click.option("--listen", default=":8000")
 @click.option("--max-seq-len", default=2048, type=int)
-def main(model_dir: str, mesh: str, dtype: str, listen: str, max_seq_len: int) -> None:
+@click.option("--compile-cache/--no-compile-cache", default=True,
+              help="persistent XLA compilation cache (restart TTFT)")
+@click.option("--concurrent-load", is_flag=True, help="overlap multi-model loads")
+@click.option("--trace-dir", default="", help="jax profiler output dir (/v1/profile)")
+def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen: str,
+         max_seq_len: int, compile_cache: bool, concurrent_load: bool, trace_dir: str) -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
-    server = ModelServer(model_dir, mesh_spec=mesh, dtype=dtype, max_seq_len=max_seq_len)
-    httpd = serve(server, listen=listen)  # starts serving 503s while loading
-    stats = server.load()
-    logging.getLogger("modelx.serve").info("model loaded: %s", stats)
+    if compile_cache:
+        enable_compile_cache()
+    entries: dict[str, str] = {}
+    if model_dir:
+        entries["default"] = model_dir
+    for spec in models:
+        name, _, path = spec.partition("=")
+        if not path:
+            raise click.UsageError(f"--model wants name=dir, got {spec!r}")
+        entries[name] = path
+    if not entries:
+        raise click.UsageError("need --model-dir or at least one --model name=dir")
+
+    # one mesh shared by every tenant (same devices either way; sharing keeps
+    # shardings comparable and avoids rebuilding device lists per model)
+    import jax
+
+    from modelx_tpu.parallel.mesh import make_mesh
+
+    shared_mesh = make_mesh(mesh) if mesh else make_mesh(f"dp={len(jax.devices())}")
+    servers = {
+        name: ModelServer(path, dtype=dtype, max_seq_len=max_seq_len,
+                          name=name, mesh=shared_mesh)
+        for name, path in entries.items()
+    }
+    sset = ServerSet(servers, trace_dir=trace_dir)
+    httpd = serve(sset, listen=listen)  # starts serving 503s while loading
+    stats = sset.load_all(concurrent=concurrent_load)
+    logging.getLogger("modelx.serve").info("models loaded: %s", stats)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())

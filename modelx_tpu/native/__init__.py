@@ -47,16 +47,20 @@ def build(force: bool = False) -> str | None:
     ):
         return _SO
     os.makedirs(os.path.dirname(_SO), exist_ok=True)
-    cmd = [
-        "g++", "-O2", "-shared", "-fPIC", "-pthread",
-        "-o", _SO + ".tmp", _SRC, "-ldl",
-    ]
+    # per-process temp output so concurrent builds can't corrupt each other;
+    # os.replace publishes atomically and last-writer-wins is fine (same src)
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-o", tmp, _SRC, "-ldl"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
     except (OSError, subprocess.SubprocessError) as e:
         logger.debug("native build unavailable: %s", e)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
-    os.replace(_SO + ".tmp", _SO)
     return _SO
 
 
@@ -82,6 +86,10 @@ def lib() -> ctypes.CDLL | None:
             ctypes.c_char_p, ctypes.POINTER(MxRange), ctypes.c_int, ctypes.c_int,
         ]
         l.mx_pread_scatter.restype = ctypes.c_int
+        l.mx_pread_fd.argtypes = [
+            ctypes.c_int, ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+        ]
+        l.mx_pread_fd.restype = ctypes.c_int
         l.mx_sha256_file.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
         l.mx_sha256_file.restype = ctypes.c_int
         l.mx_sha256_buf.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p]
@@ -133,6 +141,17 @@ def sha256_buffer(view) -> str | None:
         addr = ctypes.addressof(buf)
     l.mx_sha256_buf(addr, len(mv), out)
     return out.value.decode()
+
+
+def pread_fd(fd: int, offset: int, length: int, out) -> None:
+    """Single GIL-free positional read on an already-open fd."""
+    l = lib()
+    if l is None:
+        raise RuntimeError("native engine unavailable")
+    c = ctypes.c_char.from_buffer(out)
+    rc = l.mx_pread_fd(fd, offset, length, ctypes.addressof(c))
+    if rc != 0:
+        raise OSError(-rc, f"mx_pread_fd: {os.strerror(-rc)}")
 
 
 def pread_scatter(path: str, ranges: list[tuple[int, int, memoryview]], threads: int = 8) -> None:

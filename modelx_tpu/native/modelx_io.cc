@@ -48,6 +48,23 @@ typedef struct {
 // parallel positional file reads
 // ---------------------------------------------------------------------------
 
+// Single positional read on an already-open fd (no thread, no open()).
+// Returns 0 on success, -errno / -EIO on short file.
+int mx_pread_fd(int fd, int64_t offset, int64_t length, void *buf) {
+  int64_t done = 0;
+  while (done < length) {
+    ssize_t got = pread(fd, (char *)buf + done, (size_t)(length - done),
+                        (off_t)(offset + done));
+    if (got < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    if (got == 0) return -EIO;  // short file
+    done += got;
+  }
+  return 0;
+}
+
 // Reads every range of `path` into its buffer using `threads` workers.
 // Returns 0 on success, -errno on the first failure.
 int mx_pread_scatter(const char *path, const MxRange *ranges, int n,
@@ -447,10 +464,12 @@ int mx_http_get_range(MxConn *c, const char *host_hdr, const char *path,
     int status = 0;
     if (sscanf(hdr, "HTTP/%*d.%*d %d", &status) != 1) return -2;
     int64_t clen = -1;
-    // case-insensitive Content-Length scan
-    for (char *p = hdr; p < body - 4; p++) {
-      if (strncasecmp(p, "content-length:", 15) == 0) {
-        clen = atoll(p + 15);
+    // case-insensitive Content-Length scan, anchored to line starts so a
+    // header like X-Content-Length can't match
+    for (char *p = strstr(hdr, "\r\n"); p && p < body - 4;
+         p = strstr(p + 2, "\r\n")) {
+      if (strncasecmp(p + 2, "content-length:", 15) == 0) {
+        clen = atoll(p + 17);
         break;
       }
     }

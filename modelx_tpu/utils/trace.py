@@ -1,0 +1,167 @@
+"""Structured spans for the registry->HBM path (SURVEY.md §5: the reference
+has no tracing at all — only per-request wall-clock logging in
+pkg/registry/helper.go:98-113).
+
+Design: a process-local collector of closed spans. ``span()`` is a context
+manager; nesting is tracked per-thread/task with a contextvar so span names
+compose into paths (``dl.load/fetch``). Zero deps, thread-safe, bounded.
+
+    with trace.span("dl.load", uri=uri):
+        with trace.span("fetch", tensor=name):
+            ...
+
+Every closed span is logged at DEBUG (or INFO with MODELX_TRACE=1), kept in
+the ring for ``trace.spans()`` / ``trace.export_json()``, and surfaces in
+the registry /metrics and the serve sidecar's /v1/trace endpoint.
+
+``jax_profile()`` wraps ``jax.profiler`` traces for on-demand device-level
+profiling from the serving sidecar.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Iterator
+
+logger = logging.getLogger("modelx.trace")
+
+MAX_SPANS = 8192
+
+_current_path: contextvars.ContextVar[str] = contextvars.ContextVar("modelx_span_path", default="")
+
+
+class Tracer:
+    """Collects closed spans in a bounded ring; drop count is tracked."""
+
+    def __init__(self, max_spans: int = MAX_SPANS) -> None:
+        import collections
+
+        self._lock = threading.Lock()
+        self._spans: collections.deque[dict[str, Any]] = collections.deque(maxlen=max_spans)
+        self._dropped = 0
+        self.max_spans = max_spans
+
+    def record(self, span: dict[str, Any]) -> None:
+        with self._lock:
+            if len(self._spans) == self.max_spans:
+                self._dropped += 1  # deque(maxlen) evicts the oldest in O(1)
+            self._spans.append(span)
+        level = logging.INFO if os.environ.get("MODELX_TRACE") else logging.DEBUG
+        if logger.isEnabledFor(level):
+            logger.log(
+                level,
+                "span %s %.1fms %s",
+                span["path"],
+                span["duration_s"] * 1e3,
+                {k: v for k, v in span.items() if k not in ("path", "start_s", "duration_s")},
+            )
+
+    def spans(self, prefix: str = "") -> list[dict[str, Any]]:
+        with self._lock:
+            out = list(self._spans)
+        if prefix:
+            out = [s for s in out if s["path"].startswith(prefix)]
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._dropped = 0
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def export_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.spans(), f, indent=1)
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        """Per-path aggregate: count / total_s / max_s (for /metrics)."""
+        agg: dict[str, dict[str, float]] = {}
+        for s in self.spans():
+            a = agg.setdefault(s["path"], {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            a["count"] += 1
+            a["total_s"] += s["duration_s"]
+            a["max_s"] = max(a["max_s"], s["duration_s"])
+        return agg
+
+
+_tracer = Tracer()
+
+
+def tracer() -> Tracer:
+    return _tracer
+
+
+def spans(prefix: str = "") -> list[dict[str, Any]]:
+    return _tracer.spans(prefix)
+
+
+def export_json(path: str) -> None:
+    _tracer.export_json(path)
+
+
+@contextlib.contextmanager
+def span(name: str, **attrs: Any) -> Iterator[dict[str, Any]]:
+    """Time a block; the yielded dict accepts extra attrs while open."""
+    parent = _current_path.get()
+    path = f"{parent}/{name}" if parent else name
+    token = _current_path.set(path)
+    rec: dict[str, Any] = dict(attrs)
+    start = time.monotonic()
+    try:
+        yield rec
+    except BaseException as e:
+        rec["error"] = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        _current_path.reset(token)
+        rec["path"] = path
+        rec["start_s"] = start
+        rec["duration_s"] = time.monotonic() - start
+        _tracer.record(rec)
+
+
+def traced(name: str):
+    """Decorator form of :func:`span`."""
+
+    def deco(fn):
+        def wrapper(*args, **kwargs):
+            with span(name):
+                return fn(*args, **kwargs)
+
+        wrapper.__name__ = getattr(fn, "__name__", name)
+        wrapper.__doc__ = fn.__doc__
+        return wrapper
+
+    return deco
+
+
+@contextlib.contextmanager
+def jax_profile(trace_dir: str) -> Iterator[None]:
+    """Device-level profiling window (jax.profiler trace, viewable in
+    tensorboard/xprof). No-op if jax is unavailable."""
+    try:
+        import jax
+
+        jax.profiler.start_trace(trace_dir)
+        started = True
+    except Exception as e:  # profiling must never take the service down
+        logger.warning("jax profiler unavailable: %s", e)
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:
+                logger.warning("jax profiler stop failed: %s", e)

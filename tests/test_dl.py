@@ -132,7 +132,7 @@ class TestServeSidecar:
             # probes
             assert requests.post(f"{base}/v1/forward", json={"nope": 1}).status_code == 400
             assert requests.post(f"{base}/v1/unknown", json={"tokens": [[1]]}).status_code == 404
-            assert requests.get(f"{base}/metrics").json()["requests"] >= 2
+            assert requests.get(f"{base}/metrics").json()["default"]["requests"] >= 2
         finally:
             httpd.shutdown()
 

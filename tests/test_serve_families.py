@@ -1,0 +1,128 @@
+"""Family-aware + multi-tenant serving (dl/serve.py, dl/families.py):
+every model family served from its self-describing checkpoint, and N models
+behind one HTTP front (BASELINE config #5: concurrent pull+serve)."""
+
+import numpy as np
+import pytest
+import requests
+
+import jax.numpy as jnp
+
+from modelx_tpu.dl import families as fam
+from modelx_tpu.dl import safetensors as st
+from modelx_tpu.dl.serve import ModelServer, ServerSet, serve
+from modelx_tpu.registry.server import free_port
+
+
+def _write_checkpoint(dirpath, params):
+    dirpath.mkdir(parents=True, exist_ok=True)
+    st.write_safetensors(
+        str(dirpath / "model.safetensors"),
+        {k: np.asarray(v) for k, v in params.items()},
+    )
+    return str(dirpath)
+
+
+@pytest.fixture(scope="module")
+def checkpoints(tmp_path_factory):
+    """Tiny fp32 checkpoints, one per family."""
+    import jax
+
+    root = tmp_path_factory.mktemp("families")
+    out = {}
+
+    from modelx_tpu.models import bert, gpt2, llama, mixtral
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=64)
+    import dataclasses
+
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    out["llama"] = _write_checkpoint(root / "llama", llama.init_params(cfg, jax.random.PRNGKey(0)))
+
+    g = gpt2.GPT2Config.tiny()
+    out["gpt2"] = _write_checkpoint(root / "gpt2", gpt2.init_params(g, jax.random.PRNGKey(1)))
+
+    b = bert.BertConfig.tiny()
+    out["bert"] = _write_checkpoint(root / "bert", bert.init_params(b, jax.random.PRNGKey(2)))
+
+    m = dataclasses.replace(mixtral.MixtralConfig.tiny(vocab_size=64), dtype=jnp.float32)
+    out["mixtral"] = _write_checkpoint(root / "mixtral", mixtral.init_params(m, jax.random.PRNGKey(3)))
+    return out
+
+
+class TestFamilyDetection:
+    def test_detect_each_family(self, checkpoints):
+        for name, d in checkpoints.items():
+            infos, _ = st.read_header_from_file(d + "/model.safetensors")
+            assert fam.detect(list(infos)).name == name
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError, match="family"):
+            fam.detect(["mystery.weight"])
+
+
+class TestFamilyServing:
+    @pytest.mark.parametrize("family", ["llama", "gpt2", "mixtral", "bert"])
+    def test_load_and_forward(self, checkpoints, family):
+        server = ModelServer(checkpoints[family], mesh_spec="dp=1", dtype="float32", name=family)
+        stats = server.load()
+        assert stats["family"] == family
+        out = server.forward_argmax(np.array([[1, 2, 3, 4]], np.int32))
+        assert out.shape[0] == 1 and out.shape[1] == 4
+
+    def test_generate_causal(self, checkpoints):
+        server = ModelServer(checkpoints["gpt2"], mesh_spec="dp=1", dtype="float32")
+        server.load()
+        out = server.generate(np.array([[1, 2, 3]], np.int32), max_new_tokens=2)
+        assert out.shape == (1, 5)
+
+    def test_generate_on_bert_rejected(self, checkpoints):
+        server = ModelServer(checkpoints["bert"], mesh_spec="dp=1", dtype="float32")
+        server.load()
+        with pytest.raises(ValueError, match="not generative"):
+            server.generate(np.array([[1, 2]], np.int32))
+
+
+class TestMultiTenant:
+    @pytest.fixture(scope="class")
+    def front(self, checkpoints):
+        servers = {
+            "lm": ModelServer(checkpoints["llama"], mesh_spec="dp=1", dtype="float32", name="lm"),
+            "enc": ModelServer(checkpoints["bert"], mesh_spec="dp=1", dtype="float32", name="enc"),
+        }
+        sset = ServerSet(servers, default="lm")
+        base = f"http://127.0.0.1:{free_port()}"
+        httpd = serve(sset, listen=base.rsplit("//", 1)[1])
+        sset.load_all(concurrent=True)
+        yield base
+        httpd.shutdown()
+
+    def test_healthz_ready(self, front):
+        assert requests.get(front + "/healthz").status_code == 200
+
+    def test_models_inventory(self, front):
+        inv = requests.get(front + "/v1/models").json()
+        assert inv["default"] == "lm"
+        assert set(inv["models"]) == {"lm", "enc"}
+        assert all(m["ready"] for m in inv["models"].values())
+
+    def test_default_model_route(self, front):
+        r = requests.post(front + "/v1/forward", json={"tokens": [[1, 2, 3]]})
+        assert r.status_code == 200
+        assert len(r.json()["logits_argmax"][0]) == 3
+
+    def test_named_model_route(self, front):
+        r = requests.post(front + "/v1/enc/forward", json={"tokens": [[1, 2, 3]]})
+        assert r.status_code == 200
+
+    def test_unknown_model_404(self, front):
+        r = requests.post(front + "/v1/nope/forward", json={"tokens": [[1]]})
+        assert r.status_code == 404
+
+    def test_generate_on_encoder_400(self, front):
+        r = requests.post(front + "/v1/enc/generate", json={"tokens": [[1]]})
+        assert r.status_code == 400
+
+    def test_trace_endpoint(self, front):
+        agg = requests.get(front + "/v1/trace").json()
+        assert any(p.startswith("serve.load") for p in agg)

@@ -1,0 +1,117 @@
+"""Tracing subsystem (utils/trace.py): span paths, error capture, bounded
+ring, aggregation — the observability layer SURVEY.md §5 prescribes (the
+reference has only per-request wall-clock logging)."""
+
+import json
+
+import pytest
+
+from modelx_tpu.utils.trace import Tracer, jax_profile, span, traced, tracer
+
+
+@pytest.fixture(autouse=True)
+def clean_tracer():
+    tracer().clear()
+    yield
+    tracer().clear()
+
+
+class TestSpan:
+    def test_nested_paths(self):
+        with span("outer"):
+            with span("inner", k=1):
+                pass
+        paths = [s["path"] for s in tracer().spans()]
+        assert paths == ["outer/inner", "outer"]  # children close first
+
+    def test_attrs_and_duration(self):
+        with span("op", model="m") as rec:
+            rec["extra"] = 42
+        (s,) = tracer().spans("op")
+        assert s["model"] == "m" and s["extra"] == 42
+        assert s["duration_s"] >= 0
+
+    def test_error_captured_and_reraised(self):
+        with pytest.raises(ValueError):
+            with span("boom"):
+                raise ValueError("nope")
+        (s,) = tracer().spans("boom")
+        assert "ValueError" in s["error"]
+
+    def test_traced_decorator(self):
+        @traced("fn.op")
+        def f(x):
+            return x + 1
+
+        assert f(1) == 2
+        assert tracer().spans("fn.op")
+
+    def test_prefix_filter(self):
+        with span("a.x"):
+            pass
+        with span("b.y"):
+            pass
+        assert len(tracer().spans("a.")) == 1
+
+    def test_thread_isolation(self):
+        import threading
+
+        def worker():
+            with span("w"):
+                pass
+
+        with span("main"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        paths = {s["path"] for s in tracer().spans()}
+        # the worker thread's span must not nest under "main"
+        assert "w" in paths and "main" in paths
+
+
+class TestTracer:
+    def test_ring_bound_and_dropped(self):
+        t = Tracer(max_spans=3)
+        for i in range(5):
+            t.record({"path": f"s{i}", "start_s": 0, "duration_s": 0})
+        assert len(t.spans()) == 3
+        assert t.dropped == 2
+        assert t.spans()[0]["path"] == "s2"
+
+    def test_summary_aggregates(self):
+        t = Tracer()
+        for d in (0.1, 0.3):
+            t.record({"path": "op", "start_s": 0, "duration_s": d})
+        agg = t.summary()["op"]
+        assert agg["count"] == 2
+        assert abs(agg["total_s"] - 0.4) < 1e-9
+        assert abs(agg["max_s"] - 0.3) < 1e-9
+
+    def test_export_json(self, tmp_path):
+        with span("x"):
+            pass
+        p = tmp_path / "trace.json"
+        tracer().export_json(str(p))
+        assert json.loads(p.read_text())[0]["path"] == "x"
+
+
+class TestIntegration:
+    def test_loader_emits_load_span(self, tmp_path):
+        import ml_dtypes
+        import numpy as np
+
+        from modelx_tpu.dl import safetensors as st
+        from modelx_tpu.dl.loader import LocalFileSource, load_safetensors
+        from modelx_tpu.dl.sharding import LLAMA_RULES
+        from modelx_tpu.parallel.mesh import make_mesh
+
+        path = str(tmp_path / "m.safetensors")
+        st.write_safetensors(path, {"model.norm.weight": np.ones((8,), ml_dtypes.bfloat16)})
+        load_safetensors(LocalFileSource(path), make_mesh("dp=1"), LLAMA_RULES)
+        (s,) = tracer().spans("dl.load")
+        assert s["tensors"] == 1 and s["bytes_to_device"] == 16
+
+    def test_jax_profile_noop_on_failure(self, tmp_path):
+        # an unwritable dir must not raise out of the context manager
+        with jax_profile(str(tmp_path / "trace")):
+            pass
