@@ -1,0 +1,239 @@
+"""Checkpoint/resume through the registry (SURVEY.md §5: "the registry *is*
+a checkpoint store" — versioned manifests + content-addressed incremental
+push/pull, docs/how-modelx-born.md:211-222).
+
+TPU-native shape: a training state (params + optax optimizer state + step)
+is flattened to named host tensors and written as *layer-grouped* safetensors
+shards. Grouping by layer makes incremental push real: after N more steps
+only the shards whose tensors changed get uploaded — unchanged shards are
+skipped by the push engine's content-address HEAD dedup (push.go:169-177
+semantics), and pull/restore re-downloads only changed shards (pull hash-skip).
+
+Restore goes through the HBM loader, so resumed state lands directly on the
+mesh with the same partition rules that trained it.
+
+    ckpt = Checkpointer(dir)
+    ckpt.save(params, opt_state, step=100)
+    client.push(...)                       # or ckpt.push(uri)
+    params, opt_state, step = ckpt.restore(template_params, template_opt,
+                                           mesh, rules)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any
+
+import jax
+import numpy as np
+
+from modelx_tpu.dl import safetensors as st
+
+STEP_FILE = "checkpoint.json"
+_OPT_PREFIX = "__opt__"
+_SEP = "|"
+
+
+# -- pytree <-> flat named tensors --------------------------------------------
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        elif hasattr(p, "name"):
+            parts.append(str(p.name))
+        else:
+            parts.append(str(p))
+    return _SEP.join(parts)
+
+
+def flatten_state(opt_state: Any) -> dict[str, np.ndarray]:
+    """Flatten any pytree of arrays into named host tensors (names encode
+    the tree path; scalars included)."""
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(opt_state)[0]:
+        flat[_OPT_PREFIX + _path_str(path)] = np.asarray(leaf)
+    return flat
+
+
+def restore_state(template: Any, flat: dict[str, np.ndarray]) -> Any:
+    """Rebuild a pytree shaped like ``template`` from flattened tensors."""
+    paths_and_leaves = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, tmpl_leaf in paths_and_leaves[0]:
+        key = _OPT_PREFIX + _path_str(path)
+        if key not in flat:
+            raise KeyError(f"checkpoint missing optimizer leaf {key}")
+        arr = flat[key]
+        want = tuple(np.shape(tmpl_leaf))
+        if tuple(arr.shape) != want:
+            if int(np.prod(arr.shape or (1,))) != int(np.prod(want or (1,))):
+                raise ValueError(f"optimizer leaf {key}: shape {arr.shape} != {want}")
+            arr = arr.reshape(want)  # 0-d leaves round-trip as shape-(1,)
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(paths_and_leaves[1], leaves)
+
+
+# -- layer-grouped sharding ----------------------------------------------------
+
+_LAYER = re.compile(r"(?:^|\.)layers?\.(\d+)\.")
+
+
+def group_key(name: str) -> str:
+    """Shard-group for a tensor: its layer index, or 'base' for the rest.
+    Optimizer leaves group with the params they track when their path
+    embeds a layer index."""
+    m = _LAYER.search(name.replace(_SEP, "."))
+    return f"layer-{int(m.group(1)):05d}" if m else "base"
+
+
+def save_sharded(directory: str, tensors: dict[str, np.ndarray]) -> list[str]:
+    """Write tensors as layer-grouped safetensors files. Deterministic
+    grouping + deterministic safetensors serialization => unchanged layers
+    produce byte-identical files across saves (the dedup unit). Each shard
+    is written to a temp name and renamed, so a crash mid-save never
+    corrupts an existing shard."""
+    groups: dict[str, dict[str, np.ndarray]] = {}
+    for name, arr in sorted(tensors.items()):
+        groups.setdefault(group_key(name), {})[name] = arr
+    os.makedirs(directory, exist_ok=True)
+    written = []
+    for key, members in sorted(groups.items()):
+        fname = f"state-{key}.safetensors"
+        path = os.path.join(directory, fname)
+        tmp = path + f".tmp-{os.getpid()}"
+        st.write_safetensors(tmp, members)
+        os.replace(tmp, path)
+        written.append(fname)
+    return written
+
+
+class Checkpointer:
+    """Save/restore a (params, opt_state, step) training state in a local
+    directory shaped for registry push (content-addressed incremental)."""
+
+    def __init__(self, directory: str) -> None:
+        self.directory = directory
+
+    def save(self, params: dict, opt_state: Any = None, step: int = 0) -> list[str]:
+        tensors: dict[str, np.ndarray] = {k: np.asarray(v) for k, v in params.items()}
+        if opt_state is not None:
+            tensors.update(flatten_state(opt_state))
+        written = save_sharded(self.directory, tensors)
+        # prune shards from an older save with a different layout so restore
+        # and push never resurrect stale tensors
+        import glob
+
+        for path in glob.glob(os.path.join(self.directory, "*.safetensors")):
+            if os.path.basename(path) not in written:
+                os.unlink(path)
+        meta = {"step": int(step), "files": written, "params": sorted(params)}
+        tmp = os.path.join(self.directory, STEP_FILE + f".tmp-{os.getpid()}")
+        with open(tmp, "w") as f:
+            json.dump(meta, f, sort_keys=True)
+        os.replace(tmp, os.path.join(self.directory, STEP_FILE))  # commit point
+        return written
+
+    def _shard_paths(self) -> list[str]:
+        import glob
+
+        meta_path = os.path.join(self.directory, STEP_FILE)
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                files = json.load(f).get("files")
+            if files:
+                return [os.path.join(self.directory, fn) for fn in files]
+        return sorted(glob.glob(os.path.join(self.directory, "*.safetensors")))
+
+    def _step(self) -> int:
+        meta_path = os.path.join(self.directory, STEP_FILE)
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                return int(json.load(f).get("step", 0))
+        return 0
+
+    def _read_flat(self, want=None) -> dict[str, np.ndarray]:
+        """Read tensors from the manifest's shard list; ``want(name)``
+        filters without reading skipped tensors' bytes."""
+        flat: dict[str, np.ndarray] = {}
+        for path in self._shard_paths():
+            with open(path, "rb") as f:
+                infos, off = st.read_header(f)
+                for name, info in infos.items():
+                    if want is not None and not want(name):
+                        continue
+                    f.seek(off + info.start)
+                    raw = f.read(info.nbytes)
+                    flat[name] = np.frombuffer(raw, info.np_dtype()).reshape(info.shape).copy()
+        return flat
+
+    def restore(
+        self,
+        template_params: dict,
+        template_opt: Any = None,
+        mesh=None,
+        rules=None,
+    ) -> tuple[dict, Any, int]:
+        """Returns (params, opt_state, step). With ``mesh``+``rules`` the
+        params stream through the HBM loader (sharded, parallel ranged
+        reads); optimizer state follows the same placement rules as the
+        params its leaves track."""
+        step = self._step()
+        use_loader = mesh is not None and rules is not None
+        # on the loader path only optimizer leaves are read into host memory;
+        # param bytes stream straight through the HBM loader below
+        flat = self._read_flat(want=(lambda n: n.startswith(_OPT_PREFIX)) if use_loader else None)
+        opt_flat = {k: v for k, v in flat.items() if k.startswith(_OPT_PREFIX)}
+
+        if use_loader:
+            from modelx_tpu.dl.loader import LocalFileSource, load_safetensors
+
+            params: dict = {}
+            for path in self._shard_paths():
+                with open(path, "rb") as f:
+                    infos, off = st.read_header(f)
+                wanted = {n: i for n, i in infos.items() if not n.startswith(_OPT_PREFIX)}
+                if not wanted:
+                    continue
+                loaded, _stats = load_safetensors(
+                    LocalFileSource(path), mesh, rules, tensors=wanted, data_offset=off
+                )
+                params.update(loaded)
+        else:
+            params = {k: v for k, v in flat.items() if not k.startswith(_OPT_PREFIX)}
+
+        missing = set(template_params) - set(params)
+        if missing:
+            raise KeyError(f"checkpoint missing params: {sorted(missing)[:4]}...")
+
+        opt_state = None
+        if template_opt is not None:
+            opt_state = restore_state(template_opt, opt_flat)
+            if mesh is not None:
+                # optimizer leaves inherit the sharding of their params when
+                # the tree path names one (adam mu/nu mirror the param tree)
+                from modelx_tpu.dl.sharding import sharding_for
+
+                def place(path, leaf):
+                    name = _path_str(path)
+                    for pname in template_params:
+                        if name.endswith(_SEP + pname) or name == pname:
+                            return jax.device_put(leaf, sharding_for(pname, rules, mesh))
+                    return jax.device_put(leaf)
+
+                opt_state = jax.tree_util.tree_map_with_path(place, opt_state)
+        return params, opt_state, step
+
+    def push(self, uri: str, quiet: bool = True) -> None:
+        """Push the checkpoint directory as a model version; unchanged layer
+        shards are skipped by content-address dedup."""
+        from modelx_tpu.client.reference import parse_reference
+
+        ref = parse_reference(uri)
+        ref.client(quiet=quiet).push(ref.repository, ref.version or "latest", self.directory)
