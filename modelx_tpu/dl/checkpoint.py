@@ -126,18 +126,19 @@ class Checkpointer:
         if opt_state is not None:
             tensors.update(flatten_state(opt_state))
         written = save_sharded(self.directory, tensors)
-        # prune shards from an older save with a different layout so restore
-        # and push never resurrect stale tensors
-        import glob
-
-        for path in glob.glob(os.path.join(self.directory, "*.safetensors")):
-            if os.path.basename(path) not in written:
-                os.unlink(path)
         meta = {"step": int(step), "files": written, "params": sorted(params)}
         tmp = os.path.join(self.directory, STEP_FILE + f".tmp-{os.getpid()}")
         with open(tmp, "w") as f:
             json.dump(meta, f, sort_keys=True)
         os.replace(tmp, os.path.join(self.directory, STEP_FILE))  # commit point
+        # prune shards from an older layout only AFTER the commit point: a
+        # crash before the rename must leave every shard the still-current
+        # checkpoint.json references
+        import glob
+
+        for path in glob.glob(os.path.join(self.directory, "*.safetensors")):
+            if os.path.basename(path) not in written:
+                os.unlink(path)
         return written
 
     def _shard_paths(self) -> list[str]:
@@ -186,26 +187,37 @@ class Checkpointer:
         params its leaves track."""
         step = self._step()
         use_loader = mesh is not None and rules is not None
-        # on the loader path only optimizer leaves are read into host memory;
-        # param bytes stream straight through the HBM loader below
-        flat = self._read_flat(want=(lambda n: n.startswith(_OPT_PREFIX)) if use_loader else None)
-        opt_flat = {k: v for k, v in flat.items() if k.startswith(_OPT_PREFIX)}
-
         if use_loader:
+            # one header parse per shard: optimizer leaves read inline into
+            # host memory, param bytes stream through the HBM loader
             from modelx_tpu.dl.loader import LocalFileSource, load_safetensors
 
             params: dict = {}
+            opt_flat: dict[str, np.ndarray] = {}
             for path in self._shard_paths():
                 with open(path, "rb") as f:
                     infos, off = st.read_header(f)
+                    for name, info in infos.items():
+                        if name.startswith(_OPT_PREFIX):
+                            f.seek(off + info.start)
+                            raw = f.read(info.nbytes)
+                            opt_flat[name] = (
+                                np.frombuffer(raw, info.np_dtype()).reshape(info.shape).copy()
+                            )
                 wanted = {n: i for n, i in infos.items() if not n.startswith(_OPT_PREFIX)}
                 if not wanted:
                     continue
-                loaded, _stats = load_safetensors(
-                    LocalFileSource(path), mesh, rules, tensors=wanted, data_offset=off
-                )
+                src = LocalFileSource(path)
+                try:
+                    loaded, _stats = load_safetensors(
+                        src, mesh, rules, tensors=wanted, data_offset=off
+                    )
+                finally:
+                    src.close()
                 params.update(loaded)
         else:
+            flat = self._read_flat()
+            opt_flat = {k: v for k, v in flat.items() if k.startswith(_OPT_PREFIX)}
             params = {k: v for k, v in flat.items() if not k.startswith(_OPT_PREFIX)}
 
         missing = set(template_params) - set(params)

@@ -40,6 +40,21 @@ from modelx_tpu.dl import safetensors as st
 from modelx_tpu.dl.sharding import Rules, sharding_for
 
 DEFAULT_FETCH_CONCURRENCY = 16
+FETCH_RETRIES = 3  # per-shard retry budget (SURVEY §5: loader retries per shard)
+
+
+def _read_with_retry(source: "ByteSource", offset: int, length: int, out=None,
+                     retries: int = FETCH_RETRIES):
+    """Ranged read with exponential backoff — a transient fetch error must
+    not kill a multi-hundred-shard load (mirrors the reference's per-part
+    retry x3, extension_s3.go:133-148)."""
+    for attempt in range(retries):
+        try:
+            return source.read_range(offset, length, out)
+        except OSError:
+            if attempt == retries - 1:
+                raise
+            time.sleep(0.2 * (2 ** attempt))
 
 
 class ByteSource(Protocol):
@@ -328,11 +343,11 @@ def load_safetensors(
     """
     t0 = time.monotonic()
     if tensors is None or data_offset is None:
-        head = bytes(source.read_range(0, 8))
+        head = bytes(_read_with_retry(source, 0, 8))
         import struct
 
         (hlen,) = struct.unpack("<Q", head)
-        tensors = st.parse_header(bytes(source.read_range(8, hlen)))
+        tensors = st.parse_header(bytes(_read_with_retry(source, 8, hlen)))
         data_offset = 8 + hlen
     tensors = fuse_expert_tensors(tensors, rules)
 
@@ -363,7 +378,7 @@ def load_safetensors(
             cached = _full_cache.get(info.name)
         if cached is not None:
             return cached
-        raw = source.read_range(data_offset + info.start, info.nbytes)
+        raw = _read_with_retry(source, data_offset + info.start, info.nbytes)
         with _full_lock:
             _full_cache[info.name] = raw
         return raw
@@ -381,7 +396,7 @@ def load_safetensors(
         if info.shape and inner_full:
             lead = full_spec[0]
             b0, b1 = st.row_range(info, lead.start, lead.stop)
-            raw = source.read_range(data_offset + b0, b1 - b0)
+            raw = _read_with_retry(source, data_offset + b0, b1 - b0)
             return _as_np(raw, np_dtype, (lead.stop - lead.start, *info.shape[1:])), b1 - b0
         raw = _cached_full_tensor(info)
         arr = _as_np(raw, np_dtype, info.shape)

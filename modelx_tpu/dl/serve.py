@@ -109,9 +109,11 @@ class ModelServer:
             params: dict = {}
             total = 0
             for path in paths:
-                arrays, stats = load_safetensors(
-                    LocalFileSource(path), self.mesh, self.family.rules
-                )
+                src = LocalFileSource(path)
+                try:
+                    arrays, stats = load_safetensors(src, self.mesh, self.family.rules)
+                finally:
+                    src.close()
                 params.update(arrays)
                 total += stats.bytes_to_device
             self.params = params

@@ -3,12 +3,14 @@ the hermetic push/pull e2e round-trip (SURVEY.md §4: 'client push/pull can be
 tested hermetically against an in-process handler' — preserved)."""
 
 import os
+import pathlib
 
 import pytest
 
 from modelx_tpu import errors
 from modelx_tpu.client import helper
 from modelx_tpu.client.client import Client
+from modelx_tpu.client.pull import Puller
 from modelx_tpu.client.reference import parse_reference
 from modelx_tpu.client.repo import RepoDetails, RepoManager
 from modelx_tpu.registry.fs import MemoryFSProvider
@@ -238,3 +240,97 @@ class TestCorruptDirectoryBlob:
         with pytest.raises(Exception) as ei:
             client.pull("library/demo", "v1", str(tmp_path / "broken"))
         assert not isinstance(ei.value, BrokenPipeError)
+
+
+class TestPullResume:
+    """Ranged-GET resume of interrupted downloads (SURVEY §5 upgrade: the
+    reference restarts partial blobs from byte zero)."""
+
+    @staticmethod
+    def _partial_name(blob):
+        import hashlib as _h
+
+        hexpart = blob.digest.split(":")[1][:16]
+        namepart = _h.sha256(blob.name.encode()).hexdigest()[:8]
+        return f".partial-{hexpart}-{namepart}"
+
+    def _blob_get_bytes(self, base):
+        import requests
+
+        text = requests.get(base + "/metrics").text
+        for line in text.splitlines():
+            if line.startswith("modelx_blob_get_bytes"):
+                return float(line.split()[1])
+        return 0.0
+
+    def test_resume_from_partial(self, server, model_dir, tmp_path):
+        import hashlib
+
+        base = server
+        client = Client(base, quiet=True)
+        client.push("library/resume", "v1", model_dir)
+        manifest = client.get_manifest("library/resume", "v1")
+        blob = next(b for b in manifest.blobs if b.name == "weights.bin")
+
+        dest = tmp_path / "out"
+        dest.mkdir()
+        # fabricate an interrupted download: correct first half on disk
+        full = (pathlib.Path(model_dir) / "weights.bin").read_bytes()
+        half = len(full) // 2
+        partial = dest / self._partial_name(blob)
+        partial.write_bytes(full[:half])
+
+        before = self._blob_get_bytes(base)
+        Puller(client.remote, quiet=True).pull_blobs("library/resume", manifest, str(dest))
+        fetched = self._blob_get_bytes(base) - before
+        assert (dest / "weights.bin").read_bytes() == full
+        assert not partial.exists()
+        # only the missing suffix of weights.bin crossed the wire
+        assert fetched < len(full), (fetched, len(full))
+
+    def test_corrupt_partial_restarts(self, server, model_dir, tmp_path):
+        base = server
+        client = Client(base, quiet=True)
+        client.push("library/resume2", "v1", model_dir)
+        manifest = client.get_manifest("library/resume2", "v1")
+        blob = next(b for b in manifest.blobs if b.name == "weights.bin")
+
+        dest = tmp_path / "out"
+        dest.mkdir()
+        full = (pathlib.Path(model_dir) / "weights.bin").read_bytes()
+        partial = dest / self._partial_name(blob)
+        partial.write_bytes(b"\xff" * (len(full) // 2))  # wrong bytes
+
+        Puller(client.remote, quiet=True).pull_blobs("library/resume2", manifest, str(dest))
+        assert (dest / "weights.bin").read_bytes() == full
+
+    def test_interrupted_pull_leaves_resumable_partial(self, server, model_dir, tmp_path, monkeypatch):
+        base = server
+        client = Client(base, quiet=True)
+        client.push("library/resume3", "v1", model_dir)
+        manifest = client.get_manifest("library/resume3", "v1")
+        blob = next(b for b in manifest.blobs if b.name == "weights.bin")
+        full = (pathlib.Path(model_dir) / "weights.bin").read_bytes()
+
+        dest = tmp_path / "out"
+        dest.mkdir()
+        puller = Puller(client.remote, quiet=True)
+
+        real = Puller._download_blob
+
+        def half_then_die(self, repository, desc, writer, progress):
+            writer.write(full[: len(full) // 2])
+            raise OSError("link dropped")
+
+        from modelx_tpu.types import Manifest
+
+        only_weights = Manifest(blobs=[blob])
+        monkeypatch.setattr(Puller, "_download_blob", half_then_die)
+        with pytest.raises(Exception):
+            puller.pull_blobs("library/resume3", only_weights, str(dest))
+        partial = dest / self._partial_name(blob)
+        assert partial.exists() and partial.stat().st_size == len(full) // 2
+
+        monkeypatch.setattr(Puller, "_download_blob", real)
+        puller.pull_blobs("library/resume3", manifest, str(dest))
+        assert (dest / "weights.bin").read_bytes() == full
