@@ -338,6 +338,10 @@ def cmd_dl(uri: str, dest: str, device_put: bool, mesh: str) -> None:
     try:
         from modelx_tpu.dl.initializer import run_initializer
 
+        if device_put:
+            from modelx_tpu.parallel.distributed import initialize
+
+            initialize()  # no-op single-process; wires multi-host TPU pods
         summary = run_initializer(uri, dest, device_put=device_put, mesh_spec=mesh)
         if "load" in summary:
             summary["load"] = {k: v for k, v in summary["load"].items() if k != "arrays"}

@@ -32,6 +32,9 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
 def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen: str,
          max_seq_len: int, compile_cache: bool, concurrent_load: bool, trace_dir: str) -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
+    from modelx_tpu.parallel.distributed import initialize
+
+    initialize()  # no-op single-process; wires multi-host TPU pods
     if compile_cache:
         enable_compile_cache()
     entries: dict[str, str] = {}

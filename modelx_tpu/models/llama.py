@@ -304,6 +304,8 @@ def _attend(q, k, v, cfg: LlamaConfig, causal: bool, q_offset, mesh, impl: str):
             impl = "reference"
     if impl == "ring":
         out = attn_ops.ring_attention(qt, kt, vt, mesh, axis="sp", causal=causal)
+    elif impl == "ulysses":
+        out = attn_ops.ulysses_attention(qt, kt, vt, mesh, axis="sp", causal=causal)
     elif impl == "flash":
         out = attn_ops.flash_attention(qt, kt, vt, causal=causal)
     else:

@@ -187,6 +187,46 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True):
     )(q, k, v)
 
 
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True):
+    """Ulysses/DeepSpeed-style sequence parallelism via all-to-all.
+
+    q/k/v: [B, H, S, D] globally, S sharded over ``axis``. Two all-to-alls
+    re-shard from sequence-parallel to *head*-parallel: each device then
+    holds H/n heads with the FULL sequence, runs the local flash kernel
+    (no ring steps, no online-softmax merging across devices), and a final
+    all-to-all restores sequence sharding. Versus ring attention the comm
+    volume is O(S·D·H/n) per device in two dense all-to-alls that ride ICI
+    all at once instead of n-1 neighbor hops — better when n is small and
+    heads divide evenly; ring wins on memory for very long S. Requires
+    H % n == 0 (kv heads are repeated first when GQA heads don't divide).
+    """
+    n = mesh.shape[axis]
+    if q.shape[1] % n:
+        raise ValueError(f"ulysses needs heads % {axis}={n} == 0, got {q.shape[1]}")
+    hkv = k.shape[1]
+    if hkv % n:
+        # GQA heads don't divide the axis: repeat kv only up to lcm(Hkv, n)
+        # — the minimal count that shards evenly; the local flash kernel
+        # finishes any remaining per-device repeat without moving bytes
+        rep = ((n * hkv) // math.gcd(n, hkv)) // hkv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+
+    def local_fn(q_blk, k_blk, v_blk):
+        # [B, H, S/n, D] -> [B, H/n, S, D]: split heads, gather sequence
+        to_heads = lambda x: jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+        out = flash_attention(
+            to_heads(q_blk), to_heads(k_blk), to_heads(v_blk), causal=causal
+        )
+        # [B, H/n, S, D] -> [B, H, S/n, D]
+        return jax.lax.all_to_all(out, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    spec = P(None, None, axis, None)
+    return shard_map(
+        local_fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+    )(q, k, v)
+
+
 def _merge_block(q, k, v, acc, m_prev, l_prev, q_offset, k_offset, causal):
     """Merge one k/v block into running flash statistics. All [B,H,S,D]."""
     q32, k32, v32 = (x.astype(jnp.float32) for x in _repeat_kv_heads(q, k, v))
