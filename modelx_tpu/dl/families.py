@@ -123,18 +123,11 @@ def _mixtral_forward(params, tokens, cfg, mesh=None):
 
 
 def _mixtral_generate(params, tokens, cfg, mesh=None, max_new_tokens=16):
-    """Greedy decode via full re-forward per step (cacheless reference
-    path); fine for the sidecar's correctness surface."""
-    import jax.numpy as jnp
-
     from modelx_tpu.models import mixtral
 
-    out = tokens
-    for _ in range(max_new_tokens):
-        logits = mixtral.forward(params, out, cfg, mesh=mesh)[0]
-        nxt = jnp.argmax(logits[:, -1:, :], axis=-1).astype(out.dtype)
-        out = jnp.concatenate([out, nxt], axis=1)
-    return out
+    return mixtral.greedy_generate(
+        params, tokens, cfg, max_new_tokens=max_new_tokens, mesh=mesh
+    )
 
 
 # -- gpt2 ---------------------------------------------------------------------

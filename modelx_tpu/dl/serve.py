@@ -157,6 +157,104 @@ def infer_llama_config(params: dict):
     return fam.infer_llama_config(params)
 
 
+class Batcher:
+    """Dynamic batching for forward requests: concurrent requests arriving
+    within a small window coalesce into one device call.
+
+    Right-padding is output-preserving ONLY for causal models (later
+    positions never influence earlier ones) — bidirectional encoders like
+    BERT attend to the pad tokens, so ServerSet only routes causal families
+    through a batcher. Rows pad to the group's max sequence and the batch
+    to the next power of two — bounding the set of compiled shapes — then
+    results are sliced back per request. ``generate`` is not batched here
+    (rows of different prompt lengths decode from different positions)."""
+
+    def __init__(self, server: ModelServer, max_batch: int = 32, window_ms: float = 3.0) -> None:
+        import queue
+
+        self.server = server
+        self.max_batch = max_batch
+        self.window_s = window_ms / 1e3
+        self._q: "queue.Queue" = queue.Queue()
+        self._closed = False
+        self._thread = threading.Thread(target=self._worker, daemon=True)
+        self._thread.start()
+        self.batches = 0  # observability: device calls issued
+
+    def forward_argmax(self, tokens: np.ndarray) -> np.ndarray:
+        import concurrent.futures
+
+        if self._closed:
+            raise RuntimeError("batcher is closed")
+        fut: "concurrent.futures.Future" = concurrent.futures.Future()
+        self._q.put((np.asarray(tokens, np.int32), fut))
+        return fut.result()
+
+    def _worker(self) -> None:
+        import queue
+
+        while True:
+            item = self._q.get()
+            if item is None:
+                self._drain_closed()
+                return
+            group = [item]
+            deadline = time.monotonic() + self.window_s
+            while len(group) < self.max_batch:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=timeout)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._run(group)
+                    self._drain_closed()
+                    return
+                group.append(nxt)
+            self._run(group)
+
+    def _drain_closed(self) -> None:
+        """Fail anything that raced past close() rather than hang its waiter."""
+        import queue
+
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if item is not None:
+                item[1].set_exception(RuntimeError("batcher is closed"))
+
+    def _run(self, group: list) -> None:
+        try:
+            rows = sum(t.shape[0] for t, _ in group)
+            max_s = max(t.shape[1] for t, _ in group)
+            pad_s = -(-max_s // 16) * 16  # seq to a multiple of 16
+            pad_b = 1 << (rows - 1).bit_length()  # batch to a power of two
+            batch = np.zeros((pad_b, pad_s), np.int32)
+            r = 0
+            spans = []
+            for tokens, _fut in group:
+                b, s = tokens.shape
+                batch[r : r + b, :s] = tokens
+                spans.append((r, b, s))
+                r += b
+            out = self.server.forward_argmax(batch)
+            self.batches += 1
+            for (tokens, fut), (start, b, s) in zip(group, spans):
+                fut.set_result(out[start : start + b, :s])
+        except BaseException as e:
+            for _tokens, fut in group:
+                if not fut.done():
+                    fut.set_exception(e)
+
+    def close(self) -> None:
+        self._closed = True
+        self._q.put(None)
+
+
 _MODEL_ROUTE = re.compile(r"^/v1/(?P<model>[A-Za-z0-9._-]+)/(?P<verb>forward|generate)$")
 
 
@@ -164,13 +262,32 @@ class ServerSet:
     """Named ModelServers behind one HTTP front (multi-tenant serving)."""
 
     def __init__(self, servers: dict[str, ModelServer], default: str | None = None,
-                 trace_dir: str = "") -> None:
+                 trace_dir: str = "", dynamic_batch: bool = False) -> None:
         if not servers:
             raise ValueError("no models")
         self.servers = servers
+        for name, s in servers.items():
+            s.name = name  # route key and server identity must agree
         self.default = default or next(iter(servers))
         self.trace_dir = trace_dir or os.path.join(os.getcwd(), "jax-trace")
         self._profiling = threading.Lock()
+        self._dynamic_batch = dynamic_batch
+        self._batcher_lock = threading.Lock()
+        self.batchers: dict[str, Batcher] = {}
+
+    def batcher_for(self, server: ModelServer) -> "Batcher | None":
+        """Lazily create a batcher once the model is loaded — only causal
+        families batch (right-padding changes bidirectional-encoder
+        outputs, see Batcher docstring)."""
+        if not self._dynamic_batch or server.family is None or server.family.generate is None:
+            return None
+        b = self.batchers.get(server.name)
+        if b is None:
+            with self._batcher_lock:
+                b = self.batchers.get(server.name)
+                if b is None:
+                    b = self.batchers[server.name] = Batcher(server)
+        return b
 
     @property
     def ready(self) -> bool:
@@ -286,7 +403,8 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
             server.stats["requests"] += 1
             try:
                 if verb == "forward":
-                    out = server.forward_argmax(tokens)
+                    batcher = sset.batcher_for(server)
+                    out = (batcher or server).forward_argmax(tokens)
                     self._json(200, {"logits_argmax": out.tolist()})
                 else:
                     n = int(req.get("max_new_tokens", 16))

@@ -29,8 +29,11 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
               help="persistent XLA compilation cache (restart TTFT)")
 @click.option("--concurrent-load", is_flag=True, help="overlap multi-model loads")
 @click.option("--trace-dir", default="", help="jax profiler output dir (/v1/profile)")
+@click.option("--dynamic-batch", is_flag=True,
+              help="coalesce concurrent forward requests into one device call")
 def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen: str,
-         max_seq_len: int, compile_cache: bool, concurrent_load: bool, trace_dir: str) -> None:
+         max_seq_len: int, compile_cache: bool, concurrent_load: bool, trace_dir: str,
+         dynamic_batch: bool) -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     from modelx_tpu.parallel.distributed import initialize
 
@@ -60,7 +63,7 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
                           name=name, mesh=shared_mesh)
         for name, path in entries.items()
     }
-    sset = ServerSet(servers, trace_dir=trace_dir)
+    sset = ServerSet(servers, trace_dir=trace_dir, dynamic_batch=dynamic_batch)
     httpd = serve(sset, listen=listen)  # starts serving 503s while loading
     stats = sset.load_all(concurrent=concurrent_load)
     logging.getLogger("modelx.serve").info("models loaded: %s", stats)

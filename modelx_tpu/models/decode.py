@@ -1,0 +1,38 @@
+"""Shared cached greedy decode: one scan-based implementation for every
+causal family (llama, mixtral) — forward/init_kv_cache are parameters, so
+the offset/scan logic can't drift between families."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def greedy_generate(
+    forward,  # (params, tokens, kv_cache=, cache_offset=, mesh=) -> (logits, cache)
+    init_kv_cache,  # (batch, max_len) -> cache
+    params,
+    prompt: jax.Array,  # [B, S]
+    max_new_tokens: int = 16,
+    mesh=None,
+) -> jax.Array:
+    """Greedy decode with a static-shape KV cache (lax.scan over steps).
+    Returns [B, S + max_new_tokens]; max_new_tokens <= 0 returns the prompt."""
+    if max_new_tokens <= 0:
+        return prompt
+    b, s = prompt.shape
+    cache = init_kv_cache(b, s + max_new_tokens)
+    logits, cache = forward(params, prompt, kv_cache=cache, cache_offset=0, mesh=mesh)
+    next_tok = jnp.argmax(logits[:, -1, :], axis=-1)[:, None]  # [B,1]
+
+    def step(carry, _i):
+        cache, tok, offset = carry
+        logits, cache = forward(params, tok, kv_cache=cache, cache_offset=offset, mesh=mesh)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1)[:, None]
+        return (cache, nxt, offset + 1), tok[:, 0]
+
+    (_, last, _), toks = jax.lax.scan(
+        step, (cache, next_tok, jnp.int32(s)), jnp.arange(max_new_tokens - 1)
+    )
+    generated = jnp.concatenate([toks.T, last], axis=1)  # [B, max_new_tokens]
+    return jnp.concatenate([prompt, generated], axis=1)

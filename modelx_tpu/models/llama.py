@@ -333,21 +333,14 @@ def greedy_generate(
     max_new_tokens: int = 16,
     mesh: Mesh | None = None,
 ) -> jax.Array:
-    """Greedy decode with a static-shape KV cache (lax.scan over steps)."""
-    b, s = prompt.shape
-    max_len = s + max_new_tokens
-    cache = init_kv_cache(cfg, b, max_len)
-    logits, cache = forward(params, prompt, cfg, kv_cache=cache, cache_offset=0, mesh=mesh)
-    next_tok = jnp.argmax(logits[:, -1, :], axis=-1)[:, None]  # [B,1]
+    """Greedy decode with a static-shape KV cache (lax.scan over steps).
+    Shared scan implementation: models/decode.py."""
+    from modelx_tpu.models import decode
 
-    def step(carry, i):
-        cache, tok, offset = carry
-        logits, cache = forward(params, tok, cfg, kv_cache=cache, cache_offset=offset, mesh=mesh)
-        nxt = jnp.argmax(logits[:, -1, :], axis=-1)[:, None]
-        return (cache, nxt, offset + 1), tok[:, 0]
-
-    (_, last, _), toks = jax.lax.scan(
-        step, (cache, next_tok, jnp.int32(s)), jnp.arange(max_new_tokens - 1)
+    return decode.greedy_generate(
+        lambda p, t, kv_cache, cache_offset, mesh: forward(
+            p, t, cfg, kv_cache=kv_cache, cache_offset=cache_offset, mesh=mesh
+        ),
+        lambda b, max_len: init_kv_cache(cfg, b, max_len),
+        params, prompt, max_new_tokens=max_new_tokens, mesh=mesh,
     )
-    generated = jnp.concatenate([toks.T, last], axis=1)  # [B, max_new_tokens]
-    return jnp.concatenate([prompt, generated], axis=1)

@@ -243,3 +243,21 @@ class TestMixtral:
         balanced = moe_ops.load_balancing_loss(jnp.zeros((2, 16, 4)), jnp.eye(4)[jnp.arange(32).reshape(2, 16) % 4])
         skewed = moe_ops.load_balancing_loss(skew_logits, skew_mask)
         assert float(skewed) > float(balanced)
+
+
+class TestMixtralGenerate:
+    def test_cached_decode_matches_naive(self):
+        """greedy_generate (KV cache + scan) must equal full re-forward."""
+        from modelx_tpu.models import mixtral
+
+        cfg = dataclasses.replace(mixtral.MixtralConfig.tiny(vocab_size=64), dtype=jnp.float32)
+        params = mixtral.init_params(cfg, jax.random.PRNGKey(5))
+        prompt = jnp.array([[3, 9, 12, 7]], jnp.int32)
+        out = mixtral.greedy_generate(params, prompt, cfg, max_new_tokens=5)
+
+        naive = prompt
+        for _ in range(5):
+            logits = mixtral.forward(params, naive, cfg)[0]
+            nxt = jnp.argmax(logits[:, -1:, :], axis=-1).astype(naive.dtype)
+            naive = jnp.concatenate([naive, nxt], axis=1)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(naive))

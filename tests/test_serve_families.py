@@ -126,3 +126,82 @@ class TestMultiTenant:
     def test_trace_endpoint(self, front):
         agg = requests.get(front + "/v1/trace").json()
         assert any(p.startswith("serve.load") for p in agg)
+
+
+class TestDynamicBatching:
+    def test_concurrent_requests_coalesce_and_match(self, checkpoints):
+        """N concurrent forwards through the batcher return exactly the
+        per-request results while issuing fewer device calls."""
+        import concurrent.futures
+
+        from modelx_tpu.dl.serve import Batcher
+
+        server = ModelServer(checkpoints["gpt2"], mesh_spec="dp=1", dtype="float32")
+        server.load()
+        batcher = Batcher(server, window_ms=50)
+        try:
+            prompts = [
+                np.array([[i + 1, i + 2, i + 3, i + 4]], np.int32) for i in range(8)
+            ] + [np.array([[7, 8]], np.int32)]  # a shorter one pads
+            expected = [server.forward_argmax(p) for p in prompts]
+            with concurrent.futures.ThreadPoolExecutor(9) as pool:
+                got = list(pool.map(batcher.forward_argmax, prompts))
+            for e, g in zip(expected, got):
+                np.testing.assert_array_equal(e, g)
+            assert batcher.batches < len(prompts)  # actually coalesced
+        finally:
+            batcher.close()
+
+    def test_error_propagates_to_all_waiters(self, checkpoints):
+        from modelx_tpu.dl.serve import Batcher
+
+        server = ModelServer(checkpoints["gpt2"], mesh_spec="dp=1", dtype="float32")
+        server.load()
+        batcher = Batcher(server, window_ms=50)
+
+        def boom(tokens):
+            raise RuntimeError("device fell over")
+
+        server.forward_argmax = boom
+        try:
+            with pytest.raises(RuntimeError, match="fell over"):
+                batcher.forward_argmax(np.array([[1, 2]], np.int32))
+        finally:
+            batcher.close()
+
+    def test_http_route_uses_batcher(self, checkpoints):
+        server = ModelServer(checkpoints["gpt2"], mesh_spec="dp=1", dtype="float32", name="g")
+        sset = ServerSet({"g": server}, dynamic_batch=True)
+        base = f"http://127.0.0.1:{free_port()}"
+        httpd = serve(sset, listen=base.rsplit("//", 1)[1])
+        try:
+            sset.load_all()
+            r = requests.post(base + "/v1/forward", json={"tokens": [[1, 2, 3]]})
+            assert r.status_code == 200
+            assert sset.batchers["g"].batches >= 1
+        finally:
+            httpd.shutdown()
+
+    def test_encoder_family_never_batched(self, checkpoints):
+        """BERT is bidirectional: right-padding changes its outputs, so no
+        batcher is created for encoder families even with dynamic_batch."""
+        server = ModelServer(checkpoints["bert"], mesh_spec="dp=1", dtype="float32", name="b")
+        sset = ServerSet({"b": server}, dynamic_batch=True)
+        sset.load_all()
+        assert sset.batcher_for(server) is None
+
+    def test_generate_zero_new_tokens_returns_prompt(self, checkpoints):
+        server = ModelServer(checkpoints["mixtral"], mesh_spec="dp=1", dtype="float32")
+        server.load()
+        out = server.generate(np.array([[4, 2]], np.int32), max_new_tokens=0)
+        np.testing.assert_array_equal(out, [[4, 2]])
+
+    def test_requests_after_close_fail_fast(self, checkpoints):
+        from modelx_tpu.dl.serve import Batcher
+
+        server = ModelServer(checkpoints["gpt2"], mesh_spec="dp=1", dtype="float32")
+        server.load()
+        batcher = Batcher(server, window_ms=50)
+        batcher.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            batcher.forward_argmax(np.array([[1]], np.int32))
