@@ -177,6 +177,7 @@ class Batcher:
         self.window_s = window_ms / 1e3
         self._q: "queue.Queue" = queue.Queue()
         self._closed = False
+        self._close_lock = threading.Lock()
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
         self.batches = 0  # observability: device calls issued
@@ -184,10 +185,18 @@ class Batcher:
     def forward_argmax(self, tokens: np.ndarray) -> np.ndarray:
         import concurrent.futures
 
-        if self._closed:
-            raise RuntimeError("batcher is closed")
+        tokens = np.asarray(tokens, np.int32)
+        if tokens.ndim != 2:
+            # validate BEFORE enqueueing: a malformed request inside _run
+            # would fail every other request coalesced into its group
+            raise ValueError(f"tokens must be 2-D [batch, seq], got shape {tokens.shape}")
         fut: "concurrent.futures.Future" = concurrent.futures.Future()
-        self._q.put((np.asarray(tokens, np.int32), fut))
+        # enqueue under the close lock so a racing close() can't consume the
+        # sentinel and exit between our check and our put (hung future)
+        with self._close_lock:
+            if self._closed:
+                raise RuntimeError("batcher is closed")
+            self._q.put((tokens, fut))
         return fut.result()
 
     def _worker(self) -> None:
@@ -251,8 +260,9 @@ class Batcher:
                     fut.set_exception(e)
 
     def close(self) -> None:
-        self._closed = True
-        self._q.put(None)
+        with self._close_lock:
+            self._closed = True
+            self._q.put(None)
 
 
 _MODEL_ROUTE = re.compile(r"^/v1/(?P<model>[A-Za-z0-9._-]+)/(?P<verb>forward|generate)$")
@@ -396,6 +406,8 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                 return self._json(404, {"error": "not found"})
             try:
                 tokens = np.asarray(req["tokens"], np.int32)
+                if tokens.ndim != 2:
+                    raise ValueError(f"tokens must be 2-D [batch, seq], got shape {tokens.shape}")
             except (ValueError, KeyError) as e:
                 return self._json(400, {"error": f"bad request: {e}"})
             if not server.ready:

@@ -72,6 +72,20 @@ LLAMA_RULES: Rules = [
     (r".*", []),
 ]
 
+# Llama with FSDP: every weight additionally shards its non-tp dimension
+# over the ``fsdp`` axis (ZeRO-3 / scaling-book "fully sharded" layout);
+# XLA all-gathers params just-in-time per layer and reduce-scatters grads.
+LLAMA_FSDP_RULES: Rules = [
+    (r"embed_tokens\.weight$", ["tp", "fsdp"]),
+    (r"lm_head\.weight$", ["tp", "fsdp"]),
+    (r"(q|k|v)_proj\.weight$", ["tp", "fsdp"]),
+    (r"o_proj\.weight$", ["fsdp", "tp"]),
+    (r"(gate|up)_proj\.weight$", ["tp", "fsdp"]),
+    (r"down_proj\.weight$", ["fsdp", "tp"]),
+    (r"norm\.weight$", [None]),
+    (r".*", []),
+]
+
 # GPT-2 (HF names; Conv1D weights are [in, out] so column-parallel = dim 1).
 GPT2_RULES: Rules = [
     (r"wte\.weight$", ["tp", None]),

@@ -75,9 +75,12 @@ def shard_params(params: dict, rules: Rules, mesh: Mesh) -> dict:
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     axes = mesh.axis_names
-    batch_axis = "dp" if "dp" in axes else None
+    # fsdp shards the batch together with dp (ZeRO data parallelism): the
+    # param shards live on the fsdp axis but each fsdp rank still consumes
+    # its own slice of the global batch
+    batch = tuple(a for a in ("dp", "fsdp") if a in axes) or None
     seq_axis = "sp" if "sp" in axes else None
-    return NamedSharding(mesh, P(batch_axis, seq_axis))
+    return NamedSharding(mesh, P(batch, seq_axis))
 
 
 def jit_train_step(cfg, optimizer, mesh: Mesh, rules: Rules):

@@ -6,7 +6,7 @@ Mesh specs are the string form stored in manifests / modelx.yaml
 
     dp — data parallel (batch)           ep — expert parallel (MoE)
     tp — tensor/model parallel           pp — pipeline stage parallel
-    sp — sequence/context parallel
+    sp — sequence/context parallel       fsdp — fully-sharded data parallel
 
 A size of -1 means "absorb the remaining devices" (like a reshape).
 """
@@ -25,8 +25,9 @@ AXIS_MODEL = "tp"
 AXIS_SEQUENCE = "sp"
 AXIS_EXPERT = "ep"
 AXIS_STAGE = "pp"
+AXIS_FSDP = "fsdp"
 
-KNOWN_AXES = (AXIS_BATCH, AXIS_STAGE, AXIS_EXPERT, AXIS_SEQUENCE, AXIS_MODEL)
+KNOWN_AXES = (AXIS_BATCH, AXIS_FSDP, AXIS_STAGE, AXIS_EXPERT, AXIS_SEQUENCE, AXIS_MODEL)
 
 
 @dataclasses.dataclass

@@ -205,3 +205,18 @@ class TestDynamicBatching:
         batcher.close()
         with pytest.raises(RuntimeError, match="closed"):
             batcher.forward_argmax(np.array([[1]], np.int32))
+
+    def test_1d_tokens_rejected_per_request(self, checkpoints):
+        """Malformed input must 400 its own request, never poison a group."""
+        server = ModelServer(checkpoints["gpt2"], mesh_spec="dp=1", dtype="float32", name="g")
+        sset = ServerSet({"g": server}, dynamic_batch=True)
+        base = f"http://127.0.0.1:{free_port()}"
+        httpd = serve(sset, listen=base.rsplit("//", 1)[1])
+        try:
+            sset.load_all()
+            r = requests.post(base + "/v1/forward", json={"tokens": [1, 2, 3]})
+            assert r.status_code == 400
+            r = requests.post(base + "/v1/forward", json={"tokens": [[1, 2, 3]]})
+            assert r.status_code == 200
+        finally:
+            httpd.shutdown()
