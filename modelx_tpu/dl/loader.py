@@ -331,6 +331,7 @@ def load_safetensors(
     dtype=None,
     progress: Callable[[int], None] | None = None,
     transfer_concurrency: int = 0,
+    quantize: str | None = None,
 ) -> tuple[dict[str, jax.Array], LoadStats]:
     """Load every tensor of a safetensors blob onto ``mesh`` per ``rules``.
 
@@ -340,6 +341,10 @@ def load_safetensors(
     when serving bf16 from an f32 checkpoint). ``transfer_concurrency``
     bounds concurrent host->device dispatches (0 = auto: 1 per local device,
     capped at 4 — wide fan-out contends on the transfer link).
+    ``quantize="int8"`` converts the big matmul weights to weight-only int8
+    (ops/quant.py) ON THE HOST, halving host->device bytes and HBM; the
+    per-output-channel scales are computed globally so sharded math stays
+    exact. Quantized entries come back as ``QTensor``s.
     """
     t0 = time.monotonic()
     if tensors is None or data_offset is None:
@@ -369,9 +374,24 @@ def load_safetensors(
             groups.setdefault(key, []).append((dev, idx))
         plans[name] = (sharding, list(groups.values()))
 
+    if quantize not in (None, "int8"):
+        raise ValueError(f"unsupported quantize mode {quantize!r}")
+    if quantize:
+        from modelx_tpu.ops import quant as qt
+
+    def _quantized(name: str, info: st.TensorInfo) -> bool:
+        return (
+            quantize == "int8"
+            and info.members is None
+            and len(info.shape) == 2
+            and qt.DEFAULT_ELIGIBLE.search(name) is not None
+        )
+
     # whole-tensor fetches are deduped across shard-groups of the same tensor
     _full_cache: dict[str, bytes] = {}
     _full_lock = threading.Lock()
+    # global per-channel scales for quantized tensors on the full-fetch path
+    _scale_cache: dict[str, np.ndarray] = {}
 
     def _cached_full_tensor(info: st.TensorInfo) -> bytes:
         with _full_lock:
@@ -428,7 +448,26 @@ def load_safetensors(
         with lock:
             stats.bytes_fetched += nread
             stats.fetch_seconds += time.monotonic() - tf0
-        if dtype is not None and arr.dtype != np.dtype(dtype):
+        scale = None
+        if _quantized(info.name, info):
+            inner_full = full_spec[1].start == 0 and full_spec[1].stop == info.shape[1]
+            if inner_full:
+                # this group's rows are complete channels: local scales ARE
+                # the global per-channel scales
+                scale = qt.channel_scales(arr)
+            else:
+                # input dim sharded: scales must span the full contraction
+                # axis — compute once from the cached full tensor
+                with _full_lock:
+                    scale_full = _scale_cache.get(info.name)
+                if scale_full is None:
+                    full = _as_np(_cached_full_tensor(info), info.np_dtype(), info.shape)
+                    scale_full = qt.channel_scales(full)
+                    with _full_lock:
+                        _scale_cache[info.name] = scale_full
+                scale = scale_full[full_spec[0].start : full_spec[0].stop]
+            arr = qt.quantize_rows(arr, scale)
+        elif dtype is not None and arr.dtype != np.dtype(dtype):
             arr = arr.astype(dtype)
         if progress:
             progress(arr.nbytes * len(group))
@@ -439,7 +478,14 @@ def load_safetensors(
 
         def xfer():
             try:
-                return [(dev, jax.device_put(arr, dev)) for dev, _ in group]
+                return [
+                    (
+                        dev,
+                        jax.device_put(arr, dev),
+                        jax.device_put(scale, dev) if scale is not None else None,
+                    )
+                    for dev, _ in group
+                ]
             finally:
                 inflight.release()
 
@@ -465,20 +511,36 @@ def load_safetensors(
             futures[name] = [pool.submit(fetch_group, info, g) for g in groups]
         for name, info in tensors.items():
             sharding, _groups = plans[name]
-            shards = []
+            shards, scale_shards = [], []
             for fut in futures[name]:
-                shards.extend(arr for _dev, arr in fut.result().result())
+                for _dev, arr, sc in fut.result().result():
+                    shards.append(arr)
+                    if sc is not None:
+                        scale_shards.append(sc)
             global_shape = info.shape if info.shape else ()
-            target_dtype = np.dtype(dtype) if dtype is not None else info.np_dtype()
-            results[name] = jax.make_array_from_single_device_arrays(
-                global_shape, sharding, shards
-            )
+            if scale_shards:
+                spec = sharding.spec
+                scale_sharding = NamedSharding(
+                    mesh, PartitionSpec(spec[0] if len(spec) else None)
+                )
+                results[name] = qt.QTensor(
+                    jax.make_array_from_single_device_arrays(global_shape, sharding, shards),
+                    jax.make_array_from_single_device_arrays(
+                        (info.shape[0],), scale_sharding, scale_shards
+                    ),
+                )
+                stats.bytes_to_device += int(np.prod(info.shape)) + info.shape[0] * 4
+            else:
+                target_dtype = np.dtype(dtype) if dtype is not None else info.np_dtype()
+                results[name] = jax.make_array_from_single_device_arrays(
+                    global_shape, sharding, shards
+                )
+                stats.bytes_to_device += int(np.prod(info.shape or (1,))) * target_dtype.itemsize
             stats.tensors += 1
-            stats.bytes_to_device += int(np.prod(info.shape or (1,))) * target_dtype.itemsize
         _full_cache.clear()
+        _scale_cache.clear()
 
-    for arr in results.values():
-        arr.block_until_ready()
+    jax.block_until_ready(results)  # QTensor entries are pytrees
     stats.total_seconds = time.monotonic() - t0
     from modelx_tpu.utils import trace
 

@@ -75,9 +75,11 @@ class ModelServer:
         max_seq_len: int = 2048,
         mesh=None,
         name: str = "default",
+        quantize: str | None = None,
     ) -> None:
         self.name = name
         self.model_dir = model_dir
+        self.quantize = quantize
         self.mesh = mesh if mesh is not None else (
             make_mesh(mesh_spec) if mesh_spec else make_mesh(f"dp={len(jax.devices())}")
         )
@@ -111,7 +113,9 @@ class ModelServer:
             for path in paths:
                 src = LocalFileSource(path)
                 try:
-                    arrays, stats = load_safetensors(src, self.mesh, self.family.rules)
+                    arrays, stats = load_safetensors(
+                        src, self.mesh, self.family.rules, quantize=self.quantize
+                    )
                 finally:
                     src.close()
                 params.update(arrays)

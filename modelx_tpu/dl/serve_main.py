@@ -31,9 +31,11 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
 @click.option("--trace-dir", default="", help="jax profiler output dir (/v1/profile)")
 @click.option("--dynamic-batch", is_flag=True,
               help="coalesce concurrent forward requests into one device call")
+@click.option("--quantize", type=click.Choice(["int8"]), default=None,
+              help="weight-only int8: half the HBM/transfer bytes for the big matmuls")
 def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen: str,
          max_seq_len: int, compile_cache: bool, concurrent_load: bool, trace_dir: str,
-         dynamic_batch: bool) -> None:
+         dynamic_batch: bool, quantize: str | None) -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     from modelx_tpu.parallel.distributed import initialize
 
@@ -60,7 +62,7 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
     shared_mesh = make_mesh(mesh) if mesh else make_mesh(f"dp={len(jax.devices())}")
     servers = {
         name: ModelServer(path, dtype=dtype, max_seq_len=max_seq_len,
-                          name=name, mesh=shared_mesh)
+                          name=name, mesh=shared_mesh, quantize=quantize)
         for name, path in entries.items()
     }
     sset = ServerSet(servers, trace_dir=trace_dir, dynamic_batch=dynamic_batch)

@@ -167,10 +167,15 @@ class ShardingCtx:
         names = set(self.mesh.axis_names)
         cleaned = []
         for dim, s in zip(x.shape, spec):
+            # "dp" means the batch dimension: fsdp ranks consume their own
+            # batch slice too (ZeRO data parallelism), so the batch shards
+            # over every data-ish axis present
+            cand = ("dp", "fsdp") if s == "dp" else (s,)
+            kept = tuple(a for a in cand if a in names)
             # drop axes the mesh lacks or that don't divide the dim (e.g. GQA
             # kv heads smaller than tp)
-            if s in names and dim % self.mesh.shape[s] == 0:
-                cleaned.append(s)
+            if kept and dim % math.prod(self.mesh.shape[a] for a in kept) == 0:
+                cleaned.append(kept if len(kept) > 1 else kept[0])
             else:
                 cleaned.append(None)
         return jax.lax.with_sharding_constraint(x, NamedSharding(self.mesh, P(*cleaned)))

@@ -11,6 +11,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from modelx_tpu.ops.quant import QTensor
+
 
 def layer_norm(x, weight, bias, eps):
     x32 = x.astype(jnp.float32)
@@ -20,10 +22,22 @@ def layer_norm(x, weight, bias, eps):
 
 
 def linear(x, w, b=None):
-    """y = x @ w.T (+ b) with w stored [out, in] (torch Linear layout)."""
-    y = jax.lax.dot_general(
-        x, w, (((x.ndim - 1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-    ).astype(x.dtype)
+    """y = x @ w.T (+ b) with w stored [out, in] (torch Linear layout).
+
+    ``w`` may be an int8 ``ops.quant.QTensor``: the matmul runs in the
+    activation dtype against the int8 codes and the per-output-channel scale
+    applies in the f32 epilogue (fused by XLA) — weight-only quantization.
+    """
+    if isinstance(w, QTensor):
+        y = jax.lax.dot_general(
+            x, w.q.astype(x.dtype), (((x.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        y = (y * w.scale).astype(x.dtype)  # per-channel scale in the epilogue
+    else:
+        y = jax.lax.dot_general(
+            x, w, (((x.ndim - 1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ).astype(x.dtype)
     return y if b is None else y + b
 
 

@@ -1,0 +1,80 @@
+"""Weight-only int8 quantization for serving.
+
+Symmetric per-output-channel int8: ``w ≈ q * scale[:, None]`` with
+``q ∈ [-127, 127]``. The matmul stays on the MXU in the activation dtype —
+``y = (x @ q.T) * scale`` — so the only change is HALF the weight bytes in
+HBM (and over the host->device link at load time); the per-channel scale
+multiply fuses into the matmul's epilogue under XLA.
+
+Scales are per *output* channel, so any sharding of the input (contraction)
+dimension keeps the math exact across devices: partial products psum before
+the channel scale, which is constant per channel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# weights worth quantizing: the big llama/mixtral attention + mlp matmuls
+# ([out, in] torch layout). Embeddings/norms/expert stacks stay full
+# precision (gathers and einsums, not nn.linear matmuls).
+DEFAULT_ELIGIBLE = re.compile(
+    r"((q|k|v|o)_proj|gate_proj|up_proj|down_proj|lm_head)\.weight$"
+)
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QTensor:
+    """int8 weight + per-output-channel scale; drop-in for a 2-D weight in
+    ops.nn.linear."""
+
+    q: jax.Array  # int8 [out, in]
+    scale: jax.Array  # f32 [out]
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def dtype(self):
+        return self.scale.dtype
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+
+def channel_scales(w: np.ndarray) -> np.ndarray:
+    """Per-output-channel symmetric scale (f32 [out]) for an [out, in] weight."""
+    w32 = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w32), axis=1)
+    return (amax / 127.0 + (amax == 0)).astype(np.float32)  # avoid /0 for zero rows
+
+
+def quantize_rows(w: np.ndarray, scale: np.ndarray) -> np.ndarray:
+    """int8 rows of an [out_rows, in] slice given those rows' scales."""
+    w32 = np.asarray(w, np.float32)
+    return np.clip(np.rint(w32 / scale[:, None]), -127, 127).astype(np.int8)
+
+
+def quantize(w: np.ndarray) -> QTensor:
+    """Host-side quantize of a full [out, in] weight (tests / serve-time)."""
+    scale = channel_scales(w)
+    return QTensor(q=jnp.asarray(quantize_rows(w, scale)), scale=jnp.asarray(scale))
+
+
+def dequantize(t: QTensor, dtype=jnp.float32) -> jax.Array:
+    return (t.q.astype(jnp.float32) * t.scale[:, None]).astype(dtype)

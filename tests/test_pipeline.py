@@ -133,3 +133,15 @@ class TestFSDP:
         step = jax.jit(make_train_step(cfg, optimizer, mesh=mesh))
         _p2, _o2, loss = step(sharded, opt_state, sharded_batch)
         np.testing.assert_allclose(float(loss), float(loss_ref), rtol=2e-5)
+
+    def test_fsdp_activations_shard_over_fsdp(self):
+        """The batch dim of activations must shard over dp x fsdp — an
+        fsdp-replicated forward would silently waste every fsdp rank."""
+        from modelx_tpu.models import llama as llama_mod
+        from modelx_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh("dp=2,fsdp=2,tp=2")
+        ctx = llama_mod.ShardingCtx(mesh)
+        x = jnp.zeros((8, 16, 32))
+        y = ctx.constrain(x, "dp", "sp", None)
+        assert y.sharding.spec[0] == ("dp", "fsdp")
