@@ -133,10 +133,13 @@ class Checkpointer:
         os.replace(tmp, os.path.join(self.directory, STEP_FILE))  # commit point
         # prune shards from an older layout only AFTER the commit point: a
         # crash before the rename must leave every shard the still-current
-        # checkpoint.json references
+        # checkpoint.json references. Only files matching this class's own
+        # shard naming scheme (state-*.safetensors) are candidates — the
+        # directory may also hold pulled model weights (model.safetensors
+        # etc.), which a checkpoint save must never touch.
         import glob
 
-        for path in glob.glob(os.path.join(self.directory, "*.safetensors")):
+        for path in glob.glob(os.path.join(self.directory, "state-*.safetensors")):
             if os.path.basename(path) not in written:
                 os.unlink(path)
         return written

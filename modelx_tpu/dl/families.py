@@ -45,6 +45,19 @@ def _shape(params: dict, name: str) -> tuple[int, ...]:
     return tuple(params[name].shape)
 
 
+def _act_dtype(params: dict, name: str):
+    """Activation dtype for a checkpoint: the (float) dtype of its embedding
+    weight. A config whose dtype disagrees with the params breaks the cached
+    decode path — the KV cache allocates cfg.dtype while k/v arrive in the
+    params' compute dtype, and dynamic_update_slice rejects the mismatch.
+    Non-float storage (e.g. int8 weight-only quant) computes in bfloat16."""
+    import jax.numpy as jnp
+
+    dt = params[name].dtype
+    # jnp.issubdtype understands the extended float types (bfloat16 etc.)
+    return dt if jnp.issubdtype(dt, jnp.floating) else jnp.bfloat16
+
+
 # -- llama --------------------------------------------------------------------
 
 
@@ -72,6 +85,7 @@ def infer_llama_config(params: dict):
         num_kv_heads=kv // head_dim,
         head_dim=head_dim,
         tie_embeddings="lm_head.weight" not in params,
+        dtype=_act_dtype(params, "model.embed_tokens.weight"),
     )
 
 
@@ -113,6 +127,7 @@ def infer_mixtral_config(params: dict):
         num_kv_heads=kv // head_dim,
         head_dim=head_dim,
         num_experts=num_experts,
+        dtype=_act_dtype(params, "model.embed_tokens.weight"),
     )
 
 
@@ -148,6 +163,7 @@ def infer_gpt2_config(params: dict):
     return gpt2.GPT2Config(
         vocab_size=vocab, n_positions=n_pos, hidden_size=hidden,
         num_layers=layers, num_heads=num_heads,
+        dtype=_act_dtype(params, "wte.weight"),
     )
 
 
@@ -190,6 +206,7 @@ def infer_bert_config(params: dict):
         vocab_size=vocab, hidden_size=hidden, num_layers=layers,
         num_heads=num_heads, intermediate_size=inter,
         max_position_embeddings=max_pos, type_vocab_size=type_vocab,
+        dtype=_act_dtype(params, "bert.embeddings.word_embeddings.weight"),
     )
 
 

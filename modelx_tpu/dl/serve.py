@@ -49,6 +49,13 @@ from modelx_tpu.utils import trace
 
 logger = logging.getLogger("modelx.serve")
 
+# /v1/generate decode budget an unauthenticated client may request; each
+# distinct max_new_tokens value also compiles a new decode program, so the
+# cap bounds both HBM for the KV cache and compile-cache churn.
+DEFAULT_MAX_NEW_TOKENS_LIMIT = 1024
+# /v1/profile holds the handler thread and the profiler for this long at most
+MAX_PROFILE_SECONDS = 60
+
 
 def enable_compile_cache(path: str = "") -> None:
     """Persistent XLA compilation cache (idempotent)."""
@@ -276,9 +283,11 @@ class ServerSet:
     """Named ModelServers behind one HTTP front (multi-tenant serving)."""
 
     def __init__(self, servers: dict[str, ModelServer], default: str | None = None,
-                 trace_dir: str = "", dynamic_batch: bool = False) -> None:
+                 trace_dir: str = "", dynamic_batch: bool = False,
+                 max_new_tokens_limit: int = DEFAULT_MAX_NEW_TOKENS_LIMIT) -> None:
         if not servers:
             raise ValueError("no models")
+        self.max_new_tokens_limit = max_new_tokens_limit
         self.servers = servers
         for name, s in servers.items():
             s.name = name  # route key and server identity must agree
@@ -389,18 +398,26 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
             except ValueError as e:
                 return self._json(400, {"error": f"bad request: {e}"})
 
+            if not isinstance(req, dict):
+                # a non-object body ({"tokens": ...} is the contract) must be
+                # a 400, not an uncaught TypeError that drops the connection
+                return self._json(400, {"error": "request body must be a JSON object"})
+
             if self.path == "/v1/profile":
                 try:
-                    seconds = float(req.get("seconds", 3)) if isinstance(req, dict) else -1.0
+                    seconds = float(req.get("seconds", 3))
                 except (TypeError, ValueError):
                     seconds = -1.0
-                if not (0 <= seconds <= 300):
-                    return self._json(400, {"error": "seconds must be a number in [0, 300]"})
+                if not (0 <= seconds <= MAX_PROFILE_SECONDS):
+                    return self._json(
+                        400,
+                        {"error": f"seconds must be a number in [0, {MAX_PROFILE_SECONDS}]"},
+                    )
                 if not sset._profiling.acquire(blocking=False):
                     return self._json(409, {"error": "profile already running"})
                 try:
                     with trace.jax_profile(sset.trace_dir):
-                        time.sleep(min(seconds, 60))
+                        time.sleep(seconds)
                 finally:
                     sset._profiling.release()
                 return self._json(200, {"trace_dir": sset.trace_dir})
@@ -423,7 +440,20 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                     out = (batcher or server).forward_argmax(tokens)
                     self._json(200, {"logits_argmax": out.tolist()})
                 else:
-                    n = int(req.get("max_new_tokens", 16))
+                    try:
+                        n = int(req.get("max_new_tokens", 16))
+                    except (TypeError, ValueError):
+                        return self._json(400, {"error": "max_new_tokens must be an integer"})
+                    if not (1 <= n <= sset.max_new_tokens_limit):
+                        # an unauthenticated client must not be able to force
+                        # a huge compile / HBM alloc with one request
+                        return self._json(
+                            400,
+                            {
+                                "error": "max_new_tokens must be in "
+                                f"[1, {sset.max_new_tokens_limit}]"
+                            },
+                        )
                     out = server.generate(tokens, max_new_tokens=n)
                     self._json(200, {"tokens": out.tolist()})
             except ValueError as e:  # e.g. generate on a non-generative family

@@ -144,10 +144,15 @@ def sha256_buffer(view) -> str | None:
 
 
 def pread_fd(fd: int, offset: int, length: int, out) -> None:
-    """Single GIL-free positional read on an already-open fd."""
+    """Single GIL-free positional read on an already-open fd. ``out`` must
+    hold at least ``length`` bytes — the native side writes ``length`` bytes
+    unconditionally, so an undersized buffer would be heap corruption."""
     l = lib()
     if l is None:
         raise RuntimeError("native engine unavailable")
+    mv = memoryview(out)
+    if length < 0 or mv.nbytes < length:
+        raise ValueError(f"buffer holds {mv.nbytes} bytes, need {length}")
     c = ctypes.c_char.from_buffer(out)
     rc = l.mx_pread_fd(fd, offset, length, ctypes.addressof(c))
     if rc != 0:
@@ -162,6 +167,10 @@ def pread_scatter(path: str, ranges: list[tuple[int, int, memoryview]], threads:
     arr = (MxRange * len(ranges))()
     _keep = []
     for i, (off, ln, mv) in enumerate(ranges):
+        if ln < 0 or memoryview(mv).nbytes < ln:
+            raise ValueError(
+                f"range {i}: buffer holds {memoryview(mv).nbytes} bytes, need {ln}"
+            )
         c = ctypes.c_char.from_buffer(mv)
         _keep.append(c)
         arr[i] = MxRange(off, ln, ctypes.addressof(c))

@@ -10,6 +10,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from modelx_tpu.dl import safetensors as st
 from modelx_tpu.dl.checkpoint import (
     Checkpointer,
     flatten_state,
@@ -168,3 +169,15 @@ class TestIncrementalPush:
         assert not os.path.exists(os.path.join(d, "state-layer-00099.safetensors"))
         p2, _o, _s = ckpt.restore(params, None)
         assert "model.layers.99.w" not in p2
+
+    def test_prune_never_touches_foreign_safetensors(self, tiny_state, tmp_path):
+        """save() prunes only its own state-*.safetensors shards — pulled
+        model weights sharing the directory must survive a checkpoint save."""
+        _cfg, params, _optimizer, _opt = tiny_state
+        d = tmp_path / "ck"
+        d.mkdir()
+        foreign = d / "model.safetensors"
+        st.write_safetensors(str(foreign), {"w": np.ones(3, np.float32)})
+        payload = foreign.read_bytes()
+        Checkpointer(str(d)).save(params, None, step=1)
+        assert foreign.read_bytes() == payload

@@ -64,6 +64,31 @@ class TestPreadScatter:
         with pytest.raises(OSError):
             native.pread_scatter(str(p), [(0, 10, memoryview(buf))])
 
+    def test_undersized_buffer_rejected(self, tmp_path):
+        """The native side writes `length` bytes unconditionally — an
+        undersized buffer must be rejected before it becomes heap
+        corruption."""
+        p = tmp_path / "blob"
+        p.write_bytes(b"x" * 4096)
+        small = np.empty(16, np.uint8)
+        with pytest.raises(ValueError, match="buffer"):
+            native.pread_scatter(str(p), [(0, 4096, memoryview(small))])
+
+    def test_pread_fd_undersized_buffer_rejected(self, tmp_path):
+        p = tmp_path / "blob"
+        p.write_bytes(b"y" * 4096)
+        fd = os.open(str(p), os.O_RDONLY)
+        try:
+            small = np.empty(16, np.uint8)
+            with pytest.raises(ValueError, match="buffer"):
+                native.pread_fd(fd, 0, 4096, memoryview(small))
+            # exact-size buffer still works
+            buf = np.empty(4096, np.uint8)
+            native.pread_fd(fd, 0, 4096, memoryview(buf))
+            assert bytes(buf) == b"y" * 4096
+        finally:
+            os.close(fd)
+
 
 class TestNativeHTTP:
     @pytest.fixture()

@@ -127,6 +127,42 @@ class TestMultiTenant:
         agg = requests.get(front + "/v1/trace").json()
         assert any(p.startswith("serve.load") for p in agg)
 
+    def test_non_dict_body_is_400(self, front):
+        """A JSON array/string/number body must be a 400, not a dropped
+        connection from an uncaught TypeError."""
+        for body in ([1, 2, 3], "tokens", 7):
+            r = requests.post(front + "/v1/forward", json=body)
+            assert r.status_code == 400, body
+            assert "JSON object" in r.json()["error"]
+
+    def test_max_new_tokens_bounded(self, front):
+        from modelx_tpu.dl.serve import DEFAULT_MAX_NEW_TOKENS_LIMIT
+
+        for n in (0, -4, DEFAULT_MAX_NEW_TOKENS_LIMIT + 1, 10**9):
+            r = requests.post(
+                front + "/v1/generate", json={"tokens": [[1, 2]], "max_new_tokens": n}
+            )
+            assert r.status_code == 400, n
+        r = requests.post(
+            front + "/v1/generate", json={"tokens": [[1, 2]], "max_new_tokens": "soon"}
+        )
+        assert r.status_code == 400
+        r = requests.post(
+            front + "/v1/generate", json={"tokens": [[1, 2]], "max_new_tokens": 2}
+        )
+        assert r.status_code == 200
+
+    def test_profile_seconds_validated_consistently(self, front):
+        from modelx_tpu.dl.serve import MAX_PROFILE_SECONDS
+
+        # above the cap is rejected, not silently truncated to a shorter sleep
+        r = requests.post(
+            front + "/v1/profile", json={"seconds": MAX_PROFILE_SECONDS + 1}
+        )
+        assert r.status_code == 400
+        r = requests.post(front + "/v1/profile", json={"seconds": "a while"})
+        assert r.status_code == 400
+
 
 class TestDynamicBatching:
     def test_concurrent_requests_coalesce_and_match(self, checkpoints):
