@@ -1,39 +1,68 @@
-"""Benchmark: registry -> TPU HBM load throughput (the BASELINE metric).
+"""Benchmark: registry -> TPU HBM load, TTFT, and serving throughput.
 
 Stands up a local registry, pushes a synthetic llama-shaped bf16 checkpoint,
 then measures:
 
 - baseline: the reference's deployment shape — download the blob to a pod
-  volume as one sequential stream (modelxdl semantics), then read it and
-  device_put tensor-by-tensor;
-- modelx-tpu: the loader path — parallel ranged HTTP reads planned from the
-  manifest's tensor index, streamed straight into device memory.
+  volume as one sequential stream (modelxdl semantics, pull.go:111-143),
+  then read it and device_put tensor-by-tensor;
+- modelx-tpu: the loader path — blob-location redirect (file provider for
+  the colocated registry, ranged HTTP otherwise) planned from the manifest's
+  tensor index, streamed into device memory overlapped with fetches;
+- link probe: raw host->device bandwidth of this rig (the tunnel to the TPU
+  is the hard ceiling for any loader; report it so the ratio value/link is
+  interpretable and a degraded run is visible as a degraded link, not
+  mistaken for a code regression);
+- ttft_ms: p50 time from "fresh process asks the registry for the model" to
+  "first decoded token", warm persistent XLA cache (BASELINE.md north star);
+- serving: prefill/decode tokens/s and MFU for the pushed model.
 
-Prints ONE JSON line: {"metric", "value" (GB/s into HBM), "unit",
-"vs_baseline" (speedup over the sequential path), ...extras}.
+Both timed legs alternate with settle pauses: the TPU tunnel on this rig is
+token-bucket shaped (a burst allowance, then a lower sustained rate), so
+back-to-back legs would hand whichever ran first an unearned advantage.
+
+Prints ONE JSON line; "value" stays registry->HBM GB/s (the BASELINE
+metric), extras carry the rest.
 """
 
 from __future__ import annotations
 
-import io
 import json
 import os
 import shutil
+import statistics
+import subprocess
 import sys
 import tempfile
 import time
 
 import numpy as np
 
+# Per-chip peaks used for MFU / bandwidth-utilization. Public specs:
+# v5e 197 bf16 TFLOP/s + 819 GB/s HBM; v5p 459 TFLOP/s + 2765 GB/s;
+# v4 275 TFLOP/s + 1228 GB/s. Longest-prefix match wins ("TPU v5p" must not
+# fall into the v5e bucket).
+PEAK_FLOPS = {"TPU v5p": 459e12, "TPU v5 lite": 197e12, "TPU v5e": 197e12,
+              "TPU v4": 275e12, "cpu": 1e12}
+HBM_GBPS = {"TPU v5p": 2765e9, "TPU v5 lite": 819e9, "TPU v5e": 819e9,
+            "TPU v4": 1228e9, "cpu": 100e9}
 
-def build_checkpoint(path: str, target_bytes: int) -> int:
+
+def _chip_spec(table: dict, device_kind: str, default: float) -> float:
+    for k, v in table.items():
+        if device_kind.startswith(k):
+            return v
+    return default
+
+
+def build_checkpoint(path: str, target_bytes: int, hidden: int = 2048,
+                     inter: int = 5632, vocab: int = 32000) -> int:
     """Synthetic llama-shaped checkpoint (bf16) of roughly target_bytes."""
     import ml_dtypes
 
     from modelx_tpu.dl import safetensors as st
 
     rng = np.random.RandomState(0)
-    hidden, inter, vocab = 2048, 5632, 32000
     tensors: dict[str, np.ndarray] = {
         "model.embed_tokens.weight": rng.rand(vocab, hidden).astype(ml_dtypes.bfloat16),
         "model.norm.weight": np.ones((hidden,), ml_dtypes.bfloat16),
@@ -56,113 +85,330 @@ def build_checkpoint(path: str, target_bytes: int) -> int:
     return os.path.getsize(path)
 
 
-def main() -> None:
-    import jax
+def start_registry(workdir: str) -> tuple[subprocess.Popen, str]:
+    from modelx_tpu.registry.server import free_port
 
+    port = free_port()
+    base = f"http://127.0.0.1:{port}"
+    env = dict(os.environ,
+               PYTHONPATH=os.path.dirname(os.path.abspath(__file__)),
+               JAX_PLATFORMS="cpu")
+    srv = subprocess.Popen(
+        [sys.executable, "-m", "modelx_tpu.cli", "serve",
+         "--listen", f"127.0.0.1:{port}",
+         "--data", os.path.join(workdir, "registry")],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    import requests
+
+    for _ in range(50):
+        try:
+            requests.get(base + "/healthz", timeout=1)
+            break
+        except Exception:
+            time.sleep(0.2)
+    return srv, base
+
+
+def push_checkpoint(base: str, repo: str, ckpt: str):
     from modelx_tpu.client.client import Client
     from modelx_tpu.client.helper import descriptor_for_file
     from modelx_tpu.client.push import _annotate_safetensors
-    from modelx_tpu.dl import safetensors as st
-    from modelx_tpu.dl.loader import HTTPSource, LocalFileSource, load_safetensors
-    from modelx_tpu.dl.sharding import LLAMA_RULES
-    from modelx_tpu.parallel.mesh import make_mesh
-    from modelx_tpu.registry.server import free_port
     from modelx_tpu.types import Manifest
 
-    devices = jax.devices()
-    workdir = tempfile.mkdtemp(prefix="modelx-bench-")
+    client = Client(base, quiet=True)
+    desc = descriptor_for_file(ckpt, "model.safetensors", "application/vnd.modelx.model.file.v1")
+    _annotate_safetensors(ckpt, desc)
+    with open(ckpt, "rb") as f:
+        client.remote.upload_blob_content(repo, desc, f)
+    client.remote.put_manifest(repo, "v1", Manifest(blobs=[desc]))
+    return client, desc
+
+
+def probe_link_gbps(device, nbytes: int = 16 << 20, reps: int = 3) -> float:
+    """Median raw host->device bandwidth for random (incompressible) bytes."""
+    import jax
+
+    a = np.random.randint(0, 256, nbytes, dtype=np.uint8)
+    x = jax.device_put(a, device)
+    x.block_until_ready()
+    del x
+    ts = []
+    for _ in range(reps):
+        t0 = time.monotonic()
+        x = jax.device_put(a, device)
+        x.block_until_ready()
+        ts.append(time.monotonic() - t0)
+        del x
+    return nbytes / statistics.median(ts) / 1e9
+
+
+def run_ours(client, repo: str, desc, mesh, size: int) -> tuple[float, str]:
+    """The loader path through the blob-location seam. Returns (seconds,
+    source-class name actually used — proves which engine ran)."""
+    from modelx_tpu.dl.initializer import _blob_source
+    from modelx_tpu.dl.loader import load_safetensors
+    from modelx_tpu.dl import safetensors as st
+    from modelx_tpu.dl.sharding import LLAMA_RULES
+
+    t0 = time.monotonic()
+    source = _blob_source(client, repo, desc)
+    tensors = data_offset = None
+    from modelx_tpu.types import AnnotationTensorIndex
+
+    if AnnotationTensorIndex in desc.annotations:
+        tensors, data_offset = st.parse_index_annotation(desc.annotations[AnnotationTensorIndex])
     try:
-        # -- build + push ------------------------------------------------------
+        loaded, stats = load_safetensors(
+            source, mesh, LLAMA_RULES, tensors=tensors, data_offset=data_offset
+        )
+    finally:
+        if hasattr(source, "close"):
+            source.close()
+    seconds = time.monotonic() - t0
+    del loaded
+    return seconds, type(source).__name__
+
+
+def run_baseline(base: str, repo: str, desc, workdir: str, devices) -> float:
+    """Reference deployment shape: one sequential download to a volume file,
+    then read + per-tensor device_put (cmd/modelxdl semantics)."""
+    import jax
+    import requests
+
+    from modelx_tpu.dl import safetensors as st
+
+    url = f"{base}/{repo}/blobs/{desc.digest}"
+    t0 = time.monotonic()
+    vol = os.path.join(workdir, "volume.safetensors")
+    with requests.get(url, stream=True) as r, open(vol, "wb") as f:
+        for chunk in r.iter_content(chunk_size=1024 * 1024):
+            f.write(chunk)
+    arrays = []
+    with open(vol, "rb") as f:
+        infos, off = st.read_header(f)
+        for name, info in infos.items():
+            f.seek(off + info.start)
+            raw = f.read(info.nbytes)
+            arr = np.frombuffer(raw, info.np_dtype()).reshape(info.shape)
+            arrays.append(jax.device_put(arr, devices[0]))
+    jax.block_until_ready(arrays)
+    seconds = time.monotonic() - t0
+    del arrays
+    os.unlink(vol)
+    return seconds
+
+
+def measure_ttft(base: str, repo: str, workdir: str, runs: int = 5) -> dict:
+    """p50 registry->first-token (BASELINE north star), warm persistent XLA
+    cache. Each run starts from a cleared in-process jit cache
+    (``jax.clear_caches``): the deploy being modeled is a fresh sidecar that
+    ships a pre-warmed persistent compile cache but must re-trace and fetch
+    weights. The TPU on this rig is single-tenant, so a subprocess-per-run
+    harness can't hold the device while the bench does."""
+    import jax
+
+    from modelx_tpu.client.client import Client
+    from modelx_tpu.dl import families as fam
+    from modelx_tpu.dl.initializer import load_to_mesh
+    from modelx_tpu.dl.serve import enable_compile_cache
+
+    cache_dir = os.path.join(workdir, "xla-cache")
+    enable_compile_cache(cache_dir)
+    samples, load_ms, token_ms = [], [], []
+    for i in range(runs + 1):  # run 0 warms the persistent cache, unscored
+        jax.clear_caches()
+        t0 = time.monotonic()
+        client = Client(base, quiet=True)
+        manifest = client.get_manifest(repo, "v1")
+        out = load_to_mesh(client, repo, manifest, mesh_spec="dp=1")
+        params = out["arrays"]
+        t1 = time.monotonic()
+        family = fam.detect(list(params))
+        cfg = family.infer_config(params)
+        # first decoded token == argmax of the prefill logits' last position
+        # (greedy). The decode-with-cache program for tokens 2..N compiles
+        # after the first token is already out, off the TTFT clock — same
+        # split a serving sidecar uses.
+        fwd = jax.jit(
+            lambda p, t: jax.numpy.argmax(  # noqa: B023
+                family.forward(p, t, cfg)[:, -1, :], axis=-1  # noqa: B023
+            )
+        )
+        first = fwd(params, np.array([[1, 2, 3, 4]], np.int32))
+        np.asarray(first)
+        t2 = time.monotonic()
+        del params, out, first, fwd
+        if i > 0:
+            samples.append((t2 - t0) * 1e3)
+            load_ms.append((t1 - t0) * 1e3)
+            token_ms.append((t2 - t1) * 1e3)
+    if not samples:
+        return {}
+    return {
+        "ttft_ms": round(statistics.median(samples), 1),
+        "ttft_ms_runs": [round(s, 1) for s in samples],
+        "ttft_load_ms": round(statistics.median(load_ms), 1),
+        "ttft_compile_token_ms": round(statistics.median(token_ms), 1),
+    }
+
+
+def measure_serving(params: dict, mesh, device_kind: str) -> dict:
+    """Prefill + cached-decode throughput and MFU for the loaded model."""
+    import jax
+    import jax.numpy as jnp
+
+    from modelx_tpu.dl import families as fam
+
+    family = fam.detect(list(params))
+    cfg = family.infer_config(params)
+    # the forward spans the whole mesh: utilization is against ALL its chips
+    peak = _chip_spec(PEAK_FLOPS, device_kind, 1e12) * mesh.devices.size
+
+    h, layers, inter, vocab = (cfg.hidden_size, cfg.num_layers,
+                               cfg.intermediate_size, cfg.vocab_size)
+    # dense matmul params touched per token: attention + mlp + lm_head
+    # (embedding lookup is a gather, not a matmul)
+    p_matmul = layers * (4 * h * h + 3 * h * inter) + vocab * h
+
+    out: dict = {}
+    rng = np.random.RandomState(7)
+
+    # Timing discipline for a tunneled device: every rep uses DISTINCT
+    # inputs (the relay memoizes repeat executions) and forces a small
+    # result fetch. Per-call latency includes the host<->device round trip;
+    # steady-state throughput pipelines N dispatches and fetches once, the
+    # shape a serving batcher actually drives.
+    def fetch(x):
+        return float(jnp.reshape(x, (-1,))[0])
+
+    # -- prefill ------------------------------------------------------------
+    B, S = 8, 512
+    toks = [jnp.asarray(rng.randint(1, vocab, (B, S)), jnp.int32) for _ in range(10)]
+    fwd = jax.jit(lambda p, t: family.forward(p, t, cfg, mesh=mesh))
+    fetch(fwd(params, toks[9]))  # compile
+    lat = []
+    for i in range(3):
+        t0 = time.monotonic()
+        fetch(fwd(params, toks[i]))
+        lat.append(time.monotonic() - t0)
+    t0 = time.monotonic()
+    outs = [fwd(params, t) for t in toks[:8]]
+    fetch(outs[-1])
+    pipe_dt = (time.monotonic() - t0) / 8
+    dt = statistics.median(lat)
+    # attention score+value matmuls: 2 * 2 * h per (query, key<=query) pair
+    flops = 2 * p_matmul * B * S + layers * 4 * h * B * S * S / 2
+    out["prefill_latency_ms"] = round(dt * 1e3, 1)
+    out["prefill_tokens_per_s"] = round(B * S / pipe_dt, 1)
+    out["prefill_mfu"] = round(flops / pipe_dt / peak, 4)
+
+    # -- cached decode ------------------------------------------------------
+    # one jit call decodes NEW tokens via lax.scan, so the per-call round
+    # trip amortizes across the whole generation (the product's shape)
+    NEW = 32
+    prompts = [t[:, :128] for t in toks]
+    gen = jax.jit(lambda p, t: family.generate(p, t, cfg, mesh=mesh, max_new_tokens=NEW))
+    fetch(gen(params, prompts[9]))  # compile
+    lat = []
+    for i in range(3):
+        t0 = time.monotonic()
+        fetch(gen(params, prompts[i]))
+        lat.append(time.monotonic() - t0)
+    dt = statistics.median(lat)
+    out["decode_tokens_per_s"] = round(B * NEW / dt, 1)
+    # decode is HBM-bound: every step re-reads the weights; utilization
+    # against the mesh's aggregate memory bandwidth is the honest roofline
+    hbm_bw = _chip_spec(HBM_GBPS, device_kind, 1e12) * mesh.devices.size
+    step_bytes = 2 * p_matmul  # bf16 weights
+    out["decode_model_bandwidth_util"] = round(step_bytes * NEW / dt / hbm_bw, 4)
+    out["serving_batch"] = B
+    return out
+
+
+def main() -> None:
+    import jax
+
+    from modelx_tpu import native
+    from modelx_tpu.dl import safetensors as st
+    from modelx_tpu.dl.loader import load_safetensors
+    from modelx_tpu.dl.sharding import LLAMA_RULES
+    from modelx_tpu.dl.initializer import _blob_source
+    from modelx_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    device_kind = getattr(devices[0], "device_kind", str(devices[0]))
+    workdir = tempfile.mkdtemp(prefix="modelx-bench-")
+    settle_s = float(os.environ.get("BENCH_SETTLE_S", 8.0))
+    srv = None
+    try:
         ckpt = os.path.join(workdir, "model.safetensors")
         target = int(os.environ.get("BENCH_BYTES", 512 * 1024 * 1024))
         size = build_checkpoint(ckpt, target)
+        srv, base = start_registry(workdir)
+        client, desc = push_checkpoint(base, "library/bench", ckpt)
 
-        import subprocess
+        # small model for TTFT (BASELINE #3 scaled to the rig: the 500 ms
+        # budget was set for a multi-chip pod; this rig is one tunneled chip)
+        ttft_ckpt = os.path.join(workdir, "ttft.safetensors")
+        build_checkpoint(ttft_ckpt, 48 * 1024 * 1024, hidden=512, inter=1408, vocab=8192)
+        push_checkpoint(base, "library/ttft", ttft_ckpt)
 
-        port = free_port()
-        base = f"http://127.0.0.1:{port}"
-        env = dict(os.environ, PYTHONPATH=os.path.dirname(os.path.abspath(__file__)), JAX_PLATFORMS="cpu")
-        srv = subprocess.Popen(
-            [sys.executable, "-m", "modelx_tpu.cli", "serve",
-             "--listen", f"127.0.0.1:{port}",
-             "--data", os.path.join(workdir, "registry")],
-            env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-        )
-        import requests as _rq
-
-        for _ in range(50):
-            try:
-                _rq.get(base + "/healthz", timeout=1)
-                break
-            except Exception:
-                time.sleep(0.2)
-        client = Client(base, quiet=True)
-
-        desc = descriptor_for_file(ckpt, "model.safetensors", "application/vnd.modelx.model.file.v1")
-        _annotate_safetensors(ckpt, desc)
-        with open(ckpt, "rb") as f:
-            client.remote.upload_blob_content("library/bench", desc, f)
-        client.remote.put_manifest("library/bench", "v1", Manifest(blobs=[desc]))
-
-        url = f"{base}/library/bench/blobs/{desc.digest}"
         mesh = make_mesh(f"dp={len(devices)}")
-        tensors, data_offset = st.read_header_from_file(ckpt)
 
-        # warm up the device transfer path so neither side pays setup costs
-        warm = jax.device_put(np.zeros(8 << 20, np.uint8), devices[0])
-        warm.block_until_ready()
-        del warm
+        # warm up the device transfer path so neither leg pays setup costs
+        link_gbps = probe_link_gbps(devices[0])
 
-        # -- modelx-tpu loader: ranged parallel -> HBM ------------------------
-        t0 = time.monotonic()
-        loaded, stats = load_safetensors(
-            HTTPSource(url, total=size), mesh, LLAMA_RULES,
-            tensors=tensors, data_offset=data_offset,
-        )
-        ours_s = time.monotonic() - t0
+        # alternate legs with settle pauses (token-bucket tunnel; see module
+        # docstring), baseline first = any leftover burst credit goes to the
+        # reference's shape, not ours
+        baseline_ts, ours_ts, engine_src = [], [], ""
+        for _ in range(2):
+            time.sleep(settle_s)
+            baseline_ts.append(run_baseline(base, "library/bench", desc, workdir, devices))
+            time.sleep(settle_s)
+            s, engine_src = run_ours(client, "library/bench", desc, mesh, size)
+            ours_ts.append(s)
+        ours_s, baseline_s = min(ours_ts), min(baseline_ts)
+
+        ttft = measure_ttft(base, "library/ttft", workdir)
+
+        # serving: load once more (cheap assert it still works), reuse arrays
+        source = _blob_source(client, "library/bench", desc)
+        try:
+            loaded, _stats = load_safetensors(source, mesh, LLAMA_RULES)
+        finally:
+            if hasattr(source, "close"):
+                source.close()
+        serving = measure_serving(loaded, mesh, device_kind)
         del loaded
-
-        # -- baseline: sequential download to volume, then load ---------------
-        t0 = time.monotonic()
-        vol = os.path.join(workdir, "volume.safetensors")
-        import requests
-
-        with requests.get(url, stream=True) as r, open(vol, "wb") as f:
-            for chunk in r.iter_content(chunk_size=1024 * 1024):
-                f.write(chunk)
-        arrays = []
-        with open(vol, "rb") as f:
-            infos, off = st.read_header(f)
-            for name, info in infos.items():
-                f.seek(off + info.start)
-                raw = f.read(info.nbytes)
-                arr = np.frombuffer(raw, info.np_dtype()).reshape(info.shape)
-                arrays.append(jax.device_put(arr, devices[0]))
-        jax.block_until_ready(arrays)
-        baseline_s = time.monotonic() - t0
-        del arrays
 
         ours_gbps = size / ours_s / 1e9
         baseline_gbps = size / baseline_s / 1e9
-        srv.terminate()
 
-        print(
-            json.dumps(
-                {
-                    "metric": "registry_to_hbm_gbps",
-                    "value": round(ours_gbps, 3),
-                    "unit": "GB/s",
-                    "vs_baseline": round(ours_gbps / baseline_gbps, 3),
-                    "baseline_gbps": round(baseline_gbps, 3),
-                    "bytes": size,
-                    "seconds": round(ours_s, 3),
-                    "baseline_seconds": round(baseline_s, 3),
-                    "device": str(devices[0]),
-                    "n_devices": len(devices),
-                }
-            )
-        )
+        print(json.dumps({
+            "metric": "registry_to_hbm_gbps",
+            "value": round(ours_gbps, 3),
+            "unit": "GB/s",
+            "vs_baseline": round(ours_gbps / baseline_gbps, 3),
+            "baseline_gbps": round(baseline_gbps, 3),
+            "bytes": size,
+            "seconds": round(ours_s, 3),
+            "baseline_seconds": round(baseline_s, 3),
+            "seconds_runs": [round(t, 3) for t in ours_ts],
+            "baseline_seconds_runs": [round(t, 3) for t in baseline_ts],
+            "link_gbps": round(link_gbps, 3),
+            "link_utilization": round(ours_gbps / link_gbps, 3) if link_gbps else None,
+            "engine": {"native": native.available(), "source": engine_src},
+            **ttft,
+            **serving,
+            "device": str(devices[0]),
+            "device_kind": device_kind,
+            "n_devices": len(devices),
+        }))
     finally:
+        if srv is not None:
+            srv.terminate()  # before rmtree: never delete a live server's data
         shutil.rmtree(workdir, ignore_errors=True)
 
 

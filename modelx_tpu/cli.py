@@ -294,12 +294,15 @@ def cmd_gc(ref: str, grace: float | None) -> None:
 @click.option("--s3-bucket", default="registry")
 @click.option("--s3-region", default="us-east-1")
 @click.option("--enable-redirect", is_flag=True, help="presigned load separation")
+@click.option("--local-redirect/--no-local-redirect", default=True,
+              help="FS store: redirect colocated clients to blob paths")
 @click.option("--auth-token", multiple=True, help="accepted bearer token (repeatable)")
 @click.option("--oidc-issuer", default="", help="OIDC issuer URL for JWT bearer auth")
 @click.option("--gc-interval", default=0.0, type=float, help="seconds between GC sweeps (0=off)")
 def cmd_serve(
     listen, data_dir, tls_cert, tls_key, s3_url, s3_access_key, s3_secret_key,
-    s3_bucket, s3_region, enable_redirect, auth_token, oidc_issuer, gc_interval,
+    s3_bucket, s3_region, enable_redirect, local_redirect, auth_token, oidc_issuer,
+    gc_interval,
 ) -> None:
     """Run the registry daemon (cmd/modelxd/modelxd.go:26-58)."""
     from modelx_tpu.registry.server import Options, RegistryServer
@@ -317,6 +320,7 @@ def cmd_serve(
         s3_bucket=s3_bucket,
         s3_region=s3_region,
         enable_redirect=enable_redirect,
+        local_redirect=local_redirect,
         auth_tokens=tuple(auth_token),
         oidc_issuer=oidc_issuer,
         gc_interval_s=gc_interval,

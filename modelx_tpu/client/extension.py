@@ -10,6 +10,7 @@ storage.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import BinaryIO, Callable, Protocol
 
@@ -130,4 +131,52 @@ class RawHTTPExtension:
         http_upload(url, reader, headers=location.properties.get("headers"), progress=progress)
 
 
+class FileExtension:
+    """``file`` provider: the registry advertised the blob's path on a
+    filesystem this client can (maybe) see — a colocated FS store or a
+    shared pod volume. Download reads the file directly, so bytes never
+    cross the registry process. ``LocationUnreachable`` (an OSError) tells
+    the pull engine to fall back to the direct GET: a *remote* client
+    receives the same location and simply can't open the path.
+
+    The size check guards against reading a half-written or wrong file: the
+    store only advertises committed content-addressed blobs, so a mismatch
+    means the path isn't the blob the manifest promised."""
+
+    def download(self, location, desc, writer, progress=None, chunk_size=4 * 1024 * 1024) -> None:
+        path = location.properties.get("path", "")
+        want = int(location.properties.get("size", desc.size or -1))
+        try:
+            # ANY failure to see/open the path means this client can't use
+            # the location (remote host, odd mount shape — ENOTDIR, ELOOP,
+            # ...): fall back. Errors after the first byte is read are real
+            # I/O errors and propagate — a silent fallback there could mask
+            # a corrupt read mid-stream.
+            st_size = os.stat(path).st_size
+            if want >= 0 and st_size != want:
+                raise LocationUnreachable(f"{path}: size {st_size} != advertised {want}")
+            f = open(path, "rb")
+        except OSError as e:
+            if isinstance(e, LocationUnreachable):
+                raise
+            raise LocationUnreachable(str(e)) from e
+        with f:
+            while True:
+                chunk = f.read(chunk_size)
+                if not chunk:
+                    break
+                writer.write(chunk)
+                if progress:
+                    progress(len(chunk))
+
+    def upload(self, location, desc, reader, progress=None) -> None:
+        raise errors.unsupported("file locations are download-only")
+
+
+class LocationUnreachable(OSError):
+    """A blob location this client cannot use (e.g. a ``file`` path on
+    another host). Callers fall back to the direct server GET."""
+
+
 register_extension("http", RawHTTPExtension())
+register_extension("file", FileExtension())

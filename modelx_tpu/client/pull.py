@@ -263,9 +263,16 @@ class Puller:
         """pull.go:206-215 — presigned location first, direct GET fallback."""
         location = self.remote.get_blob_location(repository, desc, BlobLocationPurposeDownload)
         if location is not None:
+            from modelx_tpu.client.extension import LocationUnreachable
+
             ext = get_extension(location.provider)
-            ext.download(location, desc, writer, progress=progress)
-            return
+            try:
+                ext.download(location, desc, writer, progress=progress)
+                return
+            except LocationUnreachable:
+                # a location only a colocated client could use (e.g. a file
+                # path on the registry host) — take the direct GET instead
+                pass
         for chunk in self.remote.get_blob_content(repository, desc.digest):
             writer.write(chunk)
             if progress:

@@ -25,6 +25,7 @@ GSPMD" (BASELINE.json north_star).
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
 import re
 import threading
@@ -41,6 +42,14 @@ from modelx_tpu.dl.sharding import Rules, sharding_for
 
 DEFAULT_FETCH_CONCURRENCY = 16
 FETCH_RETRIES = 3  # per-shard retry budget (SURVEY §5: loader retries per shard)
+# packed-transfer default: OFF. Small tensors CAN ride one concatenated
+# uint8 buffer + on-device bitcast (pack_threshold>0), but measured on a
+# tunneled v5e the plain path pipelines per-tensor device_puts at <1 ms each
+# while the unpack program costs ~2 s to compile in every fresh process —
+# packing only pays for checkpoints with thousands of tiny tensors served
+# by a long-lived process that amortizes the compile.
+DEFAULT_PACK_THRESHOLD = 0
+PACK_CHUNK = 64 << 20
 
 
 def _read_with_retry(source: "ByteSource", offset: int, length: int, out=None,
@@ -115,10 +124,10 @@ class HTTPSource:
     """Ranged GETs against a URL (registry blob endpoint or presigned S3).
 
     Built on raw ``http.client`` with ``readinto`` and one persistent
-    connection per thread: the requests/urllib3 stack tops out around
-    0.1-0.4 GB/s because it shuttles 10 KB chunks through Python, which
-    would throttle the whole registry->HBM path (measured: this
-    implementation sustains >1 GB/s per stream against the local registry).
+    connection per thread: the requests/urllib3 stack shuttles small chunks
+    through Python, which would throttle the registry->HBM path. Colocated
+    clients should prefer the registry's ``file`` location redirect
+    (LocalFileSource) — direct preads beat any loopback HTTP.
     """
 
     def __init__(self, url: str, headers: dict[str, str] | None = None, total: int = -1) -> None:
@@ -315,6 +324,61 @@ def fuse_expert_tensors(
     return out
 
 
+@functools.partial(jax.jit, static_argnums=(1,))
+def _unpack_packed(buf: jax.Array, layout: tuple) -> tuple:
+    """Split one packed uint8 buffer back into typed tensors on device.
+    ``layout`` is static: ((offset, nbytes, dtype_str, shape), ...). Each
+    element's bytes are bitcast in place — device-side slicing costs HBM
+    bandwidth, not a host round-trip per tensor."""
+    import jax.numpy as jnp
+
+    outs = []
+    for off, nbytes, dtype_str, shape in layout:
+        piece = jax.lax.slice(buf, (off,), (off + nbytes,))
+        dt = jnp.dtype(dtype_str)
+        if dt.itemsize == 1:
+            outs.append(jax.lax.bitcast_convert_type(piece.reshape(shape), dt))
+        else:
+            outs.append(
+                jax.lax.bitcast_convert_type(piece.reshape(*shape, dt.itemsize), dt)
+            )
+    return tuple(outs)
+
+
+def _transfer_packs(pack_jobs: dict) -> dict:
+    """Ship packed small tensors: per device-set, concatenate host bytes into
+    <=PACK_CHUNK buffers, one device_put (+ one unpack dispatch) per device
+    per buffer. Returns {(tensor name, group index): [(device, shard), ...]}."""
+    out: dict[tuple, list] = {}
+    for items in pack_jobs.values():
+        chunks, cur, cur_bytes = [], [], 0
+        for item in items:
+            nb = item[2].nbytes
+            if cur and cur_bytes + nb > PACK_CHUNK:
+                chunks.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(item)
+            cur_bytes += nb
+        if cur:
+            chunks.append(cur)
+        for chunk in chunks:
+            bufs, layout, off = [], [], 0
+            for _name, _gi, arr, _group in chunk:
+                flat = np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
+                bufs.append(flat)
+                layout.append((off, arr.nbytes, str(arr.dtype), tuple(arr.shape)))
+                off += arr.nbytes
+            pack = np.concatenate(bufs) if len(bufs) > 1 else bufs[0]
+            layout = tuple(layout)
+            devices = [dev for dev, _idx in chunk[0][3]]
+            for dev in devices:
+                dbuf = jax.device_put(pack, dev)
+                pieces = _unpack_packed(dbuf, layout)
+                for (name, gi, _arr, _group), piece in zip(chunk, pieces):
+                    out.setdefault((name, gi), []).append((dev, piece))
+    return out
+
+
 def _leading_axis_only(spec: PartitionSpec) -> bool:
     if len(spec) == 0 or spec[0] is None:
         return False
@@ -332,6 +396,7 @@ def load_safetensors(
     progress: Callable[[int], None] | None = None,
     transfer_concurrency: int = 0,
     quantize: str | None = None,
+    pack_threshold: int = DEFAULT_PACK_THRESHOLD,
 ) -> tuple[dict[str, jax.Array], LoadStats]:
     """Load every tensor of a safetensors blob onto ``mesh`` per ``rules``.
 
@@ -345,6 +410,10 @@ def load_safetensors(
     (ops/quant.py) ON THE HOST, halving host->device bytes and HBM; the
     per-output-channel scales are computed globally so sharded math stays
     exact. Quantized entries come back as ``QTensor``s.
+    ``pack_threshold``: per-device shards smaller than this are concatenated
+    and shipped as one uint8 buffer per ~PACK_CHUNK, then split/bitcast on
+    device — per-tensor dispatch latency (~5 ms on a tunneled device) would
+    otherwise dominate checkpoints with many small tensors. 0 disables.
     """
     t0 = time.monotonic()
     if tensors is None or data_offset is None:
@@ -426,9 +495,10 @@ def load_safetensors(
     def fetch_group(info: st.TensorInfo, group: list):
         """Fetch one shard-group's bytes; hand the host array to the transfer
         pool. Fetches run wide (network-bound); device dispatch is funneled
-        through few threads because concurrent device_puts *contend* on the
-        host->device link (measured on a v5e tunnel: 8-thread device_put runs
-        at 0.16 GB/s vs 0.42 GB/s for pipelined single-thread dispatch).
+        through few threads because concurrent device_puts contend on the
+        host->device link rather than adding bandwidth (wide fan-out
+        measured slower than funneled dispatch on a TPU tunnel; the link,
+        not dispatch, is the bottleneck).
         Returns a future of [(device, on-device shard), ...]."""
         _dev0, idx0 = group[0]
         full_spec = _normalize_index(idx0, info.shape)
@@ -471,6 +541,19 @@ def load_safetensors(
             arr = arr.astype(dtype)
         if progress:
             progress(arr.nbytes * len(group))
+        packable = (
+            scale is None
+            and pack_threshold
+            and arr.nbytes < pack_threshold
+            # dtypes jax would silently narrow (int64 without x64) must take
+            # the plain device_put path, which applies that canonicalization
+            and jax.dtypes.canonicalize_dtype(arr.dtype) == arr.dtype
+        )
+        if packable:
+            # small shard: ride the packed transfer instead of paying a
+            # per-tensor device round-trip (host bytes are bounded by the
+            # threshold times the tensor count, i.e. the small tail only)
+            return ("pack", arr, group)
         # backpressure: bound host arrays parked in the transfer queue, so a
         # checkpoint larger than host RAM streams instead of accumulating
         # (fetch runs >1 GB/s, the device link ~0.3 GB/s)
@@ -509,11 +592,31 @@ def load_safetensors(
         for name, info in sorted(tensors.items(), key=lambda kv: -kv[1].nbytes):
             _sharding, groups = plans[name]
             futures[name] = [pool.submit(fetch_group, info, g) for g in groups]
+        # drain fetches: big tensors already stream through the transfer
+        # pool; small ones collect into pack jobs keyed by device-set
+        settled: dict[str, list] = {}
+        pack_jobs: dict[tuple, list] = {}
+        for name in futures:
+            entries = []
+            for gi, fut in enumerate(futures[name]):
+                r = fut.result()
+                if isinstance(r, tuple) and r and r[0] == "pack":
+                    _tag, arr, group = r
+                    key = tuple(sorted(d.id for d, _idx in group))
+                    pack_jobs.setdefault(key, []).append((name, gi, arr, group))
+                    entries.append(None)  # shard arrives via the pack
+                else:
+                    entries.append(r)
+            settled[name] = entries
+        packed = _transfer_packs(pack_jobs)
         for name, info in tensors.items():
             sharding, _groups = plans[name]
             shards, scale_shards = [], []
-            for fut in futures[name]:
-                for _dev, arr, sc in fut.result().result():
+            for gi, entry in enumerate(settled[name]):
+                if entry is None:
+                    shards.extend(arr for _dev, arr in packed[(name, gi)])
+                    continue
+                for _dev, arr, sc in entry.result():
                     shards.append(arr)
                     if sc is not None:
                         scale_shards.append(sc)

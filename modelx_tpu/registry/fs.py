@@ -226,6 +226,12 @@ class LocalFSProvider:
             content_type=self._content_type(abspath),
         )
 
+    def local_path(self, path: str) -> str:
+        """Absolute on-disk path for an object — the hook the FS store's
+        ``file`` blob-location redirect uses. Only providers physically
+        backed by a local filesystem define this method."""
+        return self._abs(path)
+
     def remove(self, path: str) -> None:
         abspath = self._abs(path)
         if os.path.isdir(abspath):

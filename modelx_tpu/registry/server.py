@@ -71,6 +71,11 @@ class Options:
     s3_region: str = "us-east-1"
     s3_presign_expire_s: int = 3600
     enable_redirect: bool = False
+    # FS store: advertise blobs' local paths as ``file`` download locations so
+    # colocated clients (shared volume / same host) read them directly instead
+    # of streaming through this process. Clients that can't see the path fall
+    # back to the direct GET, so this is safe to leave on.
+    local_redirect: bool = True
     # auth: static bearer token(s) and/or OIDC issuer; both empty = anonymous
     # (reference: OIDC filter in helper.go:63-96, pkg/auth otherwise empty)
     auth_tokens: tuple[str, ...] = ()
@@ -548,7 +553,7 @@ def new_store(opts: Options) -> RegistryStore:
         from modelx_tpu.registry.store_s3 import S3RegistryStore
 
         return S3RegistryStore(opts)
-    return FSRegistryStore(LocalFSProvider(opts.data_dir))
+    return FSRegistryStore(LocalFSProvider(opts.data_dir), local_redirect=opts.local_redirect)
 
 
 def free_port() -> int:

@@ -48,8 +48,11 @@ _INDEX_REBUILD_CONCURRENCY = 16
 class FSRegistryStore:
     """store_fs.go:23-28."""
 
-    def __init__(self, fs: FSProvider, refresh_on_init: bool = True) -> None:
+    def __init__(
+        self, fs: FSProvider, refresh_on_init: bool = True, local_redirect: bool = False
+    ) -> None:
         self.fs = fs
+        self.local_redirect = local_redirect
         self._index_locks: dict[str, threading.Lock] = {}
         self._index_locks_guard = threading.Lock()
         self._global_lock = threading.Lock()
@@ -276,8 +279,34 @@ class FSRegistryStore:
     def get_blob_location(
         self, repository: str, digest: str, purpose: str, properties: dict[str, str]
     ) -> BlobLocation | None:
-        """FS store does not support load separation (store_fs.go:380-386)."""
-        return None
+        """Load separation for colocated clients: when the store sits on a
+        filesystem the client can also see (same host, or a shared pod
+        volume — the modelxdl deployment shape), downloads redirect to the
+        blob's path and bytes never cross the registry process at all. This
+        extends the reference's presign seam (store_s3.go:122-134) with a
+        ``file`` provider; clients that can't read the path fall back to the
+        direct GET (pull.go:206-215 fallback semantics), so advertising it
+        to a remote client costs one stat. The reference's FS store returns
+        unsupported here (store_fs.go:380-386). Uploads still flow through
+        the server: the manifest commit's digest verification needs them.
+        """
+        if not self.local_redirect or purpose != "download":
+            return None
+        local_path = getattr(self.fs, "local_path", None)
+        if local_path is None:
+            return None
+        path = local_path(blob_digest_path(repository, digest))
+        if path is None:
+            return None
+        try:
+            meta = self.fs.stat(blob_digest_path(repository, digest))
+        except FSNotFound:
+            raise errors.blob_unknown(digest) from None
+        return BlobLocation(
+            provider="file",
+            purpose=purpose,
+            properties={"path": path, "size": meta.size},
+        )
 
     # -- listing helpers ------------------------------------------------------
 

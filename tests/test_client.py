@@ -334,3 +334,51 @@ class TestPullResume:
         monkeypatch.setattr(Puller, "_download_blob", real)
         puller.pull_blobs("library/resume3", manifest, str(dest))
         assert (dest / "weights.bin").read_bytes() == full
+
+
+class TestFileRedirectPull:
+    """Colocated load separation: a LocalFS-backed registry redirects pulls
+    to the blob's path; remote clients (unreadable path) fall back to the
+    direct GET; bytes stay correct either way."""
+
+    @pytest.fixture
+    def local_server(self, tmp_path):
+        from modelx_tpu.registry.fs import LocalFSProvider
+
+        store = FSRegistryStore(
+            LocalFSProvider(str(tmp_path / "reg")), local_redirect=True
+        )
+        srv = RegistryServer(Options(listen=f"127.0.0.1:{free_port()}"), store=store)
+        base = srv.serve_background()
+        yield base, srv
+        srv.shutdown()
+
+    def test_pull_bypasses_registry_data_plane(self, local_server, model_dir, tmp_path):
+        import requests
+
+        base, srv = local_server
+        client = Client(base, quiet=True)
+        client.push("library/demo", "v1", model_dir)
+        out = tmp_path / "pulled"
+        client.pull("library/demo", "v1", str(out))
+        assert (out / "weights.bin").read_bytes() == b"W" * 4096
+        metrics = requests.get(base + "/metrics").text
+        # every blob came through the file location, none through the server
+        assert "blob_get_total 0" in metrics or "blob_get_total" not in metrics
+
+    def test_unreachable_path_falls_back_to_direct_get(
+        self, local_server, model_dir, tmp_path, monkeypatch
+    ):
+        base, srv = local_server
+        client = Client(base, quiet=True)
+        client.push("library/demo", "v1", model_dir)
+        # simulate a remote client: the advertised path doesn't exist here
+        fs = srv.registry.store.fs
+        real = fs.local_path
+        monkeypatch.setattr(
+            fs, "local_path", lambda p: "/nonexistent-host-path/" + p.replace("/", "_")
+        )
+        out = tmp_path / "pulled"
+        client.pull("library/demo", "v1", str(out))
+        assert (out / "weights.bin").read_bytes() == b"W" * 4096
+        monkeypatch.setattr(fs, "local_path", real)

@@ -163,3 +163,45 @@ class TestPodSpec:
         spec = generate_pod_spec("m", "modelx://r/l/m@v1", mc)
         cmd = spec["spec"]["containers"][0]["command"]
         assert "dp=1,tp=4" in cmd
+
+
+class TestFileRedirectLoader:
+    @pytest.fixture
+    def local_registry(self, tmp_path):
+        from modelx_tpu.registry.fs import LocalFSProvider
+
+        store = FSRegistryStore(
+            LocalFSProvider(str(tmp_path / "reg")), local_redirect=True
+        )
+        srv = RegistryServer(Options(listen=f"127.0.0.1:{free_port()}"), store=store)
+        base = srv.serve_background()
+        yield base
+        srv.shutdown()
+
+    def test_load_to_mesh_uses_local_file_source(self, local_registry, tmp_path, monkeypatch):
+        """A colocated loader must read blob bytes by pread, not ranged HTTP:
+        constructing an HTTPSource at all fails the test."""
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        src = tmp_path / "model"
+        src.mkdir()
+        st.write_safetensors(
+            str(src / "model.safetensors"), {k: np.asarray(v) for k, v in params.items()}
+        )
+        client = Client(local_registry, quiet=True)
+        client.push("library/tiny", "v1", str(src))
+
+        from modelx_tpu.dl import initializer as ini
+        from modelx_tpu.dl import loader as loader_mod
+
+        def _no_http(*a, **kw):
+            raise AssertionError("loader took the HTTP path despite a readable file location")
+
+        monkeypatch.setattr(loader_mod, "HTTPSource", _no_http)
+        manifest = client.get_manifest("library/tiny", "v1")
+        out = ini.load_to_mesh(client, "library/tiny", manifest, mesh_spec="dp=1")
+        assert out["tensors"] == len(params)
+        name = "model.embed_tokens.weight"
+        np.testing.assert_array_equal(
+            np.asarray(out["arrays"][name], np.float32), np.asarray(params[name], np.float32)
+        )

@@ -298,3 +298,53 @@ class TestExpertFusionGate:
             OnceFlaky(path), mesh, LLAMA_RULES, tensors=tensors, data_offset=off
         )
         assert np.asarray(arrays["model.norm.weight"]).shape == (16,)
+
+
+class TestPackedTransfer:
+    """Small tensors ride one packed uint8 buffer + on-device bitcast; the
+    result must be bit-identical to per-tensor device_put for every dtype,
+    sharded or replicated."""
+
+    @pytest.fixture
+    def mixed_checkpoint(self, tmp_path):
+        import ml_dtypes
+
+        rng = np.random.RandomState(1)
+        tensors = {
+            "model.layers.0.self_attn.q_proj.weight": rng.rand(32, 16).astype(
+                ml_dtypes.bfloat16
+            ),
+            "model.layers.0.self_attn.o_proj.weight": rng.rand(16, 32).astype(np.float32),
+            "model.norm.weight": rng.rand(16).astype(np.float16),
+            "model.embed_tokens.weight": rng.rand(64, 16).astype(ml_dtypes.bfloat16),
+            "quant_flag": (rng.rand(8) * 100).astype(np.int8),
+            "scalar_step": np.array(7, dtype=np.int64),  # forces unpackable path
+        }
+        path = str(tmp_path / "mixed.safetensors")
+        st.write_safetensors(path, tensors)
+        return path, tensors
+
+    @pytest.mark.parametrize("mesh_spec", ["dp=1", "dp=2,tp=4"])
+    def test_packed_equals_unpacked(self, mixed_checkpoint, mesh_spec):
+        path, tensors = mixed_checkpoint
+        mesh = make_mesh(mesh_spec)
+        packed, _ = load_safetensors(
+            LocalFileSource(path), mesh, LLAMA_RULES, pack_threshold=1 << 20
+        )
+        plain, _ = load_safetensors(
+            LocalFileSource(path), mesh, LLAMA_RULES, pack_threshold=0
+        )
+        for name in tensors:
+            a, b = np.asarray(packed[name]), np.asarray(plain[name])
+            assert a.dtype == b.dtype, name
+            np.testing.assert_array_equal(a, b, err_msg=name)
+            assert packed[name].sharding == plain[name].sharding, name
+
+    def test_sharded_small_tensors_keep_layout(self, mixed_checkpoint):
+        path, _ = mixed_checkpoint
+        mesh = make_mesh("dp=2,tp=4")
+        arrays, _ = load_safetensors(
+            LocalFileSource(path), mesh, LLAMA_RULES, pack_threshold=1 << 20
+        )
+        q = arrays["model.layers.0.self_attn.q_proj.weight"]
+        assert {s.data.shape for s in q.addressable_shards} == {(8, 16)}

@@ -228,3 +228,38 @@ class TestFaultInjection:
         fs.put("x", io.BytesIO(b"1"), 1)
         assert fs.get("x").read_all() == b"1"
         assert ("put", "x") in fs.ops
+
+
+class TestLocalRedirect:
+    """The ``file`` blob-location: FS stores on a real filesystem advertise
+    the blob path so colocated clients bypass the registry data plane."""
+
+    def test_local_provider_advertises_path(self, tmp_path):
+        fs = LocalFSProvider(str(tmp_path / "reg"))
+        store = FSRegistryStore(fs, local_redirect=True)
+        desc = put_blob(store, "library/m", b"weights")
+        loc = store.get_blob_location("library/m", desc.digest, "download", {})
+        assert loc is not None and loc.provider == "file"
+        path = loc.properties["path"]
+        assert open(path, "rb").read() == b"weights"
+        assert loc.properties["size"] == 7
+
+    def test_upload_purpose_not_redirected(self, tmp_path):
+        store = FSRegistryStore(LocalFSProvider(str(tmp_path / "reg")), local_redirect=True)
+        desc = put_blob(store, "library/m", b"w")
+        assert store.get_blob_location("library/m", desc.digest, "upload", {}) is None
+
+    def test_disabled_by_default(self, tmp_path):
+        store = FSRegistryStore(LocalFSProvider(str(tmp_path / "reg")))
+        desc = put_blob(store, "library/m", b"w")
+        assert store.get_blob_location("library/m", desc.digest, "download", {}) is None
+
+    def test_memory_provider_never_redirects(self):
+        store = FSRegistryStore(MemoryFSProvider(), local_redirect=True)
+        desc = put_blob(store, "library/m", b"w")
+        assert store.get_blob_location("library/m", desc.digest, "download", {}) is None
+
+    def test_missing_blob_is_blob_unknown(self, tmp_path):
+        store = FSRegistryStore(LocalFSProvider(str(tmp_path / "reg")), local_redirect=True)
+        with pytest.raises(errors.ErrorInfo, match="unknown"):
+            store.get_blob_location("library/m", "sha256:" + "0" * 64, "download", {})
