@@ -18,7 +18,12 @@ test:
 	$(PY) -m pytest tests/ -q
 
 lint:
-	$(PY) -m compileall -q modelx_tpu
+	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
+		$(PY) -m ruff check modelx_tpu tests bench.py; \
+	else \
+		echo "ruff unavailable; falling back to compileall"; \
+		$(PY) -m compileall -q modelx_tpu; \
+	fi
 
 wheel:
 	$(PY) -m pip wheel --no-deps -w dist .

@@ -144,21 +144,10 @@ class FileExtension:
     means the path isn't the blob the manifest promised."""
 
     def download(self, location, desc, writer, progress=None, chunk_size=4 * 1024 * 1024) -> None:
-        path = location.properties.get("path", "")
-        want = int(location.properties.get("size", desc.size or -1))
+        path = usable_file_path(location, desc.size or -1)
         try:
-            # ANY failure to see/open the path means this client can't use
-            # the location (remote host, odd mount shape — ENOTDIR, ELOOP,
-            # ...): fall back. Errors after the first byte is read are real
-            # I/O errors and propagate — a silent fallback there could mask
-            # a corrupt read mid-stream.
-            st_size = os.stat(path).st_size
-            if want >= 0 and st_size != want:
-                raise LocationUnreachable(f"{path}: size {st_size} != advertised {want}")
             f = open(path, "rb")
         except OSError as e:
-            if isinstance(e, LocationUnreachable):
-                raise
             raise LocationUnreachable(str(e)) from e
         with f:
             while True:
@@ -176,6 +165,25 @@ class FileExtension:
 class LocationUnreachable(OSError):
     """A blob location this client cannot use (e.g. a ``file`` path on
     another host). Callers fall back to the direct server GET."""
+
+
+def usable_file_path(location: BlobLocation, expect_size: int = -1) -> str:
+    """Validate a ``file`` location for THIS host: the single definition of
+    "can this client use this path" shared by the pull engine and the HBM
+    loader's source selection. Returns the path; raises LocationUnreachable
+    when the path can't be stat'd for any reason (remote host, odd mount
+    shape — ENOTDIR, ELOOP, ...) or its size disagrees with the advertised
+    blob size (a committed content-addressed blob never changes size, so a
+    mismatch means this is not the promised blob)."""
+    path = location.properties.get("path", "")
+    want = int(location.properties.get("size", expect_size))
+    try:
+        st_size = os.stat(path).st_size
+    except OSError as e:
+        raise LocationUnreachable(str(e)) from e
+    if want >= 0 and st_size != want:
+        raise LocationUnreachable(f"{path}: size {st_size} != advertised {want}")
+    return path
 
 
 register_extension("http", RawHTTPExtension())

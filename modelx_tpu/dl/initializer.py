@@ -150,18 +150,14 @@ def _blob_source(client, repository: str, blob):
     ``file`` location (colocated registry / shared volume) beats ranged HTTP
     — local preads cost no server round-trips and no tunnel bytes. Presigned
     URLs and the direct blob endpoint are the remote paths."""
-    import os
-
+    from modelx_tpu.client.extension import LocationUnreachable, usable_file_path
     from modelx_tpu.dl.loader import HTTPSource, LocalFileSource
 
     location = client.remote.get_blob_location(repository, blob, BlobLocationPurposeDownload)
     if location is not None and location.provider == "file":
-        path = location.properties.get("path", "")
-        want = int(location.properties.get("size", blob.size or -1))
         try:
-            if os.stat(path).st_size == want:
-                return LocalFileSource(path)
-        except OSError:
+            return LocalFileSource(usable_file_path(location, blob.size or -1))
+        except LocationUnreachable:
             pass  # advertised for a colocated client; we're not one
     if location is not None and location.properties.get("url"):
         return HTTPSource(location.properties["url"], total=blob.size)

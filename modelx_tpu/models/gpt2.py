@@ -9,7 +9,6 @@ dl/sharding.py GPT2_RULES.
 from __future__ import annotations
 
 import dataclasses
-import math
 from typing import Any
 
 import jax

@@ -22,7 +22,7 @@ import shutil
 import tempfile
 import threading
 import time
-from typing import BinaryIO, Callable, Iterable, Protocol, runtime_checkable
+from typing import BinaryIO, Callable, Protocol, runtime_checkable
 
 
 @dataclasses.dataclass

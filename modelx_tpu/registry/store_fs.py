@@ -295,11 +295,10 @@ class FSRegistryStore:
         local_path = getattr(self.fs, "local_path", None)
         if local_path is None:
             return None
-        path = local_path(blob_digest_path(repository, digest))
-        if path is None:
-            return None
+        blob_path = blob_digest_path(repository, digest)
+        path = local_path(blob_path)
         try:
-            meta = self.fs.stat(blob_digest_path(repository, digest))
+            meta = self.fs.stat(blob_path)
         except FSNotFound:
             raise errors.blob_unknown(digest) from None
         return BlobLocation(
