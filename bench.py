@@ -380,24 +380,37 @@ def measure_serving(params: dict, mesh, device_kind: str) -> dict:
     out["prefill_mfu"] = round(flops / pipe_dt / peak, 4)
 
     # -- cached decode ------------------------------------------------------
-    # one jit call decodes NEW tokens via lax.scan, so the per-call round
-    # trip amortizes across the whole generation (the product's shape)
-    NEW = 32
+    # one jit call decodes N tokens via lax.scan. Per-step cost comes from
+    # the slope between two generation lengths — a single-length timing
+    # would bill the fixed host<->device round trip (tens of ms on a
+    # tunneled rig) to the decode loop and understate throughput ~3x.
     prompts = [t[:, :128] for t in toks]
-    gen = jax.jit(lambda p, t: family.generate(p, t, cfg, mesh=mesh, max_new_tokens=NEW))
-    fetch(gen(params, prompts[9]))  # compile
-    lat = []
-    for i in range(3):
-        t0 = time.monotonic()
-        fetch(gen(params, prompts[i]))
-        lat.append(time.monotonic() - t0)
-    dt = statistics.median(lat)
-    out["decode_tokens_per_s"] = round(B * NEW / dt, 1)
-    # decode is HBM-bound: every step re-reads the weights; utilization
-    # against the mesh's aggregate memory bandwidth is the honest roofline
-    hbm_bw = _chip_spec(HBM_GBPS, device_kind, 1e12) * mesh.devices.size
-    step_bytes = 2 * p_matmul  # bf16 weights
-    out["decode_model_bandwidth_util"] = round(step_bytes * NEW / dt / hbm_bw, 4)
+    lens = (16, 144)  # wide spread: slope noise shrinks with the step gap
+    call_dt = {}
+    for new in lens:
+        gen = jax.jit(
+            lambda p, t, n=new: family.generate(p, t, cfg, mesh=mesh, max_new_tokens=n)
+        )
+        fetch(gen(params, prompts[9]))  # compile
+        lat = []
+        for i in range(4):
+            t0 = time.monotonic()
+            fetch(gen(params, prompts[i]))
+            lat.append(time.monotonic() - t0)
+        call_dt[new] = statistics.median(lat)
+    slope = (call_dt[lens[1]] - call_dt[lens[0]]) / (lens[1] - lens[0])
+    if slope <= 0:
+        # noise won: a longer generation measured faster than a shorter one.
+        # Flag it instead of publishing a nonsense throughput.
+        out["decode_slope_invalid"] = True
+        out["decode_call_seconds"] = {str(k): round(v, 4) for k, v in call_dt.items()}
+    else:
+        out["decode_tokens_per_s"] = round(B / slope, 1)
+        out["decode_call_overhead_ms"] = round((call_dt[lens[0]] - lens[0] * slope) * 1e3, 1)
+        # decode is HBM-bound: every step re-reads the weights; utilization
+        # against the mesh's aggregate memory bandwidth is the honest roofline
+        hbm_bw = _chip_spec(HBM_GBPS, device_kind, 1e12) * mesh.devices.size
+        out["decode_model_bandwidth_util"] = round(2 * p_matmul / slope / hbm_bw, 4)
     out["serving_batch"] = B
     return out
 

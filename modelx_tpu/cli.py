@@ -4,7 +4,8 @@ Reference parity — all three binaries in one entrypoint:
 
 - ``modelx`` user CLI (cmd/modelx/model/model.go:15-28): init / login /
   list / info / push / pull, repo management, shell completion (click's
-  built-in completion covers bash/zsh/fish).
+  built-in completion covers bash/zsh/fish; powershell is hand-rolled over
+  the hidden ``__complete`` backend, completion.go parity).
 - ``modelx serve`` = modelxd (cmd/modelxd/modelxd.go:26-58) with the full
   flag surface (listen / tls / s3 / auth / redirect).
 - ``modelx dl`` = modelxdl (cmd/modelxdl/modelxdl.go:30-98), the Seldon-style
@@ -365,13 +366,68 @@ def cmd_version() -> None:
 # -- completion ---------------------------------------------------------------
 
 
+# click has no powershell backend, so the reference's fourth shell
+# (completion.go:1-20) gets a hand-rolled Register-ArgumentCompleter script
+# that shells out to the hidden `modelx __complete` command below — same
+# dynamic remote completion as the POSIX shells.
+_POWERSHELL_COMPLETION = r"""
+Register-ArgumentCompleter -Native -CommandName modelx -ScriptBlock {
+    param($wordToComplete, $commandAst, $cursorPosition)
+    # AST tokens exclude trailing whitespace; $wordToComplete is '' exactly
+    # when the cursor sits after a space, i.e. a fresh argument position
+    $words = @($commandAst.ToString().Split(" ") | Where-Object { $_ -ne "" } | Select-Object -Skip 1)
+    if ([string]::IsNullOrEmpty($wordToComplete)) { $words = $words + "" }
+    modelx __complete -- @($words) 2>$null | ForEach-Object {
+        [System.Management.Automation.CompletionResult]::new($_, $_, 'ParameterValue', $_)
+    }
+}
+""".strip()
+
+
 @main.command("completion")
-@click.argument("shell", type=click.Choice(["bash", "zsh", "fish"]))
+@click.argument("shell", type=click.Choice(["bash", "zsh", "fish", "powershell"]))
 def cmd_completion(shell: str) -> None:
     """Emit shell completion script (cmd/modelx/completion)."""
+    if shell == "powershell":
+        click.echo(_POWERSHELL_COMPLETION)
+        return
     var = "_MODELX_COMPLETE"
     prog = "modelx"
     click.echo(f'eval "$({var}={shell}_source {prog})"')
+
+
+# commands whose FIRST positional argument is a model reference; later
+# positions are directories (filename completion is the shell's own job)
+_REF_COMMANDS = ("push", "pull", "info", "list", "gc", "dl")
+
+
+@main.command(
+    "__complete",
+    hidden=True,
+    context_settings={"ignore_unknown_options": True},
+)
+@click.argument("words", nargs=-1, type=click.UNPROCESSED)
+def cmd_hidden_complete(words: tuple[str, ...]) -> None:
+    """Completion backend for shells click can't drive (powershell):
+    ``modelx __complete -- <words...>`` prints one candidate per line. The
+    last word is the one being completed (may be empty)."""
+    words = list(words) or [""]
+    incomplete, prior = words[-1], words[:-1]
+    try:
+        args = [w for w in prior if not w.startswith("-")]
+        if not args:  # completing the subcommand itself
+            if not incomplete.startswith("-"):
+                for name, cmd in main.commands.items():
+                    if not cmd.hidden and name.startswith(incomplete):
+                        click.echo(name)
+            return
+        # only the ref argument completes remotely: `push <ref> <dir>` must
+        # not offer repo refs for the directory slot
+        if args[0] in _REF_COMMANDS and len(args) == 1 and not incomplete.startswith("-"):
+            for cand in _complete_ref(None, None, incomplete):
+                click.echo(cand)
+    except Exception:
+        pass  # completion must never fail the shell
 
 
 if __name__ == "__main__":

@@ -46,21 +46,32 @@ class S3Extension:
             http_upload(props["url"], reader, method="PUT", progress=progress)
             return
         lock = threading.Lock()
+        # per-part state inside the blob's bar (progress/bar.go:75-94 parity)
+        frag = getattr(progress, "fragment", None)
+        if getattr(progress, "set_fragments", None):
+            progress.set_fragments(len(parts))
 
-        def upload_part(part: dict) -> None:
+        def upload_part(item: tuple[int, dict]) -> None:
+            i, part = item
             if part.get("done"):
                 if progress:
                     progress(part["length"])
+                if frag:
+                    frag(i, "done")
                 return  # resume: server already has this part
+            if frag:
+                frag(i, "active")
             with lock:
                 reader.seek(part["offset"])
                 data = reader.read(part["length"])
             http_upload(part["url"], data, method="PUT", retries=3)
             if progress:
                 progress(len(data))
+            if frag:
+                frag(i, "done")
 
         with ThreadPoolExecutor(max_workers=UPLOAD_PART_CONCURRENCY) as pool:
-            list(pool.map(upload_part, parts))  # propagates first error
+            list(pool.map(upload_part, enumerate(parts)))  # propagates first error
 
     def download(
         self,
@@ -93,9 +104,15 @@ class S3Extension:
                     reported[0] += n
                 progress(n)
 
-        def fetch(rng: tuple[int, int]) -> None:
-            off, ln = rng
+        frag = getattr(progress, "fragment", None)
+        if getattr(progress, "set_fragments", None):
+            progress.set_fragments(len(ranges))
+
+        def fetch(item: tuple[int, tuple[int, int]]) -> None:
+            i, (off, ln) = item
             last: Exception | None = None
+            if frag:
+                frag(i, "active")
             for _ in range(3):
                 if range_ignored.is_set():
                     return
@@ -119,14 +136,18 @@ class S3Extension:
                         writer.seek(off)
                         writer.write(data)
                     report(len(data))
+                    if frag:
+                        frag(i, "done")
                     return
                 except (errors.ErrorInfo, requests.RequestException, OSError) as e:
                     last = e
+                    if frag:
+                        frag(i, "retry")
             assert last is not None
             raise last
 
         with ThreadPoolExecutor(max_workers=DOWNLOAD_PART_CONCURRENCY) as pool:
-            list(pool.map(fetch, ranges))
+            list(pool.map(fetch, enumerate(ranges)))
         if range_ignored.is_set():
             if progress and reported[0]:
                 progress(-reported[0])  # rewind the bar; re-streaming from 0

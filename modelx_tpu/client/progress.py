@@ -22,10 +22,19 @@ PULL_PUSH_CONCURRENCY = 8
 
 
 class _NullBar:
+    def __call__(self, n: int) -> None:
+        pass
+
     def update(self, n: int) -> None:
         pass
 
     def set_total(self, total: int) -> None:
+        pass
+
+    def set_fragments(self, n: int) -> None:
+        pass
+
+    def fragment(self, i: int, state: str) -> None:
         pass
 
     def done(self, note: str = "") -> None:
@@ -68,11 +77,49 @@ class MultiBar:
             task_id = progress.add_task(name[-40:], total=total or None)
 
         class _Bar:
+            """Callable like a plain progress fn; fragment-aware transfers
+            (multipart up/downloads) may additionally call set_fragments /
+            fragment to render per-range state inside this one bar —
+            reference parity with the per-bar fragment model of
+            progress/bar.go:75-94."""
+
+            _frags: list[str] = []
+            _frag_done = 0
+            _frag_lock = threading.Lock()  # parts finish on pool threads
+
+            def __call__(self, n: int) -> None:
+                progress.update(task_id, advance=n)
+
             def update(self, n: int) -> None:
                 progress.update(task_id, advance=n)
 
             def set_total(self, total: int) -> None:
                 progress.update(task_id, total=total)
+
+            def set_fragments(self, n: int) -> None:
+                with self._frag_lock:
+                    self._frags = ["·"] * n
+                    self._frag_done = 0
+                self._render_frags()
+
+            def fragment(self, i: int, state: str) -> None:
+                glyph = {"active": "▸", "done": "█", "retry": "!"}.get(state, "·")
+                with self._frag_lock:
+                    if not (0 <= i < len(self._frags)):
+                        return
+                    if state == "done" and self._frags[i] != "█":
+                        self._frag_done += 1
+                    self._frags[i] = glyph
+                self._render_frags()
+
+            def _render_frags(self) -> None:
+                with self._frag_lock:
+                    n = len(self._frags)
+                    # glyph strip for few parts; a counter when it won't fit
+                    tail = (
+                        "".join(self._frags) if n <= 32 else f"{self._frag_done}/{n} parts"
+                    )
+                progress.update(task_id, description=f"{name[-40:]} {tail}")
 
             def done(self, note: str = "") -> None:
                 desc = name[-40:] + (f" [{note}]" if note else "")

@@ -191,7 +191,7 @@ class Puller:
             else:
                 with open(tmp, "wb") as f:
                     hf = _HashingFile(f)
-                    self._download_blob(repository, desc, hf, bar.update)
+                    self._download_blob(repository, desc, hf, bar)
             # sequential downloads hashed inline for free; out-of-order
             # (ranged) downloads need a post-hoc re-read
             got = hf.digest() or str(Digest.from_file(tmp))
@@ -242,7 +242,7 @@ class Puller:
         t = threading.Thread(target=extract, daemon=True)
         t.start()
         try:
-            self._download_blob(repository, desc, writer, bar.update)
+            self._download_blob(repository, desc, writer, bar)
         except BrokenPipeError:
             # extractor died and closed the pipe; its error (in errs) is the
             # real cause — don't let the pipe write mask it

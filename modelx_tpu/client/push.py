@@ -134,7 +134,7 @@ class Pusher:
         if location is not None:
             ext = get_extension(location.provider)
             with open(path, "rb") as f:
-                ext.upload(location, desc, f, progress=bar.update)
+                ext.upload(location, desc, f, progress=bar)
             bar.done()
             return  # the return push.go:196-207 forgot
         # fallback: direct PUT through the server

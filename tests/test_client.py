@@ -382,3 +382,48 @@ class TestFileRedirectPull:
         client.pull("library/demo", "v1", str(out))
         assert (out / "weights.bin").read_bytes() == b"W" * 4096
         monkeypatch.setattr(fs, "local_path", real)
+
+
+class TestCompletionCLI:
+    """Shell completion emitters (cmd/modelx/completion parity: bash / zsh /
+    fish via click, powershell via the hand-rolled ArgumentCompleter that
+    drives the hidden `modelx __complete` backend)."""
+
+    def _run(self, *args):
+        from click.testing import CliRunner
+
+        from modelx_tpu.cli import main as cli_main
+
+        return CliRunner().invoke(cli_main, list(args))
+
+    def test_posix_shells_emit_eval(self):
+        for shell in ("bash", "zsh", "fish"):
+            r = self._run("completion", shell)
+            assert r.exit_code == 0
+            assert f"_MODELX_COMPLETE={shell}_source" in r.output
+
+    def test_powershell_emits_argument_completer(self):
+        r = self._run("completion", "powershell")
+        assert r.exit_code == 0
+        assert "Register-ArgumentCompleter" in r.output
+        assert "modelx __complete" in r.output
+
+    def test_hidden_complete_lists_commands(self):
+        r = self._run("__complete", "pu")
+        assert r.exit_code == 0
+        assert set(r.output.split()) == {"push", "pull"}
+
+    def test_hidden_complete_is_hidden_from_help(self):
+        r = self._run("--help")
+        assert "__complete" not in r.output
+
+    def test_hidden_complete_completes_repo_aliases(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("HOME", str(tmp_path))
+        from modelx_tpu.client.repo import RepoDetails, RepoManager
+
+        RepoManager(str(tmp_path / ".modelx" / "repos.json")).set(
+            RepoDetails(name="prod", url="http://reg:8080")
+        )
+        r = self._run("__complete", "pull", "pr")
+        assert r.exit_code == 0
+        assert "prod/" in r.output

@@ -339,3 +339,69 @@ class TestReviewRegressions:
         data = b"x" * 10
         digest = str(Digest.from_bytes(data))
         assert store.get_blob_location("library/g", digest, BlobLocationPurposeUpload, {"size": "10"}) is None
+
+
+class TestFragmentProgress:
+    """Per-bar fragment rendering (reference progress/bar.go:75-94): the S3
+    extension reports each part's lifecycle to a fragment-capable progress
+    object; plain callables keep working untouched."""
+
+    class _Recorder:
+        def __init__(self):
+            self.states: dict[int, list[str]] = {}
+            self.n = None
+            self.bytes = 0
+
+        def __call__(self, n):
+            self.bytes += n
+
+        def set_fragments(self, n):
+            self.n = n
+
+        def fragment(self, i, state):
+            self.states.setdefault(i, []).append(state)
+
+    def test_multipart_upload_reports_fragments(self, s3_opts, monkeypatch, tmp_path):
+        import modelx_tpu.registry.store_s3 as s3mod
+        from modelx_tpu.client.extension_s3 import S3Extension
+
+        monkeypatch.setattr(s3mod, "MULTIPART_THRESHOLD", 1024)
+        monkeypatch.setattr(s3mod, "TARGET_PART_SIZE", 4096)
+        monkeypatch.setattr(s3mod, "MIN_PART_SIZE", 4096)
+        store = S3RegistryStore(s3_opts)
+        payload = bytes(range(256)) * 64  # 16 KiB => 4 parts
+        digest = str(Digest.from_bytes(payload))
+        loc = store.get_blob_location(
+            "library/f", digest, BlobLocationPurposeUpload, {"size": str(len(payload))}
+        )
+        rec = self._Recorder()
+        blob = tmp_path / "b.bin"
+        blob.write_bytes(payload)
+        desc = Descriptor(name="b.bin", digest=digest, size=len(payload))
+        with open(blob, "rb") as f:
+            S3Extension().upload(loc, desc, f, progress=rec)
+        assert rec.n == len(loc.properties["parts"]) >= 2
+        assert rec.bytes == len(payload)
+        for i in range(rec.n):
+            assert rec.states[i][-1] == "done"
+
+    def test_plain_callable_progress_still_works(self, s3_opts, monkeypatch, tmp_path):
+        import modelx_tpu.registry.store_s3 as s3mod
+        from modelx_tpu.client.extension_s3 import S3Extension
+
+        monkeypatch.setattr(s3mod, "MULTIPART_THRESHOLD", 1024)
+        monkeypatch.setattr(s3mod, "TARGET_PART_SIZE", 4096)
+        monkeypatch.setattr(s3mod, "MIN_PART_SIZE", 4096)
+        store = S3RegistryStore(s3_opts)
+        payload = bytes(range(256)) * 64
+        digest = str(Digest.from_bytes(payload))
+        loc = store.get_blob_location(
+            "library/f2", digest, BlobLocationPurposeUpload, {"size": str(len(payload))}
+        )
+        got = []
+        blob = tmp_path / "b.bin"
+        blob.write_bytes(payload)
+        desc = Descriptor(name="b.bin", digest=digest, size=len(payload))
+        with open(blob, "rb") as f:
+            S3Extension().upload(loc, desc, f, progress=got.append)
+        assert sum(got) == len(payload)
