@@ -235,3 +235,45 @@ def detect(tensor_names) -> Family:
             f"supported: {sorted(FAMILIES)}"
         )
     return FAMILIES[name]
+
+
+def abstract_params(infos: dict, rules: Rules | None = None, mesh=None) -> dict:
+    """ShapeDtypeStructs for a checkpoint known only by its header/manifest
+    tensor index — everything config inference and AOT compilation need,
+    before a single weight byte arrives. ``infos`` values need ``shape`` and
+    either ``np_dtype()`` (st.TensorInfo) or ``dtype``. With rules+mesh the
+    structs carry the placement shardings, so the compiled program matches
+    the arrays the loader will deliver."""
+    from modelx_tpu.dl.sharding import sharding_for
+
+    out = {}
+    for name, info in infos.items():
+        dt = info.np_dtype() if hasattr(info, "np_dtype") else info.dtype
+        sharding = sharding_for(name, rules, mesh) if rules is not None and mesh is not None else None
+        out[name] = jax.ShapeDtypeStruct(tuple(info.shape), dt, sharding=sharding)
+    return out
+
+
+def precompile_forward(family: Family, cfg, param_sds: dict, token_shape: tuple,
+                       mesh=None, mode: str = "forward"):
+    """AOT-compile the prefill forward for one token shape from abstract
+    params — the weights do not need to exist yet, so a deploy overlaps this
+    with the loader's byte streaming and the first request (or first token)
+    meets an already-compiled program. Returns the compiled executable;
+    call it with (params, tokens) of exactly these shapes/shardings.
+    ``mode``: "forward" (logits), "argmax_all" (per-position argmax — the
+    serve forward route), "argmax_last" (first decoded token — TTFT)."""
+    import jax.numpy as jnp
+
+    if mode == "argmax_all":
+        def fn(p, t):
+            return jnp.argmax(family.forward(p, t, cfg, mesh=mesh), axis=-1)
+    elif mode == "argmax_last":
+        def fn(p, t):
+            return jnp.argmax(family.forward(p, t, cfg, mesh=mesh)[:, -1, :], axis=-1)
+    else:
+        def fn(p, t):
+            return family.forward(p, t, cfg, mesh=mesh)
+
+    tok = jax.ShapeDtypeStruct(token_shape, jnp.int32)
+    return jax.jit(fn).lower(param_sds, tok).compile()

@@ -97,9 +97,19 @@ class ModelServer:
         self.cfg = config
         self.family: fam.Family | None = None
         self.params: dict | None = None
+        self._forward_aot: dict[tuple, object] = {}
+
+    # the shape the dynamic batcher pads a lone first request to (seq to a
+    # multiple of 16, batch to a power of two): precompiling it during load
+    # means the first real request meets a ready executable
+    WARMUP_TOKEN_SHAPES = ((1, 16),)
 
     def load(self) -> dict:
-        """Load every *.safetensors under model_dir onto the mesh."""
+        """Load every *.safetensors under model_dir onto the mesh. The
+        checkpoint headers fully determine the architecture, so the prefill
+        program for the warmup shapes AOT-compiles on a side thread WHILE
+        the weight bytes stream — a deploy pays max(load, compile), not
+        their sum (TTFT budget, BASELINE.md)."""
         from modelx_tpu.dl.loader import LocalFileSource, load_safetensors
         from modelx_tpu.dl.safetensors import read_header_from_file
 
@@ -110,11 +120,27 @@ class ModelServer:
                 raise FileNotFoundError(f"no safetensors under {self.model_dir}")
             # detect the family from the headers so the right partition rules
             # apply from the first byte fetched
-            names: list[str] = []
+            infos_all: dict = {}
             for path in paths:
                 infos, _ = read_header_from_file(path)
-                names.extend(infos)
-            self.family = fam.detect(names)
+                infos_all.update(infos)
+            self.family = fam.detect(list(infos_all))
+            # mirror the loader's expert fusion so header-derived shapes
+            # match the params it will deliver (stacked [E, ...] experts)
+            from modelx_tpu.dl.loader import fuse_expert_tensors
+
+            infos_all = fuse_expert_tensors(infos_all, self.family.rules)
+            if self.cfg is None:
+                self.cfg = self.family.infer_config(
+                    fam.abstract_params(infos_all)
+                )
+            compile_thread = None
+            if not self.quantize:  # QTensor params have no abstract form yet
+                sds = fam.abstract_params(infos_all, self.family.rules, self.mesh)
+                compile_thread = threading.Thread(
+                    target=self._precompile_warmup, args=(sds,), daemon=True
+                )
+                compile_thread.start()
             params: dict = {}
             total = 0
             for path in paths:
@@ -128,16 +154,31 @@ class ModelServer:
                 params.update(arrays)
                 total += stats.bytes_to_device
             self.params = params
-            if self.cfg is None:
-                self.cfg = self.family.infer_config(params)
             seconds = time.monotonic() - t0
             self.stats["family"] = self.family.name
             self.stats["load_seconds"] = round(seconds, 3)
             self.stats["load_bytes"] = total
             self.stats["load_gbps"] = round(total / max(seconds, 1e-9) / 1e9, 3)
             self._compile()
+            if compile_thread is not None:
+                compile_thread.join()
+            self.stats["ready_seconds"] = round(time.monotonic() - t0, 3)
             self.ready = True
         return dict(self.stats)
+
+    def _precompile_warmup(self, sds: dict) -> None:
+        """AOT-compile the forward for the warmup token shapes (overlapped
+        with the weight load). Failures only lose the warm start."""
+        for shape in self.WARMUP_TOKEN_SHAPES:
+            try:
+                with trace.span("serve.precompile", model=self.name, shape=str(shape)):
+                    compiled = fam.precompile_forward(
+                        self.family, self.cfg, sds, shape,
+                        mesh=self.mesh, mode="argmax_all",
+                    )
+                self._forward_aot[shape] = compiled
+            except Exception as e:
+                logger.warning("precompile %s failed (cold first request): %s", shape, e)
 
     def _compile(self) -> None:
         cfg, mesh, family = self.cfg, self.mesh, self.family
@@ -148,6 +189,9 @@ class ModelServer:
 
     def forward_argmax(self, tokens: np.ndarray) -> np.ndarray:
         with trace.span("serve.forward", model=self.name, batch=int(tokens.shape[0])):
+            aot = self._forward_aot.get(tuple(tokens.shape))
+            if aot is not None:
+                return np.asarray(aot(self.params, jnp.asarray(tokens, jnp.int32)))
             out = self._forward(self.params, jnp.asarray(tokens, jnp.int32))
             return np.asarray(jnp.argmax(out, axis=-1))
 

@@ -256,3 +256,34 @@ class TestDynamicBatching:
             assert r.status_code == 200
         finally:
             httpd.shutdown()
+
+
+class TestAOTWarmup:
+    def test_warmup_shape_uses_aot_and_matches_jit(self, checkpoints):
+        """load() precompiles the batcher's first-request shape on a side
+        thread; the AOT executable must exist and agree bit-for-bit with the
+        lazily-jitted forward path."""
+        server = ModelServer(checkpoints["llama"], mesh_spec="dp=1", dtype="float32")
+        server.load()
+        shape = ModelServer.WARMUP_TOKEN_SHAPES[0]
+        assert shape in server._forward_aot
+        tokens = np.arange(shape[0] * shape[1], dtype=np.int32).reshape(shape) % 60 + 1
+        via_aot = server.forward_argmax(tokens)
+        # off-warmup shape exercises the jit path; slice back to compare
+        del server._forward_aot[shape]
+        via_jit = server.forward_argmax(tokens)
+        np.testing.assert_array_equal(via_aot, via_jit)
+
+    def test_quantized_load_skips_warmup_but_serves(self, checkpoints):
+        server = ModelServer(
+            checkpoints["llama"], mesh_spec="dp=1", dtype="float32", quantize="int8"
+        )
+        server.load()
+        assert server._forward_aot == {}
+        out = server.forward_argmax(np.array([[1, 2, 3]], np.int32))
+        assert out.shape == (1, 3)
+
+    def test_ready_seconds_reported(self, checkpoints):
+        server = ModelServer(checkpoints["gpt2"], mesh_spec="dp=1", dtype="float32")
+        stats = server.load()
+        assert stats["ready_seconds"] >= stats["load_seconds"] > 0
