@@ -39,6 +39,10 @@ class Family:
     infer_config: Callable[[dict], Any]
     forward: Callable[..., jax.Array]  # (params, tokens, cfg, mesh) -> logits
     generate: Callable[..., jax.Array] | None = None  # causal LMs only
+    # ragged-batch decode (params, prompt, row_lens, cfg, mesh, max_new_tokens)
+    # -> generated [B, max_new]; cached-decode families only — the serving
+    # batcher uses it to coalesce concurrent generate requests
+    generate_ragged: Callable[..., jax.Array] | None = None
 
 
 def _shape(params: dict, name: str) -> tuple[int, ...]:
@@ -101,6 +105,14 @@ def _llama_generate(params, tokens, cfg, mesh=None, max_new_tokens=16):
     return llama.greedy_generate(params, tokens, cfg, max_new_tokens=max_new_tokens, mesh=mesh)
 
 
+def _llama_generate_ragged(params, tokens, row_lens, cfg, mesh=None, max_new_tokens=16):
+    from modelx_tpu.models import llama
+
+    return llama.ragged_greedy_generate(
+        params, tokens, row_lens, cfg, max_new_tokens=max_new_tokens, mesh=mesh
+    )
+
+
 # -- mixtral ------------------------------------------------------------------
 
 
@@ -142,6 +154,14 @@ def _mixtral_generate(params, tokens, cfg, mesh=None, max_new_tokens=16):
 
     return mixtral.greedy_generate(
         params, tokens, cfg, max_new_tokens=max_new_tokens, mesh=mesh
+    )
+
+
+def _mixtral_generate_ragged(params, tokens, row_lens, cfg, mesh=None, max_new_tokens=16):
+    from modelx_tpu.models import mixtral
+
+    return mixtral.ragged_greedy_generate(
+        params, tokens, row_lens, cfg, max_new_tokens=max_new_tokens, mesh=mesh
     )
 
 
@@ -219,8 +239,10 @@ def _bert_forward(params, tokens, cfg, mesh=None):
 
 
 FAMILIES: dict[str, Family] = {
-    "llama": Family("llama", LLAMA_RULES, infer_llama_config, _llama_forward, _llama_generate),
-    "mixtral": Family("mixtral", MIXTRAL_RULES, infer_mixtral_config, _mixtral_forward, _mixtral_generate),
+    "llama": Family("llama", LLAMA_RULES, infer_llama_config, _llama_forward,
+                    _llama_generate, _llama_generate_ragged),
+    "mixtral": Family("mixtral", MIXTRAL_RULES, infer_mixtral_config, _mixtral_forward,
+                      _mixtral_generate, _mixtral_generate_ragged),
     "gpt2": Family("gpt2", GPT2_RULES, infer_gpt2_config, _gpt2_forward, _gpt2_generate),
     "bert": Family("bert", BERT_RULES, infer_bert_config, _bert_forward, None),
 }

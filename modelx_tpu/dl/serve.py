@@ -206,6 +206,26 @@ class ModelServer:
             self.stats["tokens_generated"] += int(out.shape[0] * max_new_tokens)
             return np.asarray(out)
 
+    def generate_ragged(
+        self, tokens: np.ndarray, row_lens: np.ndarray, max_new_tokens: int
+    ) -> np.ndarray:
+        """Ragged-batch decode: right-padded rows [B,S] with per-row real
+        lengths. Returns generated tokens only, [B, max_new_tokens]. The
+        caller accounts tokens_generated — padded rows and bucket rounding
+        here would inflate the counter."""
+        if self.family.generate_ragged is None:
+            raise ValueError(f"family {self.family.name} has no ragged decode")
+        with trace.span(
+            "serve.generate_ragged", model=self.name,
+            rows=int(tokens.shape[0]), new_tokens=max_new_tokens,
+        ):
+            out = self.family.generate_ragged(
+                self.params, jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(row_lens, jnp.int32), self.cfg,
+                mesh=self.mesh, max_new_tokens=max_new_tokens,
+            )
+            return np.asarray(out)
+
 
 def infer_llama_config(params: dict):
     """Back-compat alias (dl/families.py owns config inference now)."""
@@ -213,16 +233,18 @@ def infer_llama_config(params: dict):
 
 
 class Batcher:
-    """Dynamic batching for forward requests: concurrent requests arriving
-    within a small window coalesce into one device call.
+    """Dynamic batching: concurrent requests arriving within a small window
+    coalesce into one device call — forward requests into one padded
+    forward, generate requests into one RAGGED decode (per-row prompt
+    lengths and offsets, models/decode.ragged_greedy_generate).
 
     Right-padding is output-preserving ONLY for causal models (later
     positions never influence earlier ones) — bidirectional encoders like
     BERT attend to the pad tokens, so ServerSet only routes causal families
     through a batcher. Rows pad to the group's max sequence and the batch
-    to the next power of two — bounding the set of compiled shapes — then
-    results are sliced back per request. ``generate`` is not batched here
-    (rows of different prompt lengths decode from different positions)."""
+    to the next power of two, and decode lengths round up to a power of two
+    — bounding the set of compiled shapes — then results are sliced back
+    per request."""
 
     def __init__(self, server: ModelServer, max_batch: int = 32, window_ms: float = 3.0) -> None:
         import queue
@@ -233,26 +255,43 @@ class Batcher:
         self._q: "queue.Queue" = queue.Queue()
         self._closed = False
         self._close_lock = threading.Lock()
+        # decodes run for seconds; dispatching them off the worker thread
+        # keeps fast forward groups from queueing behind them. One worker
+        # preserves decode-group ordering.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._gen_pool = ThreadPoolExecutor(max_workers=1)
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
         self.batches = 0  # observability: device calls issued
 
-    def forward_argmax(self, tokens: np.ndarray) -> np.ndarray:
+    def _submit(self, kind: str, tokens: np.ndarray, n: int):
         import concurrent.futures
 
         tokens = np.asarray(tokens, np.int32)
-        if tokens.ndim != 2:
+        if tokens.ndim != 2 or tokens.shape[0] < 1 or tokens.shape[1] < 1:
             # validate BEFORE enqueueing: a malformed request inside _run
-            # would fail every other request coalesced into its group
-            raise ValueError(f"tokens must be 2-D [batch, seq], got shape {tokens.shape}")
+            # would fail every other request coalesced into its group (and
+            # a zero-length prompt has no last position to decode from)
+            raise ValueError(
+                f"tokens must be non-empty 2-D [batch, seq], got shape {tokens.shape}"
+            )
         fut: "concurrent.futures.Future" = concurrent.futures.Future()
         # enqueue under the close lock so a racing close() can't consume the
         # sentinel and exit between our check and our put (hung future)
         with self._close_lock:
             if self._closed:
                 raise RuntimeError("batcher is closed")
-            self._q.put((tokens, fut))
+            self._q.put((kind, tokens, n, fut))
         return fut.result()
+
+    def forward_argmax(self, tokens: np.ndarray) -> np.ndarray:
+        return self._submit("fwd", tokens, 0)
+
+    def generate(self, tokens: np.ndarray, max_new_tokens: int = 16) -> np.ndarray:
+        """Returns [B, S + max_new_tokens] (prompt + generated), matching
+        ModelServer.generate."""
+        return self._submit("gen", tokens, max_new_tokens)
 
     def _worker(self) -> None:
         import queue
@@ -289,22 +328,43 @@ class Batcher:
             except queue.Empty:
                 return
             if item is not None:
-                item[1].set_exception(RuntimeError("batcher is closed"))
+                item[3].set_exception(RuntimeError("batcher is closed"))
 
     def _run(self, group: list) -> None:
+        fwd = [(t, f) for kind, t, _n, f in group if kind == "fwd"]
+        gen = [(t, n, f) for kind, t, n, f in group if kind == "gen"]
+        if gen:
+            # off-thread: a long decode must not head-of-line-block the next
+            # window's forward requests
+            try:
+                self._gen_pool.submit(self._run_generate, gen)
+            except RuntimeError:  # pool shut down by a racing close(): inline
+                self._run_generate(gen)
+        if fwd:
+            self._run_forward(fwd)
+
+    @staticmethod
+    def _pack(token_rows: list) -> tuple:
+        """Right-pad a list of [b,s] token arrays into one padded batch:
+        seq to a multiple of 16, batch rows to a power of two — bounding
+        the set of compiled shapes. Returns (batch, spans=[(start, b, s)])."""
+        rows = sum(t.shape[0] for t in token_rows)
+        max_s = max(t.shape[1] for t in token_rows)
+        pad_s = -(-max_s // 16) * 16
+        pad_b = 1 << (rows - 1).bit_length()
+        batch = np.zeros((pad_b, pad_s), np.int32)
+        r = 0
+        spans = []
+        for tokens in token_rows:
+            b, s = tokens.shape
+            batch[r : r + b, :s] = tokens
+            spans.append((r, b, s))
+            r += b
+        return batch, spans
+
+    def _run_forward(self, group: list) -> None:
         try:
-            rows = sum(t.shape[0] for t, _ in group)
-            max_s = max(t.shape[1] for t, _ in group)
-            pad_s = -(-max_s // 16) * 16  # seq to a multiple of 16
-            pad_b = 1 << (rows - 1).bit_length()  # batch to a power of two
-            batch = np.zeros((pad_b, pad_s), np.int32)
-            r = 0
-            spans = []
-            for tokens, _fut in group:
-                b, s = tokens.shape
-                batch[r : r + b, :s] = tokens
-                spans.append((r, b, s))
-                r += b
+            batch, spans = self._pack([t for t, _f in group])
             out = self.server.forward_argmax(batch)
             self.batches += 1
             for (tokens, fut), (start, b, s) in zip(group, spans):
@@ -314,10 +374,36 @@ class Batcher:
                 if not fut.done():
                     fut.set_exception(e)
 
+    def _run_generate(self, group: list) -> None:
+        """Coalesce generate requests into one ragged decode: rows pad right
+        to a common (16-aligned) length, decode steps round up to a power of
+        two, each request slices back its own rows and first n tokens."""
+        try:
+            batch, spans = self._pack([t for t, _n, _f in group])
+            new_bucket = 1 << max(3, (max(n for _t, n, _f in group) - 1).bit_length())
+            row_lens = np.ones(batch.shape[0], np.int32)  # pad rows decode harmlessly
+            for (start, b, s) in spans:
+                row_lens[start : start + b] = s
+            out = self.server.generate_ragged(batch, row_lens, new_bucket)
+            self.batches += 1
+            # the padded rows and the bucket rounding are implementation
+            # details: account only the tokens requests asked for
+            requested = sum(b * n for (_t, n, _f), (_r, b, _s) in zip(group, spans))
+            self.server.stats["tokens_generated"] += requested
+            for (tokens, n, fut), (start, b, _s) in zip(group, spans):
+                generated = out[start : start + b, :n]
+                fut.set_result(np.concatenate([tokens, generated], axis=1))
+        except BaseException as e:
+            for _tokens, _n, fut in group:
+                if not fut.done():
+                    fut.set_exception(e)
+
     def close(self) -> None:
         with self._close_lock:
             self._closed = True
             self._q.put(None)
+        # let any in-flight decode finish delivering its futures
+        self._gen_pool.shutdown(wait=False)
 
 
 _MODEL_ROUTE = re.compile(r"^/v1/(?P<model>[A-Za-z0-9._-]+)/(?P<verb>forward|generate)$")
@@ -471,8 +557,10 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                 return self._json(404, {"error": "not found"})
             try:
                 tokens = np.asarray(req["tokens"], np.int32)
-                if tokens.ndim != 2:
-                    raise ValueError(f"tokens must be 2-D [batch, seq], got shape {tokens.shape}")
+                if tokens.ndim != 2 or tokens.shape[0] < 1 or tokens.shape[1] < 1:
+                    raise ValueError(
+                        f"tokens must be non-empty 2-D [batch, seq], got shape {tokens.shape}"
+                    )
             except (ValueError, KeyError) as e:
                 return self._json(400, {"error": f"bad request: {e}"})
             if not server.ready:
@@ -498,7 +586,11 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                                 f"[1, {sset.max_new_tokens_limit}]"
                             },
                         )
-                    out = server.generate(tokens, max_new_tokens=n)
+                    batcher = sset.batcher_for(server)
+                    if batcher is not None and server.family.generate_ragged is not None:
+                        out = batcher.generate(tokens, max_new_tokens=n)
+                    else:
+                        out = server.generate(tokens, max_new_tokens=n)
                     self._json(200, {"tokens": out.tolist()})
             except ValueError as e:  # e.g. generate on a non-generative family
                 self._json(400, {"error": str(e)})

@@ -36,3 +36,50 @@ def greedy_generate(
     )
     generated = jnp.concatenate([toks.T, last], axis=1)  # [B, max_new_tokens]
     return jnp.concatenate([prompt, generated], axis=1)
+
+
+def ragged_greedy_generate(
+    forward,
+    init_kv_cache,
+    params,
+    prompt: jax.Array,  # [B, S] right-padded
+    row_lens: jax.Array,  # [B] real prompt length per row (1..S)
+    max_new_tokens: int = 16,
+    mesh=None,
+) -> jax.Array:
+    """Greedy decode for a RAGGED batch: rows of different prompt lengths
+    right-padded to a common S, each decoding from its own offset. Returns
+    the generated tokens only, [B, max_new_tokens] (row b's sequence is
+    prompt[b, :row_lens[b]] + result[b]).
+
+    Why right-padding is output-preserving for causal models: pads sit
+    AFTER every real token, so the causal mask already hides them from the
+    prefill; decode then writes each new token at the row's own next
+    position (vmapped cache update), progressively overwriting pad slots,
+    and the per-row causal threshold (kpos <= row offset) keeps any
+    not-yet-overwritten garbage invisible. This is the shape the serving
+    batcher coalesces concurrent /v1/generate requests into — one device
+    program instead of one per request."""
+    b, s = prompt.shape
+    row_lens = jnp.asarray(row_lens, jnp.int32)
+    if max_new_tokens <= 0:
+        return jnp.zeros((b, 0), prompt.dtype)
+    cache = init_kv_cache(b, s + max_new_tokens)
+    logits, cache = forward(params, prompt, kv_cache=cache, cache_offset=0, mesh=mesh)
+    # each row's first decoded token comes from ITS last real position
+    idx = jnp.broadcast_to((row_lens - 1)[:, None, None], (b, 1, logits.shape[-1]))
+    last_logits = jnp.take_along_axis(logits, idx, axis=1)[:, 0, :]
+    next_tok = jnp.argmax(last_logits, axis=-1)[:, None]  # [B,1]
+
+    def step(carry, t):
+        cache, tok = carry
+        logits, cache = forward(
+            params, tok, kv_cache=cache, cache_offset=row_lens + t, mesh=mesh
+        )
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1)[:, None]
+        return (cache, nxt), tok[:, 0]
+
+    (_, last), toks = jax.lax.scan(
+        step, (cache, next_tok), jnp.arange(max_new_tokens - 1)
+    )
+    return jnp.concatenate([toks.T, last], axis=1)  # [B, max_new_tokens]

@@ -214,8 +214,17 @@ def decoder_layer(
     new_cache: tuple[jax.Array, jax.Array] | None = None
     if cache is not None:
         ck, cv = cache
-        ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_offset, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_offset, 0, 0))
+        if jnp.ndim(cache_offset) == 0:
+            ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_offset, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_offset, 0, 0))
+        else:
+            # ragged batch: each row appends at its own position (per-row
+            # dynamic_update_slice via vmap lowers to a scatter)
+            row_dus = jax.vmap(
+                lambda c, u, o: jax.lax.dynamic_update_slice(c, u, (o, 0, 0))
+            )
+            ck = row_dus(ck, k, cache_offset)
+            cv = row_dus(cv, v, cache_offset)
         new_cache = (ck, cv)
         attn_out = _attend(q, ck, cv, cfg, causal=True,
                            q_offset=cache_offset, mesh=mesh, impl="reference")
@@ -268,9 +277,8 @@ def forward(
     ctx = ShardingCtx(mesh)
     b, s = tokens.shape
     if positions is None:
-        positions = jnp.arange(s)[None, :] + (
-            cache_offset if kv_cache is not None else 0
-        )
+        off = jnp.asarray(cache_offset if kv_cache is not None else 0)
+        positions = jnp.arange(s)[None, :] + (off[:, None] if off.ndim else off)
         positions = jnp.broadcast_to(positions, (b, s))
 
     x = jnp.take(params["model.embed_tokens.weight"], tokens, axis=0).astype(cfg.dtype)
@@ -348,4 +356,25 @@ def greedy_generate(
         ),
         lambda b, max_len: init_kv_cache(cfg, b, max_len),
         params, prompt, max_new_tokens=max_new_tokens, mesh=mesh,
+    )
+
+
+def ragged_greedy_generate(
+    params: dict[str, jax.Array],
+    prompt: jax.Array,  # [B, S] right-padded
+    row_lens: jax.Array,  # [B]
+    cfg: LlamaConfig,
+    max_new_tokens: int = 16,
+    mesh: Mesh | None = None,
+) -> jax.Array:
+    """Ragged-batch greedy decode (models/decode.py); returns the generated
+    tokens [B, max_new_tokens] only."""
+    from modelx_tpu.models import decode
+
+    return decode.ragged_greedy_generate(
+        lambda p, t, kv_cache, cache_offset, mesh: forward(
+            p, t, cfg, kv_cache=kv_cache, cache_offset=cache_offset, mesh=mesh
+        ),
+        lambda b, max_len: init_kv_cache(cfg, b, max_len),
+        params, prompt, row_lens, max_new_tokens=max_new_tokens, mesh=mesh,
     )

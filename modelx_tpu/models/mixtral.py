@@ -146,7 +146,8 @@ def forward(
     )
     b, s = tokens.shape
     if positions is None:
-        positions = jnp.arange(s)[None, :] + (cache_offset if kv_cache is not None else 0)
+        off = jnp.asarray(cache_offset if kv_cache is not None else 0)
+        positions = jnp.arange(s)[None, :] + (off[:, None] if off.ndim else off)
         positions = jnp.broadcast_to(positions, (b, s))
 
     x = jnp.take(params["model.embed_tokens.weight"], tokens, axis=0).astype(cfg.dtype)
@@ -213,4 +214,24 @@ def greedy_generate(
         ),
         lambda b, max_len: init_kv_cache(cfg, b, max_len),
         params, prompt, max_new_tokens=max_new_tokens, mesh=mesh,
+    )
+
+
+def ragged_greedy_generate(
+    params: dict[str, jax.Array],
+    prompt: jax.Array,  # [B, S] right-padded
+    row_lens: jax.Array,  # [B]
+    cfg: MixtralConfig,
+    max_new_tokens: int = 16,
+    mesh: Mesh | None = None,
+) -> jax.Array:
+    """Ragged-batch greedy decode; returns generated tokens [B, max_new]."""
+    from modelx_tpu.models import decode
+
+    return decode.ragged_greedy_generate(
+        lambda p, t, kv_cache, cache_offset, mesh: forward(
+            p, t, cfg, kv_cache=kv_cache, cache_offset=cache_offset, mesh=mesh
+        ),
+        lambda b, max_len: init_kv_cache(cfg, b, max_len),
+        params, prompt, row_lens, max_new_tokens=max_new_tokens, mesh=mesh,
     )

@@ -35,15 +35,20 @@ NEG_INF = -1e30
 # -- reference (jnp) ----------------------------------------------------------
 
 
-def attention_reference(q, k, v, causal: bool = True, q_offset: int = 0):
+def attention_reference(q, k, v, causal: bool = True, q_offset=0):
     """Plain softmax(QK^T/sqrt(d))V. Shapes: [B, H, S, D] (kv may have fewer
-    heads than q — GQA — as long as H % Hkv == 0)."""
+    heads than q — GQA — as long as H % Hkv == 0). ``q_offset`` positions the
+    queries for cached decode: a scalar for uniform batches, or a [B] vector
+    for ragged ones (each row decoding from its own prompt length)."""
     q, k, v = _repeat_kv_heads(q, k, v)
     scale = 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
     if causal:
         qlen, klen = q.shape[2], k.shape[2]
-        qpos = jnp.arange(qlen)[:, None] + q_offset
+        off = jnp.asarray(q_offset)
+        qpos = jnp.arange(qlen)[:, None] + (
+            off[:, None, None, None] if off.ndim else off
+        )  # [Q,K] or [B,1,Q,K]
         kpos = jnp.arange(klen)[None, :]
         logits = jnp.where(kpos <= qpos, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)

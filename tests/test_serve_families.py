@@ -287,3 +287,115 @@ class TestAOTWarmup:
         server = ModelServer(checkpoints["gpt2"], mesh_spec="dp=1", dtype="float32")
         stats = server.load()
         assert stats["ready_seconds"] >= stats["load_seconds"] > 0
+
+
+class TestGenerateBatching:
+    def test_concurrent_ragged_generates_coalesce_and_match(self, checkpoints):
+        """Concurrent generate requests of different prompt lengths and
+        decode budgets coalesce into one ragged device call and return
+        exactly their unbatched results."""
+        import concurrent.futures
+
+        from modelx_tpu.dl.serve import Batcher
+
+        server = ModelServer(checkpoints["llama"], mesh_spec="dp=1", dtype="float32")
+        server.load()
+        reqs = [
+            (np.array([[1, 2, 3]], np.int32), 4),
+            (np.array([[9, 8, 7, 6, 5, 4, 3]], np.int32), 2),
+            (np.array([[5, 5], [6, 6]], np.int32), 3),  # multi-row request
+            (np.array([[11]], np.int32), 5),
+        ]
+        expected = [server.generate(t, max_new_tokens=n) for t, n in reqs]
+        batcher = Batcher(server, window_ms=80)
+        try:
+            with concurrent.futures.ThreadPoolExecutor(len(reqs)) as pool:
+                got = list(pool.map(lambda r: batcher.generate(*r[:1], max_new_tokens=r[1]), reqs))
+            device_calls = batcher.batches
+        finally:
+            batcher.close()
+        for (t, n), e, g in zip(reqs, expected, got):
+            assert g.shape == (t.shape[0], t.shape[1] + n)
+            np.testing.assert_array_equal(e, g)
+        assert device_calls < len(reqs)  # actually coalesced
+
+    def test_mixed_forward_and_generate_group(self, checkpoints):
+        import concurrent.futures
+
+        from modelx_tpu.dl.serve import Batcher
+
+        server = ModelServer(checkpoints["llama"], mesh_spec="dp=1", dtype="float32")
+        server.load()
+        fwd_tokens = np.array([[4, 5, 6]], np.int32)
+        gen_tokens = np.array([[7, 8]], np.int32)
+        want_fwd = server.forward_argmax(fwd_tokens)
+        want_gen = server.generate(gen_tokens, max_new_tokens=3)
+        batcher = Batcher(server, window_ms=80)
+        try:
+            with concurrent.futures.ThreadPoolExecutor(2) as pool:
+                f1 = pool.submit(batcher.forward_argmax, fwd_tokens)
+                f2 = pool.submit(batcher.generate, gen_tokens, 3)
+                np.testing.assert_array_equal(want_fwd, f1.result())
+                np.testing.assert_array_equal(want_gen, f2.result())
+        finally:
+            batcher.close()
+
+    def test_http_generate_route_batches(self, checkpoints):
+        """Through the real HTTP front with dynamic batching on, concurrent
+        generate requests still return per-request results."""
+        import concurrent.futures
+
+        server = ModelServer(checkpoints["llama"], mesh_spec="dp=1", dtype="float32", name="g")
+        sset = ServerSet({"g": server}, dynamic_batch=True)
+        base = f"http://127.0.0.1:{free_port()}"
+        httpd = serve(sset, listen=base.rsplit("//", 1)[1])
+        try:
+            sset.load_all()
+            want = {
+                n: server.generate(np.array([[1, 2, n]], np.int32), max_new_tokens=4).tolist()
+                for n in (3, 4, 5)
+            }
+            def call(n):
+                r = requests.post(
+                    base + "/v1/generate",
+                    json={"tokens": [[1, 2, n]], "max_new_tokens": 4},
+                )
+                assert r.status_code == 200, r.text
+                return n, r.json()["tokens"]
+            with concurrent.futures.ThreadPoolExecutor(3) as pool:
+                for n, got in pool.map(call, (3, 4, 5)):
+                    assert got == want[n], n
+        finally:
+            httpd.shutdown()
+
+    def test_empty_prompt_is_400(self, checkpoints):
+        server = ModelServer(checkpoints["llama"], mesh_spec="dp=1", dtype="float32", name="e")
+        sset = ServerSet({"e": server}, dynamic_batch=True)
+        base = f"http://127.0.0.1:{free_port()}"
+        httpd = serve(sset, listen=base.rsplit("//", 1)[1])
+        try:
+            sset.load_all()
+            for path in ("/v1/generate", "/v1/forward"):
+                r = requests.post(base + path, json={"tokens": [[]]})
+                assert r.status_code == 400, (path, r.text)
+        finally:
+            httpd.shutdown()
+
+    def test_tokens_generated_counts_requested_only(self, checkpoints):
+        """Padded rows and the power-of-two decode bucket must not inflate
+        the tokens_generated metric."""
+        import concurrent.futures
+
+        from modelx_tpu.dl.serve import Batcher
+
+        server = ModelServer(checkpoints["llama"], mesh_spec="dp=1", dtype="float32")
+        server.load()
+        server.stats["tokens_generated"] = 0
+        batcher = Batcher(server, window_ms=80)
+        try:
+            reqs = [(np.array([[1, 2]], np.int32), 3)] * 3  # 3 rows pad to 4
+            with concurrent.futures.ThreadPoolExecutor(3) as pool:
+                list(pool.map(lambda r: batcher.generate(r[0], r[1]), reqs))
+        finally:
+            batcher.close()
+        assert server.stats["tokens_generated"] == 9
