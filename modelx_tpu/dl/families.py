@@ -105,11 +105,13 @@ def _llama_generate(params, tokens, cfg, mesh=None, max_new_tokens=16):
     return llama.greedy_generate(params, tokens, cfg, max_new_tokens=max_new_tokens, mesh=mesh)
 
 
-def _llama_generate_ragged(params, tokens, row_lens, cfg, mesh=None, max_new_tokens=16):
+def _llama_generate_ragged(params, tokens, row_lens, cfg, mesh=None,
+                            max_new_tokens=16, **sampling):
     from modelx_tpu.models import llama
 
     return llama.ragged_greedy_generate(
-        params, tokens, row_lens, cfg, max_new_tokens=max_new_tokens, mesh=mesh
+        params, tokens, row_lens, cfg, max_new_tokens=max_new_tokens, mesh=mesh,
+        **sampling,
     )
 
 
@@ -157,11 +159,13 @@ def _mixtral_generate(params, tokens, cfg, mesh=None, max_new_tokens=16):
     )
 
 
-def _mixtral_generate_ragged(params, tokens, row_lens, cfg, mesh=None, max_new_tokens=16):
+def _mixtral_generate_ragged(params, tokens, row_lens, cfg, mesh=None,
+                            max_new_tokens=16, **sampling):
     from modelx_tpu.models import mixtral
 
     return mixtral.ragged_greedy_generate(
-        params, tokens, row_lens, cfg, max_new_tokens=max_new_tokens, mesh=mesh
+        params, tokens, row_lens, cfg, max_new_tokens=max_new_tokens, mesh=mesh,
+        **sampling,
     )
 
 

@@ -12,7 +12,9 @@ forward/decode functions, and serves:
     POST /v1/profile            {"seconds": N} -> device-level jax profiler
                                 trace written to trace_dir
     POST /v1/forward            default model      {"tokens": [[...]]}
-    POST /v1/generate           default model      + {"max_new_tokens": N}
+    POST /v1/generate           default model      + {"max_new_tokens": N,
+                                "temperature": t, "top_k": k, "top_p": p,
+                                "seed": s}  (temperature 0 = greedy)
     POST /v1/{model}/forward    named model
     POST /v1/{model}/generate   named model
 
@@ -195,9 +197,36 @@ class ModelServer:
             out = self._forward(self.params, jnp.asarray(tokens, jnp.int32))
             return np.asarray(jnp.argmax(out, axis=-1))
 
-    def generate(self, tokens: np.ndarray, max_new_tokens: int = 16) -> np.ndarray:
+    def generate(
+        self,
+        tokens: np.ndarray,
+        max_new_tokens: int = 16,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
+    ) -> np.ndarray:
+        """Greedy by default; temperature > 0 samples (with optional top-k /
+        nucleus cuts and a request seed) via the ragged decode path."""
         if self.family.generate is None:
             raise ValueError(f"family {self.family.name} is not generative")
+        if temperature > 0:
+            if self.family.generate_ragged is None:
+                raise ValueError(
+                    f"family {self.family.name} does not support sampling"
+                )
+            b, s = np.asarray(tokens).shape
+            gen = self.generate_ragged(
+                tokens, np.full((b,), s, np.int32), max_new_tokens,
+                temperature=np.full((b,), temperature, np.float32),
+                top_k=np.full((b,), top_k, np.int32) if top_k > 0 else None,
+                top_p=np.full((b,), top_p, np.float32) if top_p < 1.0 else None,
+                # distinct per-row streams: a request asking for B samples of
+                # one prompt gets B different completions
+                seeds=((seed + np.arange(b)) % (2**31)).astype(np.int32),
+            )
+            self.stats["tokens_generated"] += int(b * max_new_tokens)
+            return np.concatenate([np.asarray(tokens, np.int32), gen], axis=1)
         with trace.span("serve.generate", model=self.name, new_tokens=max_new_tokens):
             out = self.family.generate(
                 self.params, jnp.asarray(tokens, jnp.int32), self.cfg,
@@ -207,7 +236,8 @@ class ModelServer:
             return np.asarray(out)
 
     def generate_ragged(
-        self, tokens: np.ndarray, row_lens: np.ndarray, max_new_tokens: int
+        self, tokens: np.ndarray, row_lens: np.ndarray, max_new_tokens: int,
+        temperature=None, top_k=None, top_p=None, seeds=None,
     ) -> np.ndarray:
         """Ragged-batch decode: right-padded rows [B,S] with per-row real
         lengths. Returns generated tokens only, [B, max_new_tokens]. The
@@ -223,6 +253,7 @@ class ModelServer:
                 self.params, jnp.asarray(tokens, jnp.int32),
                 jnp.asarray(row_lens, jnp.int32), self.cfg,
                 mesh=self.mesh, max_new_tokens=max_new_tokens,
+                temperature=temperature, top_k=top_k, top_p=top_p, seeds=seeds,
             )
             return np.asarray(out)
 
@@ -265,7 +296,7 @@ class Batcher:
         self._thread.start()
         self.batches = 0  # observability: device calls issued
 
-    def _submit(self, kind: str, tokens: np.ndarray, n: int):
+    def _submit(self, kind: str, tokens: np.ndarray, n: int, samp=None):
         import concurrent.futures
 
         tokens = np.asarray(tokens, np.int32)
@@ -282,16 +313,22 @@ class Batcher:
         with self._close_lock:
             if self._closed:
                 raise RuntimeError("batcher is closed")
-            self._q.put((kind, tokens, n, fut))
+            self._q.put((kind, tokens, n, samp, fut))
         return fut.result()
 
     def forward_argmax(self, tokens: np.ndarray) -> np.ndarray:
         return self._submit("fwd", tokens, 0)
 
-    def generate(self, tokens: np.ndarray, max_new_tokens: int = 16) -> np.ndarray:
+    def generate(self, tokens: np.ndarray, max_new_tokens: int = 16,
+                 temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+                 seed: int = 0) -> np.ndarray:
         """Returns [B, S + max_new_tokens] (prompt + generated), matching
-        ModelServer.generate."""
-        return self._submit("gen", tokens, max_new_tokens)
+        ModelServer.generate. Sampling controls are per-request: a coalesced
+        batch can mix greedy and sampled rows (ops/sampling.py)."""
+        return self._submit(
+            "gen", tokens, max_new_tokens,
+            (float(temperature), int(top_k), float(top_p), int(seed)),
+        )
 
     def _worker(self) -> None:
         import queue
@@ -328,11 +365,11 @@ class Batcher:
             except queue.Empty:
                 return
             if item is not None:
-                item[3].set_exception(RuntimeError("batcher is closed"))
+                item[-1].set_exception(RuntimeError("batcher is closed"))
 
     def _run(self, group: list) -> None:
-        fwd = [(t, f) for kind, t, _n, f in group if kind == "fwd"]
-        gen = [(t, n, f) for kind, t, n, f in group if kind == "gen"]
+        fwd = [(t, f) for kind, t, _n, _s, f in group if kind == "fwd"]
+        gen = [(t, n, s, f) for kind, t, n, s, f in group if kind == "gen"]
         if gen:
             # off-thread: a long decode must not head-of-line-block the next
             # window's forward requests
@@ -377,24 +414,48 @@ class Batcher:
     def _run_generate(self, group: list) -> None:
         """Coalesce generate requests into one ragged decode: rows pad right
         to a common (16-aligned) length, decode steps round up to a power of
-        two, each request slices back its own rows and first n tokens."""
+        two, each request slices back its own rows and first n tokens.
+        Per-request sampling controls become per-row vectors; an all-greedy
+        group takes the plain greedy program (no sampling compile)."""
         try:
-            batch, spans = self._pack([t for t, _n, _f in group])
-            new_bucket = 1 << max(3, (max(n for _t, n, _f in group) - 1).bit_length())
-            row_lens = np.ones(batch.shape[0], np.int32)  # pad rows decode harmlessly
+            batch, spans = self._pack([t for t, _n, _s, _f in group])
+            new_bucket = 1 << max(3, (max(n for _t, n, _s, _f in group) - 1).bit_length())
+            pad_b = batch.shape[0]
+            row_lens = np.ones(pad_b, np.int32)  # pad rows decode harmlessly
             for (start, b, s) in spans:
                 row_lens[start : start + b] = s
-            out = self.server.generate_ragged(batch, row_lens, new_bucket)
+            sampling: dict = {}
+            if any(samp and samp[0] > 0 for _t, _n, samp, _f in group):
+                temp = np.zeros(pad_b, np.float32)
+                seeds = np.zeros(pad_b, np.int32)
+                # filters only when some request asked: the filter-free
+                # program skips a full-vocab sort per decode step
+                use_k = any(samp and samp[1] > 0 for _t, _n, samp, _f in group)
+                use_p = any(samp and samp[2] < 1.0 for _t, _n, samp, _f in group)
+                top_k = np.zeros(pad_b, np.int32) if use_k else None
+                top_p = np.ones(pad_b, np.float32) if use_p else None
+                for (_t, _n, samp, _f), (start, b, _s) in zip(group, spans):
+                    if samp:
+                        temp[start : start + b] = samp[0]
+                        if use_k:
+                            top_k[start : start + b] = samp[1]
+                        if use_p:
+                            top_p[start : start + b] = samp[2]
+                        # distinct per-row streams within a multi-row request
+                        seeds[start : start + b] = (samp[3] + np.arange(b)) % (2**31)
+                sampling = {"temperature": temp, "top_k": top_k,
+                            "top_p": top_p, "seeds": seeds}
+            out = self.server.generate_ragged(batch, row_lens, new_bucket, **sampling)
             self.batches += 1
             # the padded rows and the bucket rounding are implementation
             # details: account only the tokens requests asked for
-            requested = sum(b * n for (_t, n, _f), (_r, b, _s) in zip(group, spans))
+            requested = sum(b * n for (_t, n, _ss, _f), (_r, b, _s) in zip(group, spans))
             self.server.stats["tokens_generated"] += requested
-            for (tokens, n, fut), (start, b, _s) in zip(group, spans):
+            for (tokens, n, _samp, fut), (start, b, _s) in zip(group, spans):
                 generated = out[start : start + b, :n]
                 fut.set_result(np.concatenate([tokens, generated], axis=1))
         except BaseException as e:
-            for _tokens, _n, fut in group:
+            for _tokens, _n, _samp, fut in group:
                 if not fut.done():
                     fut.set_exception(e)
 
@@ -586,11 +647,34 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                                 f"[1, {sset.max_new_tokens_limit}]"
                             },
                         )
+                    try:
+                        samp = {
+                            "temperature": float(req.get("temperature", 0.0)),
+                            "top_k": int(req.get("top_k", 0)),
+                            "top_p": float(req.get("top_p", 1.0)),
+                            "seed": int(req.get("seed", 0)),
+                        }
+                    except (TypeError, ValueError):
+                        return self._json(
+                            400, {"error": "temperature/top_k/top_p/seed must be numbers"}
+                        )
+                    if (
+                        not (0.0 <= samp["temperature"] <= 100.0)
+                        or not (0 <= samp["top_k"] < 2**31)
+                        or not (0.0 < samp["top_p"] <= 1.0)
+                        or not (0 <= samp["seed"] < 2**31)
+                        # int32 vectors carry these on device; out-of-range
+                        # values must 400 here, not overflow a whole batch
+                    ):
+                        return self._json(400, {
+                            "error": "temperature in [0,100], top_k/seed in "
+                            "[0, 2^31), top_p in (0,1] required"
+                        })
                     batcher = sset.batcher_for(server)
                     if batcher is not None and server.family.generate_ragged is not None:
-                        out = batcher.generate(tokens, max_new_tokens=n)
+                        out = batcher.generate(tokens, max_new_tokens=n, **samp)
                     else:
-                        out = server.generate(tokens, max_new_tokens=n)
+                        out = server.generate(tokens, max_new_tokens=n, **samp)
                     self._json(200, {"tokens": out.tolist()})
             except ValueError as e:  # e.g. generate on a non-generative family
                 self._json(400, {"error": str(e)})

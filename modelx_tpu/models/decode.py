@@ -46,11 +46,18 @@ def ragged_greedy_generate(
     row_lens: jax.Array,  # [B] real prompt length per row (1..S)
     max_new_tokens: int = 16,
     mesh=None,
+    temperature=None,  # [B] float; enables sampling (<=0 rows stay greedy)
+    top_k=None,  # [B] int32; 0 = off
+    top_p=None,  # [B] float; >=1 = off
+    seeds=None,  # [B] int32 per-row sample stream
 ) -> jax.Array:
-    """Greedy decode for a RAGGED batch: rows of different prompt lengths
+    """Decode for a RAGGED batch: rows of different prompt lengths
     right-padded to a common S, each decoding from its own offset. Returns
     the generated tokens only, [B, max_new_tokens] (row b's sequence is
-    prompt[b, :row_lens[b]] + result[b]).
+    prompt[b, :row_lens[b]] + result[b]). Greedy by default; passing
+    ``temperature`` switches to per-row sampling (ops/sampling.py), so one
+    compiled program serves a batch mixing greedy and sampled requests with
+    different controls.
 
     Why right-padding is output-preserving for causal models: pads sit
     AFTER every real token, so the causal mask already hides them from the
@@ -64,19 +71,40 @@ def ragged_greedy_generate(
     row_lens = jnp.asarray(row_lens, jnp.int32)
     if max_new_tokens <= 0:
         return jnp.zeros((b, 0), prompt.dtype)
+
+    if temperature is None:
+        def pick(logits2d, step_i):
+            return jnp.argmax(logits2d, axis=-1)
+    else:
+        from modelx_tpu.ops import sampling as sampling_ops
+
+        base_key = jax.random.PRNGKey(0)  # per-row streams come from seeds
+        temperature = jnp.asarray(temperature, jnp.float32)
+        # None filters stay None: the sampler then compiles without the
+        # full-vocab sort the filters need
+        top_k = None if top_k is None else jnp.asarray(top_k, jnp.int32)
+        top_p = None if top_p is None else jnp.asarray(top_p, jnp.float32)
+        seeds = jnp.zeros((b,), jnp.int32) if seeds is None else jnp.asarray(seeds, jnp.int32)
+
+        def pick(logits2d, step_i):
+            return sampling_ops.sample(
+                logits2d.astype(jnp.float32), base_key, temperature,
+                top_k=top_k, top_p=top_p, seeds=seeds, step=step_i,
+            )
+
     cache = init_kv_cache(b, s + max_new_tokens)
     logits, cache = forward(params, prompt, kv_cache=cache, cache_offset=0, mesh=mesh)
     # each row's first decoded token comes from ITS last real position
     idx = jnp.broadcast_to((row_lens - 1)[:, None, None], (b, 1, logits.shape[-1]))
     last_logits = jnp.take_along_axis(logits, idx, axis=1)[:, 0, :]
-    next_tok = jnp.argmax(last_logits, axis=-1)[:, None]  # [B,1]
+    next_tok = pick(last_logits, 0)[:, None]  # [B,1]
 
     def step(carry, t):
         cache, tok = carry
         logits, cache = forward(
             params, tok, kv_cache=cache, cache_offset=row_lens + t, mesh=mesh
         )
-        nxt = jnp.argmax(logits[:, -1, :], axis=-1)[:, None]
+        nxt = pick(logits[:, -1, :], t + 1)[:, None]
         return (cache, nxt), tok[:, 0]
 
     (_, last), toks = jax.lax.scan(

@@ -366,8 +366,12 @@ def ragged_greedy_generate(
     cfg: LlamaConfig,
     max_new_tokens: int = 16,
     mesh: Mesh | None = None,
+    temperature=None,
+    top_k=None,
+    top_p=None,
+    seeds=None,
 ) -> jax.Array:
-    """Ragged-batch greedy decode (models/decode.py); returns the generated
+    """Ragged-batch decode, greedy or per-row-sampled (models/decode.py); returns the generated
     tokens [B, max_new_tokens] only."""
     from modelx_tpu.models import decode
 
@@ -377,4 +381,5 @@ def ragged_greedy_generate(
         ),
         lambda b, max_len: init_kv_cache(cfg, b, max_len),
         params, prompt, row_lens, max_new_tokens=max_new_tokens, mesh=mesh,
+        temperature=temperature, top_k=top_k, top_p=top_p, seeds=seeds,
     )

@@ -224,8 +224,12 @@ def ragged_greedy_generate(
     cfg: MixtralConfig,
     max_new_tokens: int = 16,
     mesh: Mesh | None = None,
+    temperature=None,
+    top_k=None,
+    top_p=None,
+    seeds=None,
 ) -> jax.Array:
-    """Ragged-batch greedy decode; returns generated tokens [B, max_new]."""
+    """Ragged-batch decode, greedy or per-row-sampled; returns generated tokens [B, max_new]."""
     from modelx_tpu.models import decode
 
     return decode.ragged_greedy_generate(
@@ -234,4 +238,5 @@ def ragged_greedy_generate(
         ),
         lambda b, max_len: init_kv_cache(cfg, b, max_len),
         params, prompt, row_lens, max_new_tokens=max_new_tokens, mesh=mesh,
+        temperature=temperature, top_k=top_k, top_p=top_p, seeds=seeds,
     )

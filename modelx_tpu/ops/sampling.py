@@ -1,0 +1,77 @@
+"""Token sampling: temperature / top-k / top-p with PER-ROW parameters.
+
+Serving batches rows from different requests (dl/serve.py Batcher), so the
+sampling controls are vectors — one compiled program covers a batch where
+row 0 is greedy, row 1 samples at temperature 0.9 with top_p 0.95, and
+row 2 uses top_k 40. Per-row semantics:
+
+- ``temperature <= 0``   -> greedy (argmax) for that row;
+- ``top_k == 0``         -> no top-k cut;
+- ``top_p >= 1``         -> no nucleus cut.
+
+Everything is ``vmap``/``lax``-friendly: no data-dependent shapes, the
+row's filters reduce to thresholds gathered from a sorted copy.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample(
+    logits: jax.Array,  # [B, V] float
+    key: jax.Array,  # base PRNG key
+    temperature: jax.Array,  # [B] float; <=0 = greedy
+    top_k: jax.Array | None = None,  # [B] int32; 0 = off; None = skip filter
+    top_p: jax.Array | None = None,  # [B] float; >=1 = off; None = skip filter
+    seeds: jax.Array | None = None,  # [B] int32 per-row stream
+    step=0,  # scalar int: decode step, folded in so steps differ
+) -> jax.Array:
+    """Next token per row, [B] int32. ``top_k``/``top_p`` as None (the
+    common temperature-only case) compiles without the O(B·V log V) sort
+    the filters need."""
+    b, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1)
+    if seeds is None:
+        seeds = jnp.zeros((b,), jnp.int32)
+
+    temperature = jnp.asarray(temperature, logits.dtype)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_t[:, None]
+
+    if top_k is None and top_p is None:
+        filtered = scaled
+    else:
+        # one descending sort serves both filters
+        sorted_logits = -jnp.sort(-scaled, axis=-1)  # [B, V] desc
+        keep = jnp.ones_like(scaled, bool)
+        if top_k is not None:
+            # top-k: keep logits >= the k-th largest (per-row k)
+            k = jnp.clip(jnp.asarray(top_k, jnp.int32), 0, v)
+            k_idx = jnp.clip(k - 1, 0, v - 1)[:, None]
+            kth = jnp.take_along_axis(sorted_logits, k_idx, axis=1)  # [B,1]
+            keep &= jnp.where(k[:, None] > 0, scaled >= kth, True)
+        if top_p is not None:
+            # top-p (nucleus): smallest prefix of the sorted distribution
+            # with cumulative probability >= p; keep logits >= its last
+            # member's value
+            probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs_sorted, axis=-1)
+            p = jnp.asarray(top_p, logits.dtype)[:, None]
+            # prefix including the item that crosses p (cum[-1]=1 always)
+            in_nucleus = cum - probs_sorted < p
+            cut_idx = jnp.maximum(jnp.sum(in_nucleus, axis=-1) - 1, 0)[:, None]
+            pth = jnp.take_along_axis(sorted_logits, cut_idx, axis=1)
+            keep &= jnp.where(p < 1.0, scaled >= pth, True)
+        filtered = jnp.where(keep, scaled, NEG_INF)
+
+    # per-row streams: fold the row's request seed and the step into the key
+    def row_key(seed):
+        return jax.random.fold_in(jax.random.fold_in(key, seed), step)
+
+    keys = jax.vmap(row_key)(jnp.asarray(seeds, jnp.int32))
+    sampled = jax.vmap(lambda kk, lg: jax.random.categorical(kk, lg))(keys, filtered)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

@@ -399,3 +399,25 @@ class TestGenerateBatching:
         finally:
             batcher.close()
         assert server.stats["tokens_generated"] == 9
+
+    def test_sampling_params_over_http(self, checkpoints):
+        server = ModelServer(checkpoints["llama"], mesh_spec="dp=1", dtype="float32", name="s")
+        sset = ServerSet({"s": server}, dynamic_batch=True)
+        base = f"http://127.0.0.1:{free_port()}"
+        httpd = serve(sset, listen=base.rsplit("//", 1)[1])
+        try:
+            sset.load_all()
+            body = {"tokens": [[1, 2, 3]], "max_new_tokens": 5,
+                    "temperature": 0.8, "seed": 11}
+            a = requests.post(base + "/v1/generate", json=body)
+            b = requests.post(base + "/v1/generate", json=body)
+            assert a.status_code == b.status_code == 200
+            assert a.json() == b.json()  # same seed -> deterministic
+            # validation
+            for bad in ({"temperature": -1}, {"top_p": 0}, {"top_p": 1.5},
+                        {"top_k": -2}, {"temperature": "hot"}):
+                r = requests.post(base + "/v1/generate",
+                                  json={"tokens": [[1]], **bad})
+                assert r.status_code == 400, bad
+        finally:
+            httpd.shutdown()
