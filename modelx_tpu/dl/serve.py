@@ -216,15 +216,16 @@ class ModelServer:
                     f"family {self.family.name} does not support sampling"
                 )
             b, s = np.asarray(tokens).shape
-            gen = self.generate_ragged(
-                tokens, np.full((b,), s, np.int32), max_new_tokens,
-                temperature=np.full((b,), temperature, np.float32),
-                top_k=np.full((b,), top_k, np.int32) if top_k > 0 else None,
-                top_p=np.full((b,), top_p, np.float32) if top_p < 1.0 else None,
-                # distinct per-row streams: a request asking for B samples of
-                # one prompt gets B different completions
-                seeds=((seed + np.arange(b)) % (2**31)).astype(np.int32),
-            )
+            with trace.span("serve.generate", model=self.name, new_tokens=max_new_tokens):
+                gen = self.generate_ragged(
+                    tokens, np.full((b,), s, np.int32), max_new_tokens,
+                    temperature=np.full((b,), temperature, np.float32),
+                    top_k=np.full((b,), top_k, np.int32) if top_k > 0 else None,
+                    top_p=np.full((b,), top_p, np.float32) if top_p < 1.0 else None,
+                    # distinct per-row streams: a request asking for B samples
+                    # of one prompt gets B different completions
+                    seeds=((seed + np.arange(b)) % (2**31)).astype(np.int32),
+                )
             self.stats["tokens_generated"] += int(b * max_new_tokens)
             return np.concatenate([np.asarray(tokens, np.int32), gen], axis=1)
         with trace.span("serve.generate", model=self.name, new_tokens=max_new_tokens):
@@ -430,8 +431,10 @@ class Batcher:
                 seeds = np.zeros(pad_b, np.int32)
                 # filters only when some request asked: the filter-free
                 # program skips a full-vocab sort per decode step
-                use_k = any(samp and samp[1] > 0 for _t, _n, samp, _f in group)
-                use_p = any(samp and samp[2] < 1.0 for _t, _n, samp, _f in group)
+                # ...asked by a request that actually SAMPLES — a greedy
+                # request's stray filter values must not force the sort
+                use_k = any(samp and samp[0] > 0 and samp[1] > 0 for _t, _n, samp, _f in group)
+                use_p = any(samp and samp[0] > 0 and samp[2] < 1.0 for _t, _n, samp, _f in group)
                 top_k = np.zeros(pad_b, np.int32) if use_k else None
                 top_p = np.ones(pad_b, np.float32) if use_p else None
                 for (_t, _n, samp, _f), (start, b, _s) in zip(group, spans):

@@ -427,3 +427,30 @@ class TestCompletionCLI:
         r = self._run("__complete", "pull", "pr")
         assert r.exit_code == 0
         assert "prod/" in r.output
+
+
+class TestConcurrentPushes:
+    def test_concurrent_version_pushes_keep_index_consistent(self, server, tmp_path):
+        """N clients pushing different versions of one repo simultaneously
+        (the race the reference's RefreshIndex loses, store_fs.go:185-238)
+        must leave the index containing every version."""
+        import concurrent.futures
+
+        dirs = []
+        for i in range(6):
+            d = tmp_path / f"m{i}"
+            d.mkdir()
+            (d / "modelx.yaml").write_text(f"description: v{i}\nframework: jax\n")
+            (d / "w.bin").write_bytes(bytes([i]) * 2048)
+            dirs.append(str(d))
+
+        def push(i):
+            Client(server, quiet=True).push("library/race", f"v{i}", dirs[i])
+
+        with concurrent.futures.ThreadPoolExecutor(6) as pool:
+            list(pool.map(push, range(6)))
+        idx = Client(server, quiet=True).get_index("library/race")
+        assert {m.name for m in idx.manifests} == {f"v{i}" for i in range(6)}
+        # global index sees the repo too
+        gidx = Client(server, quiet=True).get_global_index()
+        assert any(m.name == "library/race" for m in gidx.manifests)
