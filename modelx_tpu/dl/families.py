@@ -43,6 +43,9 @@ class Family:
     # -> generated [B, max_new]; cached-decode families only — the serving
     # batcher uses it to coalesce concurrent generate requests
     generate_ragged: Callable[..., jax.Array] | None = None
+    # (cfg, mesh) -> (forward-with-cache, init_kv_cache) for streaming decode
+    # (models/decode.ChunkedDecoder); cached-decode families only
+    decode_fns: Callable[..., tuple] | None = None
 
 
 def _shape(params: dict, name: str) -> tuple[int, ...]:
@@ -115,6 +118,17 @@ def _llama_generate_ragged(params, tokens, row_lens, cfg, mesh=None,
     )
 
 
+def _llama_decode_fns(cfg, mesh=None):
+    from modelx_tpu.models import llama
+
+    def fwd(p, t, kv_cache, cache_offset, mesh=mesh):
+        return llama.forward(
+            p, t, cfg, kv_cache=kv_cache, cache_offset=cache_offset, mesh=mesh
+        )
+
+    return fwd, (lambda b, max_len: llama.init_kv_cache(cfg, b, max_len))
+
+
 # -- mixtral ------------------------------------------------------------------
 
 
@@ -167,6 +181,17 @@ def _mixtral_generate_ragged(params, tokens, row_lens, cfg, mesh=None,
         params, tokens, row_lens, cfg, max_new_tokens=max_new_tokens, mesh=mesh,
         **sampling,
     )
+
+
+def _mixtral_decode_fns(cfg, mesh=None):
+    from modelx_tpu.models import mixtral
+
+    def fwd(p, t, kv_cache, cache_offset, mesh=mesh):
+        return mixtral.forward(
+            p, t, cfg, kv_cache=kv_cache, cache_offset=cache_offset, mesh=mesh
+        )
+
+    return fwd, (lambda b, max_len: mixtral.init_kv_cache(cfg, b, max_len))
 
 
 # -- gpt2 ---------------------------------------------------------------------
@@ -244,9 +269,9 @@ def _bert_forward(params, tokens, cfg, mesh=None):
 
 FAMILIES: dict[str, Family] = {
     "llama": Family("llama", LLAMA_RULES, infer_llama_config, _llama_forward,
-                    _llama_generate, _llama_generate_ragged),
+                    _llama_generate, _llama_generate_ragged, _llama_decode_fns),
     "mixtral": Family("mixtral", MIXTRAL_RULES, infer_mixtral_config, _mixtral_forward,
-                      _mixtral_generate, _mixtral_generate_ragged),
+                      _mixtral_generate, _mixtral_generate_ragged, _mixtral_decode_fns),
     "gpt2": Family("gpt2", GPT2_RULES, infer_gpt2_config, _gpt2_forward, _gpt2_generate),
     "bert": Family("bert", BERT_RULES, infer_bert_config, _bert_forward, None),
 }

@@ -100,6 +100,8 @@ class ModelServer:
         self.family: fam.Family | None = None
         self.params: dict | None = None
         self._forward_aot: dict[tuple, object] = {}
+        self._decoders: dict[int, object] = {}  # chunk_size -> ChunkedDecoder
+        self._decoders_lock = threading.Lock()
 
     # the shape the dynamic batcher pads a lone first request to (seq to a
     # multiple of 16, batch to a power of two): precompiling it during load
@@ -235,6 +237,50 @@ class ModelServer:
             )
             self.stats["tokens_generated"] += int(out.shape[0] * max_new_tokens)
             return np.asarray(out)
+
+    def generate_stream(
+        self,
+        tokens: np.ndarray,
+        max_new_tokens: int = 16,
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
+        chunk_size: int = 8,
+    ):
+        """Yields [B, k] arrays of new tokens as they decode (k <=
+        chunk_size) — the transport behind streaming /v1/generate. The
+        concatenated chunks equal the non-streaming result exactly."""
+        if self.family.decode_fns is None:
+            raise ValueError(f"family {self.family.name} does not support streaming")
+        dec = self._decoders.get(chunk_size)
+        if dec is None:
+            with self._decoders_lock:
+                dec = self._decoders.get(chunk_size)
+                if dec is None:  # double-checked: concurrent first streams
+                    from modelx_tpu.models.decode import ChunkedDecoder
+
+                    fwd, init = self.family.decode_fns(self.cfg, mesh=self.mesh)
+                    dec = self._decoders[chunk_size] = ChunkedDecoder(fwd, init, chunk_size)
+        tokens = np.asarray(tokens, np.int32)
+        b, s = tokens.shape
+        pad_s = -(-s // 16) * 16  # bound compiled shapes like the batcher
+        padded = np.zeros((b, pad_s), np.int32)
+        padded[:, :s] = tokens
+        with trace.span("serve.generate_stream", model=self.name,
+                        new_tokens=max_new_tokens):
+            for piece in dec.stream(
+                self.params, jnp.asarray(padded), np.full((b,), s, np.int32),
+                max_new_tokens,
+                temperature=np.full((b,), temperature, np.float32),
+                top_k=np.full((b,), top_k, np.int32),
+                top_p=np.full((b,), top_p, np.float32),
+                seeds=((seed + np.arange(b)) % (2**31)).astype(np.int32),
+            ):
+                # account as chunks leave: a client disconnect must not
+                # erase the decode work the device already did
+                self.stats["tokens_generated"] += int(piece.size)
+                yield piece
 
     def generate_ragged(
         self, tokens: np.ndarray, row_lens: np.ndarray, max_new_tokens: int,
@@ -565,6 +611,45 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
             self.end_headers()
             self.wfile.write(body)
 
+        def _stream_generate(self, server, tokens, n, samp) -> None:
+            """Chunked transfer encoding, one NDJSON line of NEW tokens per
+            decoded chunk, then {"done": true}. Decode errors after the 200
+            terminate the chunk stream with an {"error": ...} line — the
+            status is already on the wire."""
+            def write_chunk(payload: bytes) -> None:
+                self.wfile.write(f"{len(payload):x}\r\n".encode())
+                self.wfile.write(payload + b"\r\n")
+
+            gen = server.generate_stream(tokens, max_new_tokens=n, **samp)
+            try:
+                # pull the first chunk BEFORE committing a 200: an
+                # unsupported family / bad request must still be a 4xx
+                first = next(gen, None)
+            except ValueError as e:
+                return self._json(400, {"error": str(e)})
+
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            try:
+                if first is not None:
+                    write_chunk(json.dumps({"tokens": first.tolist()}).encode() + b"\n")
+                    for piece in gen:
+                        write_chunk(json.dumps({"tokens": piece.tolist()}).encode() + b"\n")
+                write_chunk(b'{"done": true}\n')
+            except Exception as e:
+                logger.exception("stream error")
+                try:
+                    write_chunk(json.dumps({"error": str(e)}).encode() + b"\n")
+                except OSError:
+                    pass  # client went away
+            finally:
+                try:
+                    self.wfile.write(b"0\r\n\r\n")  # chunked terminator
+                except OSError:
+                    pass
+
         def do_GET(self):
             if self.path == "/healthz":
                 if sset.ready:
@@ -673,6 +758,8 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                             "error": "temperature in [0,100], top_k/seed in "
                             "[0, 2^31), top_p in (0,1] required"
                         })
+                    if bool(req.get("stream", False)):
+                        return self._stream_generate(server, tokens, n, samp)
                     batcher = sset.batcher_for(server)
                     if batcher is not None and server.family.generate_ragged is not None:
                         out = batcher.generate(tokens, max_new_tokens=n, **samp)

@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def greedy_generate(
@@ -111,3 +112,98 @@ def ragged_greedy_generate(
         step, (cache, next_tok), jnp.arange(max_new_tokens - 1)
     )
     return jnp.concatenate([toks.T, last], axis=1)  # [B, max_new_tokens]
+
+
+class ChunkedDecoder:
+    """Streaming decode: tokens come back in fixed-size chunks so a server
+    can flush them to the client while the rest still generates. Two
+    compiled programs per (batch, prompt, cache-length) shape — prefill and
+    a ``chunk_size``-step scan — reused across requests (jit caches on the
+    bound methods). The token stream is IDENTICAL to ragged_greedy_generate
+    with the same controls: same per-row offsets, same (seed, step) sample
+    streams, chunking is invisible in the output.
+
+    Sampling vectors are always traced inputs (temperature 0 rows pick
+    greedy on device), so one program pair serves greedy and sampled
+    streams alike.
+    """
+
+    def __init__(self, forward, init_kv_cache, chunk_size: int = 8) -> None:
+        self.forward = forward
+        self.init_kv_cache = init_kv_cache
+        self.chunk_size = int(chunk_size)
+        # donate the cache: without aliasing every chunk would copy the
+        # whole KV cache (2x HBM residency on long streams). Backends that
+        # can't donate (CPU tests) just warn and copy.
+        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(3,))
+        self._chunk = jax.jit(self._chunk_impl, donate_argnums=(1,))
+
+    def _pick(self, logits2d, step_i, temperature, top_k, top_p, seeds):
+        from modelx_tpu.ops import sampling as sampling_ops
+
+        sampled = sampling_ops.sample(
+            logits2d.astype(jnp.float32), jax.random.PRNGKey(0), temperature,
+            top_k=top_k, top_p=top_p, seeds=seeds, step=step_i,
+        )
+        return sampled
+
+    def _prefill_impl(self, params, prompt, row_lens, cache,
+                      temperature, top_k, top_p, seeds):
+        b = prompt.shape[0]
+        logits, cache = self.forward(params, prompt, kv_cache=cache, cache_offset=0)
+        idx = jnp.broadcast_to((row_lens - 1)[:, None, None], (b, 1, logits.shape[-1]))
+        last = jnp.take_along_axis(logits, idx, axis=1)[:, 0, :]
+        tok = self._pick(last, 0, temperature, top_k, top_p, seeds)
+        return cache, tok[:, None]
+
+    def _chunk_impl(self, params, cache, tok, row_lens, start,
+                    temperature, top_k, top_p, seeds):
+        def step(carry, i):
+            cache, tok = carry
+            logits, cache = self.forward(
+                params, tok, kv_cache=cache, cache_offset=row_lens + start + i
+            )
+            nxt = self._pick(
+                logits[:, -1, :], start + i + 1, temperature, top_k, top_p, seeds
+            )[:, None]
+            return (cache, nxt), tok[:, 0]
+
+        (cache, tok), toks = jax.lax.scan(step, (cache, tok), jnp.arange(self.chunk_size))
+        return cache, tok, toks.T  # emitted [B, chunk_size]
+
+    def stream(self, params, prompt, row_lens, max_new_tokens: int,
+               temperature=None, top_k=None, top_p=None, seeds=None):
+        """Yields [B, k] arrays of new tokens (k <= chunk_size), totalling
+        exactly max_new_tokens per row."""
+        b, s = prompt.shape
+        if max_new_tokens <= 0:
+            return
+        row_lens = jnp.asarray(row_lens, jnp.int32)
+        temperature = (
+            jnp.zeros((b,), jnp.float32) if temperature is None
+            else jnp.asarray(temperature, jnp.float32)
+        )
+        top_k = jnp.zeros((b,), jnp.int32) if top_k is None else jnp.asarray(top_k, jnp.int32)
+        top_p = jnp.ones((b,), jnp.float32) if top_p is None else jnp.asarray(top_p, jnp.float32)
+        seeds = jnp.zeros((b,), jnp.int32) if seeds is None else jnp.asarray(seeds, jnp.int32)
+        # cache sized for whole chunks, rounded up to a power of two of them:
+        # every distinct cache length compiles a fresh program pair, so the
+        # rounding bounds compile churn the same way the serving batcher's
+        # new_bucket does (a client cycling max_new_tokens must not be able
+        # to force hundreds of compilations)
+        n_chunks = -(-max_new_tokens // self.chunk_size)
+        n_chunks = 1 << (n_chunks - 1).bit_length()
+        cache = self.init_kv_cache(b, s + n_chunks * self.chunk_size + 1)
+        cache, tok = self._prefill(
+            params, prompt, row_lens, cache, temperature, top_k, top_p, seeds
+        )
+        emitted = 0
+        start = jnp.int32(0)
+        while emitted < max_new_tokens:
+            cache, tok, toks = self._chunk(
+                params, cache, tok, row_lens, start, temperature, top_k, top_p, seeds
+            )
+            start = start + self.chunk_size
+            take = min(self.chunk_size, max_new_tokens - emitted)
+            yield np.asarray(toks[:, :take])
+            emitted += take

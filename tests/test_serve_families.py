@@ -2,6 +2,8 @@
 every model family served from its self-describing checkpoint, and N models
 behind one HTTP front (BASELINE config #5: concurrent pull+serve)."""
 
+import json
+
 import numpy as np
 import pytest
 import requests
@@ -419,5 +421,57 @@ class TestGenerateBatching:
                 r = requests.post(base + "/v1/generate",
                                   json={"tokens": [[1]], **bad})
                 assert r.status_code == 400, bad
+        finally:
+            httpd.shutdown()
+
+
+class TestStreamingGenerate:
+    def test_stream_chunks_equal_nonstreamed(self, checkpoints):
+        """Concatenated stream chunks must reproduce the one-shot result
+        exactly, greedy and sampled, including a partial last chunk."""
+        server = ModelServer(checkpoints["llama"], mesh_spec="dp=1", dtype="float32")
+        server.load()
+        tokens = np.array([[1, 2, 3]], np.int32)
+        for kw in ({}, {"temperature": 0.9, "seed": 4}):
+            n = 11  # not a multiple of chunk_size -> partial final chunk
+            chunks = list(server.generate_stream(tokens, max_new_tokens=n,
+                                                 chunk_size=4, **kw))
+            assert [c.shape[1] for c in chunks] == [4, 4, 3]
+            streamed = np.concatenate(chunks, axis=1)
+            whole = server.generate(tokens, max_new_tokens=n, **kw)
+            np.testing.assert_array_equal(streamed, whole[:, 3:], err_msg=str(kw))
+
+    def test_http_stream_route(self, checkpoints):
+        server = ModelServer(checkpoints["llama"], mesh_spec="dp=1", dtype="float32", name="st")
+        sset = ServerSet({"st": server})
+        base = f"http://127.0.0.1:{free_port()}"
+        httpd = serve(sset, listen=base.rsplit("//", 1)[1])
+        try:
+            sset.load_all()
+            body = {"tokens": [[1, 2, 3]], "max_new_tokens": 10, "stream": True}
+            with requests.post(base + "/v1/generate", json=body, stream=True) as r:
+                assert r.status_code == 200
+                assert r.headers["Content-Type"] == "application/x-ndjson"
+                lines = [json.loads(ln) for ln in r.iter_lines() if ln]
+            assert lines[-1] == {"done": True}
+            streamed = [t for ln in lines[:-1] for t in ln["tokens"][0]]
+            assert len(streamed) == 10
+            whole = requests.post(
+                base + "/v1/generate", json={"tokens": [[1, 2, 3]], "max_new_tokens": 10}
+            ).json()["tokens"][0]
+            assert streamed == whole[3:]
+        finally:
+            httpd.shutdown()
+
+    def test_stream_unsupported_family_is_400(self, checkpoints):
+        server = ModelServer(checkpoints["bert"], mesh_spec="dp=1", dtype="float32", name="b")
+        sset = ServerSet({"b": server})
+        base = f"http://127.0.0.1:{free_port()}"
+        httpd = serve(sset, listen=base.rsplit("//", 1)[1])
+        try:
+            sset.load_all()
+            r = requests.post(base + "/v1/b/generate",
+                              json={"tokens": [[1]], "stream": True})
+            assert r.status_code == 400
         finally:
             httpd.shutdown()
