@@ -20,9 +20,10 @@ forward/decode functions, and serves:
 
 Model family (llama / mixtral / gpt2 / bert) is detected from checkpoint
 tensor names (dl/families.py) — the checkpoint is self-describing, no
-config.json needed. Token IDs in, token IDs out — tokenization is the
-caller's concern (the registry stores tokenizer files alongside weights;
-wiring a tokenizer in is deployment glue, not framework).
+config.json needed. Token IDs in, token IDs out by default; when the model
+directory carries a ``tokenizer.json`` (pulled alongside the weights),
+``/v1/generate`` also takes ``{"text": "..."}`` and returns the decoded
+continuation.
 
 Compile latency: a persistent XLA compilation cache can be enabled
 (MODELX_COMPILE_CACHE or ~/.cache/modelx-tpu/xla) so a sidecar restart
@@ -57,6 +58,8 @@ logger = logging.getLogger("modelx.serve")
 DEFAULT_MAX_NEW_TOKENS_LIMIT = 1024
 # /v1/profile holds the handler thread and the profiler for this long at most
 MAX_PROFILE_SECONDS = 60
+
+_UNSET = object()  # tokenizer not probed yet (absent is cached as None)
 
 
 def enable_compile_cache(path: str = "") -> None:
@@ -102,6 +105,7 @@ class ModelServer:
         self._forward_aot: dict[tuple, object] = {}
         self._decoders: dict[int, object] = {}  # chunk_size -> ChunkedDecoder
         self._decoders_lock = threading.Lock()
+        self._tokenizer: object = _UNSET
 
     # the shape the dynamic batcher pads a lone first request to (seq to a
     # multiple of 16, batch to a power of two): precompiling it during load
@@ -237,6 +241,31 @@ class ModelServer:
             )
             self.stats["tokens_generated"] += int(out.shape[0] * max_new_tokens)
             return np.asarray(out)
+
+    def tokenizer(self):
+        """The model's tokenizer (``tokenizer.json`` pulled alongside the
+        weights — the registry stores tokenizer files as ordinary blobs), or
+        None. Loaded lazily: transformers is a heavy import the token-id
+        API never pays."""
+        if self._tokenizer is _UNSET:
+            with self._decoders_lock:
+                if self._tokenizer is _UNSET:
+                    path = os.path.join(self.model_dir, "tokenizer.json")
+                    if not os.path.isfile(path):
+                        self._tokenizer = None  # genuinely absent: cache it
+                    else:
+                        try:
+                            from transformers import PreTrainedTokenizerFast
+
+                            self._tokenizer = PreTrainedTokenizerFast(tokenizer_file=path)
+                        except Exception as e:
+                            # NOT cached: a missing optional dep or transient
+                            # read error must surface as a load failure (and
+                            # retry later), not as "no tokenizer.json"
+                            raise RuntimeError(
+                                f"tokenizer.json exists but failed to load: {e}"
+                            ) from e
+        return self._tokenizer
 
     def generate_stream(
         self,
@@ -705,7 +734,29 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
             if server is None:
                 return self._json(404, {"error": "not found"})
             try:
-                tokens = np.asarray(req["tokens"], np.int32)
+                tok = None
+                if "text" in req and "tokens" not in req:
+                    # text in, text out — needs the model's tokenizer.json
+                    if not isinstance(req["text"], str) or not req["text"]:
+                        raise ValueError("text must be a non-empty string")
+                    if bool(req.get("stream", False)):
+                        return self._json(400, {
+                            "error": "text with stream is not supported; send token ids"
+                        })
+                    try:
+                        tok = server.tokenizer()
+                    except RuntimeError as e:  # file exists, load failed
+                        return self._json(503, {"error": str(e)})
+                    if tok is None:
+                        return self._json(400, {
+                            "error": "model has no tokenizer.json; send token ids"
+                        })
+                    ids = tok.encode(req["text"])
+                    if not ids:
+                        raise ValueError("text tokenized to zero tokens")
+                    tokens = np.asarray([ids], np.int32)
+                else:
+                    tokens = np.asarray(req["tokens"], np.int32)
                 if tokens.ndim != 2 or tokens.shape[0] < 1 or tokens.shape[1] < 1:
                     raise ValueError(
                         f"tokens must be non-empty 2-D [batch, seq], got shape {tokens.shape}"
@@ -765,7 +816,10 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                         out = batcher.generate(tokens, max_new_tokens=n, **samp)
                     else:
                         out = server.generate(tokens, max_new_tokens=n, **samp)
-                    self._json(200, {"tokens": out.tolist()})
+                    resp = {"tokens": out.tolist()}
+                    if tok is not None:  # text request: decode the new tokens
+                        resp["text"] = tok.decode(out[0, tokens.shape[1]:].tolist())
+                    self._json(200, resp)
             except ValueError as e:  # e.g. generate on a non-generative family
                 self._json(400, {"error": str(e)})
             except Exception as e:  # surface inference errors as 500 JSON

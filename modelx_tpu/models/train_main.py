@@ -45,10 +45,14 @@ def _load_tokens(data: str, vocab_size: int, steps: int, batch: int, seq: int) -
     return arr
 
 
-def _batches(tokens: np.ndarray, steps: int, batch: int, seq: int, start_step: int = 0):
+def _batches(tokens: np.ndarray, steps: int, batch: int, seq: int,
+             start_step: int = 0, vocab_size: int | None = None):
     """Consecutive [B, S+1] windows -> {"tokens", "targets"}; wraps around.
     ``start_step`` places the cursor where a resumed run left off, so a
-    restart continues through the stream instead of replaying the start."""
+    restart continues through the stream instead of replaying the start.
+    Ids are validated against ``vocab_size``: XLA's gather silently CLAMPS
+    out-of-range indices inside jit, so a vocab-mismatched tokenizer would
+    otherwise train on garbage with a finite loss."""
     need = batch * (seq + 1)
     total = len(tokens)
     if total < need:
@@ -62,12 +66,38 @@ def _batches(tokens: np.ndarray, steps: int, batch: int, seq: int, start_step: i
             off = 0
         window = np.asarray(tokens[off : off + need]).reshape(batch, seq + 1)
         off += need
+        if vocab_size is not None:
+            hi, lo = int(window.max()), int(window.min())
+            if hi >= vocab_size or lo < 0:
+                raise click.ClickException(
+                    f"data contains token id {hi if hi >= vocab_size else lo}, "
+                    f"outside the model's vocab [0, {vocab_size}) — wrong tokenizer?"
+                )
         yield {"tokens": window[:, :-1].copy(), "targets": window[:, 1:].copy()}
+
+
+def _cfg_from_dir(model_dir: str):
+    """Architecture from the checkpoint headers alone (no weight bytes)."""
+    import glob as _glob
+
+    from modelx_tpu.dl import families as fam
+    from modelx_tpu.dl.safetensors import read_header_from_file
+
+    paths = sorted(_glob.glob(os.path.join(model_dir, "*.safetensors")))
+    if not paths:
+        raise click.ClickException(f"no safetensors under {model_dir}")
+    infos: dict = {}
+    for p in paths:
+        h, _ = read_header_from_file(p)
+        infos.update(h)
+    return fam.infer_llama_config(fam.abstract_params(infos))
 
 
 @click.command("modelx-train")
 @click.option("--model-dir", default="", help="checkpoint dir with *.safetensors to start from")
-@click.option("--config", default="tiny", help="llama config when starting fresh: tiny|llama3_8b|llama3_70b")
+@click.option("--config", default="tiny",
+              type=click.Choice(["tiny", "llama3_8b", "llama3_70b"]),
+              help="llama config when starting fresh")
 @click.option("--data", default="synthetic", help="token id stream: .npy / int32 .bin / 'synthetic'")
 @click.option("--mesh", "mesh_spec", default="", help='mesh spec, e.g. "dp=2,fsdp=4" (default: dp over all devices)')
 @click.option("--fsdp", is_flag=True, help="use the ZeRO-3 partition rules (params sharded over fsdp)")
@@ -114,6 +144,10 @@ def main(model_dir, config, data, mesh_spec, fsdp, steps, batch, seq, lr,
         raise click.ClickException(
             f"--batch {batch} must be divisible by the data axes (dp*fsdp = {data_ways})"
         )
+    if "sp" in mesh.axis_names and seq % mesh.shape["sp"]:
+        raise click.ClickException(
+            f"--seq {seq} must be divisible by the sp axis ({mesh.shape['sp']})"
+        )
 
     # -- model: resume > checkpoint dir > fresh config ------------------------
     ckpt = Checkpointer(checkpoint_dir) if checkpoint_dir else None
@@ -121,52 +155,39 @@ def main(model_dir, config, data, mesh_spec, fsdp, steps, batch, seq, lr,
         os.path.join(ckpt.directory, "checkpoint.json")
     )
     start_step = 0
-    if model_dir and resuming:
-        # restore() replaces the weights anyway: skip the redundant base
-        # load, keep only the header-derived config
-        from modelx_tpu.dl import families as fam
-        from modelx_tpu.dl.safetensors import read_header_from_file
-
-        import glob as _glob
-
-        infos: dict = {}
-        for p in sorted(_glob.glob(os.path.join(model_dir, "*.safetensors"))):
-            h, _ = read_header_from_file(p)
-            infos.update(h)
-        cfg = fam.infer_llama_config(fam.abstract_params(infos))
-        params = shard_params(llama.init_params(cfg, jax.random.PRNGKey(0)), rules, mesh)
+    optimizer = make_optimizer(lr=lr)
+    cfg = (
+        _cfg_from_dir(model_dir) if model_dir
+        else getattr(llama.LlamaConfig, config)()
+    )
+    if resuming:
+        # restore() delivers both weights and optimizer state; all it needs
+        # from the templates is names/shapes — abstract values avoid
+        # materializing (and device_put-ing) a full random init just to
+        # throw it away
+        abstract = jax.eval_shape(
+            lambda: llama.init_params(cfg, jax.random.PRNGKey(0))
+        )
+        opt_abstract = jax.eval_shape(optimizer.init, abstract)
+        params, opt_state, start_step = ckpt.restore(abstract, opt_abstract, mesh, rules)
+        logger.info("resumed from step %d (%s)", start_step, ckpt.directory)
     elif model_dir:
-        from modelx_tpu.dl import families as fam
         from modelx_tpu.dl.loader import LocalFileSource, load_safetensors
-        from modelx_tpu.dl.safetensors import read_header_from_file
 
         import glob as _glob
 
-        paths = sorted(_glob.glob(os.path.join(model_dir, "*.safetensors")))
-        if not paths:
-            raise click.ClickException(f"no safetensors under {model_dir}")
-        infos: dict = {}
-        for p in paths:
-            h, _ = read_header_from_file(p)
-            infos.update(h)
-        cfg = fam.infer_llama_config(fam.abstract_params(infos))
         params = {}
-        for p in paths:
+        for p in sorted(_glob.glob(os.path.join(model_dir, "*.safetensors"))):
             src = LocalFileSource(p)
             try:
                 arrays, _ = load_safetensors(src, mesh, rules)
             finally:
                 src.close()
             params.update(arrays)
+        opt_state = optimizer.init(params)
     else:
-        cfg = getattr(llama.LlamaConfig, config)()
         params = shard_params(llama.init_params(cfg, jax.random.PRNGKey(0)), rules, mesh)
-
-    optimizer = make_optimizer(lr=lr)
-    opt_state = optimizer.init(params)
-    if resuming:
-        params, opt_state, start_step = ckpt.restore(params, opt_state, mesh, rules)
-        logger.info("resumed from step %d (%s)", start_step, ckpt.directory)
+        opt_state = optimizer.init(params)
 
     from modelx_tpu.models.train import jit_train_step
 
@@ -177,7 +198,8 @@ def main(model_dir, config, data, mesh_spec, fsdp, steps, batch, seq, lr,
     t0 = time.monotonic()
     losses = []
     n = last_saved = start_step
-    for batch_np in _batches(tokens, steps, batch, seq, start_step=start_step):
+    for batch_np in _batches(tokens, steps, batch, seq, start_step=start_step,
+                             vocab_size=cfg.vocab_size):
         dev_batch = {k: jax.device_put(v, bsh) for k, v in batch_np.items()}
         params, opt_state, loss = step_fn(params, opt_state, dev_batch)
         n += 1

@@ -475,3 +475,65 @@ class TestStreamingGenerate:
             assert r.status_code == 400
         finally:
             httpd.shutdown()
+
+
+class TestTextAPI:
+    @pytest.fixture
+    def text_front(self, checkpoints, tmp_path_factory):
+        """Llama checkpoint with a tiny word-level tokenizer.json beside it."""
+        tokenizers = pytest.importorskip("tokenizers")
+        import shutil
+
+        d = tmp_path_factory.mktemp("textmodel")
+        shutil.copy(checkpoints["llama"] + "/model.safetensors", d / "model.safetensors")
+        vocab = {"<unk>": 0, "hello": 1, "world": 2, "tpu": 3}
+        vocab.update({f"w{i}": i for i in range(4, 64)})
+        tok = tokenizers.Tokenizer(tokenizers.models.WordLevel(vocab, unk_token="<unk>"))
+        tok.pre_tokenizer = tokenizers.pre_tokenizers.Whitespace()
+        tok.save(str(d / "tokenizer.json"))
+        server = ModelServer(str(d), mesh_spec="dp=1", dtype="float32", name="t")
+        sset = ServerSet({"t": server})
+        base = f"http://127.0.0.1:{free_port()}"
+        httpd = serve(sset, listen=base.rsplit("//", 1)[1])
+        sset.load_all()
+        yield base, server
+        httpd.shutdown()
+
+    def test_text_in_text_out(self, text_front):
+        base, server = text_front
+        r = requests.post(base + "/v1/generate",
+                          json={"text": "hello world tpu", "max_new_tokens": 4})
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["tokens"][0][:3] == [1, 2, 3]  # encoded prompt
+        assert len(body["tokens"][0]) == 7
+        assert isinstance(body["text"], str)
+        # decoded text equals decoding the generated ids ourselves
+        want = server.tokenizer().decode(body["tokens"][0][3:])
+        assert body["text"] == want
+
+    def test_text_without_tokenizer_is_400(self, checkpoints):
+        server = ModelServer(checkpoints["llama"], mesh_spec="dp=1", dtype="float32", name="nt")
+        sset = ServerSet({"nt": server})
+        base = f"http://127.0.0.1:{free_port()}"
+        httpd = serve(sset, listen=base.rsplit("//", 1)[1])
+        try:
+            sset.load_all()
+            r = requests.post(base + "/v1/generate", json={"text": "hi"})
+            assert r.status_code == 400
+            assert "tokenizer" in r.json()["error"]
+        finally:
+            httpd.shutdown()
+
+    def test_bad_text_types_are_400(self, text_front):
+        base, _ = text_front
+        for bad in ("", 7, ["a", "b"]):
+            r = requests.post(base + "/v1/generate", json={"text": bad})
+            assert r.status_code == 400, bad
+
+    def test_text_with_stream_is_400(self, text_front):
+        base, _ = text_front
+        r = requests.post(base + "/v1/generate",
+                          json={"text": "hello", "stream": True})
+        assert r.status_code == 400
+        assert "stream" in r.json()["error"]
