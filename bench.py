@@ -284,10 +284,15 @@ def measure_ttft(base: str, repo: str, workdir: str, runs: int = 5) -> dict:
 
 # stdlib-only puller (no jax import: interpreter startup must not drown the
 # transfer on a small-core host) — http.client + readinto into one reused
-# buffer, the same zero-copy discipline the loader's HTTPSource uses
+# buffer, the same zero-copy discipline the loader's HTTPSource uses. The
+# stream is consumed, counted, and discarded: in the deployment being
+# modeled each tenant lands bytes on its own pod volume (or straight in
+# HBM), so N tenants funneling ~2 GB through THIS rig's one shared disk
+# would measure the kernel's dirty-page writeback throttle, not the
+# registry's data plane. Byte count goes to stdout for verification.
 _PULL_SNIPPET = r"""
 import sys, time, http.client, urllib.parse
-url, out = sys.argv[1], sys.argv[2]
+url = sys.argv[1]
 u = urllib.parse.urlsplit(url)
 t0 = time.monotonic()
 conn = http.client.HTTPConnection(u.hostname, u.port, timeout=300)
@@ -297,18 +302,16 @@ assert resp.status == 200, resp.status
 buf = bytearray(16 << 20)
 view = memoryview(buf)
 n = 0
-with open(out, "wb") as f:
-    while True:
-        got = resp.readinto(view)
-        if not got:
-            break
-        f.write(view[:got])
-        n += got
+while True:
+    got = resp.readinto(view)
+    if not got:
+        break
+    n += got
 print(time.monotonic() - t0, n)
 """
 
 
-def measure_multitenant(base: str, repo: str, desc, workdir: str, size: int,
+def measure_multitenant(base: str, repo: str, desc, size: int,
                         clients: int = 4) -> dict:
     """BASELINE config #5: N tenants pulling concurrently from one registry.
     Each tenant is its own process (the pod shape), streaming through the
@@ -327,20 +330,19 @@ def measure_multitenant(base: str, repo: str, desc, workdir: str, size: int,
         t0 = time.monotonic()
         for i in range(n):
             procs.append(subprocess.Popen(
-                [sys.executable, "-S", "-c", _PULL_SNIPPET, url,
-                 os.path.join(workdir, f"mt-{i}.bin")],
+                [sys.executable, "-S", "-c", _PULL_SNIPPET, url],
                 stdout=subprocess.PIPE, text=True, env=env))
+        outs = []
         for i, p in enumerate(procs):
-            p.wait(timeout=600)
+            out, _ = p.communicate(timeout=600)
             if p.returncode != 0:
                 raise RuntimeError(f"multitenant puller {i} exited {p.returncode}")
+            outs.append(out)
         wall = time.monotonic() - t0
-        for i in range(n):
-            out = os.path.join(workdir, f"mt-{i}.bin")
-            got = os.path.getsize(out)
+        for i, out in enumerate(outs):
+            got = int(out.split()[1])
             if got != size:  # a partial transfer must not inflate the GB/s
                 raise RuntimeError(f"multitenant puller {i}: {got} of {size} bytes")
-            os.unlink(out)
         return wall
 
     run_n(1)  # warm page cache + interpreter startup path
@@ -493,7 +495,7 @@ def main() -> None:
         ours_s, baseline_s = min(ours_ts), min(baseline_ts)
 
         ttft = measure_ttft(base, "library/ttft", workdir)
-        multitenant = measure_multitenant(base, "library/bench", desc, workdir, size)
+        multitenant = measure_multitenant(base, "library/bench", desc, size)
 
         # serving: load once more (cheap assert it still works), reuse arrays
         source = _blob_source(client, "library/bench", desc)

@@ -62,6 +62,19 @@ MAX_PROFILE_SECONDS = 60
 _UNSET = object()  # tokenizer not probed yet (absent is cached as None)
 
 
+class _Tokenizer:
+    """list[int]-in/str-out facade over a raw ``tokenizers.Tokenizer``."""
+
+    def __init__(self, tok) -> None:
+        self._tok = tok
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text).ids
+
+    def decode(self, ids) -> str:
+        return self._tok.decode(list(ids))
+
+
 def enable_compile_cache(path: str = "") -> None:
     """Persistent XLA compilation cache (idempotent)."""
     path = path or os.environ.get(
@@ -105,6 +118,9 @@ class ModelServer:
         self._forward_aot: dict[tuple, object] = {}
         self._decoders: dict[int, object] = {}  # chunk_size -> ChunkedDecoder
         self._decoders_lock = threading.Lock()
+        # separate lock: tokenizer loading must not block streaming-decoder
+        # creation (unrelated caches)
+        self._tokenizer_lock = threading.Lock()
         self._tokenizer: object = _UNSET
 
     # the shape the dynamic batcher pads a lone first request to (seq to a
@@ -245,19 +261,21 @@ class ModelServer:
     def tokenizer(self):
         """The model's tokenizer (``tokenizer.json`` pulled alongside the
         weights — the registry stores tokenizer files as ordinary blobs), or
-        None. Loaded lazily: transformers is a heavy import the token-id
-        API never pays."""
+        None. Loaded lazily: the token-id API never pays the import."""
         if self._tokenizer is _UNSET:
-            with self._decoders_lock:
+            with self._tokenizer_lock:
                 if self._tokenizer is _UNSET:
                     path = os.path.join(self.model_dir, "tokenizer.json")
                     if not os.path.isfile(path):
                         self._tokenizer = None  # genuinely absent: cache it
                     else:
                         try:
-                            from transformers import PreTrainedTokenizerFast
+                            import tokenizers  # rust core; loads in ms where
+                            # transformers' wrapper costs a multi-second import
 
-                            self._tokenizer = PreTrainedTokenizerFast(tokenizer_file=path)
+                            self._tokenizer = _Tokenizer(
+                                tokenizers.Tokenizer.from_file(path)
+                            )
                         except Exception as e:
                             # NOT cached: a missing optional dep or transient
                             # read error must surface as a load failure (and
@@ -733,9 +751,17 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
             server, verb = sset.resolve(self.path)
             if server is None:
                 return self._json(404, {"error": "not found"})
+            if "text" in req and "tokens" in req:
+                # generating from the tokens while silently dropping the text
+                # would answer the wrong prompt; make the caller pick one
+                return self._json(400, {"error": "send either text or tokens, not both"})
+            if "text" in req and verb != "generate":
+                # text is a generate-only contract (docs/api.md); a typo'd
+                # endpoint must not return an undocumented hybrid response
+                return self._json(400, {"error": "text is only supported on generate"})
             try:
                 tok = None
-                if "text" in req and "tokens" not in req:
+                if "text" in req:
                     # text in, text out — needs the model's tokenizer.json
                     if not isinstance(req["text"], str) or not req["text"]:
                         raise ValueError("text must be a non-empty string")
@@ -761,10 +787,19 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                     raise ValueError(
                         f"tokens must be non-empty 2-D [batch, seq], got shape {tokens.shape}"
                     )
-            except (ValueError, KeyError) as e:
+            except (ValueError, KeyError, TypeError, OverflowError) as e:
+                # numpy raises OverflowError for ids outside int32 and
+                # TypeError for null/ragged rows — those are 400s, not a
+                # dropped connection
                 return self._json(400, {"error": f"bad request: {e}"})
             if not server.ready:
                 return self._json(503, {"error": "still loading"})
+            vocab = getattr(server.cfg, "vocab_size", 0) or 0
+            if vocab and (int(tokens.min()) < 0 or int(tokens.max()) >= vocab):
+                # inside jit the gather CLAMPS out-of-range ids (silent
+                # garbage); this also catches a tokenizer.json whose vocab
+                # outgrew the checkpoint's embedding table
+                return self._json(400, {"error": f"token ids must be in [0, {vocab})"})
             server.stats["requests"] += 1
             try:
                 if verb == "forward":

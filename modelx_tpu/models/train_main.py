@@ -76,8 +76,9 @@ def _batches(tokens: np.ndarray, steps: int, batch: int, seq: int,
         yield {"tokens": window[:, :-1].copy(), "targets": window[:, 1:].copy()}
 
 
-def _cfg_from_dir(model_dir: str):
-    """Architecture from the checkpoint headers alone (no weight bytes)."""
+def _scan_model_dir(model_dir: str):
+    """(config, shard paths) from the checkpoint headers alone (no weight
+    bytes) — the single owner of *.safetensors discovery."""
     import glob as _glob
 
     from modelx_tpu.dl import families as fam
@@ -90,7 +91,7 @@ def _cfg_from_dir(model_dir: str):
     for p in paths:
         h, _ = read_header_from_file(p)
         infos.update(h)
-    return fam.infer_llama_config(fam.abstract_params(infos))
+    return fam.infer_llama_config(fam.abstract_params(infos)), paths
 
 
 @click.command("modelx-train")
@@ -156,10 +157,10 @@ def main(model_dir, config, data, mesh_spec, fsdp, steps, batch, seq, lr,
     )
     start_step = 0
     optimizer = make_optimizer(lr=lr)
-    cfg = (
-        _cfg_from_dir(model_dir) if model_dir
-        else getattr(llama.LlamaConfig, config)()
-    )
+    if model_dir:
+        cfg, shard_paths = _scan_model_dir(model_dir)
+    else:
+        cfg, shard_paths = getattr(llama.LlamaConfig, config)(), []
     if resuming:
         # restore() delivers both weights and optimizer state; all it needs
         # from the templates is names/shapes — abstract values avoid
@@ -174,10 +175,8 @@ def main(model_dir, config, data, mesh_spec, fsdp, steps, batch, seq, lr,
     elif model_dir:
         from modelx_tpu.dl.loader import LocalFileSource, load_safetensors
 
-        import glob as _glob
-
         params = {}
-        for p in sorted(_glob.glob(os.path.join(model_dir, "*.safetensors"))):
+        for p in shard_paths:
             src = LocalFileSource(p)
             try:
                 arrays, _ = load_safetensors(src, mesh, rules)

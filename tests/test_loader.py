@@ -169,6 +169,19 @@ class TestLoader:
         assert len(q_reads) == 8
         np.testing.assert_array_equal(np.asarray(arrays["model.layers.0.self_attn.q_proj.weight"]), q)
 
+    def test_tiny_transfer_budget_still_streams(self, checkpoint):
+        """A byte budget smaller than every tensor must admit them one at a
+        time (clamped), not deadlock — the RAM bound is independent of the
+        dispatch-thread count."""
+        path, tensors = checkpoint
+        mesh = make_mesh("dp=2,tp=4")
+        arrays, stats = load_safetensors(
+            LocalFileSource(path), mesh, LLAMA_RULES, transfer_budget_bytes=64
+        )
+        assert stats.tensors == 4
+        for name, expected in tensors.items():
+            np.testing.assert_array_equal(np.asarray(arrays[name]), expected)
+
     def test_dtype_cast_on_host(self, checkpoint):
         import ml_dtypes
 

@@ -154,6 +154,33 @@ class TestMultiTenant:
         )
         assert r.status_code == 200
 
+    def test_text_plus_tokens_ambiguous_400(self, front):
+        """Both text and tokens in one request must 400 — generating from
+        the tokens while dropping the text would answer the wrong prompt."""
+        r = requests.post(
+            front + "/v1/generate",
+            json={"text": "hi", "tokens": [[1, 2]], "max_new_tokens": 2},
+        )
+        assert r.status_code == 400
+        assert "either" in r.json()["error"]
+
+    def test_out_of_vocab_token_ids_400(self, front):
+        """Ids beyond the embedding table must 400: inside jit the gather
+        silently CLAMPS out-of-range ids and returns plausible garbage."""
+        for bad in ([[0, 10**6]], [[-1, 2]]):
+            r = requests.post(front + "/v1/forward", json={"tokens": bad})
+            assert r.status_code == 400, bad
+            assert "token ids" in r.json()["error"]
+        # beyond int32: numpy raises OverflowError before the vocab check —
+        # still a 400 JSON response, never a dropped connection
+        for bad in ([[2**31]], [[None, 2]], None):
+            r = requests.post(front + "/v1/forward", json={"tokens": bad})
+            assert r.status_code == 400, bad
+        r = requests.post(
+            front + "/v1/generate", json={"tokens": [[0, 10**6]], "max_new_tokens": 2}
+        )
+        assert r.status_code == 400
+
     def test_profile_seconds_validated_consistently(self, front):
         from modelx_tpu.dl.serve import MAX_PROFILE_SECONDS
 
@@ -537,3 +564,11 @@ class TestTextAPI:
                           json={"text": "hello", "stream": True})
         assert r.status_code == 400
         assert "stream" in r.json()["error"]
+
+    def test_text_on_forward_is_400(self, text_front):
+        """text is a generate-only contract (docs/api.md): a typo'd verb
+        must 400, not return an undocumented ids-only hybrid response."""
+        base, _ = text_front
+        r = requests.post(base + "/v1/forward", json={"text": "hello"})
+        assert r.status_code == 400
+        assert "generate" in r.json()["error"]
