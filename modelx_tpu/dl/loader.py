@@ -65,15 +65,18 @@ class _ByteBudget:
         self._avail = self.limit
         self._cv = threading.Condition()
 
-    def acquire(self, n: int) -> None:
+    def acquire(self, n: int) -> int:
+        """Returns the amount actually charged (clamped to the limit);
+        callers must release exactly that — releasing the unclamped request
+        would inflate the budget past its limit over time."""
         n = min(n, self.limit)
         with self._cv:
             while self._avail < n:
                 self._cv.wait()
             self._avail -= n
+        return n
 
     def release(self, n: int) -> None:
-        n = min(n, self.limit)
         with self._cv:
             self._avail += n
             self._cv.notify_all()
@@ -542,7 +545,11 @@ def load_safetensors(
         # arrays pile up uncounted. The cost is the bytes this group will
         # materialize: its slice, or the whole tensor when a byte-strided
         # inner-axis slice forces a (cached) full fetch.
-        slice_bytes = info.np_dtype().itemsize * int(
+        itemsize = info.np_dtype().itemsize
+        if dtype is not None:
+            # a host-side upcast parks the POST-cast bytes; charge for those
+            itemsize = max(itemsize, np.dtype(dtype).itemsize)
+        slice_bytes = itemsize * int(
             np.prod([s.stop - s.start for s in full_spec], initial=1)
         )
         if info.members is not None:
@@ -568,7 +575,7 @@ def load_safetensors(
             with _full_lock:
                 cached = info.name in _full_cache
             cost = slice_bytes if cached else max(slice_bytes, info.nbytes)
-        inflight.acquire(cost)
+        cost = inflight.acquire(cost)  # clamped: release exactly this much
         try:
             tf0 = time.monotonic()
             if info.members is not None:

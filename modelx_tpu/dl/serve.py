@@ -72,7 +72,9 @@ class _Tokenizer:
         return self._tok.encode(text).ids
 
     def decode(self, ids) -> str:
-        return self._tok.decode(list(ids))
+        # keep special tokens: clients watch for e.g. "</s>" in the text,
+        # and tokenizers' own default (skip=True) would silently strip them
+        return self._tok.decode(list(ids), skip_special_tokens=False)
 
 
 def enable_compile_cache(path: str = "") -> None:

@@ -75,8 +75,14 @@ LLAMA_RULES: Rules = [
 # Llama with FSDP: every weight additionally shards its non-tp dimension
 # over the ``fsdp`` axis (ZeRO-3 / scaling-book "fully sharded" layout);
 # XLA all-gathers params just-in-time per layer and reduce-scatters grads.
+# The embedding shards VOCAB over both axes (hidden replicated): an
+# fsdp-sharded hidden dim would make the lookup's output hidden-sharded,
+# and resharding that to the batch-sharded activation layout is an
+# involuntary full rematerialization in the SPMD partitioner; the
+# vocab-parallel table lowers to masked-gather + psum instead and is just
+# as fully sharded.
 LLAMA_FSDP_RULES: Rules = [
-    (r"embed_tokens\.weight$", ["tp", "fsdp"]),
+    (r"embed_tokens\.weight$", [["tp", "fsdp"], None]),
     (r"lm_head\.weight$", ["tp", "fsdp"]),
     (r"(q|k|v)_proj\.weight$", ["tp", "fsdp"]),
     (r"o_proj\.weight$", ["fsdp", "tp"]),

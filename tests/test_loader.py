@@ -169,6 +169,18 @@ class TestLoader:
         assert len(q_reads) == 8
         np.testing.assert_array_equal(np.asarray(arrays["model.layers.0.self_attn.q_proj.weight"]), q)
 
+    def test_byte_budget_balances_when_clamped(self):
+        """acquire() returns the clamped charge; releasing exactly that must
+        restore the budget to its limit, never inflate past it."""
+        from modelx_tpu.dl.loader import _ByteBudget
+
+        b = _ByteBudget(100)
+        got = b.acquire(300)
+        assert got == 100 and b._avail == 0
+        b.release(got - 40)  # partial give-back (post-fetch trim)
+        b.release(40)  # transfer done
+        assert b._avail == 100
+
     def test_tiny_transfer_budget_still_streams(self, checkpoint):
         """A byte budget smaller than every tensor must admit them one at a
         time (clamped), not deadlock — the RAM bound is independent of the
