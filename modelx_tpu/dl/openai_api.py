@@ -1,0 +1,354 @@
+"""OpenAI-compatible serving surface: /v1/completions + /v1/chat/completions.
+
+The reference registry has no serving API at all; this sidecar's native
+token-id API (docs/api.md) is the precise contract, and this module is the
+compatibility veneer over it so off-the-shelf OpenAI SDK clients can point
+at a modelx-tpu sidecar unchanged (``base_url=http://sidecar:8000/v1``).
+
+Scope (documented, deliberate):
+- ``prompt``: str or list of str (each row generated independently);
+  ``messages``: the standard role/content list, rendered with the simple
+  template ``<|role|>\\n{content}\\n`` ... ``<|assistant|>\\n`` — chat
+  *templating* is model-specific and belongs to the model card, not the
+  server, so the rendering is fixed and documented rather than guessed.
+- ``max_tokens``, ``temperature``, ``top_p``, ``seed``, ``stop`` (up to 4
+  strings), ``stream`` (SSE). ``top_k`` accepted as an extension.
+- ``n``, ``logprobs``, ``echo``, tool calls: rejected with a clear 400.
+
+Requires the model to ship a ``tokenizer.json`` (the registry stores it as
+an ordinary blob next to the weights).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import time
+import uuid
+from typing import Iterator
+
+import numpy as np
+
+OBJ_COMPLETION = "text_completion"
+OBJ_CHAT = "chat.completion"
+OBJ_CHAT_CHUNK = "chat.completion.chunk"
+
+_UNSUPPORTED = ("n", "logprobs", "echo", "tools", "tool_choice", "functions")
+
+
+class APIError(Exception):
+    """OpenAI-shaped error: {"error": {"message", "type", "code"}}."""
+
+    def __init__(self, status: int, message: str, err_type: str = "invalid_request_error") -> None:
+        super().__init__(message)
+        self.status = status
+        self.payload = {
+            "error": {"message": message, "type": err_type, "param": None, "code": None}
+        }
+
+
+def resolve_model(sset, req: dict):
+    """The ``model`` field picks the sidecar tenant; absent = default."""
+    name = req.get("model") or sset.default
+    server = sset.servers.get(name)
+    if server is None:
+        raise APIError(404, f"model {name!r} not found", "not_found_error")
+    if not server.ready:
+        raise APIError(503, f"model {name!r} is still loading", "server_error")
+    return server
+
+
+def tokenizer_for(server):
+    try:
+        tok = server.tokenizer()
+    except RuntimeError as e:
+        raise APIError(503, str(e), "server_error") from e
+    if tok is None:
+        raise APIError(
+            400, "model has no tokenizer.json; use the token-id API (/v1/generate)"
+        )
+    return tok
+
+
+def render_messages(messages) -> str:
+    if not isinstance(messages, list) or not messages:
+        raise APIError(400, "messages must be a non-empty list")
+    parts = []
+    for i, m in enumerate(messages):
+        if not isinstance(m, dict) or not isinstance(m.get("content"), str):
+            raise APIError(400, f"messages[{i}] must be {{role, content}} with string content")
+        role = m.get("role", "user")
+        if role not in ("system", "user", "assistant"):
+            raise APIError(400, f"messages[{i}].role must be system|user|assistant")
+        parts.append(f"<|{role}|>\n{m['content']}\n")
+    parts.append("<|assistant|>\n")
+    return "".join(parts)
+
+
+MAX_PROMPTS = 32  # one request must stay one bounded unit of device work
+
+
+def parse_prompts(req: dict, chat: bool) -> list[str]:
+    if chat:
+        return [render_messages(req.get("messages"))]
+    prompt = req.get("prompt")
+    if isinstance(prompt, str) and prompt:
+        return [prompt]
+    if (
+        isinstance(prompt, list)
+        and prompt
+        and all(isinstance(p, str) and p for p in prompt)
+    ):
+        if len(prompt) > MAX_PROMPTS:
+            raise APIError(400, f"at most {MAX_PROMPTS} prompts per request")
+        return prompt
+    raise APIError(400, "prompt must be a non-empty string or list of non-empty strings")
+
+
+def parse_sampling(req: dict, limit: int) -> tuple[int, dict]:
+    for key in _UNSUPPORTED:
+        if key not in req:
+            continue
+        # ignoring these would silently change semantics the caller asked
+        # for — but values that ask for nothing (None/False, empty
+        # containers like LiteLLM's tools: [], the default n=1) must pass.
+        # NB bool checks come first: True == 1 in Python, and logprobs:
+        # true must 400, not slip through an n-style ==1 comparison.
+        val = req.get(key)
+        asks_nothing = (
+            val is None
+            or val is False
+            or val == []
+            or val == {}
+            or (key == "n" and not isinstance(val, bool) and val == 1)
+        )
+        if not asks_nothing:
+            raise APIError(400, f"{key!r} is not supported")
+    try:
+        n_tokens = int(req.get("max_tokens", 16))
+        samp = {
+            "temperature": float(req.get("temperature", 1.0)),
+            "top_k": int(req.get("top_k", 0)),
+            "top_p": float(req.get("top_p", 1.0)),
+            "seed": int(req.get("seed", 0)),
+        }
+    except (TypeError, ValueError):
+        raise APIError(400, "max_tokens/temperature/top_k/top_p/seed must be numbers") from None
+    if not (1 <= n_tokens <= limit):
+        raise APIError(400, f"max_tokens must be in [1, {limit}]")
+    if not (0.0 <= samp["temperature"] <= 2.0):
+        raise APIError(400, "temperature must be in [0, 2]")
+    if not (0.0 < samp["top_p"] <= 1.0):
+        raise APIError(400, "top_p must be in (0, 1]")
+    if not (0 <= samp["top_k"] < 2**31) or not (0 <= samp["seed"] < 2**31):
+        raise APIError(400, "top_k/seed must be in [0, 2^31)")
+    return n_tokens, samp
+
+
+def parse_stop(req: dict) -> list[str]:
+    stop = req.get("stop")
+    if stop is None:
+        return []
+    if isinstance(stop, str):
+        stop = [stop]
+    if (
+        not isinstance(stop, list)
+        or len(stop) > 4
+        or not all(isinstance(s, str) and s for s in stop)
+    ):
+        raise APIError(400, "stop must be a string or a list of up to 4 non-empty strings")
+    return stop
+
+
+def apply_stop(text: str, stops: list[str]) -> tuple[str, str]:
+    """(truncated text, finish_reason): cut at the earliest stop match."""
+    cut = len(text)
+    for s in stops:
+        i = text.find(s)
+        if i >= 0:
+            cut = min(cut, i)
+    if cut < len(text):
+        return text[:cut], "stop"
+    return text, "length"
+
+
+def encode_prompt(tok, server, text: str) -> list[int]:
+    ids = tok.encode(text)
+    if not ids:
+        raise APIError(400, "prompt tokenized to zero tokens")
+    vocab = getattr(server.cfg, "vocab_size", 0) or 0
+    if vocab and (min(ids) < 0 or max(ids) >= vocab):
+        raise APIError(400, f"tokenizer produced ids outside the model vocab [0, {vocab})")
+    return ids
+
+
+def _envelope(obj_type: str, model: str) -> dict:
+    return {
+        "id": f"cmpl-{uuid.uuid4().hex[:24]}",
+        "object": obj_type,
+        "created": int(time.time()),
+        "model": model,
+    }
+
+
+def run_completion(sset, req: dict, chat: bool) -> dict:
+    """Non-streaming completions/chat: returns the OpenAI response body."""
+    server = resolve_model(sset, req)
+    tok = tokenizer_for(server)
+    prompts = parse_prompts(req, chat)
+    n_tokens, samp = parse_sampling(req, sset.max_new_tokens_limit)
+    stops = parse_stop(req)
+
+    batcher = sset.batcher_for(server)
+    engine = batcher if (batcher is not None and server.family.generate_ragged is not None) else server
+    server.stats["requests"] += 1
+    id_rows = [encode_prompt(tok, server, text) for text in prompts]
+
+    def _one(ids: list[int]) -> list[int]:
+        out = engine.generate(np.asarray([ids], np.int32), max_new_tokens=n_tokens, **samp)
+        return out[0, len(ids):].tolist()
+
+    if len(id_rows) > 1 and engine is not server:
+        # concurrent submissions ride the batcher's coalescing window and
+        # decode as ONE ragged device call instead of N sequential ones
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=len(id_rows)) as pool:
+            rows_out = list(pool.map(_one, id_rows))
+    else:
+        rows_out = [_one(ids) for ids in id_rows]
+
+    choices = []
+    prompt_tokens = completion_tokens = 0
+    for i, (ids, new_ids) in enumerate(zip(id_rows, rows_out)):
+        prompt_tokens += len(ids)
+        completion_tokens += len(new_ids)
+        text_out, finish = apply_stop(tok.decode(new_ids), stops)
+        if chat:
+            choices.append({
+                "index": i,
+                "message": {"role": "assistant", "content": text_out},
+                "finish_reason": finish,
+            })
+        else:
+            choices.append({"index": i, "text": text_out, "finish_reason": finish})
+
+    body = _envelope(OBJ_CHAT if chat else OBJ_COMPLETION, server.name)
+    body["choices"] = choices
+    body["usage"] = {
+        "prompt_tokens": prompt_tokens,
+        "completion_tokens": completion_tokens,
+        "total_tokens": prompt_tokens + completion_tokens,
+    }
+    return body
+
+
+def stream_completion(sset, req: dict, chat: bool) -> Iterator[dict]:
+    """SSE event bodies for stream=true (single prompt only). The first
+    ``next()`` performs all validation — callers pull one event before
+    committing a 200 so bad requests still fail with their real status."""
+    server = resolve_model(sset, req)
+    tok = tokenizer_for(server)
+    prompts = parse_prompts(req, chat)
+    if len(prompts) != 1:
+        raise APIError(400, "stream supports a single prompt")
+    n_tokens, samp = parse_sampling(req, sset.max_new_tokens_limit)
+    stops = parse_stop(req)
+    ids = encode_prompt(tok, server, prompts[0])
+    if server.family.decode_fns is None:
+        # fail before any SSE bytes hit the wire, not mid-stream
+        raise APIError(400, f"model family {server.family.name!r} does not support streaming")
+
+    server.stats["requests"] += 1
+    # a stop sequence can straddle decode chunks ("hello wo" + "rld"):
+    # hold back the longest prefix a stop could still complete, so no text
+    # past a stop match ever reaches the wire
+    reserve = max((len(s) for s in stops), default=1) - 1
+
+    def events() -> Iterator[dict]:
+        gen = server.generate_stream(
+            np.asarray([ids], np.int32), max_new_tokens=n_tokens, **samp
+        )
+        # prime generation BEFORE yielding anything: the transport commits
+        # its 200 after the first event, and a compile/decode failure must
+        # surface as a real status even for chat (whose first event is the
+        # role chunk, not decoded text)
+        first_piece = next(gen, None)
+        envelope = _envelope(OBJ_CHAT_CHUNK if chat else OBJ_COMPLETION, server.name)
+
+        def content_event(delta: str) -> dict:
+            choice = (
+                {"index": 0, "delta": {"content": delta}, "finish_reason": None}
+                if chat
+                else {"index": 0, "text": delta, "finish_reason": None}
+            )
+            return {**envelope, "choices": [choice]}
+
+        if chat:  # role announcement chunk (OpenAI contract)
+            yield {
+                **envelope,
+                "choices": [{"index": 0, "delta": {"role": "assistant"}, "finish_reason": None}],
+            }
+        sent = ""
+        text = ""
+        new_ids: list[int] = []
+        finish = "length"
+        pieces = gen if first_piece is None else itertools.chain((first_piece,), gen)
+        for piece in pieces:
+            new_ids.extend(piece[0].tolist())
+            # decode the FULL generated prefix each chunk and emit the tail:
+            # per-chunk decode would split multi-token glyphs at chunk edges
+            text = tok.decode(new_ids)
+            cut, finish_now = apply_stop(text, stops)
+            if finish_now == "stop":
+                if cut[len(sent):]:
+                    yield content_event(cut[len(sent):])
+                sent, finish = cut, "stop"
+                break
+            if not cut.startswith(sent):
+                # an emitted prefix changed on re-decode (an incomplete glyph
+                # slipped out); bytes on the wire can't be retracted — hold
+                # everything until the decode re-extends what was sent
+                continue
+            # trailing U+FFFD means the last glyph's bytes are still split
+            # across tokens: provisional, the next chunk may resolve it
+            stable = len(cut)
+            while stable > len(sent) and cut[stable - 1] == "�":
+                stable -= 1
+            safe = max(len(sent), min(len(cut) - reserve, stable))
+            if cut[len(sent):safe]:
+                yield content_event(cut[len(sent):safe])
+                sent = cut[:safe]
+        if finish != "stop" and text.startswith(sent) and text[len(sent):]:
+            yield content_event(text[len(sent):])  # flush the held-back tail
+        yield {
+            **envelope,
+            "choices": [
+                {"index": 0, "delta": {}, "finish_reason": finish}
+                if chat
+                else {"index": 0, "text": "", "finish_reason": finish}
+            ],
+        }
+
+    return events()
+
+
+def models_payload(sset) -> dict:
+    """GET /v1/models body serving BOTH contracts: the sidecar's native
+    {default, models} keys and OpenAI's {object: "list", data: [...]}."""
+    return {
+        "default": sset.default,
+        "models": {n: {"ready": s.ready, **s.stats} for n, s in sset.servers.items()},
+        "object": "list",
+        "data": [
+            {"id": n, "object": "model", "created": 0, "owned_by": "modelx-tpu"}
+            for n in sset.servers
+        ],
+    }
+
+
+def sse_encode(event: dict) -> bytes:
+    return b"data: " + json.dumps(event).encode() + b"\n\n"
+
+
+SSE_DONE = b"data: [DONE]\n\n"

@@ -660,15 +660,39 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
             self.end_headers()
             self.wfile.write(body)
 
-        def _stream_generate(self, server, tokens, n, samp) -> None:
-            """Chunked transfer encoding, one NDJSON line of NEW tokens per
-            decoded chunk, then {"done": true}. Decode errors after the 200
-            terminate the chunk stream with an {"error": ...} line — the
-            status is already on the wire."""
+        def _stream_chunks(self, content_type: str, payloads, error_payload) -> None:
+            """Commit a 200 + chunked transfer encoding and write each bytes
+            payload. A mid-stream error (status already on the wire) writes
+            ``error_payload(e)``; the terminator always goes out. Shared by
+            the NDJSON token stream and the OpenAI SSE stream."""
+            self.send_response(200)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
             def write_chunk(payload: bytes) -> None:
                 self.wfile.write(f"{len(payload):x}\r\n".encode())
                 self.wfile.write(payload + b"\r\n")
 
+            try:
+                for payload in payloads:
+                    write_chunk(payload)
+            except Exception as e:
+                logger.exception("stream error")
+                try:
+                    write_chunk(error_payload(e))
+                except OSError:
+                    pass  # client went away
+            finally:
+                try:
+                    self.wfile.write(b"0\r\n\r\n")  # chunked terminator
+                except OSError:
+                    pass
+
+        def _stream_generate(self, server, tokens, n, samp) -> None:
+            """One NDJSON line of NEW tokens per decoded chunk, then
+            {"done": true}; concatenates to the non-streaming result."""
             gen = server.generate_stream(tokens, max_new_tokens=n, **samp)
             try:
                 # pull the first chunk BEFORE committing a 200: an
@@ -677,27 +701,56 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
             except ValueError as e:
                 return self._json(400, {"error": str(e)})
 
-            self.send_response(200)
-            self.send_header("Content-Type", "application/x-ndjson")
-            self.send_header("Transfer-Encoding", "chunked")
-            self.end_headers()
-            try:
+            def payloads():
                 if first is not None:
-                    write_chunk(json.dumps({"tokens": first.tolist()}).encode() + b"\n")
+                    yield json.dumps({"tokens": first.tolist()}).encode() + b"\n"
                     for piece in gen:
-                        write_chunk(json.dumps({"tokens": piece.tolist()}).encode() + b"\n")
-                write_chunk(b'{"done": true}\n')
+                        yield json.dumps({"tokens": piece.tolist()}).encode() + b"\n"
+                yield b'{"done": true}\n'
+
+            self._stream_chunks(
+                "application/x-ndjson", payloads(),
+                lambda e: json.dumps({"error": str(e)}).encode() + b"\n",
+            )
+
+        def _openai(self, req: dict, chat: bool) -> None:
+            """/v1/completions + /v1/chat/completions (openai_api.py). SSE
+            for stream=true; errors use the OpenAI {"error": {...}} shape."""
+            from modelx_tpu.dl import openai_api as oai
+
+            try:
+                if bool(req.get("stream", False)):
+                    events = oai.stream_completion(sset, req, chat)
+                    try:
+                        # validation + compile errors must surface as a real
+                        # status, so pull the first event before the 200
+                        # (stream_completion primes generation before its
+                        # first yield, chat role chunk included)
+                        first = next(events, None)
+                    except ValueError as e:
+                        raise oai.APIError(400, str(e)) from e
+
+                    def payloads():
+                        if first is not None:
+                            yield oai.sse_encode(first)
+                            for ev in events:
+                                yield oai.sse_encode(ev)
+                        yield oai.SSE_DONE
+
+                    return self._stream_chunks(
+                        "text/event-stream", payloads(),
+                        lambda e: oai.sse_encode(
+                            {"error": {"message": str(e), "type": "server_error"}}
+                        ),
+                    )
+                return self._json(200, oai.run_completion(sset, req, chat))
+            except oai.APIError as e:
+                return self._json(e.status, e.payload)
+            except ValueError as e:
+                return self._json(400, oai.APIError(400, str(e)).payload)
             except Exception as e:
-                logger.exception("stream error")
-                try:
-                    write_chunk(json.dumps({"error": str(e)}).encode() + b"\n")
-                except OSError:
-                    pass  # client went away
-            finally:
-                try:
-                    self.wfile.write(b"0\r\n\r\n")  # chunked terminator
-                except OSError:
-                    pass
+                logger.exception("openai api error")
+                return self._json(500, oai.APIError(500, str(e), "server_error").payload)
 
         def do_GET(self):
             if self.path == "/healthz":
@@ -708,12 +761,11 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
             elif self.path == "/metrics":
                 self._json(200, {n: dict(s.stats) for n, s in sset.servers.items()})
             elif self.path == "/v1/models":
-                self._json(200, {
-                    "default": sset.default,
-                    "models": {
-                        n: {"ready": s.ready, **s.stats} for n, s in sset.servers.items()
-                    },
-                })
+                from modelx_tpu.dl import openai_api as oai
+
+                # one body, two contracts: the native {default, models} keys
+                # plus OpenAI's {object: "list", data: [...]}
+                self._json(200, oai.models_payload(sset))
             elif self.path == "/v1/trace":
                 self._json(200, trace.tracer().summary())
             else:
@@ -749,6 +801,9 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                 finally:
                     sset._profiling.release()
                 return self._json(200, {"trace_dir": sset.trace_dir})
+
+            if self.path in ("/v1/completions", "/v1/chat/completions"):
+                return self._openai(req, chat=self.path.endswith("chat/completions"))
 
             server, verb = sset.resolve(self.path)
             if server is None:

@@ -1,0 +1,305 @@
+"""OpenAI-compatible surface (dl/openai_api.py): /v1/completions,
+/v1/chat/completions (+SSE streaming), OpenAI-shape /v1/models — so stock
+OpenAI SDK clients can point at the sidecar unchanged."""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+import requests
+
+import jax
+import jax.numpy as jnp
+
+from modelx_tpu.dl.openai_api import apply_stop, render_messages, APIError
+from modelx_tpu.dl.serve import ModelServer, ServerSet, serve
+from modelx_tpu.registry.server import free_port
+
+
+@pytest.fixture(scope="module")
+def front(tmp_path_factory):
+    """Tiny llama with a word-level tokenizer.json, served over HTTP."""
+    tokenizers = pytest.importorskip("tokenizers")
+    from modelx_tpu.dl import safetensors as st
+    from modelx_tpu.models import llama
+
+    d = tmp_path_factory.mktemp("oai")
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64), dtype=jnp.float32)
+    st.write_safetensors(
+        str(d / "model.safetensors"),
+        {k: np.asarray(v) for k, v in llama.init_params(cfg, jax.random.PRNGKey(0)).items()},
+    )
+    vocab = {"<unk>": 0, "hello": 1, "world": 2, "tpu": 3}
+    vocab.update({f"w{i}": i for i in range(4, 64)})
+    tok = tokenizers.Tokenizer(tokenizers.models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = tokenizers.pre_tokenizers.Whitespace()
+    tok.save(str(d / "tokenizer.json"))
+    server = ModelServer(str(d), mesh_spec="dp=1", dtype="float32", name="m")
+    sset = ServerSet({"m": server})
+    base = f"http://127.0.0.1:{free_port()}"
+    httpd = serve(sset, listen=base.rsplit("//", 1)[1])
+    sset.load_all()
+    yield base, server
+    httpd.shutdown()
+
+
+class TestCompletions:
+    def test_completion_roundtrip_and_usage(self, front):
+        base, server = front
+        r = requests.post(base + "/v1/completions",
+                          json={"prompt": "hello world tpu", "max_tokens": 4,
+                                "temperature": 0})
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["object"] == "text_completion"
+        assert body["model"] == "m"
+        assert body["id"].startswith("cmpl-")
+        (choice,) = body["choices"]
+        assert choice["finish_reason"] == "length"
+        assert body["usage"] == {"prompt_tokens": 3, "completion_tokens": 4,
+                                 "total_tokens": 7}
+        # text equals decoding a direct token-id generate of the same prompt
+        ids = server.tokenizer().encode("hello world tpu")
+        out = server.generate(np.asarray([ids], np.int32), max_new_tokens=4)
+        assert choice["text"] == server.tokenizer().decode(out[0, 3:].tolist())
+
+    def test_batch_prompts_get_indexed_choices(self, front):
+        base, _ = front
+        r = requests.post(base + "/v1/completions",
+                          json={"prompt": ["hello world", "tpu hello"],
+                                "max_tokens": 2, "temperature": 0})
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert [c["index"] for c in body["choices"]] == [0, 1]
+        assert body["usage"]["prompt_tokens"] == 4
+        assert body["usage"]["completion_tokens"] == 4
+
+    def test_batch_prompts_through_dynamic_batcher(self, front):
+        """List prompts coalesce into one ragged decode via the batcher and
+        match the unbatched engine's rows exactly."""
+        _, server = front
+        plain = ServerSet({"m": server})
+        batched = ServerSet({"m": server}, dynamic_batch=True)
+        base_p = f"http://127.0.0.1:{free_port()}"
+        base_b = f"http://127.0.0.1:{free_port()}"
+        h1 = serve(plain, listen=base_p.rsplit("//", 1)[1])
+        h2 = serve(batched, listen=base_b.rsplit("//", 1)[1])
+        try:
+            req = {"prompt": ["hello world", "tpu hello world w9"],
+                   "max_tokens": 3, "temperature": 0}
+            a = requests.post(base_p + "/v1/completions", json=req).json()
+            b = requests.post(base_b + "/v1/completions", json=req).json()
+            assert [c["text"] for c in a["choices"]] == [c["text"] for c in b["choices"]]
+            assert a["usage"] == b["usage"]
+        finally:
+            h1.shutdown()
+            h2.shutdown()
+            for batcher in batched.batchers.values():
+                batcher.close()
+
+    def test_default_model_and_explicit_model(self, front):
+        base, _ = front
+        for req in ({"prompt": "hello"}, {"prompt": "hello", "model": "m"}):
+            r = requests.post(base + "/v1/completions", json={**req, "max_tokens": 1})
+            assert r.status_code == 200, r.text
+        r = requests.post(base + "/v1/completions",
+                          json={"prompt": "hello", "model": "nope"})
+        assert r.status_code == 404
+        assert r.json()["error"]["type"] == "not_found_error"
+
+    def test_validation_errors_are_openai_shaped(self, front):
+        base, _ = front
+        cases = [
+            {"prompt": ""},
+            {"prompt": 7},
+            {"prompt": "hello", "max_tokens": 0},
+            {"prompt": "hello", "temperature": 3.0},
+            {"prompt": "hello", "top_p": 0.0},
+            {"prompt": "hello", "stop": ["a", "b", "c", "d", "e"]},
+            {"prompt": "hello", "n": 3},
+            {"prompt": "hello", "logprobs": 5},
+            {"prompt": "hello", "logprobs": True},  # True == 1 must not slip
+            {"prompt": ["hello"] * 33},  # prompt-list cap
+        ]
+        for req in cases:
+            r = requests.post(base + "/v1/completions", json=req)
+            assert r.status_code == 400, req
+            err = r.json()["error"]
+            assert err["type"] == "invalid_request_error" and err["message"], req
+
+    def test_stop_sequence_truncates(self, front):
+        base, server = front
+        # find what greedy decoding emits, then use its first word as stop
+        tok = server.tokenizer()
+        ids = tok.encode("hello world tpu")
+        out = server.generate(np.asarray([ids], np.int32), max_new_tokens=4)
+        first_word = tok.decode(out[0, 3:4].tolist())
+        r = requests.post(base + "/v1/completions",
+                          json={"prompt": "hello world tpu", "max_tokens": 4,
+                                "temperature": 0, "stop": [first_word]})
+        assert r.status_code == 200, r.text
+        (choice,) = r.json()["choices"]
+        assert choice["finish_reason"] == "stop"
+        assert first_word not in choice["text"]
+
+    def test_models_serves_both_contracts(self, front):
+        base, _ = front
+        body = requests.get(base + "/v1/models").json()
+        assert body["object"] == "list"
+        assert [m["id"] for m in body["data"]] == ["m"]
+        assert body["default"] == "m" and body["models"]["m"]["ready"]
+
+
+class TestChat:
+    def test_chat_roundtrip(self, front):
+        base, _ = front
+        r = requests.post(base + "/v1/chat/completions",
+                          json={"messages": [
+                                    {"role": "system", "content": "hello"},
+                                    {"role": "user", "content": "world tpu"},
+                                ],
+                                "max_tokens": 3, "temperature": 0})
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["object"] == "chat.completion"
+        (choice,) = body["choices"]
+        assert choice["message"]["role"] == "assistant"
+        assert isinstance(choice["message"]["content"], str)
+        assert choice["finish_reason"] == "length"
+
+    def test_message_validation(self, front):
+        base, _ = front
+        for messages in ([], [{"role": "alien", "content": "x"}],
+                         [{"role": "user"}], "hi"):
+            r = requests.post(base + "/v1/chat/completions",
+                              json={"messages": messages})
+            assert r.status_code == 400, messages
+
+    def test_render_template_is_stable(self):
+        text = render_messages([
+            {"role": "system", "content": "s"},
+            {"role": "user", "content": "u"},
+        ])
+        assert text == "<|system|>\ns\n<|user|>\nu\n<|assistant|>\n"
+
+
+class TestStreaming:
+    def _events(self, resp):
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        raw = resp.content.decode()
+        assert raw.endswith("data: [DONE]\n\n")
+        return [json.loads(line[len("data: "):])
+                for line in raw.split("\n\n")
+                if line.startswith("data: ") and line != "data: [DONE]"]
+
+    def test_stream_concatenates_to_nonstreamed(self, front):
+        base, _ = front
+        req = {"prompt": "hello world tpu", "max_tokens": 6, "temperature": 0}
+        plain = requests.post(base + "/v1/completions", json=req).json()
+        r = requests.post(base + "/v1/completions", json={**req, "stream": True})
+        assert r.status_code == 200, r.text
+        events = self._events(r)
+        text = "".join(c["text"] for e in events for c in e["choices"])
+        assert text == plain["choices"][0]["text"]
+        assert events[-1]["choices"][0]["finish_reason"] == "length"
+
+    def test_chat_stream_role_then_deltas(self, front):
+        base, _ = front
+        r = requests.post(base + "/v1/chat/completions",
+                          json={"messages": [{"role": "user", "content": "hello world"}],
+                                "max_tokens": 4, "temperature": 0, "stream": True})
+        assert r.status_code == 200, r.text
+        events = self._events(r)
+        assert events[0]["object"] == "chat.completion.chunk"
+        assert events[0]["choices"][0]["delta"] == {"role": "assistant"}
+        assert events[-1]["choices"][0]["finish_reason"] in ("length", "stop")
+        content = "".join(e["choices"][0]["delta"].get("content", "")
+                          for e in events[1:])
+        assert isinstance(content, str)
+
+    def test_stream_validation_is_pre_status(self, front):
+        base, _ = front
+        r = requests.post(base + "/v1/completions",
+                          json={"prompt": "", "stream": True})
+        assert r.status_code == 400
+        r = requests.post(base + "/v1/completions",
+                          json={"prompt": ["a", "b"], "stream": True})
+        assert r.status_code == 400  # stream supports a single prompt
+
+
+class TestStopStraddle:
+    """A stop sequence split across decode chunks must never leak text past
+    the match into the stream (stream == non-stream contract)."""
+
+    def _fake_sset(self, pieces):
+        from types import SimpleNamespace
+
+        class Tok:
+            def encode(self, text):
+                return [1, 2]
+
+            def decode(self, ids):
+                return " ".join(f"w{i}" for i in ids)
+
+        server = SimpleNamespace(
+            name="f", ready=True,
+            cfg=SimpleNamespace(vocab_size=100),
+            family=SimpleNamespace(decode_fns=object(), name="fake",
+                                   generate_ragged=None),
+            stats={"requests": 0},
+            tokenizer=lambda: Tok(),
+            generate_stream=lambda tokens, max_new_tokens, **samp: (
+                np.asarray(p) for p in pieces
+            ),
+        )
+        return SimpleNamespace(servers={"f": server}, default="f",
+                               max_new_tokens_limit=64,
+                               batcher_for=lambda s: None)
+
+    def _stream_text(self, sset, stop):
+        from modelx_tpu.dl.openai_api import stream_completion
+
+        events = list(stream_completion(
+            sset, {"prompt": "x", "max_tokens": 8, "stop": stop}, chat=False))
+        assert events[-1]["choices"][0]["finish_reason"] == (
+            "stop" if stop else "length")
+        return "".join(c["text"] for e in events for c in e["choices"])
+
+    def test_stop_spanning_two_chunks_emits_nothing_past_it(self):
+        # chunks decode to "w5", then "w5 w6": stop "w5 w6" spans both
+        sset = self._fake_sset([[[5]], [[6]], [[7]]])
+        assert self._stream_text(sset, ["w5 w6"]) == ""
+
+    def test_partial_stop_prefix_held_back_then_cut(self):
+        sset = self._fake_sset([[[5]], [[6]], [[7]]])
+        # stop "w6" first completes in chunk 2; text before it all emits
+        assert self._stream_text(sset, ["w6"]) == "w5 "
+
+    def test_no_stop_flushes_everything(self):
+        sset = self._fake_sset([[[5]], [[6]]])
+        assert self._stream_text(sset, []) == "w5 w6"
+
+    def test_incomplete_glyph_held_back_until_resolved(self):
+        """Byte-level BPE can split one glyph across chunks: the interim
+        decode ends in U+FFFD, which must stay off the wire until the next
+        chunk resolves it — streamed text equals the final decode."""
+        decodes = {(5,): "a�", (5, 6): "aé", (5, 6, 7): "aéb"}
+        sset = self._fake_sset([[[5]], [[6]], [[7]]])
+        tok = sset.servers["f"].tokenizer()
+        tok.decode = lambda ids: decodes[tuple(ids)]
+        sset.servers["f"].tokenizer = lambda: tok
+        assert self._stream_text(sset, []) == "aéb"
+
+
+class TestHelpers:
+    def test_apply_stop(self):
+        assert apply_stop("a b c", ["b"]) == ("a ", "stop")
+        assert apply_stop("a b c", ["z"]) == ("a b c", "length")
+        assert apply_stop("a b c", ["c", "b"]) == ("a ", "stop")
+        assert apply_stop("abc", []) == ("abc", "length")
+
+    def test_api_error_payload_shape(self):
+        e = APIError(400, "nope")
+        assert e.payload["error"]["message"] == "nope"
+        assert e.payload["error"]["type"] == "invalid_request_error"
