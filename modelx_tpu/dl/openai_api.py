@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import time
 import uuid
 from typing import Iterator
@@ -126,11 +127,19 @@ def parse_sampling(req: dict, limit: int) -> tuple[int, dict]:
             raise APIError(400, f"{key!r} is not supported")
     try:
         n_tokens = int(req.get("max_tokens", 16))
+        if "seed" in req:
+            seed = int(req["seed"])
+        else:
+            # OpenAI semantics: no seed means nondeterministic — two
+            # identical requests must not return byte-identical samples
+            # (the default temperature here is 1.0, not the native API's
+            # greedy 0), so derive a fresh per-request seed
+            seed = int.from_bytes(os.urandom(4), "big") >> 1
         samp = {
             "temperature": float(req.get("temperature", 1.0)),
             "top_k": int(req.get("top_k", 0)),
             "top_p": float(req.get("top_p", 1.0)),
-            "seed": int(req.get("seed", 0)),
+            "seed": seed,
         }
     except (TypeError, ValueError):
         raise APIError(400, "max_tokens/temperature/top_k/top_p/seed must be numbers") from None

@@ -103,10 +103,16 @@ class ModelServer:
         mesh=None,
         name: str = "default",
         quantize: str | None = None,
+        speculative_k: int = 0,
     ) -> None:
         self.name = name
         self.model_dir = model_dir
         self.quantize = quantize
+        # > 0 turns on prompt-lookup speculative decoding for single-row
+        # greedy requests (models/speculative.py): token-exact, fewer
+        # device steps on self-repeating continuations
+        self.speculative_k = int(speculative_k)
+        self._spec_decoder = None
         self.mesh = mesh if mesh is not None else (
             make_mesh(mesh_spec) if mesh_spec else make_mesh(f"dp={len(jax.devices())}")
         )
@@ -252,6 +258,26 @@ class ModelServer:
                 )
             self.stats["tokens_generated"] += int(b * max_new_tokens)
             return np.concatenate([np.asarray(tokens, np.int32), gen], axis=1)
+        tokens = np.asarray(tokens, np.int32)
+        if (
+            self.speculative_k > 0
+            and tokens.shape[0] == 1
+            and self.family.decode_fns is not None
+        ):
+            with trace.span("serve.generate_spec", model=self.name,
+                            new_tokens=max_new_tokens):
+                dec = self._speculative_decoder()
+                new, stats = dec.generate(self.params, tokens[0].tolist(), max_new_tokens)
+                self.stats["tokens_generated"] += len(new)
+                self.stats["spec_device_steps"] = (
+                    self.stats.get("spec_device_steps", 0) + stats["device_steps"]
+                )
+                self.stats["spec_accepted"] = (
+                    self.stats.get("spec_accepted", 0) + stats["accepted"]
+                )
+                return np.concatenate(
+                    [tokens, np.asarray([new], np.int32)], axis=1
+                )
         with trace.span("serve.generate", model=self.name, new_tokens=max_new_tokens):
             out = self.family.generate(
                 self.params, jnp.asarray(tokens, jnp.int32), self.cfg,
@@ -259,6 +285,16 @@ class ModelServer:
             )
             self.stats["tokens_generated"] += int(out.shape[0] * max_new_tokens)
             return np.asarray(out)
+
+    def _speculative_decoder(self):
+        if self._spec_decoder is None:
+            with self._decoders_lock:  # double-checked, like the stream decoders
+                if self._spec_decoder is None:
+                    from modelx_tpu.models.speculative import SpeculativeDecoder
+
+                    fwd, init = self.family.decode_fns(self.cfg, mesh=self.mesh)
+                    self._spec_decoder = SpeculativeDecoder(fwd, init, k=self.speculative_k)
+        return self._spec_decoder
 
     def tokenizer(self):
         """The model's tokenizer (``tokenizer.json`` pulled alongside the

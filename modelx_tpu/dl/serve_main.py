@@ -33,9 +33,13 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
               help="coalesce concurrent forward requests into one device call")
 @click.option("--quantize", type=click.Choice(["int8"]), default=None,
               help="weight-only int8: half the HBM/transfer bytes for the big matmuls")
+@click.option("--speculative-k", default=0, type=int,
+              help="prompt-lookup speculative decoding for single-row greedy "
+                   "requests: verify up to K proposed tokens per device step "
+                   "(token-exact; 0 = off)")
 def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen: str,
          max_seq_len: int, compile_cache: bool, concurrent_load: bool, trace_dir: str,
-         dynamic_batch: bool, quantize: str | None) -> None:
+         dynamic_batch: bool, quantize: str | None, speculative_k: int) -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     from modelx_tpu.parallel.distributed import initialize
 
@@ -62,7 +66,8 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
     shared_mesh = make_mesh(mesh) if mesh else make_mesh(f"dp={len(jax.devices())}")
     servers = {
         name: ModelServer(path, dtype=dtype, max_seq_len=max_seq_len,
-                          name=name, mesh=shared_mesh, quantize=quantize)
+                          name=name, mesh=shared_mesh, quantize=quantize,
+                          speculative_k=speculative_k)
         for name, path in entries.items()
     }
     sset = ServerSet(servers, trace_dir=trace_dir, dynamic_batch=dynamic_batch)

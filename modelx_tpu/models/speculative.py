@@ -1,0 +1,152 @@
+"""Prompt-lookup speculative decoding: exact greedy decode, fewer device calls.
+
+Draft-model-free speculation (the "prompt lookup" / n-gram family of
+techniques): the continuation after the latest earlier occurrence of the
+sequence's trailing n-gram is proposed, and ONE cached forward over
+``[1, k+1]`` tokens verifies the whole proposal. Greedy acceptance keeps the
+output token-for-token IDENTICAL to plain greedy decode — speculation can
+only change how many device round-trips it takes, never what comes back.
+
+TPU shape discipline: every verify step runs the same compiled program
+(static ``[1, k+1]`` block, proposals padded), because each distinct shape
+would cost a fresh XLA compile. Decode is HBM-bound — reading the weights
+dominates — so verifying k+1 positions costs roughly one plain step, and
+each step emits ``accepted + 1`` tokens (the bonus token is the model's own
+next-token pick at the first rejected position, free with the same logits).
+
+Rejected positions leave garbage KV entries in the cache; the next step's
+offset rewinds to the accepted end, so those slots are overwritten before
+the causal mask (keys <= query offset) ever exposes them.
+
+Reference parity: none — the reference has no inference path at all; this
+extends the serving sidecar the same way ring attention does (beyond-parity
+TPU capability).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def ngram_propose(
+    ids, k: int, max_ngram: int = 3, min_ngram: int = 1
+) -> list[int]:
+    """Up to ``k`` proposed continuation tokens for the sequence ``ids``:
+    the tokens that followed the LATEST earlier occurrence of the longest
+    matching trailing n-gram. Empty when nothing matches (caller falls back
+    to an unspeculated step)."""
+    ids = list(ids)
+    L = len(ids)
+    for n in range(max_ngram, min_ngram - 1, -1):
+        if L < n + 1:
+            continue
+        tail = ids[L - n:]
+        # latest occurrence wins: recent context predicts the near future
+        # better than the distant past
+        for start in range(L - n - 1, -1, -1):
+            if ids[start:start + n] == tail:
+                cont = ids[start + n:start + n + k]
+                if cont:
+                    return cont
+    return []
+
+
+class SpeculativeDecoder:
+    """Greedy decode for a single row with n-gram speculation.
+
+    ``forward``/``init_kv_cache`` are the family decode fns
+    (dl/families.py), same seam ChunkedDecoder uses. ``generate`` returns
+    (new_tokens, stats) where stats counts device steps, proposed and
+    accepted tokens — the accept rate is the whole value proposition, so
+    it is always measured.
+    """
+
+    def __init__(self, forward, init_kv_cache, k: int = 8, max_ngram: int = 3) -> None:
+        self.forward = forward
+        self.init_kv_cache = init_kv_cache
+        self.k = int(k)
+        self.max_ngram = int(max_ngram)
+        self._prefill = jax.jit(self._prefill_impl, donate_argnums=(2,))
+        self._verify = jax.jit(self._verify_impl, donate_argnums=(2,))
+
+    def _prefill_impl(self, params, prompt, cache):
+        logits, cache = self.forward(params, prompt, kv_cache=cache, cache_offset=0)
+        return cache, jnp.argmax(logits[:, -1, :], axis=-1)  # [1]
+
+    def _verify_impl(self, params, block, cache, offset):
+        """block: [1, k+1] = last accepted token + padded proposals. Returns
+        the model's argmax at every position — position i is its pick for
+        the token AFTER block[:i+1]."""
+        logits, cache = self.forward(params, block, kv_cache=cache, cache_offset=offset)
+        return cache, jnp.argmax(logits[0], axis=-1)  # [k+1]
+
+    def generate(
+        self, params, prompt_ids, max_new_tokens: int
+    ) -> tuple[list[int], dict]:
+        """Greedy-decode ``max_new_tokens`` tokens after ``prompt_ids``
+        (a 1-D int sequence). Token-exact vs plain greedy decode."""
+        prompt_ids = [int(t) for t in prompt_ids]
+        stats = {"device_steps": 0, "proposed": 0, "accepted": 0}
+        if max_new_tokens <= 0:
+            return [], stats
+        s = len(prompt_ids)
+        # + k+1 slack: a verify block near the budget may write past it.
+        # Cache length rounds up to a power of two: every distinct cache
+        # shape compiles a fresh program pair, and a client cycling
+        # max_new_tokens must not be able to force hundreds of compiles
+        # (same guard as ChunkedDecoder.stream / the batcher's buckets)
+        need = s + max_new_tokens + self.k + 1
+        cache_len = 1 << (need - 1).bit_length()
+        cache = self.init_kv_cache(1, cache_len)
+        prompt = jnp.asarray([prompt_ids], jnp.int32)
+        cache, first = self._prefill(params, prompt, cache)
+        stats["device_steps"] += 1
+        out = [int(first[0])]
+        seq = prompt_ids + out
+        offset = s  # cache holds [0, offset) verified positions
+        while len(out) < max_new_tokens:
+            prop = ngram_propose(seq, self.k, self.max_ngram)
+            stats["proposed"] += len(prop)
+            block = np.zeros((1, self.k + 1), np.int32)  # static shape
+            block[0, 0] = seq[-1]
+            if prop:
+                block[0, 1:1 + len(prop)] = prop
+            cache, argm = self._verify(
+                params, jnp.asarray(block), cache, jnp.int32(offset)
+            )
+            stats["device_steps"] += 1
+            argm = np.asarray(argm)
+            # accept while the model agrees with the proposal, then take the
+            # model's own token at the first disagreement (always correct)
+            a = 0
+            while a < len(prop) and int(argm[a]) == prop[a]:
+                a += 1
+            stats["accepted"] += a
+            new = prop[:a] + [int(argm[a])]
+            new = new[: max_new_tokens - len(out)]
+            out.extend(new)
+            seq.extend(new)
+            # rewind past any rejected/padded cache garbage: only the block
+            # tokens that produced accepted output are verified history
+            offset += a + 1
+        return out, stats
+
+
+def speculative_generate(
+    forward, init_kv_cache, params, prompt, max_new_tokens: int = 16,
+    k: int = 8, max_ngram: int = 3,
+) -> tuple[np.ndarray, dict]:
+    """One-shot convenience over SpeculativeDecoder (prompt: [1, S]).
+    Returns ([1, S + max_new_tokens] prompt+generated, stats) — the same
+    row contract as decode.greedy_generate."""
+    prompt = np.asarray(prompt)
+    if prompt.ndim != 2 or prompt.shape[0] != 1:
+        raise ValueError("speculative decode is single-row: prompt must be [1, S]")
+    dec = SpeculativeDecoder(forward, init_kv_cache, k=k, max_ngram=max_ngram)
+    new, stats = dec.generate(params, prompt[0].tolist(), max_new_tokens)
+    full = np.concatenate([prompt[0], np.asarray(new, prompt.dtype)])[None, :]
+    return full, stats

@@ -303,3 +303,14 @@ class TestHelpers:
         e = APIError(400, "nope")
         assert e.payload["error"]["message"] == "nope"
         assert e.payload["error"]["type"] == "invalid_request_error"
+
+    def test_seed_random_when_absent_fixed_when_given(self):
+        """OpenAI semantics: no seed = nondeterministic; explicit seed pins
+        the sample stream."""
+        from modelx_tpu.dl.openai_api import parse_sampling
+
+        _, a = parse_sampling({}, 1024)
+        _, b = parse_sampling({}, 1024)
+        assert a["seed"] != b["seed"]  # 2^-31 collision odds
+        _, c = parse_sampling({"seed": 7}, 1024)
+        assert c["seed"] == 7
