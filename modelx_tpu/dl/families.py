@@ -219,20 +219,32 @@ def infer_gpt2_config(params: dict):
 def _gpt2_forward(params, tokens, cfg, mesh=None):
     from modelx_tpu.models import gpt2
 
-    return gpt2.forward(params, tokens, cfg)
+    return gpt2.forward(params, tokens, cfg)[0]
 
 
 def _gpt2_generate(params, tokens, cfg, mesh=None, max_new_tokens=16):
-    import jax.numpy as jnp
-
     from modelx_tpu.models import gpt2
 
-    out = tokens
-    for _ in range(max_new_tokens):
-        logits = gpt2.forward(params, out, cfg)
-        nxt = jnp.argmax(logits[:, -1:, :], axis=-1).astype(out.dtype)
-        out = jnp.concatenate([out, nxt], axis=1)
-    return out
+    return gpt2.greedy_generate(params, tokens, cfg, max_new_tokens=max_new_tokens, mesh=mesh)
+
+
+def _gpt2_generate_ragged(params, tokens, row_lens, cfg, mesh=None,
+                          max_new_tokens=16, **sampling):
+    from modelx_tpu.models import gpt2
+
+    return gpt2.ragged_greedy_generate(
+        params, tokens, row_lens, cfg, max_new_tokens=max_new_tokens, mesh=mesh,
+        **sampling,
+    )
+
+
+def _gpt2_decode_fns(cfg, mesh=None):
+    from modelx_tpu.models import gpt2
+
+    def fwd(p, t, kv_cache, cache_offset, mesh=mesh):
+        return gpt2.forward(p, t, cfg, kv_cache=kv_cache, cache_offset=cache_offset)
+
+    return fwd, (lambda b, max_len: gpt2.init_kv_cache(cfg, b, max_len))
 
 
 # -- bert ---------------------------------------------------------------------
@@ -272,7 +284,8 @@ FAMILIES: dict[str, Family] = {
                     _llama_generate, _llama_generate_ragged, _llama_decode_fns),
     "mixtral": Family("mixtral", MIXTRAL_RULES, infer_mixtral_config, _mixtral_forward,
                       _mixtral_generate, _mixtral_generate_ragged, _mixtral_decode_fns),
-    "gpt2": Family("gpt2", GPT2_RULES, infer_gpt2_config, _gpt2_forward, _gpt2_generate),
+    "gpt2": Family("gpt2", GPT2_RULES, infer_gpt2_config, _gpt2_forward,
+                   _gpt2_generate, _gpt2_generate_ragged, _gpt2_decode_fns),
     "bert": Family("bert", BERT_RULES, infer_bert_config, _bert_forward, None),
 }
 

@@ -210,6 +210,15 @@ def run_completion(sset, req: dict, chat: bool) -> dict:
 
     batcher = sset.batcher_for(server)
     engine = batcher if (batcher is not None and server.family.generate_ragged is not None) else server
+    if (
+        server.speculative_k > 0
+        and len(prompts) == 1
+        and samp["temperature"] == 0.0
+        and server.family.decode_fns is not None
+    ):
+        # single greedy prompt is speculation's exact target; routing it
+        # through the batcher would leave --speculative-k silently inert
+        engine = server
     server.stats["requests"] += 1
     id_rows = [encode_prompt(tok, server, text) for text in prompts]
 

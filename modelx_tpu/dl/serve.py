@@ -940,7 +940,17 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                     if bool(req.get("stream", False)):
                         return self._stream_generate(server, tokens, n, samp)
                     batcher = sset.batcher_for(server)
-                    if batcher is not None and server.family.generate_ragged is not None:
+                    speculates = (
+                        server.speculative_k > 0
+                        and tokens.shape[0] == 1
+                        and samp["temperature"] == 0.0
+                        and server.family.decode_fns is not None
+                    )
+                    if speculates:
+                        # --speculative-k targets exactly this request shape;
+                        # it must not be silently inert under --dynamic-batch
+                        out = server.generate(tokens, max_new_tokens=n, **samp)
+                    elif batcher is not None and server.family.generate_ragged is not None:
                         out = batcher.generate(tokens, max_new_tokens=n, **samp)
                     else:
                         out = server.generate(tokens, max_new_tokens=n, **samp)

@@ -81,30 +81,106 @@ def init_params(cfg: GPT2Config, key: jax.Array) -> dict[str, jax.Array]:
     return params
 
 
-def forward(params: dict[str, jax.Array], tokens: jax.Array, cfg: GPT2Config) -> jax.Array:
-    """Returns logits [B, S, V]."""
+def forward(
+    params: dict[str, jax.Array],
+    tokens: jax.Array,
+    cfg: GPT2Config,
+    positions: jax.Array | None = None,
+    kv_cache: dict | None = None,
+    cache_offset: int | jax.Array = 0,
+    mesh=None,
+) -> tuple[jax.Array, dict | None]:
+    """Returns (logits [B, S, V], updated kv_cache or None) — the same
+    cached-decode contract as llama.forward, so the shared decode module
+    (scan decode, ragged batching, streaming, speculation) serves GPT-2
+    unchanged. Prefill: kv_cache=None. Decode: pass the cache and offset
+    (scalar, or [B] for ragged rows)."""
     b, s = tokens.shape
-    positions = jnp.arange(s)[None, :]
+    if positions is None:
+        off = jnp.asarray(cache_offset if kv_cache is not None else 0)
+        positions = jnp.arange(s)[None, :] + (off[:, None] if off.ndim else off)
+        positions = jnp.broadcast_to(positions, (b, s))
     x = jnp.take(params["wte.weight"], tokens, axis=0) + jnp.take(
         params["wpe.weight"], positions, axis=0
     )
     x = x.astype(cfg.dtype)
     head_dim = cfg.hidden_size // cfg.num_heads
+    new_cache: dict | None = {} if kv_cache is not None else None
     for i in range(cfg.num_layers):
         p = f"h.{i}."
         h = _layer_norm(x, params[p + "ln_1.weight"], params[p + "ln_1.bias"], cfg.layer_norm_eps)
         qkv = _conv1d(h, params[p + "attn.c_attn.weight"], params[p + "attn.c_attn.bias"])
         q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, s, cfg.num_heads, head_dim).transpose(0, 2, 1, 3)
-        k = k.reshape(b, s, cfg.num_heads, head_dim).transpose(0, 2, 1, 3)
-        v = v.reshape(b, s, cfg.num_heads, head_dim).transpose(0, 2, 1, 3)
-        out = attn_ops.attention_reference(q, k, v, causal=True)
+        q = q.reshape(b, s, cfg.num_heads, head_dim)
+        k = k.reshape(b, s, cfg.num_heads, head_dim)
+        v = v.reshape(b, s, cfg.num_heads, head_dim)
+        if kv_cache is not None:
+            ck, cv = kv_cache[f"k{i}"], kv_cache[f"v{i}"]
+            if jnp.ndim(cache_offset) == 0:
+                ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_offset, 0, 0))
+                cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_offset, 0, 0))
+            else:
+                # ragged batch: each row appends at its own position
+                row_dus = jax.vmap(
+                    lambda c, u, o: jax.lax.dynamic_update_slice(c, u, (o, 0, 0))
+                )
+                ck = row_dus(ck, k, cache_offset)
+                cv = row_dus(cv, v, cache_offset)
+            new_cache[f"k{i}"], new_cache[f"v{i}"] = ck, cv
+            k_att, v_att = ck, cv
+            q_offset = cache_offset
+        else:
+            k_att, v_att, q_offset = k, v, 0
+        out = attn_ops.attention_reference(
+            q.transpose(0, 2, 1, 3),
+            k_att.transpose(0, 2, 1, 3),
+            v_att.transpose(0, 2, 1, 3),
+            causal=True,
+            q_offset=q_offset,
+        )
         out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.hidden_size)
         x = x + _conv1d(out, params[p + "attn.c_proj.weight"], params[p + "attn.c_proj.bias"])
         h = _layer_norm(x, params[p + "ln_2.weight"], params[p + "ln_2.bias"], cfg.layer_norm_eps)
         h = jax.nn.gelu(_conv1d(h, params[p + "mlp.c_fc.weight"], params[p + "mlp.c_fc.bias"]), approximate=True)
         x = x + _conv1d(h, params[p + "mlp.c_proj.weight"], params[p + "mlp.c_proj.bias"])
     x = _layer_norm(x, params["ln_f.weight"], params["ln_f.bias"], cfg.layer_norm_eps)
-    return jax.lax.dot_general(
+    logits = jax.lax.dot_general(
         x, params["wte.weight"], (((2,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return logits, new_cache
+
+
+def init_kv_cache(cfg: GPT2Config, batch: int, max_len: int, dtype=None) -> dict:
+    dtype = dtype or cfg.dtype
+    head_dim = cfg.hidden_size // cfg.num_heads
+    return {
+        f"{kind}{i}": jnp.zeros((batch, max_len, cfg.num_heads, head_dim), dtype)
+        for i in range(cfg.num_layers)
+        for kind in ("k", "v")
+    }
+
+
+def greedy_generate(params, prompt, cfg: GPT2Config, max_new_tokens: int = 16, mesh=None):
+    from modelx_tpu.models import decode
+
+    return decode.greedy_generate(
+        lambda p, t, kv_cache=None, cache_offset=0, mesh=None: forward(
+            p, t, cfg, kv_cache=kv_cache, cache_offset=cache_offset
+        ),
+        lambda b, n: init_kv_cache(cfg, b, n),
+        params, prompt, max_new_tokens=max_new_tokens, mesh=mesh,
+    )
+
+
+def ragged_greedy_generate(params, prompt, row_lens, cfg: GPT2Config,
+                           max_new_tokens: int = 16, mesh=None, **sampling):
+    from modelx_tpu.models import decode
+
+    return decode.ragged_greedy_generate(
+        lambda p, t, kv_cache=None, cache_offset=0, mesh=None: forward(
+            p, t, cfg, kv_cache=kv_cache, cache_offset=cache_offset
+        ),
+        lambda b, n: init_kv_cache(cfg, b, n),
+        params, prompt, row_lens, max_new_tokens=max_new_tokens, mesh=mesh,
+        **sampling,
     )

@@ -38,7 +38,9 @@ def ngram_propose(
     """Up to ``k`` proposed continuation tokens for the sequence ``ids``:
     the tokens that followed the LATEST earlier occurrence of the longest
     matching trailing n-gram. Empty when nothing matches (caller falls back
-    to an unspeculated step)."""
+    to an unspeculated step). One-shot O(L·n) scan; the decoder's inner
+    loop uses the incremental ``_NgramIndex`` instead (same answers,
+    O(max_ngram) per appended token)."""
     ids = list(ids)
     L = len(ids)
     for n in range(max_ngram, min_ngram - 1, -1):
@@ -53,6 +55,48 @@ def ngram_propose(
                 if cont:
                     return cont
     return []
+
+
+class _NgramIndex:
+    """Latest continuation-start per n-gram, maintained incrementally so
+    proposal lookup never rescans the sequence (a 16k-token context would
+    otherwise cost milliseconds of GIL-holding CPU per generated token).
+    For each gram the latest TWO positions are kept: the trailing gram's
+    own (just-appended) occurrence must not propose its empty self, so
+    lookups that land on the sequence end fall back to the previous one."""
+
+    def __init__(self, max_ngram: int) -> None:
+        self.max_ngram = max_ngram
+        self._cur: dict[tuple, int] = {}
+        self._prev: dict[tuple, int] = {}
+
+    def extend(self, seq: list, start: int) -> None:
+        """Account for seq[start:] having been appended (positions are
+        continuation starts, i.e. the index AFTER the gram)."""
+        for end in range(max(start, 1), len(seq) + 1):
+            for n in range(1, self.max_ngram + 1):
+                if end - n < 0:
+                    break
+                g = tuple(seq[end - n:end])
+                cur = self._cur.get(g)
+                if cur is not None and cur != end:
+                    self._prev[g] = cur
+                self._cur[g] = end
+
+    def propose(self, seq: list, k: int) -> list[int]:
+        L = len(seq)
+        for n in range(self.max_ngram, 0, -1):
+            if L < n + 1:
+                continue
+            g = tuple(seq[L - n:])
+            pos = self._cur.get(g)
+            if pos == L:  # the trailing gram itself: use the prior occurrence
+                pos = self._prev.get(g)
+            if pos is not None and pos < L:
+                cont = seq[pos:pos + k]
+                if cont:
+                    return cont
+        return []
 
 
 class SpeculativeDecoder:
@@ -107,9 +151,11 @@ class SpeculativeDecoder:
         stats["device_steps"] += 1
         out = [int(first[0])]
         seq = prompt_ids + out
+        index = _NgramIndex(self.max_ngram)
+        index.extend(seq, 0)
         offset = s  # cache holds [0, offset) verified positions
         while len(out) < max_new_tokens:
-            prop = ngram_propose(seq, self.k, self.max_ngram)
+            prop = index.propose(seq, self.k)
             stats["proposed"] += len(prop)
             block = np.zeros((1, self.k + 1), np.int32)  # static shape
             block[0, 0] = seq[-1]
@@ -125,11 +171,16 @@ class SpeculativeDecoder:
             a = 0
             while a < len(prop) and int(argm[a]) == prop[a]:
                 a += 1
-            stats["accepted"] += a
             new = prop[:a] + [int(argm[a])]
             new = new[: max_new_tokens - len(out)]
+            # count only EMITTED accepted tokens: a final step may accept
+            # more than the budget has room for, and the advertised accept
+            # rate must not be inflated by tokens that never went out
+            stats["accepted"] += min(a, len(new))
+            grown_from = len(seq)
             out.extend(new)
             seq.extend(new)
+            index.extend(seq, grown_from)
             # rewind past any rejected/padded cache garbage: only the block
             # tokens that produced accepted output are verified history
             offset += a + 1

@@ -23,8 +23,9 @@ class TestGPT2:
         params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
         assert set(params) == set(gpt2.param_shapes(cfg))
         tokens = jnp.array([[1, 2, 3, 4, 5]], jnp.int32)
-        logits = gpt2.forward(params, tokens, cfg)
+        logits, cache = gpt2.forward(params, tokens, cfg)
         assert logits.shape == (1, 5, cfg.vocab_size)
+        assert cache is None
 
     def test_matches_huggingface(self, tmp_path):
         hf_cfg = transformers.GPT2Config(
@@ -52,8 +53,47 @@ class TestGPT2:
         params, _ = load_safetensors(LocalFileSource(path), mesh, GPT2_RULES)
 
         cfg = gpt2.GPT2Config(vocab_size=128, n_positions=32, hidden_size=32, num_layers=2, num_heads=2)
-        got = np.asarray(gpt2.forward(params, jnp.asarray(tokens, jnp.int32), cfg))
+        got = np.asarray(gpt2.forward(params, jnp.asarray(tokens, jnp.int32), cfg)[0])
         np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
+
+    def test_kv_cache_decode_matches_full_forward(self):
+        """Cached decode (prefill + per-token steps) must equal argmax over
+        repeated full forwards — the llama/mixtral decode contract, now on
+        GPT-2 through the shared decode module."""
+        cfg = gpt2.GPT2Config.tiny()
+        params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+        prompt = jnp.array([[5, 6, 7, 5, 6]], jnp.int32)
+        n = 8
+        naive = prompt
+        for _ in range(n):
+            logits, _ = gpt2.forward(params, naive, cfg)
+            naive = jnp.concatenate(
+                [naive, jnp.argmax(logits[:, -1:, :], axis=-1).astype(naive.dtype)], axis=1
+            )
+        cached = gpt2.greedy_generate(params, prompt, cfg, max_new_tokens=n)
+        np.testing.assert_array_equal(np.asarray(cached), np.asarray(naive))
+
+    def test_ragged_decode_matches_unbatched(self):
+        cfg = gpt2.GPT2Config.tiny()
+        params = gpt2.init_params(cfg, jax.random.PRNGKey(0))
+        rows = [[3, 14, 15], [9, 2, 6, 5, 3]]
+        n = 6
+        want = [
+            np.asarray(gpt2.greedy_generate(
+                params, jnp.asarray([r], jnp.int32), cfg, max_new_tokens=n
+            ))[0, len(r):]
+            for r in rows
+        ]
+        s = max(len(r) for r in rows)
+        padded = np.zeros((2, s), np.int32)
+        for i, r in enumerate(rows):
+            padded[i, :len(r)] = r
+        got = gpt2.ragged_greedy_generate(
+            params, jnp.asarray(padded), np.asarray([len(r) for r in rows], np.int32),
+            cfg, max_new_tokens=n,
+        )
+        for i in range(2):
+            np.testing.assert_array_equal(np.asarray(got)[i], want[i])
 
 
 class TestBert:

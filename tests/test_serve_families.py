@@ -490,6 +490,27 @@ class TestStreamingGenerate:
         finally:
             httpd.shutdown()
 
+    def test_gpt2_streams_like_llama(self, checkpoints):
+        """GPT-2 now exposes decode_fns: the streaming path must serve it
+        and concatenate to the non-streamed result, same as llama."""
+        server = ModelServer(checkpoints["gpt2"], mesh_spec="dp=1", dtype="float32", name="g")
+        sset = ServerSet({"g": server})
+        base = f"http://127.0.0.1:{free_port()}"
+        httpd = serve(sset, listen=base.rsplit("//", 1)[1])
+        try:
+            sset.load_all()
+            body = {"tokens": [[7, 8, 9]], "max_new_tokens": 6, "stream": True}
+            with requests.post(base + "/v1/g/generate", json=body, stream=True) as r:
+                assert r.status_code == 200
+                lines = [json.loads(ln) for ln in r.iter_lines() if ln]
+            streamed = [t for ln in lines[:-1] for t in ln["tokens"][0]]
+            whole = requests.post(
+                base + "/v1/g/generate", json={"tokens": [[7, 8, 9]], "max_new_tokens": 6}
+            ).json()["tokens"][0]
+            assert streamed == whole[3:]
+        finally:
+            httpd.shutdown()
+
     def test_stream_unsupported_family_is_400(self, checkpoints):
         server = ModelServer(checkpoints["bert"], mesh_spec="dp=1", dtype="float32", name="b")
         sset = ServerSet({"b": server})

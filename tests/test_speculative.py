@@ -51,6 +51,23 @@ class TestNgramPropose:
         assert ngram_propose([], k=4) == []
         assert ngram_propose([1], k=4) == []
 
+    def test_incremental_index_matches_scan(self):
+        """The decoder's O(1)-per-token index must answer exactly like the
+        one-shot scan, under incremental growth."""
+        from modelx_tpu.models.speculative import _NgramIndex
+
+        rng = np.random.RandomState(3)
+        seq = rng.randint(0, 5, 40).tolist()  # small alphabet: many repeats
+        idx = _NgramIndex(max_ngram=3)
+        idx.extend(seq, 0)
+        for step in range(30):
+            for k in (1, 4):
+                assert idx.propose(seq, k) == ngram_propose(seq, k, max_ngram=3), (
+                    step, k, seq)
+            grown = len(seq)
+            seq.extend(rng.randint(0, 5, rng.randint(1, 4)).tolist())
+            idx.extend(seq, grown)
+
 
 class TestExactness:
     def _plain(self, model, prompt, n):
@@ -96,8 +113,11 @@ class TestExactness:
         params, _cfg, fwd, init = model
         prompt = np.asarray([[5, 6, 5, 6, 5, 6]], np.int32)
         for n in (1, 2, 5):
-            got, _ = speculative_generate(fwd, init, params, prompt, n, k=8)
+            got, stats = speculative_generate(fwd, init, params, prompt, n, k=8)
             assert got.shape == (1, prompt.shape[1] + n)
+            # accept-rate honesty: tokens accepted but cut by the budget on
+            # the final step must not count
+            assert stats["accepted"] <= n
 
     def test_rejects_multi_row(self, model):
         params, _cfg, fwd, init = model
@@ -133,6 +153,37 @@ class TestServeIntegration:
             plain.generate(multi, max_new_tokens=4),
             spec.generate(multi, max_new_tokens=4),
         )
+
+    def test_speculation_not_inert_under_dynamic_batch(self, model, tmp_path):
+        """--dynamic-batch routes generates through the batcher; a
+        single-row greedy request must still reach the speculative path."""
+        import requests as rq
+
+        from modelx_tpu.dl import safetensors as st
+        from modelx_tpu.dl.serve import ModelServer, ServerSet, serve
+        from modelx_tpu.registry.server import free_port
+
+        params, _cfg, _fwd, _init = model
+        d = tmp_path / "m2"
+        d.mkdir()
+        st.write_safetensors(
+            str(d / "model.safetensors"), {k: np.asarray(v) for k, v in params.items()}
+        )
+        server = ModelServer(str(d), mesh_spec="dp=1", dtype="float32", name="s",
+                             speculative_k=6)
+        sset = ServerSet({"s": server}, dynamic_batch=True)
+        base = f"http://127.0.0.1:{free_port()}"
+        httpd = serve(sset, listen=base.rsplit("//", 1)[1])
+        try:
+            server.load()
+            r = rq.post(base + "/v1/generate",
+                        json={"tokens": [[5, 6, 5, 6]], "max_new_tokens": 6})
+            assert r.status_code == 200, r.text
+            assert server.stats.get("spec_device_steps", 0) >= 1
+        finally:
+            httpd.shutdown()
+            for b in sset.batchers.values():
+                b.close()
 
 
 class TestCacheConsistency:
