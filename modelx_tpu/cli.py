@@ -355,6 +355,48 @@ def cmd_dl(uri: str, dest: str, device_put: bool, mesh: str) -> None:
         _fail(e)
 
 
+# -- convert ------------------------------------------------------------------
+
+
+@main.group("convert")
+def cmd_convert() -> None:
+    """Convert foreign checkpoints to a pushable safetensors dir."""
+
+
+@cmd_convert.command("orbax")
+@click.argument("src")
+@click.argument("dst_dir")
+@click.option("--rename", multiple=True, metavar="OLD=NEW",
+              help="prefix rewrite applied to tensor names (repeatable)")
+def cmd_convert_orbax(src: str, dst_dir: str, rename: tuple[str, ...]) -> None:
+    """Orbax PyTree checkpoint -> DST_DIR/model.safetensors."""
+    from modelx_tpu.client.convert import convert_orbax
+
+    try:
+        out = convert_orbax(src, dst_dir, list(rename), log=click.echo)
+    except Exception as e:  # orbax raises library-internal types for bad
+        # checkpoints; a CLI must say "error: ...", not print a traceback
+        _fail(e)
+    click.echo(json.dumps(out))
+
+
+@cmd_convert.command("torch")
+@click.argument("src")
+@click.argument("dst_dir")
+@click.option("--rename", multiple=True, metavar="OLD=NEW",
+              help="prefix rewrite applied to tensor names (repeatable)")
+def cmd_convert_torch(src: str, dst_dir: str, rename: tuple[str, ...]) -> None:
+    """torch state_dict (.bin/.pt) -> DST_DIR/model.safetensors."""
+    from modelx_tpu.client.convert import convert_torch
+
+    try:
+        out = convert_torch(src, dst_dir, list(rename), log=click.echo)
+    except Exception as e:  # torch.load raises pickle/runtime errors for
+        # incompatible checkpoints; surface them as "error: ..."
+        _fail(e)
+    click.echo(json.dumps(out))
+
+
 # -- version ------------------------------------------------------------------
 
 

@@ -1,0 +1,125 @@
+"""Checkpoint converters: foreign formats -> safetensors dirs ready to push.
+
+The registry's serving path consumes safetensors (tensor-index annotations,
+ranged shard reads, HBM streaming — docs/annotations.md); these converters
+bridge the two ecosystems users actually train in:
+
+- **orbax** (JAX): a ``PyTreeCheckpointer`` checkpoint (flax/optax pytrees)
+  flattens to dot-joined tensor names.
+- **torch** (PyTorch): a ``.bin``/``.pt`` ``state_dict`` converts tensor by
+  tensor (via numpy; bf16 through ml_dtypes).
+
+Both write ``model.safetensors`` into the destination directory, which then
+pushes like any other model (``modelx push``) and loads through the normal
+tensor-index/shard-annotation machinery. Name mapping to a family's HF
+names is deliberately NOT guessed: tensors keep their source names, and
+``--rename old=new`` handles prefix fixes (e.g. flax's ``params.`` or
+torch's ``module.``).
+
+Reference parity: none — the reference stores files opaquely and leaves
+conversion to the user; this makes the deploy path self-contained.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable
+
+import numpy as np
+
+
+def _flatten(tree, prefix: str = "") -> dict[str, np.ndarray]:
+    """Dot-join a nested dict/list pytree of arrays into flat names."""
+    out: dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        items = tree.items()
+    elif isinstance(tree, (list, tuple)):
+        items = ((str(i), v) for i, v in enumerate(tree))
+    else:
+        arr = np.asarray(tree)
+        out[prefix.rstrip(".")] = arr
+        return out
+    for key, value in items:
+        out.update(_flatten(value, f"{prefix}{key}."))
+    return out
+
+
+def _apply_renames(tensors: dict[str, np.ndarray], renames: list[str]) -> dict[str, np.ndarray]:
+    """``old=new`` prefix rewrites, applied in order; ``old=`` strips."""
+    for spec in renames:
+        old, sep, new = spec.partition("=")
+        if not sep or not old:
+            raise ValueError(f"--rename wants OLD=NEW (prefixes), got {spec!r}")
+        renamed: dict[str, np.ndarray] = {}
+        for name, value in tensors.items():
+            target = new + name[len(old):] if name.startswith(old) else name
+            if target in renamed:
+                # a collision would silently drop a weight from the artifact
+                raise ValueError(
+                    f"--rename {spec!r} maps two tensors onto {target!r}"
+                )
+            renamed[target] = value
+        tensors = renamed
+    return tensors
+
+
+def convert_orbax(src: str, dst_dir: str, renames: list[str] | None = None,
+                  log: Callable[[str], None] = lambda s: None) -> dict:
+    """Restore an orbax PyTree checkpoint and write dst_dir/model.safetensors."""
+    import orbax.checkpoint as ocp
+
+    from modelx_tpu.dl import safetensors as st
+
+    with ocp.PyTreeCheckpointer() as ckptr:
+        tree = ckptr.restore(os.path.abspath(src))
+    tensors = {
+        name: np.asarray(value)
+        for name, value in _flatten(tree).items()
+        if value is not None and np.asarray(value).dtype != object
+    }
+    if not tensors:
+        raise ValueError(f"no array leaves found in orbax checkpoint {src}")
+    if "" in tensors:  # bare-array checkpoint: a nameless tensor is unusable
+        raise ValueError(
+            "orbax checkpoint is a single bare array; wrap it in a dict "
+            "(e.g. {'weight': arr}) so the tensor has a name"
+        )
+    tensors = _apply_renames(tensors, renames or [])
+    os.makedirs(dst_dir, exist_ok=True)
+    path = os.path.join(dst_dir, "model.safetensors")
+    st.write_safetensors(path, tensors)
+    log(f"{len(tensors)} tensors -> {path}")
+    return {"tensors": len(tensors), "bytes": os.path.getsize(path), "path": path}
+
+
+def convert_torch(src: str, dst_dir: str, renames: list[str] | None = None,
+                  log: Callable[[str], None] = lambda s: None) -> dict:
+    """Convert a torch state_dict (.bin/.pt) to dst_dir/model.safetensors."""
+    import torch
+
+    from modelx_tpu.dl import safetensors as st
+
+    state = torch.load(src, map_location="cpu", weights_only=True)
+    if isinstance(state, dict) and "state_dict" in state and isinstance(state["state_dict"], dict):
+        state = state["state_dict"]  # lightning-style wrapper
+    tensors: dict[str, np.ndarray] = {}
+    for name, value in state.items():
+        if not hasattr(value, "detach"):
+            continue  # non-tensor metadata entries
+        t = value.detach().cpu()
+        if t.dtype == torch.bfloat16:
+            import ml_dtypes
+
+            # int16 view, not uint16: bit-identical, and torch.uint16 only
+            # exists from torch 2.3
+            tensors[name] = t.view(torch.int16).numpy().view(ml_dtypes.bfloat16)
+        else:
+            tensors[name] = t.numpy()
+    if not tensors:
+        raise ValueError(f"no tensors found in {src}")
+    tensors = _apply_renames(tensors, renames or [])
+    os.makedirs(dst_dir, exist_ok=True)
+    path = os.path.join(dst_dir, "model.safetensors")
+    st.write_safetensors(path, tensors)
+    log(f"{len(tensors)} tensors -> {path}")
+    return {"tensors": len(tensors), "bytes": os.path.getsize(path), "path": path}
