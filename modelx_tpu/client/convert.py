@@ -40,7 +40,11 @@ def _flatten(tree, prefix: str = "") -> dict[str, np.ndarray]:
         out[prefix.rstrip(".")] = arr
         return out
     for key, value in items:
-        out.update(_flatten(value, f"{prefix}{key}."))
+        for name, leaf in _flatten(value, f"{prefix}{key}.").items():
+            if name in out:
+                # {'a.b': x, 'a': {'b': y}} would silently drop a weight
+                raise ValueError(f"flattened tensor names collide on {name!r}")
+            out[name] = leaf
     return out
 
 
@@ -68,15 +72,19 @@ def convert_orbax(src: str, dst_dir: str, renames: list[str] | None = None,
     """Restore an orbax PyTree checkpoint and write dst_dir/model.safetensors."""
     import orbax.checkpoint as ocp
 
-    from modelx_tpu.dl import safetensors as st
-
     with ocp.PyTreeCheckpointer() as ckptr:
         tree = ckptr.restore(os.path.abspath(src))
-    tensors = {
-        name: np.asarray(value)
-        for name, value in _flatten(tree).items()
-        if value is not None and np.asarray(value).dtype != object
-    }
+    tensors: dict[str, np.ndarray] = {}
+    for name, value in _flatten(tree).items():
+        if value is None:
+            continue
+        arr = np.asarray(value)
+        # keep only numeric/bool leaves: strings and other metadata leaves
+        # (format tags, notes) are not tensors — and a non-array-shaped
+        # scalar like step counters IS a legitimate 0-d tensor
+        if not (np.issubdtype(arr.dtype, np.number) or arr.dtype == np.bool_):
+            continue
+        tensors[name] = arr
     if not tensors:
         raise ValueError(f"no array leaves found in orbax checkpoint {src}")
     if "" in tensors:  # bare-array checkpoint: a nameless tensor is unusable
@@ -84,20 +92,13 @@ def convert_orbax(src: str, dst_dir: str, renames: list[str] | None = None,
             "orbax checkpoint is a single bare array; wrap it in a dict "
             "(e.g. {'weight': arr}) so the tensor has a name"
         )
-    tensors = _apply_renames(tensors, renames or [])
-    os.makedirs(dst_dir, exist_ok=True)
-    path = os.path.join(dst_dir, "model.safetensors")
-    st.write_safetensors(path, tensors)
-    log(f"{len(tensors)} tensors -> {path}")
-    return {"tensors": len(tensors), "bytes": os.path.getsize(path), "path": path}
+    return _write_artifact(tensors, dst_dir, renames, log)
 
 
 def convert_torch(src: str, dst_dir: str, renames: list[str] | None = None,
                   log: Callable[[str], None] = lambda s: None) -> dict:
     """Convert a torch state_dict (.bin/.pt) to dst_dir/model.safetensors."""
     import torch
-
-    from modelx_tpu.dl import safetensors as st
 
     state = torch.load(src, map_location="cpu", weights_only=True)
     if isinstance(state, dict) and "state_dict" in state and isinstance(state["state_dict"], dict):
@@ -117,6 +118,15 @@ def convert_torch(src: str, dst_dir: str, renames: list[str] | None = None,
             tensors[name] = t.numpy()
     if not tensors:
         raise ValueError(f"no tensors found in {src}")
+    return _write_artifact(tensors, dst_dir, renames, log)
+
+
+def _write_artifact(tensors: dict[str, np.ndarray], dst_dir: str,
+                    renames: list[str] | None,
+                    log: Callable[[str], None]) -> dict:
+    """Shared converter tail: renames -> dst_dir/model.safetensors."""
+    from modelx_tpu.dl import safetensors as st
+
     tensors = _apply_renames(tensors, renames or [])
     os.makedirs(dst_dir, exist_ok=True)
     path = os.path.join(dst_dir, "model.safetensors")

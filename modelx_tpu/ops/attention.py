@@ -147,7 +147,8 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128, block_k: i
 # -- ring attention (sequence parallelism) ------------------------------------
 
 
-def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True):
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True,
+                   block_k: int = 0):
     """Ring attention over a sequence-sharded mesh axis.
 
     q/k/v: [B, H, S, D] *globally*; S is sharded over ``axis``. Each device
@@ -157,6 +158,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True):
     attention but with O(S/n) memory and neighbor-only ICI traffic.
     """
     n = mesh.shape[axis]
+    bk = block_k or RING_BLOCK_K
 
     def local_fn(q_blk, k_blk, v_blk):
         idx = jax.lax.axis_index(axis)
@@ -167,10 +169,25 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True):
             acc, m_prev, l_prev, k_cur, v_cur = carry
             src = jax.lax.rem(idx - i + n, n)  # whose kv block we hold now
             k_start = src * s_local
-            acc, m_prev, l_prev = _merge_block(
-                q_blk, k_cur, v_cur, acc, m_prev, l_prev,
-                q_offset=q_start, k_offset=k_start, causal=causal,
-            )
+
+            def merge(args):
+                acc, m_prev, l_prev = args
+                return _merge_block(
+                    q_blk, k_cur, v_cur, acc, m_prev, l_prev,
+                    q_offset=q_start, k_offset=k_start, causal=causal,
+                    block_k=bk,
+                )
+
+            if causal:
+                # a hop whose whole k/v block sits after this device's last
+                # query is fully masked: skip its matmuls entirely (on
+                # average half the hops)
+                needed = k_start <= q_start + s_local - 1
+                acc, m_prev, l_prev = jax.lax.cond(
+                    needed, merge, lambda args: args, (acc, m_prev, l_prev)
+                )
+            else:
+                acc, m_prev, l_prev = merge((acc, m_prev, l_prev))
             perm = [(j, (j + 1) % n) for j in range(n)]
             k_nxt = jax.lax.ppermute(k_cur, axis, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis, perm)
@@ -232,21 +249,50 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "sp", causal: bool = True
     )(q, k, v)
 
 
-def _merge_block(q, k, v, acc, m_prev, l_prev, q_offset, k_offset, causal):
-    """Merge one k/v block into running flash statistics. All [B,H,S,D]."""
+RING_BLOCK_K = 512
+
+
+def _merge_block(q, k, v, acc, m_prev, l_prev, q_offset, k_offset, causal,
+                 block_k: int = RING_BLOCK_K):
+    """Merge one k/v block into running flash statistics. All [B,H,S,D].
+
+    The block is consumed in ``block_k``-key chunks with the online-softmax
+    carried across chunks: peak activation memory is O(s_q x block_k), not
+    O(s_q x s_k) — materializing the whole per-hop score matrix would put
+    the O((S/n)^2) cost ring attention exists to avoid right back."""
     q32, k32, v32 = (x.astype(jnp.float32) for x in _repeat_kv_heads(q, k, v))
     scale = 1.0 / math.sqrt(q.shape[-1])
-    s = jnp.einsum("bhqd,bhkd->bhqk", q32, k32, preferred_element_type=jnp.float32) * scale
+    q32 = q32 * scale
+    s_k = k32.shape[2]
+    bk = min(block_k, s_k)
+    if s_k % bk:
+        bk = s_k  # odd block sizes: one chunk (correctness over tiling)
+    qpos = q_offset + jnp.arange(q.shape[2])[:, None]
+
+    def chunk(i, carry):
+        acc, m_prev, l_prev = carry
+        k_blk = jax.lax.dynamic_slice_in_dim(k32, i * bk, bk, axis=2)
+        v_blk = jax.lax.dynamic_slice_in_dim(v32, i * bk, bk, axis=2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q32, k_blk, preferred_element_type=jnp.float32)
+        if causal:
+            kpos = k_offset + i * bk + jnp.arange(bk)[None, :]
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk, preferred_element_type=jnp.float32
+        )
+        return acc_new, m_new, l_new
+
+    n_chunks = s_k // bk
     if causal:
-        qpos = q_offset + jnp.arange(q.shape[2])[:, None]
-        kpos = k_offset + jnp.arange(k.shape[2])[None, :]
-        s = jnp.where(kpos <= qpos, s, NEG_INF)
-    m_cur = jnp.max(s, axis=-1)
-    m_new = jnp.maximum(m_prev, m_cur)
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new[..., None])
-    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
-    acc_new = acc * alpha[..., None] + jnp.einsum(
-        "bhqk,bhkd->bhqd", p, v32, preferred_element_type=jnp.float32
-    )
-    return acc_new, m_new, l_new
+        # chunks wholly after this hop's last visible key are fully masked;
+        # cap the (traced) loop bound instead of masking wasted matmuls —
+        # the analogue of _flash_kernel's num_k cap. Offsets are traced
+        # (they come off axis_index), so the bound is dynamic.
+        visible = q_offset + q.shape[2] - k_offset  # keys this hop can see
+        n_chunks = jnp.clip((visible + bk - 1) // bk, 0, n_chunks)
+    return jax.lax.fori_loop(0, n_chunks, chunk, (acc, m_prev, l_prev))

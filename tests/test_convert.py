@@ -30,6 +30,29 @@ class TestFlatten:
         with pytest.raises(ValueError, match="maps two tensors"):
             _apply_renames(flat, ["module.="])
 
+    def test_flatten_collision_is_an_error(self):
+        tree = {"a.b": np.ones(1), "a": {"b": np.zeros(1)}}
+        with pytest.raises(ValueError, match="collide"):
+            _flatten(tree)
+
+    def test_orbax_metadata_leaves_skipped(self, tmp_path):
+        """String/format metadata leaves must not crash or pollute the
+        artifact; numeric scalars remain legitimate 0-d tensors."""
+        ocp = pytest.importorskip("orbax.checkpoint")
+        tree = {"params": {"w": np.ones(2, np.float32)}, "format": "v2", "step": 7}
+        src = tmp_path / "ck"
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(str(src), tree)
+
+        from modelx_tpu.client.convert import convert_orbax
+
+        dst = tmp_path / "out"
+        out = convert_orbax(str(src), str(dst))
+        with open(dst / "model.safetensors", "rb") as f:
+            infos, _ = st.read_header(f)
+        assert "format" not in infos
+        assert set(infos) == {"params.w", "step"}
+
 
 class TestOrbax:
     def test_roundtrip(self, tmp_path):
