@@ -167,14 +167,7 @@ class Checkpointer:
         filters without reading skipped tensors' bytes."""
         flat: dict[str, np.ndarray] = {}
         for path in self._shard_paths():
-            with open(path, "rb") as f:
-                infos, off = st.read_header(f)
-                for name, info in infos.items():
-                    if want is not None and not want(name):
-                        continue
-                    f.seek(off + info.start)
-                    raw = f.read(info.nbytes)
-                    flat[name] = np.frombuffer(raw, info.np_dtype()).reshape(info.shape).copy()
+            flat.update(st.read_tensors(path, want))
         return flat
 
     def restore(

@@ -99,6 +99,24 @@ def read_header_from_file(path: str) -> tuple[dict[str, TensorInfo], int]:
         return read_header(f)
 
 
+def read_tensors(path: str, want=None) -> dict[str, np.ndarray]:
+    """Read whole tensors from one file; ``want(name)`` filters without
+    touching skipped tensors' bytes. Arrays own their memory (copied out of
+    the read buffer). The single full-read helper shared by checkpoint
+    restore and adapter loading — the loader's ranged/sharded path is
+    separate by design (dl/loader.py)."""
+    out: dict[str, np.ndarray] = {}
+    with open(path, "rb") as f:
+        infos, off = read_header(f)
+        for name, info in infos.items():
+            if want is not None and not want(name):
+                continue
+            f.seek(off + info.start)
+            raw = f.read(info.nbytes)
+            out[name] = np.frombuffer(raw, info.np_dtype()).reshape(info.shape).copy()
+    return out
+
+
 def write_safetensors(path: str, tensors: dict[str, np.ndarray], metadata: dict[str, str] | None = None) -> None:
     """Write a safetensors file (used by push-side conversion, tests, bench)."""
     header: dict[str, Any] = {}

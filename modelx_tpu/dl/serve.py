@@ -104,10 +104,12 @@ class ModelServer:
         name: str = "default",
         quantize: str | None = None,
         speculative_k: int = 0,
+        lora_dir: str = "",
     ) -> None:
         self.name = name
         self.model_dir = model_dir
         self.quantize = quantize
+        self.lora_dir = lora_dir
         # > 0 turns on prompt-lookup speculative decoding for single-row
         # greedy requests (models/speculative.py): token-exact, fewer
         # device steps on self-repeating continuations
@@ -186,6 +188,14 @@ class ModelServer:
                 params.update(arrays)
                 total += stats.bytes_to_device
             self.params = params
+            if self.lora_dir:
+                from modelx_tpu.dl import lora
+
+                # merge BEFORE compiling: the jitted programs close over the
+                # merged weights, and merge-into-int8 is rejected upstream
+                with trace.span("serve.lora", model=self.name, dir=self.lora_dir):
+                    self.params = lora.merge_adapter(self.params, self.lora_dir)
+                self.stats["lora_dir"] = self.lora_dir
             seconds = time.monotonic() - t0
             self.stats["family"] = self.family.name
             self.stats["load_seconds"] = round(seconds, 3)

@@ -37,9 +37,13 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
               help="prompt-lookup speculative decoding for single-row greedy "
                    "requests: verify up to K proposed tokens per device step "
                    "(token-exact; 0 = off)")
+@click.option("--lora", "loras", multiple=True, metavar="NAME=ADAPTER_DIR",
+              help="merge a PEFT-style LoRA adapter into model NAME at load "
+                   "('default' for --model-dir); repeatable")
 def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen: str,
          max_seq_len: int, compile_cache: bool, concurrent_load: bool, trace_dir: str,
-         dynamic_batch: bool, quantize: str | None, speculative_k: int) -> None:
+         dynamic_batch: bool, quantize: str | None, speculative_k: int,
+         loras: tuple[str, ...]) -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     from modelx_tpu.parallel.distributed import initialize
 
@@ -56,6 +60,20 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
         entries[name] = path
     if not entries:
         raise click.UsageError("need --model-dir or at least one --model name=dir")
+    lora_dirs: dict[str, str] = {}
+    for spec in loras:
+        name, _, path = spec.partition("=")
+        if not path:
+            raise click.UsageError(f"--lora wants NAME=ADAPTER_DIR, got {spec!r}")
+        if name not in entries:
+            raise click.UsageError(f"--lora {name!r}: no such --model")
+        lora_dirs[name] = path
+    if lora_dirs and quantize:
+        # int8 quantizes exactly the 2-D proj weights LoRA targets; merging
+        # into QTensors is rejected downstream — fail before the multi-GB
+        # base streams to HBM, not after
+        raise click.UsageError("--lora cannot combine with --quantize "
+                               "(adapters merge into full-precision weights)")
 
     # one mesh shared by every tenant (same devices either way; sharing keeps
     # shardings comparable and avoids rebuilding device lists per model)
@@ -67,7 +85,8 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
     servers = {
         name: ModelServer(path, dtype=dtype, max_seq_len=max_seq_len,
                           name=name, mesh=shared_mesh, quantize=quantize,
-                          speculative_k=speculative_k)
+                          speculative_k=speculative_k,
+                          lora_dir=lora_dirs.get(name, ""))
         for name, path in entries.items()
     }
     sset = ServerSet(servers, trace_dir=trace_dir, dynamic_batch=dynamic_batch)

@@ -287,12 +287,10 @@ def _merge_block(q, k, v, acc, m_prev, l_prev, q_offset, k_offset, causal,
         )
         return acc_new, m_new, l_new
 
-    n_chunks = s_k // bk
-    if causal:
-        # chunks wholly after this hop's last visible key are fully masked;
-        # cap the (traced) loop bound instead of masking wasted matmuls —
-        # the analogue of _flash_kernel's num_k cap. Offsets are traced
-        # (they come off axis_index), so the bound is dynamic.
-        visible = q_offset + q.shape[2] - k_offset  # keys this hop can see
-        n_chunks = jnp.clip((visible + bk - 1) // bk, 0, n_chunks)
-    return jax.lax.fori_loop(0, n_chunks, chunk, (acc, m_prev, l_prev))
+    # The loop bound stays STATIC even though the diagonal hop wastes some
+    # fully-masked chunks: a traced bound (offsets come off axis_index)
+    # makes fori_loop non-reverse-differentiable, and ring attention must
+    # train (sp meshes run this under value_and_grad). The outer per-hop
+    # lax.cond skip already removes the fully-masked hops, which is where
+    # the bulk of the wasted work was.
+    return jax.lax.fori_loop(0, s_k // bk, chunk, (acc, m_prev, l_prev))
