@@ -224,6 +224,54 @@ def cmd_pull(ref: str, directory: str) -> None:
         _fail(e)
 
 
+@main.command("copy")
+@click.argument("src", shell_complete=_complete_ref)
+@click.argument("dst", shell_complete=_complete_ref)
+@click.option("--quiet", is_flag=True, help="suppress per-blob progress lines")
+def cmd_copy(src: str, dst: str, quiet: bool) -> None:
+    """Copy a model version between registries/repos with content-address
+    skip (blobs the destination already holds move zero bytes)."""
+    from modelx_tpu.client.ops import copy_model
+
+    try:
+        s, d = parse_reference(src), parse_reference(dst)
+        if not s.repository or not d.repository:
+            raise ValueError("both references must include a repository")
+        if not s.version:
+            raise ValueError("source reference needs a version (repo@version)")
+        out = copy_model(
+            s.client().remote, s.repository, s.version,
+            d.client().remote, d.repository, d.version or s.version,
+            log=(lambda line: None) if quiet else click.echo,
+        )
+        click.echo(json.dumps(out))
+    except (errors.ErrorInfo, ValueError) as e:
+        _fail(e)
+
+
+@main.command("verify")
+@click.argument("ref", shell_complete=_complete_ref)
+@click.option("--quiet", is_flag=True, help="suppress per-blob lines")
+def cmd_verify(ref: str, quiet: bool) -> None:
+    """Registry fsck: re-hash every blob the repo's manifests reference
+    (all versions, or just one with repo@version); exit 1 on any mismatch."""
+    from modelx_tpu.client.ops import verify_repo
+
+    try:
+        r = parse_reference(ref)
+        if not r.repository:
+            raise ValueError("reference must include a repository")
+        out = verify_repo(
+            r.client().remote, r.repository, r.version,
+            log=(lambda line: None) if quiet else click.echo,
+        )
+        click.echo(json.dumps(out))
+        if out["errors"]:
+            sys.exit(1)
+    except (errors.ErrorInfo, ValueError) as e:
+        _fail(e)
+
+
 # -- repo management (cmd/modelx/repo) ---------------------------------------
 
 
@@ -439,8 +487,9 @@ def cmd_completion(shell: str) -> None:
 
 
 # commands whose FIRST positional argument is a model reference; later
-# positions are directories (filename completion is the shell's own job)
-_REF_COMMANDS = ("push", "pull", "info", "list", "gc", "dl")
+# positions are directories (filename completion is the shell's own job) —
+# except `copy`, whose second position is also a ref
+_REF_COMMANDS = ("push", "pull", "info", "list", "gc", "dl", "copy", "verify")
 
 
 @main.command(
@@ -465,7 +514,12 @@ def cmd_hidden_complete(words: tuple[str, ...]) -> None:
             return
         # only the ref argument completes remotely: `push <ref> <dir>` must
         # not offer repo refs for the directory slot
-        if args[0] in _REF_COMMANDS and len(args) == 1 and not incomplete.startswith("-"):
+        ref_positions = 2 if args[0] == "copy" else 1  # copy: both args are refs
+        if (
+            args[0] in _REF_COMMANDS
+            and len(args) <= ref_positions
+            and not incomplete.startswith("-")
+        ):
             for cand in _complete_ref(None, None, incomplete):
                 click.echo(cand)
     except Exception:

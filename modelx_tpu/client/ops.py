@@ -1,0 +1,136 @@
+"""Registry operations built on the client API: copy and verify.
+
+- ``copy_model``: replicate one model version between registries (or repos)
+  with content-address skip — blobs the destination already holds move zero
+  bytes, so promoting ``staging -> prod`` after a small delta re-push costs
+  only the changed shards. Bytes are re-hashed in transit; a digest
+  mismatch aborts before the manifest commit, so a partial copy is never
+  addressable.
+- ``verify_repo``: registry fsck — re-hash every blob a repo's manifests
+  reference and report digest/size mismatches and missing blobs.
+
+Reference parity: none — the reference offers no cross-registry copy or
+integrity audit; both are standard registry tooling (think ``crane cp`` /
+``oras cp`` in the OCI world) rebuilt on this client.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import tempfile
+from typing import Callable
+
+from modelx_tpu import errors
+from modelx_tpu.types import Descriptor
+
+
+def _stream_and_hash(remote, repository: str, desc: Descriptor, sink) -> tuple[str, int]:
+    """Stream one blob into ``sink`` (or nowhere), returning (digest, size).
+    A mid-stream transport failure surfaces as ErrorInfo — the iterator
+    raises raw requests exceptions that the client wrapper only catches for
+    the initial call, and a multi-hour fsck must not die to one blip."""
+    import requests
+
+    h = hashlib.sha256()
+    n = 0
+    try:
+        for chunk in remote.get_blob_content(repository, desc.digest):
+            h.update(chunk)
+            n += len(chunk)
+            if sink is not None:
+                sink.write(chunk)
+    except requests.RequestException as e:
+        raise errors.ErrorInfo(
+            502, errors.ErrCodeUnknown,
+            f"stream of {desc.name or desc.digest} interrupted: {e}",
+        ) from e
+    return f"sha256:{h.hexdigest()}", n
+
+
+def copy_model(
+    src_remote,
+    src_repo: str,
+    src_version: str,
+    dst_remote,
+    dst_repo: str,
+    dst_version: str,
+    log: Callable[[str], None] = lambda s: None,
+) -> dict:
+    """Copy one model version; returns {blobs, copied, skipped, bytes}."""
+    manifest = src_remote.get_manifest(src_repo, src_version)
+    copied = skipped = moved = 0
+    for desc in manifest.all_descriptors():
+        if dst_remote.head_blob(dst_repo, desc.digest):
+            skipped += 1
+            log(f"skip  {desc.name or desc.digest[:19]} (already present)")
+            continue
+        # spool through disk, not RAM: model blobs are multi-GB
+        with tempfile.SpooledTemporaryFile(max_size=64 << 20) as spool:
+            digest, size = _stream_and_hash(src_remote, src_repo, desc, spool)
+            if digest != desc.digest or (desc.size and size != desc.size):
+                raise errors.ErrorInfo(
+                    502,
+                    errors.ErrCodeDigestInvalid,
+                    f"source blob {desc.name or desc.digest} corrupt in "
+                    f"transit: got {digest} ({size}B), want {desc.digest} "
+                    f"({desc.size}B)",
+                )
+            spool.seek(0)
+            dst_remote.upload_blob_content(dst_repo, desc, spool)
+        copied += 1
+        moved += size
+        log(f"copy  {desc.name or desc.digest[:19]} ({size} bytes)")
+    # manifest PUT last: the commit point, same as push (push.go:56-64)
+    dst_remote.put_manifest(dst_repo, dst_version, manifest)
+    return {"blobs": copied + skipped, "copied": copied, "skipped": skipped,
+            "bytes": moved}
+
+
+def verify_repo(
+    remote,
+    repository: str,
+    version: str = "",
+    log: Callable[[str], None] = lambda s: None,
+) -> dict:
+    """Re-hash every referenced blob; returns {versions, blobs, bytes,
+    errors: [str]} (shared blobs across versions hash once)."""
+    if version:
+        versions = [version]
+    else:
+        index = remote.get_index(repository)
+        versions = [m.name for m in index.manifests]
+    seen: dict[str, str | None] = {}  # digest -> error (None = ok)
+    problems: list[str] = []
+    total_bytes = 0
+    blob_count = 0
+    for ver in versions:
+        try:
+            manifest = remote.get_manifest(repository, ver)
+        except errors.ErrorInfo as e:
+            problems.append(f"{ver}: manifest unreadable: {e}")
+            continue
+        for desc in manifest.all_descriptors():
+            blob_count += 1
+            if desc.digest in seen:
+                if seen[desc.digest]:
+                    problems.append(f"{ver}/{desc.name}: {seen[desc.digest]}")
+                continue
+            err: str | None = None
+            try:
+                digest, size = _stream_and_hash(remote, repository, desc, None)
+                if digest != desc.digest:
+                    err = f"digest mismatch: got {digest}, want {desc.digest}"
+                elif desc.size and size != desc.size:
+                    err = f"size mismatch: got {size}, want {desc.size}"
+                else:
+                    total_bytes += size
+            except errors.ErrorInfo as e:
+                err = f"unreadable: {e}"
+            seen[desc.digest] = err
+            if err:
+                problems.append(f"{ver}/{desc.name}: {err}")
+                log(f"BAD   {ver}/{desc.name}: {err}")
+            else:
+                log(f"ok    {ver}/{desc.name}")
+    return {"versions": len(versions), "blobs": blob_count,
+            "bytes": total_bytes, "errors": problems}

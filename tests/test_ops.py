@@ -1,0 +1,111 @@
+"""Registry ops (client/ops.py): cross-registry copy with content-address
+skip, and verify (registry fsck) catching corruption."""
+
+import pytest
+
+from modelx_tpu.client.client import Client
+from modelx_tpu.client.ops import copy_model, verify_repo
+from modelx_tpu.registry.fs import MemoryFSProvider
+from modelx_tpu.registry.server import Options, RegistryServer, free_port
+from modelx_tpu.registry.store_fs import FSRegistryStore
+
+
+@pytest.fixture
+def two_registries():
+    servers = []
+    bases = []
+    stores = []
+    for _ in range(2):
+        store = FSRegistryStore(MemoryFSProvider())
+        srv = RegistryServer(
+            Options(listen=f"127.0.0.1:{free_port()}"), store=store
+        )
+        bases.append(srv.serve_background())
+        servers.append(srv)
+        stores.append(store)
+    yield bases, stores
+    for srv in servers:
+        srv.shutdown()
+
+
+@pytest.fixture
+def model_dir(tmp_path):
+    d = tmp_path / "m"
+    d.mkdir()
+    (d / "modelx.yaml").write_text("description: test\n")
+    (d / "weights.bin").write_bytes(b"W" * 8192)
+    (d / "vocab.txt").write_text("a\nb\n")
+    return str(d)
+
+
+class TestCopy:
+    def test_copy_between_registries(self, two_registries, model_dir, tmp_path):
+        (src, dst), _stores = two_registries[0], None
+        Client(src, quiet=True).push("library/m", "v1", model_dir)
+        out = copy_model(
+            Client(src, quiet=True).remote, "library/m", "v1",
+            Client(dst, quiet=True).remote, "library/m", "v1",
+        )
+        assert out["copied"] >= 2 and out["skipped"] == 0
+        # the copy is pullable and byte-identical
+        pulled = tmp_path / "pulled"
+        Client(dst, quiet=True).pull("library/m", "v1", str(pulled))
+        assert (pulled / "weights.bin").read_bytes() == b"W" * 8192
+        assert (pulled / "vocab.txt").read_text() == "a\nb\n"
+
+    def test_second_copy_skips_everything(self, two_registries, model_dir):
+        (src, dst) = two_registries[0]
+        Client(src, quiet=True).push("library/m", "v1", model_dir)
+        s, d = Client(src, quiet=True).remote, Client(dst, quiet=True).remote
+        copy_model(s, "library/m", "v1", d, "library/m", "v1")
+        again = copy_model(s, "library/m", "v1", d, "library/m", "v2")
+        assert again["copied"] == 0 and again["bytes"] == 0
+        assert again["skipped"] >= 2  # promote v1 -> v2 moved zero bytes
+
+    def test_within_registry_repo_promotion(self, two_registries, model_dir):
+        (src, _dst) = two_registries[0]
+        c = Client(src, quiet=True)
+        c.push("library/staging", "v1", model_dir)
+        out = copy_model(c.remote, "library/staging", "v1",
+                        c.remote, "library/prod", "v1")
+        assert out["copied"] >= 2
+        assert c.remote.exists_manifest("library/prod", "v1")
+
+
+class TestVerify:
+    def test_clean_repo_passes(self, two_registries, model_dir):
+        (src, _), _ = two_registries[0], None
+        Client(src, quiet=True).push("library/m", "v1", model_dir)
+        out = verify_repo(Client(src, quiet=True).remote, "library/m")
+        assert out["errors"] == []
+        assert out["versions"] == 1 and out["blobs"] >= 2
+        assert out["bytes"] > 0
+
+    def test_corrupted_blob_is_reported(self, two_registries, model_dir):
+        bases, stores = two_registries
+        src = bases[0]
+        store = stores[0]
+        c = Client(src, quiet=True)
+        c.push("library/m", "v1", model_dir)
+        manifest = c.get_manifest("library/m", "v1")
+        victim = next(b for b in manifest.blobs if b.name == "weights.bin")
+        # flip bytes directly in the store (path scheme: store.go:56-61)
+        from modelx_tpu.registry.store import blob_digest_path
+
+        import io
+
+        path = blob_digest_path("library/m", victim.digest)
+        store.fs.put(path, io.BytesIO(b"X" * victim.size), victim.size)
+        out = verify_repo(c.remote, "library/m")
+        assert len(out["errors"]) == 1
+        assert "digest mismatch" in out["errors"][0]
+        assert "weights.bin" in out["errors"][0]
+
+    def test_shared_blobs_hash_once_across_versions(self, two_registries, model_dir):
+        (src, _), _ = two_registries[0], None
+        c = Client(src, quiet=True)
+        c.push("library/m", "v1", model_dir)
+        c.push("library/m", "v2", model_dir)  # same content
+        out = verify_repo(c.remote, "library/m")
+        assert out["versions"] == 2
+        assert out["errors"] == []
