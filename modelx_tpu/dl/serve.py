@@ -279,12 +279,7 @@ class ModelServer:
                 dec = self._speculative_decoder()
                 new, stats = dec.generate(self.params, tokens[0].tolist(), max_new_tokens)
                 self.stats["tokens_generated"] += len(new)
-                self.stats["spec_device_steps"] = (
-                    self.stats.get("spec_device_steps", 0) + stats["device_steps"]
-                )
-                self.stats["spec_accepted"] = (
-                    self.stats.get("spec_accepted", 0) + stats["accepted"]
-                )
+                self._record_spec_stats(stats)
                 return np.concatenate(
                     [tokens, np.asarray([new], np.int32)], axis=1
                 )
@@ -348,6 +343,18 @@ class ModelServer:
         concatenated chunks equal the non-streaming result exactly."""
         if self.family.decode_fns is None:
             raise ValueError(f"family {self.family.name} does not support streaming")
+        tokens_arr = np.asarray(tokens, np.int32)
+        if (
+            self.speculative_k > 0
+            and tokens_arr.shape[0] == 1
+            and temperature == 0.0
+        ):
+            # single-row greedy stream: speculation's exact target — chunks
+            # flush per device step (accepted run + bonus token), and the
+            # concatenation still equals the plain stream token-for-token.
+            # (yield from, not return: this function is itself a generator)
+            yield from self._generate_stream_speculative(tokens_arr, max_new_tokens)
+            return
         dec = self._decoders.get(chunk_size)
         if dec is None:
             with self._decoders_lock:
@@ -357,11 +364,10 @@ class ModelServer:
 
                     fwd, init = self.family.decode_fns(self.cfg, mesh=self.mesh)
                     dec = self._decoders[chunk_size] = ChunkedDecoder(fwd, init, chunk_size)
-        tokens = np.asarray(tokens, np.int32)
-        b, s = tokens.shape
+        b, s = tokens_arr.shape
         pad_s = -(-s // 16) * 16  # bound compiled shapes like the batcher
         padded = np.zeros((b, pad_s), np.int32)
-        padded[:, :s] = tokens
+        padded[:, :s] = tokens_arr
         with trace.span("serve.generate_stream", model=self.name,
                         new_tokens=max_new_tokens):
             for piece in dec.stream(
@@ -376,6 +382,30 @@ class ModelServer:
                 # erase the decode work the device already did
                 self.stats["tokens_generated"] += int(piece.size)
                 yield piece
+
+    def _record_spec_stats(self, stats: dict) -> None:
+        self.stats["spec_device_steps"] = (
+            self.stats.get("spec_device_steps", 0) + stats["device_steps"]
+        )
+        self.stats["spec_accepted"] = (
+            self.stats.get("spec_accepted", 0) + stats["accepted"]
+        )
+
+    def _generate_stream_speculative(self, tokens: np.ndarray, max_new_tokens: int):
+        dec = self._speculative_decoder()
+        stats = {"device_steps": 0, "proposed": 0, "accepted": 0}
+        try:
+            with trace.span("serve.generate_stream_spec", model=self.name,
+                            new_tokens=max_new_tokens):
+                for piece in dec.stream(self.params, tokens[0].tolist(),
+                                        max_new_tokens, stats=stats):
+                    self.stats["tokens_generated"] += int(piece.size)
+                    yield piece
+        finally:
+            # an early-stopped consumer (SSE stop match, client disconnect)
+            # closes the generator mid-loop; the device work already
+            # happened and must still show up in /metrics
+            self._record_spec_stats(stats)
 
     def generate_ragged(
         self, tokens: np.ndarray, row_lens: np.ndarray, max_new_tokens: int,

@@ -133,10 +133,24 @@ class SpeculativeDecoder:
     ) -> tuple[list[int], dict]:
         """Greedy-decode ``max_new_tokens`` tokens after ``prompt_ids``
         (a 1-D int sequence). Token-exact vs plain greedy decode."""
-        prompt_ids = [int(t) for t in prompt_ids]
         stats = {"device_steps": 0, "proposed": 0, "accepted": 0}
+        out: list[int] = []
+        for chunk in self.stream(params, prompt_ids, max_new_tokens, stats=stats):
+            out.extend(chunk[0].tolist())
+        return out, stats
+
+    def stream(self, params, prompt_ids, max_new_tokens: int, stats: dict | None = None):
+        """Yields [1, c] arrays of NEW tokens — one chunk per device step
+        (first token, then each verify step's accepted run + bonus token).
+        The concatenation equals ``generate``'s output exactly, which in
+        turn equals plain greedy decode; a speculative stream flushes
+        FASTER precisely when acceptance is high. ``stats`` (optional dict)
+        accumulates device_steps/proposed/accepted."""
+        prompt_ids = [int(t) for t in prompt_ids]
+        if stats is None:
+            stats = {"device_steps": 0, "proposed": 0, "accepted": 0}
         if max_new_tokens <= 0:
-            return [], stats
+            return
         s = len(prompt_ids)
         # + k+1 slack: a verify block near the budget may write past it.
         # Cache length rounds up to a power of two: every distinct cache
@@ -150,6 +164,7 @@ class SpeculativeDecoder:
         cache, first = self._prefill(params, prompt, cache)
         stats["device_steps"] += 1
         out = [int(first[0])]
+        yield np.asarray([[out[0]]], np.int32)
         seq = prompt_ids + out
         index = _NgramIndex(self.max_ngram)
         index.extend(seq, 0)
@@ -181,10 +196,11 @@ class SpeculativeDecoder:
             out.extend(new)
             seq.extend(new)
             index.extend(seq, grown_from)
+            if new:
+                yield np.asarray([new], np.int32)
             # rewind past any rejected/padded cache garbage: only the block
             # tokens that produced accepted output are verified history
             offset += a + 1
-        return out, stats
 
 
 def speculative_generate(

@@ -154,6 +154,57 @@ class TestServeIntegration:
             spec.generate(multi, max_new_tokens=4),
         )
 
+    def test_stream_chunks_concat_to_generate(self, model):
+        """dec.stream's chunks concatenate to exactly dec.generate's output
+        (which equals plain greedy); stats accumulate identically."""
+        params, _cfg, fwd, init = model
+        prompt = [5, 6, 5, 6, 5, 6]
+        dec = SpeculativeDecoder(fwd, init, k=4)
+        want, want_stats = dec.generate(params, prompt, 10)
+        stats = {"device_steps": 0, "proposed": 0, "accepted": 0}
+        chunks = list(dec.stream(params, prompt, 10, stats=stats))
+        got = [t for c in chunks for t in c[0].tolist()]
+        assert got == want
+        assert stats == want_stats
+
+    def test_speculative_stream_matches_plain_stream(self, model, tmp_path):
+        """HTTP streaming on a --speculative-k server returns the same
+        tokens as a plain server's stream (chunk boundaries may differ)."""
+        import requests as rq
+
+        from modelx_tpu.dl import safetensors as st
+        from modelx_tpu.dl.serve import ModelServer, ServerSet, serve
+        from modelx_tpu.registry.server import free_port
+
+        params, _cfg, _fwd, _init = model
+        d = tmp_path / "m3"
+        d.mkdir()
+        st.write_safetensors(
+            str(d / "model.safetensors"), {k: np.asarray(v) for k, v in params.items()}
+        )
+        outs = {}
+        for label, k in (("plain", 0), ("spec", 5)):
+            server = ModelServer(str(d), mesh_spec="dp=1", dtype="float32",
+                                 name=label, speculative_k=k)
+            sset = ServerSet({label: server})
+            base = f"http://127.0.0.1:{free_port()}"
+            httpd = serve(sset, listen=base.rsplit("//", 1)[1])
+            try:
+                server.load()
+                import json as _json
+
+                body = {"tokens": [[5, 6, 5, 6]], "max_new_tokens": 8, "stream": True}
+                with rq.post(f"{base}/v1/{label}/generate", json=body, stream=True) as r:
+                    assert r.status_code == 200, r.text
+                    lines = [_json.loads(ln) for ln in r.iter_lines() if ln]
+                assert lines[-1] == {"done": True}
+                outs[label] = [t for ln in lines[:-1] for t in ln["tokens"][0]]
+                if k:
+                    assert server.stats.get("spec_device_steps", 0) >= 1
+            finally:
+                httpd.shutdown()
+        assert outs["spec"] == outs["plain"]
+
     def test_speculation_not_inert_under_dynamic_batch(self, model, tmp_path):
         """--dynamic-batch routes generates through the batcher; a
         single-row greedy request must still reach the speculative path."""
