@@ -27,6 +27,7 @@ from modelx_tpu.dl.sharding import (
     GPT2_RULES,
     LLAMA_RULES,
     MIXTRAL_RULES,
+    QWEN2_RULES,
     Rules,
     infer_family,
 )
@@ -79,8 +80,19 @@ def infer_llama_config(params: dict):
     q = _shape(params, "model.layers.0.self_attn.q_proj.weight")[0]
     kv = _shape(params, "model.layers.0.self_attn.k_proj.weight")[0]
     inter = _shape(params, "model.layers.0.mlp.gate_proj.weight")[0]
-    # head_dim heuristics: llama uses 128 for big models; fall back to h/32
-    head_dim = 128 if q % 128 == 0 and q // 128 >= 8 else max(q // 32, 32)
+    # head_dim heuristics: big models use 128 (llama/mistral/qwen2-7B+)
+    # unless that would leave fewer than 2 kv heads. kv=128 is genuinely
+    # ambiguous — MQA-128 (32 q heads x 1 kv head, e.g. q=4096) vs
+    # qwen2-0.5B (14 x 64, 2 kv heads, q=896) — so 128 also wins when the
+    # checkpoint is clearly big (q//128 >= 8, the pre-qwen2 rule), which
+    # keeps MQA llama checkpoints correct while 0.5B-class models (q//128
+    # == 7) fall to 64
+    if q % 128 == 0 and kv % 128 == 0 and (kv // 128 >= 2 or q // 128 >= 8):
+        head_dim = 128
+    elif q % 64 == 0 and kv % 64 == 0 and kv // 64 >= 2:
+        head_dim = 64
+    else:
+        head_dim = max(q // 32, 32)
     if hidden <= 512:  # toy checkpoints
         head_dim = 32
     return llama.LlamaConfig(
@@ -216,6 +228,15 @@ def infer_gpt2_config(params: dict):
     )
 
 
+def infer_qwen2_config(params: dict):
+    """Qwen2 = llama's decoder with qkv input biases; same inference plus
+    the bias flag and qwen2's constants (rms eps 1e-6, rope theta 1e6 —
+    every released Qwen2/2.5 uses these; shapes can't reveal them)."""
+    cfg = infer_llama_config(params)
+    return dataclasses.replace(cfg, qkv_bias=True, rms_eps=1e-6,
+                               rope_theta=1_000_000.0)
+
+
 def _gpt2_forward(params, tokens, cfg, mesh=None):
     from modelx_tpu.models import gpt2
 
@@ -281,6 +302,10 @@ def _bert_forward(params, tokens, cfg, mesh=None):
 
 FAMILIES: dict[str, Family] = {
     "llama": Family("llama", LLAMA_RULES, infer_llama_config, _llama_forward,
+                    _llama_generate, _llama_generate_ragged, _llama_decode_fns),
+    # same decoder implementation as llama — the bias params flow through
+    # the param dict, so every llama entry point serves qwen2 unchanged
+    "qwen2": Family("qwen2", QWEN2_RULES, infer_qwen2_config, _llama_forward,
                     _llama_generate, _llama_generate_ragged, _llama_decode_fns),
     "mixtral": Family("mixtral", MIXTRAL_RULES, infer_mixtral_config, _mixtral_forward,
                       _mixtral_generate, _mixtral_generate_ragged, _mixtral_decode_fns),

@@ -338,9 +338,11 @@ class ModelServer:
         seed: int = 0,
         chunk_size: int = 8,
     ):
-        """Yields [B, k] arrays of new tokens as they decode (k <=
-        chunk_size) — the transport behind streaming /v1/generate. The
-        concatenated chunks equal the non-streaming result exactly."""
+        """Yields [B, k] arrays of new tokens as they decode — the transport
+        behind streaming /v1/generate. On the plain path k <= chunk_size;
+        the speculative path instead emits one chunk per device step (up to
+        speculative_k + 1 tokens). Either way the concatenated chunks equal
+        the non-streaming result exactly."""
         if self.family.decode_fns is None:
             raise ValueError(f"family {self.family.name} does not support streaming")
         tokens_arr = np.asarray(tokens, np.int32)

@@ -92,6 +92,13 @@ LLAMA_FSDP_RULES: Rules = [
     (r".*", []),
 ]
 
+# Qwen2 (HF names): llama's layout plus q/k/v input biases, which split
+# with their column-parallel weights' output features (dim 0 over tp).
+QWEN2_RULES: Rules = [
+    (r"(q|k|v)_proj\.bias$", ["tp"]),
+    *LLAMA_RULES,
+]
+
 # GPT-2 (HF names; Conv1D weights are [in, out] so column-parallel = dim 1).
 GPT2_RULES: Rules = [
     (r"wte\.weight$", ["tp", None]),
@@ -133,6 +140,7 @@ MIXTRAL_RULES: Rules = [
 
 DEFAULT_RULES: dict[str, Rules] = {
     "llama": LLAMA_RULES,
+    "qwen2": QWEN2_RULES,
     "gpt2": GPT2_RULES,
     "bert": BERT_RULES,
     "mixtral": MIXTRAL_RULES,
@@ -148,6 +156,8 @@ def infer_family(tensor_names: Sequence[str]) -> str:
     joined = "\n".join(names)
     if "block_sparse_moe" in joined:
         return "mixtral"
+    if "q_proj.bias" in joined:
+        return "qwen2"  # llama layout + qkv biases
     if "q_proj" in joined or "gate_proj" in joined:
         return "llama"
     if "c_attn" in joined or "wte" in joined:

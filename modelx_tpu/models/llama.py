@@ -45,6 +45,9 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     rms_eps: float = 1e-5
     tie_embeddings: bool = False
+    # Qwen2-style attention input biases on q/k/v (the only architectural
+    # delta between the llama and qwen2 families; same decoder otherwise)
+    qkv_bias: bool = False
     dtype: Any = jnp.bfloat16
 
     @classmethod
@@ -88,6 +91,8 @@ def param_names(cfg: LlamaConfig) -> list[str]:
             p + "input_layernorm.weight",
             p + "post_attention_layernorm.weight",
         ]
+        if cfg.qkv_bias:
+            names += [p + s for s in BIAS_SUFFIXES]
     return names
 
 
@@ -117,6 +122,10 @@ def param_shapes(cfg: LlamaConfig) -> dict[str, tuple[int, ...]]:
                 p + "post_attention_layernorm.weight": (e,),
             }
         )
+        if cfg.qkv_bias:
+            shapes[p + "self_attn.q_proj.bias"] = (q,)
+            shapes[p + "self_attn.k_proj.bias"] = (kv,)
+            shapes[p + "self_attn.v_proj.bias"] = (kv,)
     return shapes
 
 
@@ -128,6 +137,10 @@ def init_params(cfg: LlamaConfig, key: jax.Array, dtype=None) -> dict[str, jax.A
     for (name, shape), k in zip(sorted(shapes.items()), keys):
         if name.endswith("layernorm.weight") or name.endswith("norm.weight"):
             params[name] = jnp.ones(shape, dtype)
+        elif name.endswith(".bias"):
+            # small random biases (not zeros): parity tests must catch a
+            # forward that forgets to add them
+            params[name] = (jax.random.normal(k, shape) * 0.05).astype(dtype)
         else:
             fan_in = shape[-1]
             params[name] = (jax.random.normal(k, shape) / math.sqrt(fan_in)).astype(dtype)
@@ -202,9 +215,9 @@ def decoder_layer(
     sparse-MoE block here so the attention half stays shared."""
     b, s = x.shape[:2]
     h = _rms_norm(x, lp["input_layernorm.weight"], cfg.rms_eps)
-    q = _linear(h, lp["self_attn.q_proj.weight"])
-    k = _linear(h, lp["self_attn.k_proj.weight"])
-    v = _linear(h, lp["self_attn.v_proj.weight"])
+    q = _linear(h, lp["self_attn.q_proj.weight"], lp.get("self_attn.q_proj.bias"))
+    k = _linear(h, lp["self_attn.k_proj.weight"], lp.get("self_attn.k_proj.bias"))
+    v = _linear(h, lp["self_attn.v_proj.weight"], lp.get("self_attn.v_proj.bias"))
     q = ctx.constrain(q.reshape(b, s, cfg.num_heads, cfg.head_dim), "dp", "sp", "tp", None)
     k = ctx.constrain(k.reshape(b, s, cfg.num_kv_heads, cfg.head_dim), "dp", "sp", "tp", None)
     v = ctx.constrain(v.reshape(b, s, cfg.num_kv_heads, cfg.head_dim), "dp", "sp", "tp", None)
@@ -258,6 +271,13 @@ LAYER_PARAM_SUFFIXES = (
     "post_attention_layernorm.weight",
 )
 
+# optional per-layer params (qwen2's qkv biases); present iff cfg.qkv_bias
+BIAS_SUFFIXES = (
+    "self_attn.q_proj.bias",
+    "self_attn.k_proj.bias",
+    "self_attn.v_proj.bias",
+)
+
 
 def forward(
     params: dict[str, jax.Array],
@@ -288,6 +308,9 @@ def forward(
     for i in range(cfg.num_layers):
         p = f"model.layers.{i}."
         lp = {suffix: params[p + suffix] for suffix in LAYER_PARAM_SUFFIXES}
+        for suffix in BIAS_SUFFIXES:
+            if p + suffix in params:
+                lp[suffix] = params[p + suffix]
         cache = (kv_cache[f"k{i}"], kv_cache[f"v{i}"]) if kv_cache is not None else None
         x, updated = decoder_layer(
             lp, x, positions, cfg, ctx, cache=cache, cache_offset=cache_offset,

@@ -117,9 +117,14 @@ class SpeculativeDecoder:
         self._prefill = jax.jit(self._prefill_impl, donate_argnums=(2,))
         self._verify = jax.jit(self._verify_impl, donate_argnums=(2,))
 
-    def _prefill_impl(self, params, prompt, cache):
+    def _prefill_impl(self, params, prompt, cache, last):
+        """``prompt`` is right-padded to a 16-aligned length so prompt-size
+        churn can't force per-request compiles; ``last`` (traced) is the
+        real final position whose logits pick the first token. Pad KV
+        entries are overwritten by subsequent verify writes or sit beyond
+        the causal horizon, so they never influence attention."""
         logits, cache = self.forward(params, prompt, kv_cache=cache, cache_offset=0)
-        return cache, jnp.argmax(logits[:, -1, :], axis=-1)  # [1]
+        return cache, jnp.argmax(logits[0, last, :], axis=-1)[None]  # [1]
 
     def _verify_impl(self, params, block, cache, offset):
         """block: [1, k+1] = last accepted token + padded proposals. Returns
@@ -152,16 +157,21 @@ class SpeculativeDecoder:
         if max_new_tokens <= 0:
             return
         s = len(prompt_ids)
+        # pad the prompt to a 16-aligned length (same bucketing as the
+        # serving stream path): distinct prompt lengths must not each
+        # compile a fresh prefill program
+        pad_s = -(-s // 16) * 16
+        padded = prompt_ids + [0] * (pad_s - s)
         # + k+1 slack: a verify block near the budget may write past it.
         # Cache length rounds up to a power of two: every distinct cache
         # shape compiles a fresh program pair, and a client cycling
         # max_new_tokens must not be able to force hundreds of compiles
         # (same guard as ChunkedDecoder.stream / the batcher's buckets)
-        need = s + max_new_tokens + self.k + 1
+        need = max(pad_s, s + max_new_tokens + self.k) + 1
         cache_len = 1 << (need - 1).bit_length()
         cache = self.init_kv_cache(1, cache_len)
-        prompt = jnp.asarray([prompt_ids], jnp.int32)
-        cache, first = self._prefill(params, prompt, cache)
+        prompt = jnp.asarray([padded], jnp.int32)
+        cache, first = self._prefill(params, prompt, cache, jnp.int32(s - 1))
         stats["device_steps"] += 1
         out = [int(first[0])]
         yield np.asarray([[out[0]]], np.int32)

@@ -27,13 +27,19 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from modelx_tpu.models import llama
 
 
+_ALL_SUFFIXES = llama.LAYER_PARAM_SUFFIXES + llama.BIAS_SUFFIXES
+
+
 def stack_layer_params(params: dict[str, jax.Array], num_layers: int) -> dict[str, jax.Array]:
     """Fold "model.layers.N.<suffix>" params into stacked [L, ...] arrays
-    keyed by suffix. Non-layer params pass through under their own names."""
+    keyed by suffix (qwen2's optional qkv biases included when present).
+    Non-layer params pass through under their own names."""
     out: dict[str, jax.Array] = {
         name: v for name, v in params.items() if not name.startswith("model.layers.")
     }
-    for suffix in llama.LAYER_PARAM_SUFFIXES:
+    for suffix in _ALL_SUFFIXES:
+        if f"model.layers.0.{suffix}" not in params:
+            continue
         out[suffix] = jnp.stack(
             [params[f"model.layers.{i}.{suffix}"] for i in range(num_layers)]
         )
@@ -42,8 +48,10 @@ def stack_layer_params(params: dict[str, jax.Array], num_layers: int) -> dict[st
 
 def unstack_layer_params(stacked: dict[str, jax.Array], num_layers: int) -> dict[str, jax.Array]:
     """Inverse of stack_layer_params."""
-    out = {k: v for k, v in stacked.items() if k not in llama.LAYER_PARAM_SUFFIXES}
-    for suffix in llama.LAYER_PARAM_SUFFIXES:
+    out = {k: v for k, v in stacked.items() if k not in _ALL_SUFFIXES}
+    for suffix in _ALL_SUFFIXES:
+        if suffix not in stacked:
+            continue
         for i in range(num_layers):
             out[f"model.layers.{i}.{suffix}"] = stacked[suffix][i]
     return out
@@ -51,14 +59,16 @@ def unstack_layer_params(stacked: dict[str, jax.Array], num_layers: int) -> dict
 
 def stacked_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
     """Shardings for a stacked param dict: layers over pp, per-layer specs
-    derived from the canonical LLAMA_RULES (so tp layout can't drift)."""
-    from modelx_tpu.dl.sharding import LLAMA_RULES, clean_spec, spec_for
+    derived from the canonical rules (so tp layout can't drift). QWEN2_RULES
+    is LLAMA_RULES plus the qkv-bias specs; extra entries for params a dict
+    doesn't have are simply unused."""
+    from modelx_tpu.dl.sharding import QWEN2_RULES, clean_spec, spec_for
 
     sh = {}
     for name in ("model.embed_tokens.weight", "model.norm.weight", "lm_head.weight"):
-        sh[name] = NamedSharding(mesh, clean_spec(spec_for(name, LLAMA_RULES), mesh))
-    for suffix in llama.LAYER_PARAM_SUFFIXES:
-        spec = P("pp", *spec_for(suffix, LLAMA_RULES))
+        sh[name] = NamedSharding(mesh, clean_spec(spec_for(name, QWEN2_RULES), mesh))
+    for suffix in _ALL_SUFFIXES:
+        spec = P("pp", *spec_for(suffix, QWEN2_RULES))
         sh[suffix] = NamedSharding(mesh, clean_spec(spec, mesh))
     return sh
 
@@ -86,7 +96,7 @@ def pipeline_forward(
     x = jnp.take(stacked["model.embed_tokens.weight"], tokens, axis=0).astype(cfg.dtype)
     x_mb = x.reshape(m, mb, s, cfg.hidden_size)
 
-    layer_stack = {k: stacked[k] for k in llama.LAYER_PARAM_SUFFIXES}
+    layer_stack = {k: stacked[k] for k in _ALL_SUFFIXES if k in stacked}
 
     def stage_scan(local_layers, h):
         def body(h, lp):

@@ -9,6 +9,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from modelx_tpu.dl import families as fam
 from modelx_tpu.dl.sharding import BERT_RULES, GPT2_RULES
 from modelx_tpu.models import bert, gpt2
 from modelx_tpu.parallel.mesh import make_mesh
@@ -175,6 +176,122 @@ class TestLlamaHFParity:
         )
         got, _ = llama.forward(params, jnp.asarray(tokens, jnp.int32), cfg)
         np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-4)
+
+
+class TestQwen2:
+    def test_detected_and_inferred(self):
+        from modelx_tpu.dl.sharding import infer_family
+        from modelx_tpu.models import llama
+
+        cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64),
+                                  qkv_bias=True, dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        assert any(k.endswith("q_proj.bias") for k in params)
+        assert infer_family(list(params)) == "qwen2"
+        family = fam.detect(list(params))
+        icfg = family.infer_config(params)
+        assert icfg.qkv_bias and icfg.rms_eps == 1e-6
+        assert icfg.rope_theta == 1_000_000.0
+
+    def test_head_dim_inference_qwen2_0p5b_shapes(self):
+        """Qwen2-0.5B: 14 heads x 64 with 2 kv heads. head_dim=128 would
+        'fit' (7 x 1) but garble attention; the kv>=2-heads rule must pick
+        64."""
+        import ml_dtypes
+
+        shapes = {
+            "model.embed_tokens.weight": (151936, 896),
+            "model.layers.0.self_attn.q_proj.weight": (896, 896),
+            "model.layers.0.self_attn.k_proj.weight": (128, 896),
+            "model.layers.0.mlp.gate_proj.weight": (4864, 896),
+        }
+        params = {k: jax.ShapeDtypeStruct(v, ml_dtypes.bfloat16) for k, v in shapes.items()}
+        cfg = fam.infer_llama_config(params)
+        assert (cfg.head_dim, cfg.num_heads, cfg.num_kv_heads) == (64, 14, 2)
+        # llama3-8b shapes still infer 128 (32 heads, 8 kv)
+        shapes = {
+            "model.embed_tokens.weight": (128256, 4096),
+            "model.layers.0.self_attn.q_proj.weight": (4096, 4096),
+            "model.layers.0.self_attn.k_proj.weight": (1024, 4096),
+            "model.layers.0.mlp.gate_proj.weight": (14336, 4096),
+        }
+        params = {k: jax.ShapeDtypeStruct(v, ml_dtypes.bfloat16) for k, v in shapes.items()}
+        cfg = fam.infer_llama_config(params)
+        assert (cfg.head_dim, cfg.num_heads, cfg.num_kv_heads) == (128, 32, 8)
+
+    def test_biases_affect_forward(self):
+        """A forward that ignored the biases would match the stripped dict;
+        it must not."""
+        from modelx_tpu.models import llama
+
+        cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64),
+                                  qkv_bias=True, dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jnp.array([[1, 2, 3]], jnp.int32)
+        with_bias, _ = llama.forward(params, tokens, cfg)
+        stripped = {k: v for k, v in params.items() if not k.endswith(".bias")}
+        without, _ = llama.forward(stripped, tokens, cfg)
+        assert not np.allclose(np.asarray(with_bias), np.asarray(without))
+
+    def test_matches_huggingface(self, tmp_path):
+        from modelx_tpu.dl.sharding import QWEN2_RULES
+        from modelx_tpu.models import llama
+
+        hf_cfg = transformers.Qwen2Config(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-6,
+            attention_dropout=0.0, tie_word_embeddings=False,
+        )
+        torch.manual_seed(0)
+        hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+        tokens = np.array([[3, 14, 15, 92, 65]], np.int64)
+        with torch.no_grad():
+            want = hf(torch.tensor(tokens)).logits.numpy()
+
+        from modelx_tpu.dl import safetensors as st
+        from modelx_tpu.dl.loader import LocalFileSource, load_safetensors
+
+        sd = {k: v.numpy() for k, v in hf.state_dict().items() if "rotary_emb" not in k}
+        path = str(tmp_path / "qwen2.safetensors")
+        st.write_safetensors(path, sd)
+        mesh = make_mesh("tp=2", devices=jax.devices()[:2])
+        params, _ = load_safetensors(LocalFileSource(path), mesh, QWEN2_RULES)
+        # biases landed tp-sharded like their weights' output features
+        qb = params["model.layers.0.self_attn.q_proj.bias"]
+        assert {s.data.shape for s in qb.addressable_shards} == {(16,)}
+
+        cfg = llama.LlamaConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64, num_layers=2,
+            num_heads=4, num_kv_heads=2, head_dim=8, rope_theta=10000.0,
+            rms_eps=1e-6, qkv_bias=True, dtype=jnp.float32,
+        )
+        got, _ = llama.forward(params, jnp.asarray(tokens, jnp.int32), cfg)
+        np.testing.assert_allclose(np.asarray(got), want, atol=2e-4, rtol=2e-4)
+
+    def test_serves_end_to_end(self, tmp_path):
+        from modelx_tpu.dl import safetensors as st
+        from modelx_tpu.dl.serve import ModelServer
+        from modelx_tpu.models import llama
+
+        # constants must match what family inference assumes for qwen2
+        cfg = dataclasses.replace(
+            llama.LlamaConfig.tiny(vocab_size=64), qkv_bias=True,
+            dtype=jnp.float32, rope_theta=1_000_000.0, rms_eps=1e-6,
+        )
+        params = llama.init_params(cfg, jax.random.PRNGKey(1))
+        d = tmp_path / "qwen"
+        d.mkdir()
+        st.write_safetensors(
+            str(d / "model.safetensors"), {k: np.asarray(v) for k, v in params.items()}
+        )
+        server = ModelServer(str(d), mesh_spec="dp=1", dtype="float32", name="q")
+        server.load()
+        assert server.family.name == "qwen2"
+        prompt = np.asarray([[1, 2, 3]], np.int32)
+        got = server.generate(prompt, max_new_tokens=4)
+        want = llama.greedy_generate(params, jnp.asarray(prompt), cfg, max_new_tokens=4)
+        np.testing.assert_array_equal(got, np.asarray(want))
 
 
 class TestMixtral:

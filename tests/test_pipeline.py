@@ -37,6 +37,29 @@ class TestStacking:
 
 
 class TestPipelineForward:
+    def test_qwen2_biases_survive_stack_and_pipeline(self):
+        """qwen2's qkv biases must stack, shard, and flow through the
+        pipelined forward — dropping them silently would compute bias-free
+        logits with no error."""
+        cfg = dataclasses.replace(_tiny_fp32(num_layers=4), qkv_bias=True)
+        params = llama.init_params(cfg, jax.random.PRNGKey(2))
+        stacked = stack_layer_params(params, cfg.num_layers)
+        assert "self_attn.q_proj.bias" in stacked
+        back = unstack_layer_params(stacked, cfg.num_layers)
+        assert set(back) == set(params)
+
+        tokens = jnp.array(
+            np.random.RandomState(1).randint(1, 64, size=(4, 8)), jnp.int32
+        )
+        want, _ = llama.forward(params, tokens, cfg)
+        mesh = make_mesh("pp=4,dp=2")
+        sh = stacked_shardings(mesh)
+        placed = {k: jax.device_put(v, sh[k]) for k, v in stacked.items()}
+        got = jax.jit(
+            lambda p, t: pipeline_forward(p, t, cfg, mesh, num_microbatches=2)
+        )(placed, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-5, rtol=1e-5)
+
     def test_matches_plain_forward(self):
         cfg = _tiny_fp32(num_layers=4)
         params = llama.init_params(cfg, jax.random.PRNGKey(1))
