@@ -366,8 +366,10 @@ class ModelServer:
 
                     fwd, init = self.family.decode_fns(self.cfg, mesh=self.mesh)
                     dec = self._decoders[chunk_size] = ChunkedDecoder(fwd, init, chunk_size)
+        from modelx_tpu.models.decode import pad_seq_len
+
         b, s = tokens_arr.shape
-        pad_s = -(-s // 16) * 16  # bound compiled shapes like the batcher
+        pad_s = pad_seq_len(s)  # bound compiled shapes like the batcher
         padded = np.zeros((b, pad_s), np.int32)
         padded[:, :s] = tokens_arr
         with trace.span("serve.generate_stream", model=self.name,
@@ -559,9 +561,11 @@ class Batcher:
         """Right-pad a list of [b,s] token arrays into one padded batch:
         seq to a multiple of 16, batch rows to a power of two — bounding
         the set of compiled shapes. Returns (batch, spans=[(start, b, s)])."""
+        from modelx_tpu.models.decode import pad_seq_len
+
         rows = sum(t.shape[0] for t in token_rows)
         max_s = max(t.shape[1] for t in token_rows)
-        pad_s = -(-max_s // 16) * 16
+        pad_s = pad_seq_len(max_s)
         pad_b = 1 << (rows - 1).bit_length()
         batch = np.zeros((pad_b, pad_s), np.int32)
         r = 0

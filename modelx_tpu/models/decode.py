@@ -8,6 +8,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+SEQ_BUCKET = 16
+
+
+def pad_seq_len(s: int) -> int:
+    """Round a prompt length up to the shared bucket quantum: every decode
+    path (batcher, stream, speculative prefill) buckets compiled prompt
+    shapes identically so length churn can't force per-request compiles."""
+    return -(-s // SEQ_BUCKET) * SEQ_BUCKET
+
 
 def greedy_generate(
     forward,  # (params, tokens, kv_cache=, cache_offset=, mesh=) -> (logits, cache)

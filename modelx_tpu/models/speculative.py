@@ -157,10 +157,11 @@ class SpeculativeDecoder:
         if max_new_tokens <= 0:
             return
         s = len(prompt_ids)
-        # pad the prompt to a 16-aligned length (same bucketing as the
-        # serving stream path): distinct prompt lengths must not each
-        # compile a fresh prefill program
-        pad_s = -(-s // 16) * 16
+        # pad the prompt to the shared decode bucket: distinct prompt
+        # lengths must not each compile a fresh prefill program
+        from modelx_tpu.models.decode import pad_seq_len
+
+        pad_s = pad_seq_len(s)
         padded = prompt_ids + [0] * (pad_s - s)
         # + k+1 slack: a verify block near the budget may write past it.
         # Cache length rounds up to a power of two: every distinct cache

@@ -99,8 +99,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sm_
 
     num_k = seq_k // block_k
     if causal:
-        # skip fully-masked k blocks beyond this q block
-        num_k = jnp.minimum(num_k, (q_idx + 1) * block_q // block_k + (block_q // block_k > 0))
+        # skip fully-masked k blocks beyond this q block: exact ceiling of
+        # the last visible key over block_k. (The previous floor-based form
+        # computed ZERO blocks for early q blocks whenever block_k >
+        # block_q, silently zeroing those output rows.)
+        num_k = jnp.minimum(num_k, ((q_idx + 1) * block_q + block_k - 1) // block_k)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)

@@ -52,6 +52,15 @@ class TestAttentionOps:
         fl = attn.flash_attention(self.q, self.k, self.v, causal=False, block_q=64, block_k=64)
         np.testing.assert_allclose(np.asarray(fl), np.asarray(ref), atol=2e-6)
 
+    def test_flash_block_k_larger_than_block_q(self):
+        """block_k > block_q: the causal k-block cap must be an exact
+        ceiling — the floor form computed ZERO visible blocks for early q
+        blocks and returned all-zero rows."""
+        ref = attn.attention_reference(self.q, self.k, self.v)
+        fl = attn.flash_attention(self.q, self.k, self.v, block_q=32, block_k=128)
+        np.testing.assert_allclose(np.asarray(fl), np.asarray(ref), atol=2e-6)
+        assert np.abs(np.asarray(fl)).sum() > 0
+
     def test_gqa(self):
         kv = self.k[:, :2], self.v[:, :2]
         ref = attn.attention_reference(self.q, *kv)
