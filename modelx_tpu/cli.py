@@ -249,6 +249,31 @@ def cmd_copy(src: str, dst: str, quiet: bool) -> None:
         _fail(e)
 
 
+@main.command("diff")
+@click.argument("a", shell_complete=_complete_ref)
+@click.argument("b", shell_complete=_complete_ref)
+def cmd_diff(a: str, b: str) -> None:
+    """Manifest-level diff of two model versions (no blob bytes move):
+    which blobs were added/removed/changed, how many bytes a pull or copy
+    would actually transfer, and — when tensor-index annotations are
+    present — which tensors changed layout."""
+    from modelx_tpu.client.ops import diff_versions
+
+    try:
+        ra, rb = parse_reference(a), parse_reference(b)
+        if not ra.repository or not rb.repository:
+            raise ValueError("both references must include a repository")
+        if not ra.version or not rb.version:
+            raise ValueError("both references need a version (repo@version)")
+        out = diff_versions(
+            ra.client().remote, ra.repository, ra.version,
+            rb.client().remote, rb.repository, rb.version,
+        )
+        click.echo(json.dumps(out))
+    except (errors.ErrorInfo, ValueError) as e:
+        _fail(e)
+
+
 @main.command("verify")
 @click.argument("ref", shell_complete=_complete_ref)
 @click.option("--quiet", is_flag=True, help="suppress per-blob lines")
@@ -489,7 +514,7 @@ def cmd_completion(shell: str) -> None:
 # commands whose FIRST positional argument is a model reference; later
 # positions are directories (filename completion is the shell's own job) —
 # except `copy`, whose second position is also a ref
-_REF_COMMANDS = ("push", "pull", "info", "list", "gc", "dl", "copy", "verify")
+_REF_COMMANDS = ("push", "pull", "info", "list", "gc", "dl", "copy", "verify", "diff")
 
 
 @main.command(
@@ -514,7 +539,8 @@ def cmd_hidden_complete(words: tuple[str, ...]) -> None:
             return
         # only the ref argument completes remotely: `push <ref> <dir>` must
         # not offer repo refs for the directory slot
-        ref_positions = 2 if args[0] == "copy" else 1  # copy: both args are refs
+        # copy/diff: both positional args are refs
+        ref_positions = 2 if args[0] in ("copy", "diff") else 1
         if (
             args[0] in _REF_COMMANDS
             and len(args) <= ref_positions

@@ -86,6 +86,70 @@ def copy_model(
             "bytes": moved}
 
 
+def diff_versions(
+    a_remote, a_repo: str, a_version: str,
+    b_remote, b_repo: str, b_version: str,
+) -> dict:
+    """Manifest-level diff of two model versions — zero blob bytes move.
+
+    Returns {added, removed, changed, unchanged: [blob names], bytes_added,
+    bytes_unchanged, tensors: {added, removed, layout_changed} | None}.
+    ``tensors`` compares the safetensors tensor-index annotations when both
+    sides carry them (docs/annotations.md): for a checkpoint re-pushed
+    after training, it names exactly which tensors changed inside a
+    changed blob (layout_changed = shape/dtype differs; same-layout
+    tensors in a changed blob are possibly-changed and not listed)."""
+    from modelx_tpu.dl import safetensors as st
+    from modelx_tpu.types import AnnotationTensorIndex
+
+    ma = a_remote.get_manifest(a_repo, a_version)
+    mb = b_remote.get_manifest(b_repo, b_version)
+    da = {d.name: d for d in ma.all_descriptors()}
+    db = {d.name: d for d in mb.all_descriptors()}
+    added = sorted(set(db) - set(da))
+    removed = sorted(set(da) - set(db))
+    changed = sorted(n for n in set(da) & set(db) if da[n].digest != db[n].digest)
+    unchanged = sorted(n for n in set(da) & set(db) if da[n].digest == db[n].digest)
+
+    tensors = None
+    pairs = [
+        (da[n], db[n]) for n in changed
+        if AnnotationTensorIndex in da[n].annotations
+        and AnnotationTensorIndex in db[n].annotations
+    ]
+    if pairs:
+        t_added, t_removed, t_changed = [], [], []
+        for desc_a, desc_b in pairs:
+            try:
+                ia, _ = st.parse_index_annotation(desc_a.annotations[AnnotationTensorIndex])
+                ib, _ = st.parse_index_annotation(desc_b.annotations[AnnotationTensorIndex])
+            except (ValueError, KeyError, TypeError) as e:
+                # a corrupt annotation (older/buggy pusher) degrades this
+                # pair to blob-level diff; it must not kill the whole diff
+                t_changed.append(f"<{desc_b.name}: unreadable tensor index: {e}>")
+                continue
+            t_added += sorted(set(ib) - set(ia))
+            t_removed += sorted(set(ia) - set(ib))
+            # the index carries shapes/dtypes/offsets, not content hashes:
+            # "changed" here means layout changed; same-layout tensors in a
+            # changed blob are "possibly changed" and are not listed
+            t_changed += sorted(
+                n for n in set(ia) & set(ib)
+                if (ia[n].shape, ia[n].dtype) != (ib[n].shape, ib[n].dtype)
+            )
+        tensors = {"added": t_added, "removed": t_removed,
+                   "layout_changed": t_changed}
+    return {
+        "added": added,
+        "removed": removed,
+        "changed": changed,
+        "unchanged": unchanged,
+        "bytes_added": sum(db[n].size for n in added + changed),
+        "bytes_unchanged": sum(db[n].size for n in unchanged),
+        "tensors": tensors,
+    }
+
+
 def verify_repo(
     remote,
     repository: str,

@@ -208,6 +208,10 @@ def run_completion(sset, req: dict, chat: bool) -> dict:
     n_tokens, samp = parse_sampling(req, sset.max_new_tokens_limit)
     stops = parse_stop(req)
 
+    if "stream_options" in req:
+        # OpenAI contract: only valid with stream=true — silently accepting
+        # it here would hide the misuse until the client flips stream on
+        raise APIError(400, "stream_options is only allowed when stream is true")
     batcher = sset.batcher_for(server)
     engine = batcher if (batcher is not None and server.family.generate_ragged is not None) else server
     if (
@@ -276,6 +280,10 @@ def stream_completion(sset, req: dict, chat: bool) -> Iterator[dict]:
     if server.family.decode_fns is None:
         # fail before any SSE bytes hit the wire, not mid-stream
         raise APIError(400, f"model family {server.family.name!r} does not support streaming")
+    opts = req.get("stream_options")
+    if opts is not None and not isinstance(opts, dict):
+        raise APIError(400, "stream_options must be an object")
+    include_usage = bool((opts or {}).get("include_usage", False))
 
     server.stats["requests"] += 1
     # a stop sequence can straddle decode chunks ("hello wo" + "rld"):
@@ -347,6 +355,17 @@ def stream_completion(sset, req: dict, chat: bool) -> Iterator[dict]:
                 else {"index": 0, "text": "", "finish_reason": finish}
             ],
         }
+        if include_usage:  # stream_options.include_usage (OpenAI contract:
+            # a final chunk with empty choices carrying the usage)
+            yield {
+                **envelope,
+                "choices": [],
+                "usage": {
+                    "prompt_tokens": len(ids),
+                    "completion_tokens": len(new_ids),
+                    "total_tokens": len(ids) + len(new_ids),
+                },
+            }
 
     return events()
 

@@ -218,6 +218,28 @@ class TestStreaming:
                           for e in events[1:])
         assert isinstance(content, str)
 
+    def test_stream_include_usage(self, front):
+        base, _ = front
+        req = {"prompt": "hello world tpu", "max_tokens": 4, "temperature": 0,
+               "stream": True, "stream_options": {"include_usage": True}}
+        r = requests.post(base + "/v1/completions", json=req)
+        assert r.status_code == 200, r.text
+        events = self._events(r)
+        usage_events = [e for e in events if "usage" in e]
+        assert len(usage_events) == 1
+        assert usage_events[-1] is events[-1] and events[-1]["choices"] == []
+        assert events[-1]["usage"] == {"prompt_tokens": 3, "completion_tokens": 4,
+                                       "total_tokens": 7}
+        # invalid stream_options is a 400, not a silent ignore
+        r = requests.post(base + "/v1/completions",
+                          json={**req, "stream_options": 7})
+        assert r.status_code == 400
+        # and stream_options without stream=true is a 400 (OpenAI contract)
+        r = requests.post(base + "/v1/completions",
+                          json={**req, "stream": False})
+        assert r.status_code == 400
+        assert "stream" in r.json()["error"]["message"]
+
     def test_stream_validation_is_pre_status(self, front):
         base, _ = front
         r = requests.post(base + "/v1/completions",

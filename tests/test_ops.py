@@ -72,6 +72,55 @@ class TestCopy:
         assert c.remote.exists_manifest("library/prod", "v1")
 
 
+class TestDiff:
+    def test_diff_after_delta_repush(self, two_registries, model_dir, tmp_path):
+        """Change one file, re-push, diff: the changed blob is named and
+        bytes_added counts only what a pull would actually transfer."""
+        import pathlib
+
+        from modelx_tpu.client.ops import diff_versions
+
+        (src, _), _ = two_registries[0], None
+        c = Client(src, quiet=True)
+        c.push("library/m", "v1", model_dir)
+        pathlib.Path(model_dir, "weights.bin").write_bytes(b"Z" * 8192)
+        pathlib.Path(model_dir, "extra.txt").write_text("new\n")
+        c.push("library/m", "v2", model_dir)
+        out = diff_versions(c.remote, "library/m", "v1", c.remote, "library/m", "v2")
+        assert "weights.bin" in out["changed"]
+        assert out["added"] == ["extra.txt"]
+        assert "vocab.txt" in out["unchanged"]
+        assert out["removed"] == []
+        assert out["bytes_added"] >= 8192
+        assert out["bytes_unchanged"] > 0
+
+    def test_tensor_level_diff_from_annotations(self, two_registries, tmp_path):
+        """Safetensors blobs carry tensor indexes; a layout change between
+        versions is named tensor-by-tensor without moving blob bytes."""
+        import numpy as np
+
+        from modelx_tpu.client.ops import diff_versions
+        from modelx_tpu.dl import safetensors as st
+
+        (src, _), _ = two_registries[0], None
+        c = Client(src, quiet=True)
+        d = tmp_path / "m"
+        d.mkdir()
+        (d / "modelx.yaml").write_text("description: t\n")
+        st.write_safetensors(str(d / "model.safetensors"), {
+            "a": np.ones((2, 2), np.float32), "b": np.ones((3,), np.float32),
+        })
+        c.push("library/t", "v1", str(d))
+        st.write_safetensors(str(d / "model.safetensors"), {
+            "a": np.ones((4, 2), np.float32), "c": np.ones((3,), np.float32),
+        })
+        c.push("library/t", "v2", str(d))
+        out = diff_versions(c.remote, "library/t", "v1", c.remote, "library/t", "v2")
+        assert out["tensors"] == {
+            "added": ["c"], "removed": ["b"], "layout_changed": ["a"],
+        }
+
+
 class TestVerify:
     def test_clean_repo_passes(self, two_registries, model_dir):
         (src, _), _ = two_registries[0], None
