@@ -208,9 +208,10 @@ def run_completion(sset, req: dict, chat: bool) -> dict:
     n_tokens, samp = parse_sampling(req, sset.max_new_tokens_limit)
     stops = parse_stop(req)
 
-    if "stream_options" in req:
+    if req.get("stream_options") is not None:
         # OpenAI contract: only valid with stream=true — silently accepting
-        # it here would hide the misuse until the client flips stream on
+        # it here would hide the misuse until the client flips stream on.
+        # (An explicit null matches the streaming path's "absent" handling.)
         raise APIError(400, "stream_options is only allowed when stream is true")
     batcher = sset.batcher_for(server)
     engine = batcher if (batcher is not None and server.family.generate_ragged is not None) else server

@@ -668,6 +668,9 @@ class ServerSet:
         self._dynamic_batch = dynamic_batch
         self._batcher_lock = threading.Lock()
         self.batchers: dict[str, Batcher] = {}
+        # set on SIGTERM: /healthz flips to 503 so load balancers stop
+        # routing here while in-flight requests finish (graceful drain)
+        self.draining = False
 
     def batcher_for(self, server: ModelServer) -> "Batcher | None":
         """Lazily create a batcher once the model is loaded — only causal
@@ -685,7 +688,7 @@ class ServerSet:
 
     @property
     def ready(self) -> bool:
-        return all(s.ready for s in self.servers.values())
+        return not self.draining and all(s.ready for s in self.servers.values())
 
     def load_all(self, concurrent: bool = False) -> dict:
         """Load every model; ``concurrent`` overlaps the fetch phases (device
@@ -839,7 +842,7 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                 if sset.ready:
                     self._json(200, {"status": "ok"})
                 else:
-                    self._json(503, {"status": "loading"})
+                    self._json(503, {"status": "draining" if sset.draining else "loading"})
             elif self.path == "/metrics":
                 self._json(200, {n: dict(s.stats) for n, s in sset.servers.items()})
             elif self.path == "/v1/models":

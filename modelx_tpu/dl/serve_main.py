@@ -11,6 +11,7 @@ from __future__ import annotations
 import logging
 import signal
 import threading
+import time
 
 import click
 
@@ -40,10 +41,13 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
 @click.option("--lora", "loras", multiple=True, metavar="NAME=ADAPTER_DIR",
               help="merge a PEFT-style LoRA adapter into model NAME at load "
                    "('default' for --model-dir); repeatable")
+@click.option("--drain-seconds", default=5.0, type=float,
+              help="on SIGTERM, serve 503 on /healthz for this long (so load "
+                   "balancers drain) before stopping")
 def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen: str,
          max_seq_len: int, compile_cache: bool, concurrent_load: bool, trace_dir: str,
          dynamic_batch: bool, quantize: str | None, speculative_k: int,
-         loras: tuple[str, ...]) -> None:
+         loras: tuple[str, ...], drain_seconds: float) -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     from modelx_tpu.parallel.distributed import initialize
 
@@ -94,9 +98,28 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
     stats = sset.load_all(concurrent=concurrent_load)
     logging.getLogger("modelx.serve").info("models loaded: %s", stats)
     stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *a: stop.set())
-    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    sig = {"num": signal.SIGTERM}
+
+    def _on_signal(num, _frame):
+        sig["num"] = num
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
     stop.wait()
+    # graceful drain: flip /healthz to 503 so the load balancer stops
+    # routing here, give in-flight requests the drain window, then stop.
+    # Only for SIGTERM (the LB-managed path) — an interactive Ctrl-C must
+    # exit immediately, not sit in an unskippable sleep
+    sset.draining = True
+    if sig["num"] == signal.SIGTERM and drain_seconds > 0:
+        logging.getLogger("modelx.serve").info(
+            "draining for %.0fs before shutdown", drain_seconds)
+        time.sleep(drain_seconds)
+    # snapshot: requests during the drain window may still lazily create
+    # batchers while this iterates
+    for batcher in list(sset.batchers.values()):
+        batcher.close()
     httpd.shutdown()
 
 

@@ -102,6 +102,28 @@ class TestMultiTenant:
     def test_healthz_ready(self, front):
         assert requests.get(front + "/healthz").status_code == 200
 
+    def test_draining_flips_healthz_but_keeps_serving(self, checkpoints):
+        """Graceful drain: /healthz goes 503 (LB stops routing) while
+        inference routes keep answering in-flight traffic."""
+        from modelx_tpu.dl.serve import ModelServer, ServerSet, serve
+
+        server = ModelServer(
+            checkpoints["llama"], mesh_spec="dp=1", dtype="float32", name="d"
+        )
+        sset = ServerSet({"d": server})
+        base = f"http://127.0.0.1:{free_port()}"
+        httpd = serve(sset, listen=base.rsplit("//", 1)[1])
+        try:
+            server.load()
+            assert requests.get(base + "/healthz").status_code == 200
+            sset.draining = True
+            r = requests.get(base + "/healthz")
+            assert r.status_code == 503 and r.json()["status"] == "draining"
+            r = requests.post(base + "/v1/forward", json={"tokens": [[1, 2]]})
+            assert r.status_code == 200  # in-flight traffic still served
+        finally:
+            httpd.shutdown()
+
     def test_models_inventory(self, front):
         inv = requests.get(front + "/v1/models").json()
         assert inv["default"] == "lm"
