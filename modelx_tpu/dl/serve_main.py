@@ -11,7 +11,6 @@ from __future__ import annotations
 import logging
 import signal
 import threading
-import time
 
 import click
 
@@ -98,10 +97,11 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
     stats = sset.load_all(concurrent=concurrent_load)
     logging.getLogger("modelx.serve").info("models loaded: %s", stats)
     stop = threading.Event()
-    sig = {"num": signal.SIGTERM}
+    abort = threading.Event()  # SIGINT: skip/cut short any drain window
 
     def _on_signal(num, _frame):
-        sig["num"] = num
+        if num == signal.SIGINT:
+            abort.set()
         stop.set()
 
     signal.signal(signal.SIGTERM, _on_signal)
@@ -109,13 +109,14 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
     stop.wait()
     # graceful drain: flip /healthz to 503 so the load balancer stops
     # routing here, give in-flight requests the drain window, then stop.
-    # Only for SIGTERM (the LB-managed path) — an interactive Ctrl-C must
-    # exit immediately, not sit in an unskippable sleep
+    # Only for SIGTERM (the LB-managed path); an interactive Ctrl-C —
+    # whether it started the shutdown or lands MID-drain — exits now
+    # (an Event wait, unlike time.sleep, isn't resumed after the handler)
     sset.draining = True
-    if sig["num"] == signal.SIGTERM and drain_seconds > 0:
+    if not abort.is_set() and drain_seconds > 0:
         logging.getLogger("modelx.serve").info(
             "draining for %.0fs before shutdown", drain_seconds)
-        time.sleep(drain_seconds)
+        abort.wait(timeout=drain_seconds)
     # snapshot: requests during the drain window may still lazily create
     # batchers while this iterates
     for batcher in list(sset.batchers.values()):

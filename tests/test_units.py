@@ -1,0 +1,27 @@
+"""Human size formatting (utils/units.py; reference parity size.go:41-48)."""
+
+from modelx_tpu.utils.units import human_size, human_size_binary
+
+
+class TestHumanSize:
+    def test_decimal(self):
+        assert human_size(0) == "0B"
+        assert human_size(999) == "999B"
+        assert human_size(1000) == "1kB"
+        assert human_size(1500) == "1.5kB"
+        assert human_size(1_234_000) == "1.234MB"
+        assert human_size(4_290_000_000) == "4.29GB"  # README's doc example
+        assert human_size(1e18) == "1EB"
+
+    def test_binary(self):
+        assert human_size_binary(1023) == "1023B"
+        assert human_size_binary(1024) == "1KiB"
+        assert human_size_binary(1536) == "1.5KiB"
+        assert human_size_binary(1 << 30) == "1GiB"
+
+    def test_caps_at_largest_unit(self):
+        assert human_size(1e21).endswith("EB")  # never runs off the table
+
+    def test_four_significant_digits(self):
+        assert human_size(123_456) == "123.5kB"
+        assert human_size(999_999) == "1000kB"
