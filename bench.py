@@ -143,9 +143,11 @@ def probe_link_gbps(device, nbytes: int = 16 << 20, reps: int = 3) -> float:
     return nbytes / statistics.median(ts) / 1e9
 
 
-def run_ours(client, repo: str, desc, mesh, size: int) -> tuple[float, str]:
+def run_ours(client, repo: str, desc, mesh, size: int,
+             quantize: str | None = None) -> tuple[float, str, object]:
     """The loader path through the blob-location seam. Returns (seconds,
-    source-class name actually used — proves which engine ran)."""
+    source-class name actually used — proves which engine ran, LoadStats
+    for the fetch/device decomposition)."""
     from modelx_tpu.dl.initializer import _blob_source
     from modelx_tpu.dl.loader import load_safetensors
     from modelx_tpu.dl import safetensors as st
@@ -160,14 +162,15 @@ def run_ours(client, repo: str, desc, mesh, size: int) -> tuple[float, str]:
         tensors, data_offset = st.parse_index_annotation(desc.annotations[AnnotationTensorIndex])
     try:
         loaded, stats = load_safetensors(
-            source, mesh, LLAMA_RULES, tensors=tensors, data_offset=data_offset
+            source, mesh, LLAMA_RULES, tensors=tensors, data_offset=data_offset,
+            quantize=quantize,
         )
     finally:
         if hasattr(source, "close"):
             source.close()
     seconds = time.monotonic() - t0
     del loaded
-    return seconds, type(source).__name__
+    return seconds, type(source).__name__, stats
 
 
 def run_baseline(base: str, repo: str, desc, workdir: str, devices) -> float:
@@ -199,87 +202,72 @@ def run_baseline(base: str, repo: str, desc, workdir: str, devices) -> float:
     return seconds
 
 
-def measure_ttft(base: str, repo: str, workdir: str, runs: int = 5) -> dict:
-    """p50 registry->first-token (BASELINE north star), warm persistent XLA
-    cache. Each run starts from a cleared in-process jit cache
-    (``jax.clear_caches``): the deploy being modeled is a fresh sidecar that
-    ships a pre-warmed persistent compile cache but must re-trace and fetch
-    weights. The TPU on this rig is single-tenant, so a subprocess-per-run
-    harness can't hold the device while the bench does.
+def measure_ttft(base: str, repo: str, workdir: str, runs: int = 5,
+                 int8_runs: int = 2) -> dict:
+    """p50 registry->first-token (BASELINE north star), subprocess-per-run.
 
-    The flow is the product's overlap: the manifest's tensor-index
-    annotation fully describes the architecture, so the prefill program
-    AOT-compiles on a side thread while the loader streams weight bytes —
-    the first token pays max(load, compile), not the sum. First decoded
-    token == argmax of the prefill logits' last position (greedy); the
-    decode-with-cache program compiles off the TTFT clock."""
-    import threading
+    Each run is a FRESH process (``python -m modelx_tpu.dl.ttft``) with the
+    warm persistent caches a pre-baked sidecar image ships (XLA compile
+    cache + serialized-export cache): measured on this rig, the tunnel relay
+    collapses a process's host->device bandwidth ~15x after its first
+    program execution, so same-process repeat runs (the r3 harness) measured
+    the collapsed link, not deploy latency. The caller must NOT have
+    initialized the TPU backend yet — the child processes own the device
+    while this runs.
 
-    import jax
-
-    from modelx_tpu.client.client import Client
-    from modelx_tpu.dl import families as fam
-    from modelx_tpu.dl import safetensors as st
-    from modelx_tpu.dl.initializer import load_to_mesh
-    from modelx_tpu.dl.loader import fuse_expert_tensors
-    from modelx_tpu.dl.serve import enable_compile_cache
-    from modelx_tpu.parallel.mesh import make_mesh
-    from modelx_tpu.types import AnnotationTensorIndex
-
+    Reported decomposition (medians over scored runs): plan (manifest +
+    family detect), load (registry->HBM, overlapped with the AOT compile),
+    compile_join (leftover compile after load), first_exec. ``first_exec``
+    is dominated by a flat per-process relay program-setup cost on this rig
+    (~1.7-3.7 s even for an 8-element add — measured); on directly-attached
+    TPUs it is a normal dispatch, so ``ttft_weights_ready_ms`` (the
+    registry+loader leg this framework owns) is reported alongside the
+    headline."""
     cache_dir = os.path.join(workdir, "xla-cache")
-    enable_compile_cache(cache_dir)
-    samples, load_ms, token_ms = [], [], []
-    prompt = np.array([[1, 2, 3, 4]], np.int32)
-    for i in range(runs + 1):  # run 0 warms the persistent cache, unscored
-        jax.clear_caches()
-        t0 = time.monotonic()
-        client = Client(base, quiet=True)
-        manifest = client.get_manifest(repo, "v1")
-        # architecture from the manifest alone -> compile while bytes stream
-        infos: dict = {}
-        for blob in manifest.blobs:
-            if AnnotationTensorIndex in blob.annotations:
-                parsed, _off = st.parse_index_annotation(blob.annotations[AnnotationTensorIndex])
-                infos.update(parsed)
-        mesh = make_mesh("dp=1")
-        family = fam.detect(list(infos))
-        infos = fuse_expert_tensors(infos, family.rules)
-        cfg = family.infer_config(fam.abstract_params(infos))
-        sds = fam.abstract_params(infos, family.rules, mesh)
-        compiled: dict = {}
+    here = os.path.dirname(os.path.abspath(__file__))
+    existing = os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ,
+               PYTHONPATH=here + (os.pathsep + existing if existing else ""))
+    env.pop("JAX_PLATFORMS", None)  # children use the real device
 
-        def _compile(family=family, cfg=cfg, sds=sds, mesh=mesh, out=compiled):
-            try:
-                out["fwd"] = fam.precompile_forward(
-                    family, cfg, sds, prompt.shape, mesh=mesh, mode="argmax_last"
-                )
-            except BaseException as e:  # re-raised on the measuring thread
-                out["error"] = e
+    def run_once(quantize: str = "") -> dict:
+        cmd = [sys.executable, "-m", "modelx_tpu.dl.ttft", base, repo, cache_dir]
+        if quantize:
+            cmd.append(quantize)
+        p = subprocess.run(cmd, capture_output=True, text=True, env=env, timeout=900)
+        if p.returncode != 0:
+            raise RuntimeError(f"ttft run failed: {p.stderr[-2000:]}")
+        return json.loads(p.stdout.strip().splitlines()[-1])
 
-        th = threading.Thread(target=_compile, daemon=True)
-        th.start()
-        out = load_to_mesh(client, repo, manifest, mesh_spec="dp=1")
-        params = out["arrays"]
-        t1 = time.monotonic()
-        th.join()
-        if "error" in compiled:
-            raise RuntimeError("ttft precompile failed") from compiled["error"]
-        first = compiled["fwd"](params, jax.numpy.asarray(prompt))
-        np.asarray(first)
-        t2 = time.monotonic()
-        del params, out, first, compiled
+    records = []
+    for i in range(runs + 1):  # run 0 warms the persistent caches, unscored
+        rec = run_once()
         if i > 0:
-            samples.append((t2 - t0) * 1e3)
-            load_ms.append((t1 - t0) * 1e3)
-            token_ms.append((t2 - t1) * 1e3)
-    if not samples:
+            records.append(rec)
+    if not records:
         return {}
-    return {
-        "ttft_ms": round(statistics.median(samples), 1),
-        "ttft_ms_runs": [round(s, 1) for s in samples],
-        "ttft_load_ms": round(statistics.median(load_ms), 1),
-        "ttft_compile_token_ms": round(statistics.median(token_ms), 1),
+
+    def med(key: str) -> float:
+        return round(statistics.median(r[key] for r in records), 1)
+
+    out = {
+        "ttft_ms": med("ttft_ms"),
+        "ttft_ms_runs": [round(r["ttft_ms"], 1) for r in records],
+        "ttft_plan_ms": med("plan_ms"),
+        "ttft_load_ms": med("load_ms"),
+        "ttft_compile_join_ms": med("compile_join_ms"),
+        "ttft_first_exec_ms": med("first_exec_ms"),
+        "ttft_weights_ready_ms": med("weights_ready_ms"),
     }
+    if int8_runs > 0:
+        q_records = [run_once("int8") for _ in range(int8_runs + 1)][1:]
+        out["ttft_int8_ms"] = round(
+            statistics.median(r["ttft_ms"] for r in q_records), 1
+        )
+        out["ttft_int8_weights_ready_ms"] = round(
+            statistics.median(r["weights_ready_ms"] for r in q_records), 1
+        )
+    return out
 
 
 # stdlib-only puller (no jax import: interpreter startup must not drown the
@@ -513,16 +501,6 @@ def measure_serving(params: dict, mesh, device_kind: str, decode_only: bool = Fa
 
 
 def main() -> None:
-    import jax
-
-    from modelx_tpu import native
-    from modelx_tpu.dl.loader import load_safetensors
-    from modelx_tpu.dl.sharding import LLAMA_RULES
-    from modelx_tpu.dl.initializer import _blob_source
-    from modelx_tpu.parallel.mesh import make_mesh
-
-    devices = jax.devices()
-    device_kind = getattr(devices[0], "device_kind", str(devices[0]))
     workdir = tempfile.mkdtemp(prefix="modelx-bench-")
     settle_s = float(os.environ.get("BENCH_SETTLE_S", 8.0))
     srv = None
@@ -539,31 +517,70 @@ def main() -> None:
         build_checkpoint(ttft_ckpt, 48 * 1024 * 1024, hidden=512, inter=1408, vocab=8192)
         push_checkpoint(base, "library/ttft", ttft_ckpt)
 
+        # TTFT first and subprocess-per-run, BEFORE this process touches the
+        # device at all: executing any program collapses a process's
+        # host->device bandwidth ~15x on this rig's relay, so the deploy
+        # number must come from fresh processes and the loader legs below
+        # must run before this process's first execution (the serving legs).
+        ttft = measure_ttft(base, "library/ttft", workdir)
+
+        import jax
+
+        from modelx_tpu import native
+        from modelx_tpu.dl.loader import load_safetensors
+        from modelx_tpu.dl.sharding import LLAMA_RULES
+        from modelx_tpu.dl.initializer import _blob_source
+        from modelx_tpu.parallel.mesh import make_mesh
+
+        devices = jax.devices()
+        device_kind = getattr(devices[0], "device_kind", str(devices[0]))
         mesh = make_mesh(f"dp={len(devices)}")
 
         # warm up the device transfer path so neither leg pays setup costs
         link_gbps = probe_link_gbps(devices[0])
 
-        # TTFT first: a fresh deploy is not preceded by gigabytes of bench
-        # traffic, and the tunnel's burst bucket must not bill earlier legs
-        # to the deploy-latency number
-        ttft = measure_ttft(base, "library/ttft", workdir)
-
         # alternate legs with settle pauses (token-bucket tunnel; see module
         # docstring), baseline first = any leftover burst credit goes to the
         # reference's shape, not ours
         baseline_ts, ours_ts, engine_src = [], [], ""
+        fetch_stats, int8_ts = [], []
+        int8_stats = None
         for _ in range(3):  # best-of-3: the tunnel throttles unpredictably
             time.sleep(settle_s)
             baseline_ts.append(run_baseline(base, "library/bench", desc, workdir, devices))
             time.sleep(settle_s)
-            s, engine_src = run_ours(client, "library/bench", desc, mesh, size)
+            s, engine_src, stats = run_ours(client, "library/bench", desc, mesh, size)
             ours_ts.append(s)
+            fetch_stats.append(stats)
+            # int8 load leg inside the same loop + settles (one sample after
+            # the bandwidth-heavy legs would expose it alone to a drained
+            # burst bucket): the loader quantizes on the host, so HALF the
+            # bytes cross the link and the model decodes faster once
+            # resident (int8_decode_speedup below) — the deploy shape
+            # `--quantize int8` ships. Effective GB/s counts SOURCE bytes.
+            time.sleep(settle_s)
+            qs, _src, int8_stats = run_ours(
+                client, "library/bench", desc, mesh, size, quantize="int8"
+            )
+            int8_ts.append(qs)
         ours_s, baseline_s = min(ours_ts), min(baseline_ts)
+        int8_s = min(int8_ts)
+        best_stats = fetch_stats[ours_ts.index(ours_s)]
 
         multitenant = measure_multitenant(base, "library/bench", desc, size)
         multitenant.update(
             measure_redirect_multitenant(base, "library/bench", desc, size)
+        )
+        # load separation (the reference's core architectural claim,
+        # docs/api.md:32-42): per-leg pass verdicts, stated explicitly so a
+        # 1-core host's scheduling noise can't read as an architecture
+        # regression. Direct legs stream through the server process; the
+        # redirect legs never touch it — pass = redirect path under 4-way
+        # load sustains the direct path's single-client rate, with a 10%
+        # tolerance for the shared-core scheduling noise.
+        multitenant["load_separation_pass"] = bool(
+            multitenant["mt_redirect_aggregate_gbps"]
+            >= 0.9 * multitenant["mt_single_gbps"]
         )
 
         # serving: load once more (cheap assert it still works), reuse arrays
@@ -612,6 +629,19 @@ def main() -> None:
             "baseline_seconds": round(baseline_s, 3),
             "seconds_runs": [round(t, 3) for t in ours_ts],
             "baseline_seconds_runs": [round(t, 3) for t in baseline_ts],
+            # decomposition of the winning leg: aggregate fetch-thread rate
+            # vs bytes that crossed the host->device link (fetch and
+            # transfer overlap, so the pieces don't sum to wall time)
+            "fetch_gbps": round(
+                best_stats.bytes_fetched / max(best_stats.fetch_seconds, 1e-9) / 1e9, 3
+            ),
+            "fetch_thread_seconds": round(best_stats.fetch_seconds, 3),
+            "bytes_to_device": best_stats.bytes_to_device,
+            # int8 deploy leg: same source checkpoint, half the link bytes
+            "int8_load_seconds": round(int8_s, 3),
+            "int8_load_gbps_effective": round(size / int8_s / 1e9, 3),
+            "int8_vs_baseline": round(baseline_s / int8_s, 3),
+            "int8_bytes_to_device": int8_stats.bytes_to_device,
             "link_gbps": round(link_gbps, 3),
             "link_utilization": round(ours_gbps / link_gbps, 3) if link_gbps else None,
             "engine": {"native": native.available(), "source": engine_src},

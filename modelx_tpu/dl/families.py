@@ -326,32 +326,67 @@ def detect(tensor_names) -> Family:
     return FAMILIES[name]
 
 
-def abstract_params(infos: dict, rules: Rules | None = None, mesh=None) -> dict:
+def abstract_params(infos: dict, rules: Rules | None = None, mesh=None,
+                    quantize: str | None = None) -> dict:
     """ShapeDtypeStructs for a checkpoint known only by its header/manifest
     tensor index — everything config inference and AOT compilation need,
     before a single weight byte arrives. ``infos`` values need ``shape`` and
     either ``np_dtype()`` (st.TensorInfo) or ``dtype``. With rules+mesh the
     structs carry the placement shardings, so the compiled program matches
-    the arrays the loader will deliver."""
+    the arrays the loader will deliver. ``quantize="int8"`` mirrors the
+    loader's weight-only quantization: eligible 2-D weights become QTensor
+    pytrees of structs (int8 data + f32 per-channel scale), so quantized
+    deploys AOT-compile while their (halved) bytes stream — int8 TTFT pays
+    max(load, compile), not the sum."""
     from modelx_tpu.dl.sharding import sharding_for
+
+    if quantize not in (None, "int8"):
+        raise ValueError(f"unsupported quantize mode {quantize!r}")
+    if quantize:
+        import numpy as np
+
+        from modelx_tpu.ops import quant as qt
+        from jax.sharding import NamedSharding, PartitionSpec
 
     out = {}
     for name, info in infos.items():
         dt = info.np_dtype() if hasattr(info, "np_dtype") else info.dtype
         sharding = sharding_for(name, rules, mesh) if rules is not None and mesh is not None else None
-        out[name] = jax.ShapeDtypeStruct(tuple(info.shape), dt, sharding=sharding)
+        shape = tuple(info.shape)
+        if (
+            quantize == "int8"
+            and getattr(info, "members", None) is None
+            and len(shape) == 2
+            and qt.DEFAULT_ELIGIBLE.search(name) is not None
+        ):
+            # must mirror loader._quantized exactly: a mismatch compiles a
+            # program the delivered params can't call
+            scale_sharding = None
+            if sharding is not None:
+                spec = sharding.spec
+                scale_sharding = NamedSharding(
+                    mesh, PartitionSpec(spec[0] if len(spec) else None)
+                )
+            out[name] = qt.QTensor(
+                q=jax.ShapeDtypeStruct(shape, np.int8, sharding=sharding),
+                scale=jax.ShapeDtypeStruct((shape[0],), np.float32, sharding=scale_sharding),
+            )
+        else:
+            out[name] = jax.ShapeDtypeStruct(shape, dt, sharding=sharding)
     return out
 
 
 def precompile_forward(family: Family, cfg, param_sds: dict, token_shape: tuple,
-                       mesh=None, mode: str = "forward"):
+                       mesh=None, mode: str = "forward", cache_dir: str = ""):
     """AOT-compile the prefill forward for one token shape from abstract
     params — the weights do not need to exist yet, so a deploy overlaps this
     with the loader's byte streaming and the first request (or first token)
     meets an already-compiled program. Returns the compiled executable;
     call it with (params, tokens) of exactly these shapes/shardings.
     ``mode``: "forward" (logits), "argmax_all" (per-position argmax — the
-    serve forward route), "argmax_last" (first decoded token — TTFT)."""
+    serve forward route), "argmax_last" (first decoded token — TTFT).
+    ``cache_dir`` reuses a serialized export across processes (dl/aot_cache)
+    so a warm pod start skips tracing+lowering entirely."""
     import jax.numpy as jnp
 
     if mode == "argmax_all":
@@ -365,4 +400,13 @@ def precompile_forward(family: Family, cfg, param_sds: dict, token_shape: tuple,
             return family.forward(p, t, cfg, mesh=mesh)
 
     tok = jax.ShapeDtypeStruct(token_shape, jnp.int32)
+    if cache_dir:
+        from modelx_tpu.dl import aot_cache
+
+        key = aot_cache.cache_key(
+            family.name, cfg, mode, token_shape,
+            tuple(mesh.shape.items()) if mesh is not None else None,
+            aot_cache.describe_sds(param_sds),
+        )
+        return aot_cache.load_or_compile(fn, (param_sds, tok), cache_dir, key)
     return jax.jit(fn).lower(param_sds, tok).compile()

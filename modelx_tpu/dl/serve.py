@@ -77,8 +77,18 @@ class _Tokenizer:
         return self._tok.decode(list(ids), skip_special_tokens=False)
 
 
+_compile_cache_dir = ""  # set by enable_compile_cache; "" = cold every start
+
+
+def compile_cache_dir() -> str:
+    """The enabled persistent cache dir ("" when not enabled) — warmup paths
+    key their serialized-executable (aot_cache) artifacts under it."""
+    return _compile_cache_dir
+
+
 def enable_compile_cache(path: str = "") -> None:
     """Persistent XLA compilation cache (idempotent)."""
+    global _compile_cache_dir
     path = path or os.environ.get(
         "MODELX_COMPILE_CACHE", os.path.expanduser("~/.cache/modelx-tpu/xla")
     )
@@ -86,6 +96,7 @@ def enable_compile_cache(path: str = "") -> None:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        _compile_cache_dir = path
     except Exception as e:  # cache is an optimization, never fatal
         logger.warning("compile cache unavailable: %s", e)
 
@@ -168,13 +179,16 @@ class ModelServer:
                 self.cfg = self.family.infer_config(
                     fam.abstract_params(infos_all)
                 )
-            compile_thread = None
-            if not self.quantize:  # QTensor params have no abstract form yet
-                sds = fam.abstract_params(infos_all, self.family.rules, self.mesh)
-                compile_thread = threading.Thread(
-                    target=self._precompile_warmup, args=(sds,), daemon=True
-                )
-                compile_thread.start()
+            # quantized included: abstract_params mirrors the loader's int8
+            # transform (QTensor pytrees of structs), so int8 deploys overlap
+            # load and compile like bf16 ones
+            sds = fam.abstract_params(
+                infos_all, self.family.rules, self.mesh, quantize=self.quantize
+            )
+            compile_thread = threading.Thread(
+                target=self._precompile_warmup, args=(sds,), daemon=True
+            )
+            compile_thread.start()
             params: dict = {}
             total = 0
             for path in paths:
@@ -217,6 +231,7 @@ class ModelServer:
                     compiled = fam.precompile_forward(
                         self.family, self.cfg, sds, shape,
                         mesh=self.mesh, mode="argmax_all",
+                        cache_dir=compile_cache_dir(),
                     )
                 self._forward_aot[shape] = compiled
             except Exception as e:

@@ -1,0 +1,159 @@
+"""One deploy-latency (TTFT) measurement in a fresh process.
+
+``python -m modelx_tpu.dl.ttft <registry> <repo> [cache_dir]`` prints one
+JSON line of stage timings for: registry request -> manifest -> (AOT
+compile of the first-token program from the manifest's tensor index,
+overlapped with) -> registry->HBM weight load -> first decoded token.
+
+Clock discipline: the runtime (jax backend + device handshake + mesh) is
+initialized BEFORE the clock starts — the deployment being modeled boots
+the pod runtime before the model request reaches the registry, and the
+metric is the registry+loader+compile path this framework owns, not
+interpreter startup. Each measurement must be a fresh process: the compile
+caches under ``cache_dir`` (persistent XLA cache + dl/aot_cache serialized
+exports) are exactly what a pre-warmed sidecar image ships, while kernel
+re-execution state is not.
+
+Why fresh-process (measured, this rig): the tunnel relay collapses a
+process's host->device bandwidth ~15x after its first program execution,
+so a same-process repeat TTFT measures the collapsed link, not deploy
+latency. ``first_exec_ms`` stays reported separately: it is dominated by a
+flat per-process relay program-setup cost on tunneled rigs (measured
+~1.7-3.7 s even for an 8-element add), while on a directly-attached TPU it
+is a normal dispatch.
+
+Reference shape being beaten: cmd/modelxdl pulls to a volume and a GPU
+container then mmaps + loads + compiles serially (modelxdl.go:50-98).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+
+def measure_once(base: str, repo: str, cache_dir: str = "",
+                 version: str = "v1", quantize: str | None = None) -> dict:
+    import jax
+    import numpy as np
+
+    from modelx_tpu.client.client import Client
+    from modelx_tpu.dl import families as fam
+    from modelx_tpu.dl import safetensors as st
+    from modelx_tpu.dl.initializer import _blob_source
+    from modelx_tpu.dl.loader import fuse_expert_tensors, load_safetensors
+    from modelx_tpu.dl.serve import enable_compile_cache
+    from modelx_tpu.parallel.mesh import make_mesh
+    from modelx_tpu.types import AnnotationTensorIndex
+
+    if cache_dir:
+        enable_compile_cache(cache_dir)
+    # pre-clock: pod runtime boot — backend init + device handshake + mesh,
+    # and the serving imports a real sidecar performs at process start
+    # (measured ~1.1 s of the plan leg on a 1-core host when paid lazily)
+    mesh = make_mesh(f"dp={len(jax.devices())}")
+    prompt = np.array([[1, 2, 3, 4]], np.int32)
+    from modelx_tpu.dl import aot_cache  # noqa: F401
+    from modelx_tpu.models import bert, gpt2, llama, mixtral  # noqa: F401
+    from modelx_tpu.ops import quant  # noqa: F401
+
+    t0 = time.monotonic()
+    client = Client(base, quiet=True)
+    manifest = client.get_manifest(repo, version)
+    infos: dict = {}
+    blobs = []
+    for blob in manifest.blobs:
+        if not blob.name.endswith(".safetensors"):
+            continue
+        if AnnotationTensorIndex in blob.annotations:
+            parsed, off = st.parse_index_annotation(blob.annotations[AnnotationTensorIndex])
+        else:
+            # push omits the annotation for very large tensor indexes
+            # (>256 KiB payload) — fall back to two small ranged header
+            # reads, like initializer.load_to_mesh does
+            import struct
+
+            source = _blob_source(client, repo, blob)
+            try:
+                (hlen,) = struct.unpack("<Q", bytes(source.read_range(0, 8)))
+                parsed = st.parse_header(bytes(source.read_range(8, hlen)))
+                off = 8 + hlen
+            finally:
+                if hasattr(source, "close"):
+                    source.close()
+        infos.update(parsed)
+        blobs.append((blob, parsed, off))
+    family = fam.detect(list(infos))
+    infos = fuse_expert_tensors(infos, family.rules)
+    cfg = family.infer_config(fam.abstract_params(infos))
+    sds = fam.abstract_params(infos, family.rules, mesh, quantize=quantize)
+    t_plan = time.monotonic()
+
+    compiled: dict = {}
+
+    def _compile():
+        tc = time.monotonic()
+        try:
+            compiled["fwd"] = fam.precompile_forward(
+                family, cfg, sds, prompt.shape, mesh=mesh,
+                mode="argmax_last", cache_dir=cache_dir,
+            )
+        except BaseException as e:
+            compiled["error"] = e
+        compiled["secs"] = time.monotonic() - tc
+
+    th = threading.Thread(target=_compile, daemon=True)
+    th.start()
+    params: dict = {}
+    bytes_to_device = 0
+    for blob, parsed, off in blobs:
+        source = _blob_source(client, repo, blob)
+        try:
+            arrays, stats = load_safetensors(
+                source, mesh, family.rules, tensors=parsed, data_offset=off,
+                quantize=quantize,
+            )
+        finally:
+            if hasattr(source, "close"):
+                source.close()
+        params.update(arrays)
+        bytes_to_device += stats.bytes_to_device
+    t_load = time.monotonic()
+    th.join()
+    if "error" in compiled:
+        raise RuntimeError("ttft precompile failed") from compiled["error"]
+    fwd = compiled["fwd"]
+    t_join = time.monotonic()
+    first = fwd(params, jax.numpy.asarray(prompt))
+    np.asarray(first)
+    t_token = time.monotonic()
+    return {
+        "ttft_ms": round((t_token - t0) * 1e3, 1),
+        "plan_ms": round((t_plan - t0) * 1e3, 1),
+        "load_ms": round((t_load - t_plan) * 1e3, 1),
+        "compile_join_ms": round((t_join - t_load) * 1e3, 1),
+        "first_exec_ms": round((t_token - t_join) * 1e3, 1),
+        "compile_thread_ms": round(compiled["secs"] * 1e3, 1),
+        "weights_ready_ms": round((t_load - t0) * 1e3, 1),
+        "bytes_to_device": bytes_to_device,
+    }
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) < 3:
+        print("usage: python -m modelx_tpu.dl.ttft <registry> <repo> "
+              "[cache_dir] [quantize]", file=sys.stderr)
+        return 2
+    out = measure_once(
+        argv[1], argv[2],
+        cache_dir=argv[3] if len(argv) > 3 else "",
+        quantize=(argv[4] or None) if len(argv) > 4 else None,
+    )
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -32,7 +32,8 @@ DEFAULT_ELIGIBLE = re.compile(
 @dataclasses.dataclass
 class QTensor:
     """int8 weight + per-output-channel scale; drop-in for a 2-D weight in
-    ops.nn.linear."""
+    ops.nn.linear. Registered for jax.export serialization below so AOT
+    programs over quantized params persist in the dl/aot_cache."""
 
     q: jax.Array  # int8 [out, in]
     scale: jax.Array  # f32 [out]
@@ -55,6 +56,17 @@ class QTensor:
     @classmethod
     def tree_unflatten(cls, _aux, children):
         return cls(*children)
+
+
+try:  # auxdata is always None (pure pair pytree); empty-bytes round-trip
+    jax.export.register_pytree_node_serialization(
+        QTensor,
+        serialized_name="modelx_tpu.ops.quant.QTensor",
+        serialize_auxdata=lambda aux: b"",
+        deserialize_auxdata=lambda b: None,
+    )
+except (AttributeError, ValueError):  # older jax / double registration
+    pass
 
 
 def channel_scales(w: np.ndarray) -> np.ndarray:

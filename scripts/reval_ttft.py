@@ -1,0 +1,21 @@
+"""Revalidate the TTFT bench leg standalone (driver stays off the TPU)."""
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.chdir(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import build_checkpoint, measure_ttft, push_checkpoint, start_registry
+
+workdir = tempfile.mkdtemp(prefix="ttft-reval-")
+ckpt = os.path.join(workdir, "ttft.safetensors")
+build_checkpoint(ckpt, 48 * 1024 * 1024, hidden=512, inter=1408, vocab=8192)
+srv, base = start_registry(workdir)
+push_checkpoint(base, "library/ttft", ckpt)
+try:
+    print(json.dumps(measure_ttft(base, "library/ttft", workdir, runs=5, int8_runs=0)))
+finally:
+    srv.terminate()

@@ -325,14 +325,21 @@ class TestAOTWarmup:
         via_jit = server.forward_argmax(tokens)
         np.testing.assert_array_equal(via_aot, via_jit)
 
-    def test_quantized_load_skips_warmup_but_serves(self, checkpoints):
+    def test_quantized_load_precompiles_and_matches_jit(self, checkpoints):
+        """int8 deploys overlap load+compile too: abstract_params mirrors the
+        loader's QTensor transform, so the warmup AOT executable exists and
+        agrees with the lazily-jitted quantized forward."""
         server = ModelServer(
             checkpoints["llama"], mesh_spec="dp=1", dtype="float32", quantize="int8"
         )
         server.load()
-        assert server._forward_aot == {}
-        out = server.forward_argmax(np.array([[1, 2, 3]], np.int32))
-        assert out.shape == (1, 3)
+        shape = ModelServer.WARMUP_TOKEN_SHAPES[0]
+        assert shape in server._forward_aot
+        tokens = np.arange(shape[0] * shape[1], dtype=np.int32).reshape(shape) % 60 + 1
+        via_aot = server.forward_argmax(tokens)
+        del server._forward_aot[shape]
+        via_jit = server.forward_argmax(tokens)
+        np.testing.assert_array_equal(via_aot, via_jit)
 
     def test_ready_seconds_reported(self, checkpoints):
         server = ModelServer(checkpoints["gpt2"], mesh_spec="dp=1", dtype="float32")

@@ -1,0 +1,77 @@
+"""Deploy-latency runner (dl/ttft): the fresh-process TTFT measurement the
+bench drives subprocess-per-run. Covers the in-process flow on CPU: stage
+timings present, compile overlapped, and the quantized variant."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from modelx_tpu.client.client import Client
+from modelx_tpu.dl import safetensors as st
+from modelx_tpu.dl.ttft import measure_once
+from modelx_tpu.models import llama
+from modelx_tpu.registry.fs import MemoryFSProvider
+from modelx_tpu.registry.server import Options, RegistryServer, free_port
+from modelx_tpu.registry.store_fs import FSRegistryStore
+
+
+@pytest.fixture(scope="module")
+def pushed(tmp_path_factory):
+    srv = RegistryServer(
+        Options(listen=f"127.0.0.1:{free_port()}"), store=FSRegistryStore(MemoryFSProvider())
+    )
+    base = srv.serve_background()
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64), dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    src = tmp_path_factory.mktemp("ttft-model")
+    st.write_safetensors(
+        str(src / "model.safetensors"), {k: np.asarray(v) for k, v in params.items()}
+    )
+    Client(base, quiet=True).push("library/ttft", "v1", str(src))
+    yield base
+    srv.shutdown()
+
+
+class TestTTFTRunner:
+    def test_measures_stages(self, pushed, tmp_path):
+        out = measure_once(pushed, "library/ttft", cache_dir=str(tmp_path / "cache"))
+        for key in ("ttft_ms", "plan_ms", "load_ms", "compile_join_ms",
+                    "first_exec_ms", "weights_ready_ms", "bytes_to_device"):
+            assert key in out, key
+        assert out["bytes_to_device"] > 0
+        assert out["ttft_ms"] >= out["weights_ready_ms"] > 0
+
+    def test_quantized(self, pushed, tmp_path):
+        out = measure_once(
+            pushed, "library/ttft", cache_dir=str(tmp_path / "cache"), quantize="int8"
+        )
+        assert out["bytes_to_device"] > 0
+
+    def test_unannotated_blob_header_fallback(self, pushed, tmp_path):
+        """push omits the tensor-index annotation for huge indexes; the
+        runner must fall back to ranged header reads, not drop the blob."""
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from modelx_tpu.client.helper import descriptor_for_file
+        from modelx_tpu.types import Manifest
+
+        cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64), dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.PRNGKey(1))
+        ckpt = str(tmp_path / "model.safetensors")
+        st.write_safetensors(ckpt, {k: np.asarray(v) for k, v in params.items()})
+        client = Client(pushed, quiet=True)
+        desc = descriptor_for_file(
+            ckpt, "model.safetensors", "application/vnd.modelx.model.file.v1"
+        )  # deliberately NOT annotated
+        with open(ckpt, "rb") as f:
+            client.remote.upload_blob_content("library/bare", desc, f)
+        client.remote.put_manifest("library/bare", "v1", Manifest(blobs=[desc]))
+        out = measure_once(pushed, "library/bare", cache_dir=str(tmp_path / "cache"))
+        assert out["bytes_to_device"] > 0
