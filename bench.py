@@ -500,6 +500,94 @@ def measure_serving(params: dict, mesh, device_kind: str, decode_only: bool = Fa
     return out
 
 
+def measure_continuous(params: dict, mesh, decode_tps: float | None) -> dict:
+    """In-flight batching under load: 8 concurrent clients, each submitting
+    independent generate requests against one running engine. The dial that
+    matters on this rig: every chunk dispatch pays the tunnel's ~65 ms
+    round-trip (decode_call_overhead_ms), so the engine runs a LARGE chunk
+    here (128) to amortize it — on a directly-attached TPU the default 8-16
+    serves the same aggregate at finer flush granularity. Target
+    (VERDICT r3): aggregate tokens/s >= 0.8x the batch-8 slope-derived
+    decode throughput."""
+    import threading as _t
+    from concurrent.futures import ThreadPoolExecutor
+
+    from modelx_tpu.dl import families as fam
+    from modelx_tpu.dl.continuous import ContinuousBatcher
+
+    family = fam.detect(list(params))
+    cfg = family.infer_config(params)
+
+    class _Shim:  # ContinuousBatcher's server surface, over loaded arrays
+        pass
+
+    import jax
+    import jax.numpy as jnp
+
+    shim = _Shim()
+    shim.family, shim.cfg, shim.mesh = family, cfg, mesh
+    shim.max_seq_len, shim.params = 1024, params
+    shim.stats = {"tokens_generated": 0}
+    chunk = 128
+    clients, new_tokens = 8, 256
+    cb = ContinuousBatcher(shim, max_slots=8, chunk_size=chunk, max_len=1024)
+    try:
+        rng = np.random.RandomState(11)
+        prompts = [
+            rng.randint(1, cfg.vocab_size, (1, 128)).astype(np.int32)
+            for _ in range(clients + 1)
+        ]
+        # two warm generates: the first compiles admit+chunk, the second
+        # absorbs a measured one-time second-call cost on the tunneled rig
+        cb.generate(prompts[-1], max_new_tokens=8)
+        cb.generate(prompts[-1], max_new_tokens=8)
+        start = _t.Barrier(clients)
+
+        def client(i: int) -> int:
+            start.wait()  # all clients hit the running engine together
+            out = cb.generate(prompts[i], max_new_tokens=new_tokens)
+            return out.shape[1] - prompts[i].shape[1]
+
+        t0 = time.monotonic()
+        with ThreadPoolExecutor(clients) as pool:
+            totals = list(pool.map(client, range(clients)))
+        dt = time.monotonic() - t0
+        agg = sum(totals) / dt
+
+        # what the same clients got BEFORE in-flight batching: sequential
+        # single-row decodes through the one generation worker (streams and
+        # mid-decode arrivals bypassed the window batcher entirely in r3)
+        gen1 = jax.jit(
+            lambda p, t: family.generate(p, t, cfg, mesh=mesh, max_new_tokens=new_tokens)
+        )
+        np.asarray(gen1(params, jnp.asarray(prompts[-1])))  # compile
+        t0 = time.monotonic()
+        for i in range(clients):
+            np.asarray(gen1(params, jnp.asarray(prompts[i])))
+        seq_dt = time.monotonic() - t0
+        seq_agg = clients * new_tokens / seq_dt
+        return {
+            "continuous_clients": clients,
+            "continuous_chunk_size": chunk,
+            "continuous_new_tokens": new_tokens,
+            "continuous_agg_tokens_per_s": round(agg, 1),
+            # vs the slope-derived batch-8 decode rate: that denominator
+            # excludes ALL dispatch round-trips, which cost ~65-80 ms per
+            # call on this rig's tunnel — the admissions+chunks schedule
+            # bounds this ratio well below what a directly-attached TPU
+            # would show; the sequential ratio below is the deploy-shaped
+            # comparison
+            "continuous_vs_batch_decode": (
+                round(agg / decode_tps, 3) if decode_tps else None
+            ),
+            "continuous_sequential_tokens_per_s": round(seq_agg, 1),
+            "continuous_vs_sequential": round(agg / seq_agg, 3),
+            "continuous_chunks": cb.stats["chunks"],
+        }
+    finally:
+        cb.close()
+
+
 def main() -> None:
     workdir = tempfile.mkdtemp(prefix="modelx-bench-")
     settle_s = float(os.environ.get("BENCH_SETTLE_S", 8.0))
@@ -591,6 +679,9 @@ def main() -> None:
             if hasattr(source, "close"):
                 source.close()
         serving = measure_serving(loaded, mesh, device_kind)
+        serving.update(
+            measure_continuous(loaded, mesh, serving.get("decode_tokens_per_s"))
+        )
         del loaded
 
         # int8 weight-only serving: per-step weight reads halve, so decode

@@ -1,0 +1,423 @@
+"""Continuous (in-flight) batching: requests join a RUNNING decode.
+
+The window batcher (dl/serve.Batcher) coalesces only requests that arrive
+within a few ms of each other; anything landing mid-decode waits for the
+whole previous ragged decode. This engine removes that wait: a fixed slot
+array decodes forever in ``chunk_size``-step compiled chunks, and new
+requests are admitted into free slots at chunk boundaries — iteration-level
+scheduling (the vLLM/Orca idea), built the TPU way:
+
+- **Static shapes, compile-once.** One KV cache of ``[max_slots, max_len]``
+  per layer lives on device for the engine's lifetime (donated through
+  every step, no reallocation). One chunk program serves every mix of
+  requests; per-slot prompt lengths, decode depths, and sampling controls
+  are traced VECTOR inputs, never shapes. Prefills compile per 16-bucketed
+  prompt length, exactly like the stream/batcher paths.
+- **Admission = prefill into a fresh [1, S] cache + one
+  dynamic_update_slice of that cache into the slot's rows.** The running
+  batch never re-prefills, and the prefill cost is one [S]-length row copy
+  per layer on top of the forward itself.
+- **Idle slots decode garbage harmlessly** (same trick as the ragged
+  batcher's pad rows): attention per row sees only that row's cache, so an
+  idle row's tokens are discarded on the host and its cache rows are
+  overwritten wholesale at the next admission.
+
+Token-exactness: a request decoded here yields EXACTLY the tokens the same
+request gets from the plain paths — greedy rows by argmax determinism, and
+sampled rows because the per-row (seed, step) stream (ops/sampling.py)
+depends only on the row's own request seed and decode depth, both carried
+per slot. Tests assert byte-equality against ragged_greedy_generate.
+
+No reference equivalent (the reference stores models; it cannot serve
+them); this is the serving half of the BASELINE north star. Bench target:
+8 concurrent clients sustain >= 0.8x the batch-8 decode throughput.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from modelx_tpu.models.decode import pad_seq_len
+from modelx_tpu.utils import trace
+
+_DONE = object()  # end-of-stream sentinel on per-request output queues
+
+
+class _Row:
+    """One admitted request row bound to a slot."""
+
+    __slots__ = ("slot", "budget", "emitted", "out", "skip")
+
+    def __init__(self, slot: int, budget: int, out: "queue.Queue") -> None:
+        self.slot = slot
+        self.budget = budget
+        self.emitted = 0
+        self.out = out
+        # the chunk scan emits each step's ENTRY carry token, so a freshly
+        # admitted row's first chunk re-emits the prefill token the
+        # admission already delivered — skip it once
+        self.skip = 1
+
+
+class ContinuousBatcher:
+    """Iteration-level scheduler over a fixed slot array.
+
+    ``submit_row`` enqueues one prompt row; the engine thread admits it into
+    a free slot at the next chunk boundary and its output queue receives
+    np int32 arrays of new tokens (totalling exactly ``max_new_tokens``),
+    then the ``_DONE`` sentinel. ``generate`` / ``stream`` are the blocking
+    conveniences the serving layer uses.
+    """
+
+    def __init__(self, server, max_slots: int = 8, chunk_size: int = 8,
+                 max_len: int = 0) -> None:
+        if server.family.decode_fns is None:
+            raise ValueError(f"family {server.family.name} has no cached decode")
+        self.server = server
+        self.max_slots = int(max_slots)
+        self.chunk_size = int(chunk_size)
+        self.max_len = int(max_len) or int(server.max_seq_len)
+        self._fwd, self._init_cache = server.family.decode_fns(
+            server.cfg, mesh=server.mesh
+        )
+        # engine-owned device state: the big cache (donated through every
+        # program so HBM holds exactly one copy) + last-token vector
+        self._cache = self._init_cache(self.max_slots, self.max_len)
+        self._tok = jnp.zeros((self.max_slots, 1), jnp.int32)
+        # host-side per-slot state (tiny vectors, traced as inputs)
+        self._offsets = np.zeros(self.max_slots, np.int32)
+        self._steps = np.zeros(self.max_slots, np.int32)
+        self._temp = np.zeros(self.max_slots, np.float32)
+        self._top_k = np.zeros(self.max_slots, np.int32)
+        self._top_p = np.ones(self.max_slots, np.float32)
+        self._seeds = np.zeros(self.max_slots, np.int32)
+        self._use_filters = np.zeros(self.max_slots, bool)
+        self._rows: dict[int, _Row] = {}  # slot -> active row
+        self._free = list(range(self.max_slots))
+        self._first_pending: list = []  # (row, async first-token array, done)
+
+        # admission is ONE program (prefill + first token + insert-at-slot):
+        # on a tunneled device every call costs a host round-trip, so the
+        # two-call prefill-then-insert shape would double admission latency
+        self._admit_prog = jax.jit(self._admit_impl, donate_argnums=(2, 3))
+        self._chunk = jax.jit(self._chunk_impl, donate_argnums=(1, 2))
+
+        self._q: "queue.Queue" = queue.Queue()
+        self._closed = False
+        self._broken: BaseException | None = None
+        self._close_lock = threading.Lock()
+        self.stats = {"chunks": 0, "admitted": 0, "active_peak": 0}
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    # -- compiled programs ----------------------------------------------------
+
+    def _admit_impl(self, params, prompt, cache, tok, row_len, slot,
+                    temp, top_k, top_p, seed):
+        """One program per admission: prefill the [1, S] prompt into a
+        scratch cache (allocated INSIDE the jit — zeros fuse, no host
+        transfer), sample the row's first token (step 0 of its sample
+        stream, matching ragged/stream decode byte-for-byte), and insert
+        both into ``slot`` of the donated engine state."""
+        from modelx_tpu.ops import sampling as sampling_ops
+
+        small = self._init_cache(1, prompt.shape[1])
+        logits, small = self._fwd(params, prompt, kv_cache=small, cache_offset=0)
+        idx = jnp.broadcast_to((row_len - 1)[:, None, None], (1, 1, logits.shape[-1]))
+        last = jnp.take_along_axis(logits, idx, axis=1)[:, 0, :]
+        first = sampling_ops.sample(
+            last.astype(jnp.float32), jax.random.PRNGKey(0), temp,
+            top_k=top_k, top_p=top_p, seeds=seed, step=0,
+        )
+
+        def put(big, little):
+            return jax.lax.dynamic_update_slice(
+                big, little, (slot,) + (0,) * (big.ndim - 1)
+            )
+
+        cache = jax.tree_util.tree_map(put, cache, small)
+        tok = jax.lax.dynamic_update_slice(tok, first[:, None], (slot, 0))
+        return cache, tok, first
+
+    def _chunk_impl(self, params, cache, tok, offsets, steps, temp, top_k, top_p, seeds):
+        """``chunk_size`` decode steps over ALL slots; offsets/steps are
+        per-row (slots joined at different times sit at different depths).
+        ``top_k``/``top_p`` arrive as None when NO active row uses filters —
+        the None variant compiles without the per-step full-vocab sort the
+        filters need (jit caches both variants; values are identical either
+        way since 0 / 1.0 mean "off" per row)."""
+        from modelx_tpu.ops import sampling as sampling_ops
+
+        def step_fn(carry, _i):
+            cache, tok, offsets, steps = carry
+            logits, cache = self._fwd(params, tok, kv_cache=cache, cache_offset=offsets)
+            nxt = sampling_ops.sample(
+                logits[:, -1, :].astype(jnp.float32), jax.random.PRNGKey(0), temp,
+                top_k=top_k, top_p=top_p, seeds=seeds, step=steps,
+            )
+            return (cache, nxt[:, None], offsets + 1, steps + 1), tok[:, 0]
+
+        (cache, tok, offsets, steps), toks = jax.lax.scan(
+            step_fn, (cache, tok, offsets, steps), jnp.arange(self.chunk_size)
+        )
+        return cache, tok, toks.T  # [max_slots, chunk_size]
+
+    # -- engine loop ----------------------------------------------------------
+
+    def _admit(self, item) -> None:
+        ids, n, samp, out = item
+        slot = self._free.pop()
+        s = len(ids)
+        pad_s = pad_seq_len(s)
+        prompt = np.zeros((1, pad_s), np.int32)
+        prompt[0, :s] = ids
+        temp = np.asarray([samp.get("temperature", 0.0)], np.float32)
+        k_val = int(samp.get("top_k", 0))
+        p_val = float(samp.get("top_p", 1.0))
+        filters = k_val > 0 or p_val < 1.0
+        top_k = np.asarray([k_val], np.int32) if filters else None
+        top_p = np.asarray([p_val], np.float32) if filters else None
+        seed = np.asarray([samp.get("seed", 0)], np.int32)
+        self._cache, self._tok, first = self._admit_prog(
+            self.server.params, jnp.asarray(prompt), self._cache, self._tok,
+            jnp.asarray([s], np.int32), jnp.int32(slot), temp, top_k, top_p, seed,
+        )
+        self._offsets[slot] = s
+        self._steps[slot] = 1  # prefill consumed step 0
+        self._temp[slot] = temp[0]
+        self._top_k[slot] = k_val
+        self._top_p[slot] = p_val
+        self._seeds[slot] = seed[0]
+        self._use_filters[slot] = filters
+        row = _Row(slot, n, out)
+        # the prefill's first token is delivered ASYNC (with the next
+        # delivery batch): syncing here would serialize a full dispatch
+        # round-trip per admission, where dispatching N prefills
+        # back-to-back pipelines them
+        row.emitted = 1
+        done = row.emitted >= row.budget
+        self._first_pending.append((row, first, done))
+        if done:
+            self._free.append(slot)
+        else:
+            self._rows[slot] = row
+        self.stats["admitted"] += 1
+        self.stats["active_peak"] = max(self.stats["active_peak"], len(self._rows))
+
+    def _dispatch_chunk(self) -> tuple:
+        """Dispatch one chunk (async) and PLAN its emissions now. Take
+        counts and retirements are value-independent (budgets only), so
+        scheduling runs a full chunk ahead of token delivery — the host's
+        dispatch round-trip (tens of ms on a tunneled rig) overlaps the
+        device decoding the chunk in flight instead of serializing with it."""
+        # filters only when an ACTIVE row asked: the None variant skips the
+        # per-step full-vocab sort (retired slots' stale values are garbage
+        # rows whose tokens are discarded anyway)
+        active = list(self._rows)
+        filtered = bool(self._use_filters[active].any())
+        with trace.span("continuous.chunk", active=len(self._rows)):
+            # .copy() is load-bearing: jax zero-copy-aliases host numpy
+            # buffers (CPU backend) and transfers lazily, while this loop
+            # mutates the originals (retirement resets, next admissions)
+            # possibly BEFORE the in-flight chunk reads them — each dispatch
+            # gets private snapshots nobody mutates
+            self._cache, self._tok, toks_dev = self._chunk(
+                self.server.params, self._cache, self._tok,
+                jnp.asarray(self._offsets.copy()), jnp.asarray(self._steps.copy()),
+                jnp.asarray(self._temp.copy()),
+                jnp.asarray(self._top_k.copy()) if filtered else None,
+                jnp.asarray(self._top_p.copy()) if filtered else None,
+                jnp.asarray(self._seeds.copy()),
+            )
+        self.stats["chunks"] += 1
+        self._offsets += self.chunk_size
+        self._steps += self.chunk_size
+        plan = []
+        for slot, row in list(self._rows.items()):
+            take = min(self.chunk_size - row.skip, row.budget - row.emitted)
+            row.emitted += max(take, 0)
+            done = row.emitted >= row.budget
+            plan.append((slot, row, row.skip, take, done))
+            row.skip = 0
+            if done:  # slot reuse is safe: a re-admission's cache insert is
+                # data-ordered after the in-flight chunk's writes
+                del self._rows[slot]
+                self._free.append(slot)
+                self._offsets[slot] = 0  # idle rows write harmlessly at 0
+        return toks_dev, plan
+
+    def _deliver_firsts(self) -> None:
+        """Hand this iteration's admitted rows their prefill tokens. Blocks
+        only on the prefills (ordered before any chunk dispatched after
+        them), so N admissions pay one round-trip, not N."""
+        firsts, self._first_pending = self._first_pending, []
+        for row, first, done in firsts:
+            row.out.put(np.asarray(first).reshape(1, 1))
+            if done:
+                row.out.put(_DONE)
+
+    @staticmethod
+    def _deliver(pending: tuple | None) -> None:
+        """Block on an in-flight chunk's tokens and hand them to waiters."""
+        if pending is None:
+            return
+        toks_dev, plan = pending
+        toks = np.asarray(toks_dev)
+        for slot, row, skip, take, done in plan:
+            if take > 0:
+                row.out.put(toks[slot : slot + 1, skip : skip + take])
+            if done:
+                row.out.put(_DONE)
+
+    def _loop(self) -> None:
+        pending: tuple | None = None  # depth-1 pipeline: one chunk in flight
+        try:
+            while True:
+                # admit everything waiting (up to free slots); block only
+                # when fully idle with nothing in flight
+                while True:
+                    block = not self._rows and pending is None
+                    try:
+                        item = self._q.get(block=block)
+                    except queue.Empty:
+                        break
+                    if item is None:
+                        self._deliver_firsts()
+                        self._deliver(pending)
+                        self._fail_active(RuntimeError("continuous batcher closed"))
+                        return
+                    if not self._free:
+                        # no slot free: requeue and decode on — a retire
+                        # this chunk frees a slot for it
+                        self._q.put(item)
+                        break
+                    with trace.span("continuous.admit"):
+                        self._admit(item)
+                nxt = self._dispatch_chunk() if self._rows else None
+                # both deliveries overlap the chunk just dispatched
+                self._deliver_firsts()
+                self._deliver(pending)
+                pending = nxt
+        except BaseException as e:  # engine death must not hang waiters
+            with self._close_lock:
+                # under the lock: submit_row checks _broken inside the same
+                # lock before enqueueing, so no request can slip into the
+                # queue after the drain below and hang forever
+                self._broken = e
+            self._deliver_failsafe(pending, e)
+            self._fail_active(e)
+
+    def _deliver_failsafe(self, pending: tuple | None, err: BaseException) -> None:
+        """On engine death, rows in an undelivered plan (or with undelivered
+        prefill tokens) were possibly already removed from _rows — fail them
+        directly so their waiters don't hang."""
+        for row, _first, _done in self._first_pending:
+            row.out.put(err)
+        self._first_pending = []
+        if pending is None:
+            return
+        for _slot, row, _skip, _take, _done in pending[1]:
+            row.out.put(err)
+
+    def _fail_active(self, err: BaseException) -> None:
+        for row in self._rows.values():
+            row.out.put(err)
+        self._rows.clear()
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if item is not None:
+                item[3].put(err)
+
+    # -- public API -----------------------------------------------------------
+
+    def submit_row(self, ids: list[int], max_new_tokens: int, samp: dict) -> "queue.Queue":
+        s = len(ids)
+        if s < 1:
+            raise ValueError("empty prompt row")
+        # + chunk_size margin: the slot keeps writing to the end of its last
+        # chunk even past the budget; those positions must exist
+        need = pad_seq_len(s) + max_new_tokens + self.chunk_size
+        if need > self.max_len:
+            raise ValueError(
+                f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds the "
+                f"engine's max_len {self.max_len} (margin {self.chunk_size})"
+            )
+        out: "queue.Queue" = queue.Queue()
+        with self._close_lock:
+            if self._closed:
+                raise RuntimeError("continuous batcher closed")
+            if self._broken is not None:
+                # checked under the SAME lock the dying engine takes before
+                # its final queue drain — a put here either precedes the
+                # drain (and gets failed by it) or raises
+                raise RuntimeError("continuous batcher is broken") from self._broken
+            self._q.put((list(ids), int(max_new_tokens), dict(samp), out))
+        return out
+
+    def _drain_row(self, out: "queue.Queue") -> Iterator[np.ndarray]:
+        while True:
+            item = out.get()
+            if item is _DONE:
+                return
+            if isinstance(item, BaseException):
+                raise RuntimeError("continuous decode failed") from item
+            yield item
+
+    def generate(self, tokens: np.ndarray, max_new_tokens: int = 16,
+                 temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+                 seed: int = 0) -> np.ndarray:
+        """[B, S + max_new_tokens], matching ModelServer.generate: rows of a
+        multi-row request become independent slots with seeds seed+i (the
+        same per-row streams the ragged path derives)."""
+        tokens = np.asarray(tokens, np.int32)
+        b, s = tokens.shape
+        outs = [
+            self.submit_row(
+                tokens[i].tolist(), max_new_tokens,
+                {"temperature": temperature, "top_k": top_k, "top_p": top_p,
+                 "seed": (seed + i) % (2**31)},
+            )
+            for i in range(b)
+        ]
+        rows = []
+        for out in outs:
+            pieces = list(self._drain_row(out))
+            rows.append(np.concatenate(pieces, axis=1))
+        gen = np.concatenate(rows, axis=0)
+        self.server.stats["tokens_generated"] += int(gen.size)
+        return np.concatenate([tokens, gen], axis=1)
+
+    def stream(self, tokens: np.ndarray, max_new_tokens: int = 16,
+               temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+               seed: int = 0, chunk_size: int = 0) -> Iterator[np.ndarray]:
+        """Single-row streaming: yields [1, k] arrays of new tokens as the
+        engine decodes them (k == 1 for the prefill token, then up to the
+        ENGINE's chunk size — the per-request chunk_size arg is accepted for
+        interface parity and ignored)."""
+        tokens = np.asarray(tokens, np.int32)
+        if tokens.shape[0] != 1:
+            raise ValueError("continuous stream is single-row")
+        out = self.submit_row(
+            tokens[0].tolist(), max_new_tokens,
+            {"temperature": temperature, "top_k": top_k, "top_p": top_p, "seed": seed},
+        )
+        for piece in self._drain_row(out):
+            self.server.stats["tokens_generated"] += int(piece.size)
+            yield piece
+
+    def close(self) -> None:
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._q.put(None)
+        self._thread.join(timeout=30)

@@ -213,17 +213,8 @@ def run_completion(sset, req: dict, chat: bool) -> dict:
         # it here would hide the misuse until the client flips stream on.
         # (An explicit null matches the streaming path's "absent" handling.)
         raise APIError(400, "stream_options is only allowed when stream is true")
-    batcher = sset.batcher_for(server)
-    engine = batcher if (batcher is not None and server.family.generate_ragged is not None) else server
-    if (
-        server.speculative_k > 0
-        and len(prompts) == 1
-        and samp["temperature"] == 0.0
-        and server.family.decode_fns is not None
-    ):
-        # single greedy prompt is speculation's exact target; routing it
-        # through the batcher would leave --speculative-k silently inert
-        engine = server
+    # routing policy lives in ONE place: continuous > speculation > batcher
+    engine = sset.engine_for(server, len(prompts), samp["temperature"])
     server.stats["requests"] += 1
     id_rows = [encode_prompt(tok, server, text) for text in prompts]
 
@@ -293,9 +284,8 @@ def stream_completion(sset, req: dict, chat: bool) -> Iterator[dict]:
     reserve = max((len(s) for s in stops), default=1) - 1
 
     def events() -> Iterator[dict]:
-        gen = server.generate_stream(
-            np.asarray([ids], np.int32), max_new_tokens=n_tokens, **samp
-        )
+        # continuous engine when enabled, operator chunk size either way
+        gen = sset.stream_source(server, np.asarray([ids], np.int32), n_tokens, samp)
         # prime generation BEFORE yielding anything: the transport commits
         # its 200 after the first event, and a compile/decode failure must
         # surface as a real status even for chat (whose first event is the

@@ -670,7 +670,10 @@ class ServerSet:
 
     def __init__(self, servers: dict[str, ModelServer], default: str | None = None,
                  trace_dir: str = "", dynamic_batch: bool = False,
-                 max_new_tokens_limit: int = DEFAULT_MAX_NEW_TOKENS_LIMIT) -> None:
+                 max_new_tokens_limit: int = DEFAULT_MAX_NEW_TOKENS_LIMIT,
+                 continuous_batch: bool = False, max_slots: int = 8,
+                 max_batch: int = 32, batch_window_ms: float = 3.0,
+                 stream_chunk_size: int = 8) -> None:
         if not servers:
             raise ValueError("no models")
         self.max_new_tokens_limit = max_new_tokens_limit
@@ -681,8 +684,15 @@ class ServerSet:
         self.trace_dir = trace_dir or os.path.join(os.getcwd(), "jax-trace")
         self._profiling = threading.Lock()
         self._dynamic_batch = dynamic_batch
+        self._continuous_batch = continuous_batch
+        self.max_slots = max_slots
+        self.max_batch = max_batch
+        self.batch_window_ms = batch_window_ms
+        self.stream_chunk_size = stream_chunk_size
         self._batcher_lock = threading.Lock()
         self.batchers: dict[str, Batcher] = {}
+        self.cbatchers: dict = {}  # name -> ContinuousBatcher
+        self._engine_locks: dict[str, threading.Lock] = {}  # per-model creation
         # set on SIGTERM: /healthz flips to 503 so load balancers stop
         # routing here while in-flight requests finish (graceful drain)
         self.draining = False
@@ -698,8 +708,76 @@ class ServerSet:
             with self._batcher_lock:
                 b = self.batchers.get(server.name)
                 if b is None:
-                    b = self.batchers[server.name] = Batcher(server)
+                    b = self.batchers[server.name] = Batcher(
+                        server, max_batch=self.max_batch, window_ms=self.batch_window_ms
+                    )
         return b
+
+    def continuous_for(self, server: ModelServer):
+        """Lazily create the continuous (in-flight) batching engine —
+        cached-decode causal families only. When enabled it supersedes both
+        the window batcher and speculation for generate/stream traffic:
+        iteration-level scheduling owns the device. Construction (a full
+        [max_slots, max_len] KV-cache allocation) runs under a PER-MODEL
+        lock so other tenants' traffic never stalls behind it."""
+        if (
+            not self._continuous_batch
+            or server.family is None
+            or server.family.decode_fns is None
+        ):
+            return None
+        cb = self.cbatchers.get(server.name)
+        if cb is not None:
+            return cb
+        with self._batcher_lock:
+            lk = self._engine_locks.setdefault(server.name, threading.Lock())
+        with lk:
+            cb = self.cbatchers.get(server.name)
+            if cb is None:
+                from modelx_tpu.dl.continuous import ContinuousBatcher
+
+                max_len = server.max_seq_len
+                n_pos = getattr(server.cfg, "n_positions", 0) or 0
+                if n_pos:  # gpt2: positions past wpe silently clamp
+                    max_len = min(max_len, n_pos)
+                cb = ContinuousBatcher(
+                    server, max_slots=self.max_slots,
+                    chunk_size=self.stream_chunk_size, max_len=max_len,
+                )
+                self.cbatchers[server.name] = cb
+        return cb
+
+    def engine_for(self, server: ModelServer, n_rows: int, temperature: float):
+        """THE generate-routing policy, in one place: continuous batching
+        (when enabled) > speculation (single-row greedy, --speculative-k) >
+        window batcher > plain server."""
+        cb = self.continuous_for(server)
+        if cb is not None:
+            return cb
+        if (
+            server.speculative_k > 0
+            and n_rows == 1
+            and temperature == 0.0
+            and server.family.decode_fns is not None
+        ):
+            # speculation's exact target shape; it must not be silently
+            # inert under --dynamic-batch
+            return server
+        batcher = self.batcher_for(server)
+        if batcher is not None and server.family.generate_ragged is not None:
+            return batcher
+        return server
+
+    def stream_source(self, server: ModelServer, tokens, n: int, samp: dict):
+        """Streaming analogue of engine_for: a token-chunk iterator.
+        Single-row streams join the continuous engine when enabled; all
+        paths honor the operator's --stream-chunk-size."""
+        cb = self.continuous_for(server)
+        if cb is not None and tokens.shape[0] == 1:
+            return cb.stream(tokens, max_new_tokens=n, **samp)
+        return server.generate_stream(
+            tokens, max_new_tokens=n, chunk_size=self.stream_chunk_size, **samp
+        )
 
     @property
     def ready(self) -> bool:
@@ -792,8 +870,11 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
 
         def _stream_generate(self, server, tokens, n, samp) -> None:
             """One NDJSON line of NEW tokens per decoded chunk, then
-            {"done": true}; concatenates to the non-streaming result."""
-            gen = server.generate_stream(tokens, max_new_tokens=n, **samp)
+            {"done": true}; concatenates to the non-streaming result.
+            Single-row streams ride the continuous engine when enabled, so
+            N concurrent SSE clients share one running decode instead of
+            contending with N independent loops."""
+            gen = sset.stream_source(server, tokens, n, samp)
             try:
                 # pull the first chunk BEFORE committing a 200: an
                 # unsupported family / bad request must still be a 4xx
@@ -859,7 +940,14 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                 else:
                     self._json(503, {"status": "draining" if sset.draining else "loading"})
             elif self.path == "/metrics":
-                self._json(200, {n: dict(s.stats) for n, s in sset.servers.items()})
+                payload = {}
+                for n, s in sset.servers.items():
+                    d = dict(s.stats)
+                    cb = sset.cbatchers.get(n)
+                    if cb is not None:
+                        d["continuous"] = dict(cb.stats)
+                    payload[n] = d
+                self._json(200, payload)
             elif self.path == "/v1/models":
                 from modelx_tpu.dl import openai_api as oai
 
@@ -1003,21 +1091,10 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                         })
                     if bool(req.get("stream", False)):
                         return self._stream_generate(server, tokens, n, samp)
-                    batcher = sset.batcher_for(server)
-                    speculates = (
-                        server.speculative_k > 0
-                        and tokens.shape[0] == 1
-                        and samp["temperature"] == 0.0
-                        and server.family.decode_fns is not None
+                    engine = sset.engine_for(
+                        server, tokens.shape[0], samp["temperature"]
                     )
-                    if speculates:
-                        # --speculative-k targets exactly this request shape;
-                        # it must not be silently inert under --dynamic-batch
-                        out = server.generate(tokens, max_new_tokens=n, **samp)
-                    elif batcher is not None and server.family.generate_ragged is not None:
-                        out = batcher.generate(tokens, max_new_tokens=n, **samp)
-                    else:
-                        out = server.generate(tokens, max_new_tokens=n, **samp)
+                    out = engine.generate(tokens, max_new_tokens=n, **samp)
                     resp = {"tokens": out.tolist()}
                     if tok is not None:  # text request: decode the new tokens
                         resp["text"] = tok.decode(out[0, tokens.shape[1]:].tolist())

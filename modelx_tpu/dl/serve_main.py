@@ -31,6 +31,22 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
 @click.option("--trace-dir", default="", help="jax profiler output dir (/v1/profile)")
 @click.option("--dynamic-batch", is_flag=True,
               help="coalesce concurrent forward requests into one device call")
+@click.option("--continuous-batch", is_flag=True,
+              help="iteration-level (in-flight) batching: generate/stream "
+                   "requests join a running decode at chunk boundaries "
+                   "(supersedes --dynamic-batch and --speculative-k for "
+                   "generate traffic)")
+@click.option("--max-slots", default=8, type=int,
+              help="continuous batching: concurrent decode slots (KV cache "
+                   "rows held on device)")
+@click.option("--max-batch", default=32, type=int,
+              help="dynamic batching: max requests coalesced per device call")
+@click.option("--batch-window-ms", default=3.0, type=float,
+              help="dynamic batching: how long a request waits for "
+                   "companions (latency/throughput dial)")
+@click.option("--stream-chunk-size", default=8, type=int,
+              help="tokens decoded per flush on streaming responses (also "
+                   "the continuous engine's chunk length)")
 @click.option("--quantize", type=click.Choice(["int8"]), default=None,
               help="weight-only int8: half the HBM/transfer bytes for the big matmuls")
 @click.option("--speculative-k", default=0, type=int,
@@ -45,7 +61,9 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
                    "balancers drain) before stopping")
 def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen: str,
          max_seq_len: int, compile_cache: bool, concurrent_load: bool, trace_dir: str,
-         dynamic_batch: bool, quantize: str | None, speculative_k: int,
+         dynamic_batch: bool, continuous_batch: bool, max_slots: int,
+         max_batch: int, batch_window_ms: float, stream_chunk_size: int,
+         quantize: str | None, speculative_k: int,
          loras: tuple[str, ...], drain_seconds: float) -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     from modelx_tpu.parallel.distributed import initialize
@@ -92,7 +110,14 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
                           lora_dir=lora_dirs.get(name, ""))
         for name, path in entries.items()
     }
-    sset = ServerSet(servers, trace_dir=trace_dir, dynamic_batch=dynamic_batch)
+    if continuous_batch and speculative_k:
+        logging.getLogger("modelx.serve").warning(
+            "--continuous-batch supersedes --speculative-k for generate traffic"
+        )
+    sset = ServerSet(servers, trace_dir=trace_dir, dynamic_batch=dynamic_batch,
+                     continuous_batch=continuous_batch, max_slots=max_slots,
+                     max_batch=max_batch, batch_window_ms=batch_window_ms,
+                     stream_chunk_size=stream_chunk_size)
     httpd = serve(sset, listen=listen)  # starts serving 503s while loading
     stats = sset.load_all(concurrent=concurrent_load)
     logging.getLogger("modelx.serve").info("models loaded: %s", stats)
@@ -121,6 +146,8 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
     # batchers while this iterates
     for batcher in list(sset.batchers.values()):
         batcher.close()
+    for cb in list(sset.cbatchers.values()):
+        cb.close()
     httpd.shutdown()
 
 

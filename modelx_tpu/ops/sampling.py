@@ -28,11 +28,13 @@ def sample(
     top_k: jax.Array | None = None,  # [B] int32; 0 = off; None = skip filter
     top_p: jax.Array | None = None,  # [B] float; >=1 = off; None = skip filter
     seeds: jax.Array | None = None,  # [B] int32 per-row stream
-    step=0,  # scalar int: decode step, folded in so steps differ
+    step=0,  # int or [B] int32: decode step(s), folded in so steps differ
 ) -> jax.Array:
     """Next token per row, [B] int32. ``top_k``/``top_p`` as None (the
     common temperature-only case) compiles without the O(B·V log V) sort
-    the filters need."""
+    the filters need. ``step`` may be per-row: a continuous batch holds
+    rows at different decode depths, and each row's (seed, step) stream
+    must match what the same request would see decoded alone."""
     b, v = logits.shape
     greedy = jnp.argmax(logits, axis=-1)
     if seeds is None:
@@ -69,9 +71,12 @@ def sample(
         filtered = jnp.where(keep, scaled, NEG_INF)
 
     # per-row streams: fold the row's request seed and the step into the key
-    def row_key(seed):
-        return jax.random.fold_in(jax.random.fold_in(key, seed), step)
+    # (scalar step broadcasts — identical fold_in values to the scalar form)
+    steps = jnp.broadcast_to(jnp.asarray(step, jnp.int32), (b,))
 
-    keys = jax.vmap(row_key)(jnp.asarray(seeds, jnp.int32))
+    def row_key(seed, step_row):
+        return jax.random.fold_in(jax.random.fold_in(key, seed), step_row)
+
+    keys = jax.vmap(row_key)(jnp.asarray(seeds, jnp.int32), steps)
     sampled = jax.vmap(lambda kk, lg: jax.random.categorical(kk, lg))(keys, filtered)
     return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)

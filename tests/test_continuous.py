@@ -1,0 +1,228 @@
+"""Continuous (in-flight) batching (dl/continuous.py).
+
+The exactness oracle everywhere: a request decoded by the continuous
+engine must yield byte-identical tokens to the same request on the plain
+paths (ModelServer.generate / ragged decode / ChunkedDecoder stream) —
+greedy by argmax determinism, sampled because the per-row (seed, step)
+streams are carried per slot."""
+
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+import jax
+import jax.numpy as jnp
+
+from modelx_tpu.dl import safetensors as st
+from modelx_tpu.dl.continuous import ContinuousBatcher
+from modelx_tpu.dl.serve import ModelServer, ServerSet, serve
+from modelx_tpu.registry.server import free_port
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    import dataclasses
+
+    from modelx_tpu.models import llama
+
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64), dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    d = tmp_path_factory.mktemp("continuous")
+    st.write_safetensors(
+        str(d / "model.safetensors"), {k: np.asarray(v) for k, v in params.items()}
+    )
+    srv = ModelServer(str(d), mesh_spec="dp=1", dtype="float32", max_seq_len=96)
+    srv.load()
+    return srv
+
+
+@pytest.fixture()
+def engine(server):
+    cb = ContinuousBatcher(server, max_slots=4, chunk_size=4)
+    yield cb
+    cb.close()
+
+
+class TestExactness:
+    def test_greedy_matches_plain(self, server, engine):
+        tokens = np.array([[5, 9, 2, 7, 1]], np.int32)
+        expected = server.generate(tokens, max_new_tokens=11)
+        got = engine.generate(tokens, max_new_tokens=11)
+        np.testing.assert_array_equal(got, expected)
+
+    def test_sampled_matches_ragged(self, server, engine):
+        """Same (seed, step) stream as the ragged/stream paths."""
+        tokens = np.array([[3, 4, 5]], np.int32)
+        expected = server.generate(
+            tokens, max_new_tokens=9, temperature=0.8, top_k=12, top_p=0.9, seed=41
+        )
+        got = engine.generate(
+            tokens, max_new_tokens=9, temperature=0.8, top_k=12, top_p=0.9, seed=41
+        )
+        np.testing.assert_array_equal(got, expected)
+
+    def test_multirow_request(self, server, engine):
+        tokens = np.array([[5, 9, 2], [8, 1, 1]], np.int32)
+        expected = server.generate(tokens, max_new_tokens=6)
+        got = engine.generate(tokens, max_new_tokens=6)
+        np.testing.assert_array_equal(got, expected)
+
+    def test_concurrent_mixed_requests_match_solo(self, server, engine):
+        """Requests of different lengths/budgets/sampling, submitted
+        concurrently, each match their solo result exactly."""
+        import concurrent.futures
+
+        reqs = [
+            (np.array([[1, 2, 3]], np.int32), 5, dict()),
+            (np.array([[9, 8, 7, 6, 5, 4, 3]], np.int32), 9, dict(temperature=0.7, seed=3)),
+            (np.array([[11, 12]], np.int32), 3, dict(temperature=1.1, top_p=0.8, seed=8)),
+            (np.array([[30]], np.int32), 1, dict()),
+            (np.array([[4, 4, 4, 4]], np.int32), 12, dict(temperature=0.5, top_k=7, seed=5)),
+        ]
+        expected = [server.generate(t, max_new_tokens=n, **s) for t, n, s in reqs]
+        with concurrent.futures.ThreadPoolExecutor(len(reqs)) as pool:
+            got = list(pool.map(
+                lambda r: engine.generate(r[0], max_new_tokens=r[1], **r[2]), reqs
+            ))
+        for e, g in zip(expected, got):
+            np.testing.assert_array_equal(g, e)
+
+    def test_stream_concatenates_to_generate(self, server, engine):
+        tokens = np.array([[2, 4, 6]], np.int32)
+        pieces = list(engine.stream(tokens, max_new_tokens=10))
+        got = np.concatenate(pieces, axis=1)
+        expected = server.generate(tokens, max_new_tokens=10)[:, 3:]
+        np.testing.assert_array_equal(got, expected)
+        # first piece is the prefill token alone: streaming TTFT is one
+        # prefill, not a whole chunk
+        assert pieces[0].shape == (1, 1)
+
+
+class TestScheduling:
+    def test_mid_decode_join(self, server, engine):
+        """A short request admitted while a long decode runs completes
+        WITHOUT waiting for the long decode to finish — the defining
+        continuous-batching property."""
+        long_tokens = np.array([[7, 7, 7]], np.int32)
+        short_tokens = np.array([[9, 1]], np.int32)
+        long_done = {}
+        short_done = {}
+
+        def long_req():
+            long_done["out"] = engine.generate(long_tokens, max_new_tokens=64)
+            long_done["t"] = time.monotonic()
+
+        t_long = threading.Thread(target=long_req)
+        t_long.start()
+        # wait until the long decode is genuinely mid-flight
+        deadline = time.monotonic() + 10
+        while engine.stats["chunks"] < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert engine.stats["chunks"] >= 2, "long decode never started"
+
+        short = engine.generate(short_tokens, max_new_tokens=4)
+        short_done["t"] = time.monotonic()
+        t_long.join()
+        assert short_done["t"] < long_done["t"], (
+            "short request waited for the long decode to finish"
+        )
+        np.testing.assert_array_equal(
+            short, server.generate(short_tokens, max_new_tokens=4)
+        )
+        np.testing.assert_array_equal(
+            long_done["out"], server.generate(long_tokens, max_new_tokens=64)
+        )
+
+    def test_more_requests_than_slots(self, server):
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4)
+        try:
+            import concurrent.futures
+
+            reqs = [np.array([[i + 1, i + 2]], np.int32) for i in range(5)]
+            expected = [server.generate(t, max_new_tokens=5) for t in reqs]
+            with concurrent.futures.ThreadPoolExecutor(5) as pool:
+                got = list(pool.map(lambda t: cb.generate(t, max_new_tokens=5), reqs))
+            for e, g in zip(expected, got):
+                np.testing.assert_array_equal(g, e)
+        finally:
+            cb.close()
+
+    def test_budget_exceeding_max_len_rejected(self, server, engine):
+        with pytest.raises(ValueError, match="max_len"):
+            engine.generate(np.array([[1, 2]], np.int32), max_new_tokens=1000)
+
+    def test_close_fails_waiters(self, server):
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4)
+        out = cb.submit_row([1, 2, 3], 500 // 8, {})
+        cb.close()
+        # drain: either tokens then an error/DONE — must not hang
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                item = out.get(timeout=5)
+            except queue.Empty:
+                pytest.fail("waiter hung after close")
+            if isinstance(item, BaseException) or item is not None and not isinstance(item, np.ndarray):
+                break
+
+    def test_submit_after_close_raises(self, server):
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4)
+        cb.close()
+        with pytest.raises(RuntimeError, match="closed"):
+            cb.submit_row([1], 4, {})
+
+
+class TestServingIntegration:
+    @pytest.fixture()
+    def sset(self, server):
+        s = ServerSet({"m": server}, continuous_batch=True, max_slots=4,
+                      stream_chunk_size=4)
+        yield s
+        for cb in s.cbatchers.values():
+            cb.close()
+
+    def test_http_generate_and_stream_route_through_engine(self, sset, server):
+        port = free_port()
+        httpd = serve(sset, listen=f"127.0.0.1:{port}")
+        base = f"http://127.0.0.1:{port}"
+        try:
+            tokens = [[1, 2, 3]]
+            r = requests.post(base + "/v1/generate",
+                              json={"tokens": tokens, "max_new_tokens": 6})
+            assert r.status_code == 200, r.text
+            expected = server.generate(np.asarray(tokens, np.int32), max_new_tokens=6)
+            np.testing.assert_array_equal(np.asarray(r.json()["tokens"]), expected)
+
+            r = requests.post(
+                base + "/v1/generate",
+                json={"tokens": tokens, "max_new_tokens": 6, "stream": True},
+                stream=True,
+            )
+            assert r.status_code == 200
+            got = []
+            for line in r.iter_lines():
+                obj = __import__("json").loads(line)
+                if obj.get("done"):
+                    break
+                got.extend(obj["tokens"][0])
+            assert got == expected[0, 3:].tolist()
+            cb = sset.cbatchers["m"]
+            assert cb.stats["admitted"] >= 2  # both requests rode the engine
+        finally:
+            httpd.shutdown()
+
+    def test_metrics_exposes_continuous_stats(self, sset, server):
+        port = free_port()
+        httpd = serve(sset, listen=f"127.0.0.1:{port}")
+        base = f"http://127.0.0.1:{port}"
+        try:
+            requests.post(base + "/v1/generate",
+                          json={"tokens": [[1, 2]], "max_new_tokens": 2})
+            m = requests.get(base + "/metrics").json()
+            assert m["m"]["continuous"]["admitted"] >= 1
+        finally:
+            httpd.shutdown()

@@ -264,8 +264,12 @@ class TestStopStraddle:
             def decode(self, ids):
                 return " ".join(f"w{i}" for i in ids)
 
+        import types as _types
+
+        from modelx_tpu.dl.serve import ServerSet
+
         server = SimpleNamespace(
-            name="f", ready=True,
+            name="f", ready=True, speculative_k=0,
             cfg=SimpleNamespace(vocab_size=100),
             family=SimpleNamespace(decode_fns=object(), name="fake",
                                    generate_ragged=None),
@@ -275,9 +279,15 @@ class TestStopStraddle:
                 np.asarray(p) for p in pieces
             ),
         )
-        return SimpleNamespace(servers={"f": server}, default="f",
-                               max_new_tokens_limit=64,
-                               batcher_for=lambda s: None)
+        sset = SimpleNamespace(servers={"f": server}, default="f",
+                               max_new_tokens_limit=64, stream_chunk_size=8,
+                               batcher_for=lambda s: None,
+                               continuous_for=lambda s: None)
+        # bind the REAL routing methods so the fake can't drift from the
+        # policy the production ServerSet applies
+        sset.stream_source = _types.MethodType(ServerSet.stream_source, sset)
+        sset.engine_for = _types.MethodType(ServerSet.engine_for, sset)
+        return sset
 
     def _stream_text(self, sset, stop):
         from modelx_tpu.dl.openai_api import stream_completion
