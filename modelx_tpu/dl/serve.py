@@ -262,9 +262,31 @@ class ModelServer:
         seed: int = 0,
     ) -> np.ndarray:
         """Greedy by default; temperature > 0 samples (with optional top-k /
-        nucleus cuts and a request seed) via the ragged decode path."""
+        nucleus cuts and a request seed) via the ragged decode path. With
+        --speculative-k, single rows speculate at ANY temperature: greedy
+        acceptance is token-exact, sampled acceptance is modified rejection
+        (distribution-preserving)."""
         if self.family.generate is None:
             raise ValueError(f"family {self.family.name} is not generative")
+        tokens_arr = np.asarray(tokens, np.int32)
+        if (
+            self.speculative_k > 0
+            and tokens_arr.shape[0] == 1
+            and self.family.decode_fns is not None
+        ):
+            with trace.span("serve.generate_spec", model=self.name,
+                            new_tokens=max_new_tokens):
+                dec = self._speculative_decoder()
+                new, stats = dec.generate(
+                    self.params, tokens_arr[0].tolist(), max_new_tokens,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    seed=seed,
+                )
+                self.stats["tokens_generated"] += len(new)
+                self._record_spec_stats(stats)
+                return np.concatenate(
+                    [tokens_arr, np.asarray([new], np.int32)], axis=1
+                )
         if temperature > 0:
             if self.family.generate_ragged is None:
                 raise ValueError(
@@ -283,24 +305,9 @@ class ModelServer:
                 )
             self.stats["tokens_generated"] += int(b * max_new_tokens)
             return np.concatenate([np.asarray(tokens, np.int32), gen], axis=1)
-        tokens = np.asarray(tokens, np.int32)
-        if (
-            self.speculative_k > 0
-            and tokens.shape[0] == 1
-            and self.family.decode_fns is not None
-        ):
-            with trace.span("serve.generate_spec", model=self.name,
-                            new_tokens=max_new_tokens):
-                dec = self._speculative_decoder()
-                new, stats = dec.generate(self.params, tokens[0].tolist(), max_new_tokens)
-                self.stats["tokens_generated"] += len(new)
-                self._record_spec_stats(stats)
-                return np.concatenate(
-                    [tokens, np.asarray([new], np.int32)], axis=1
-                )
         with trace.span("serve.generate", model=self.name, new_tokens=max_new_tokens):
             out = self.family.generate(
-                self.params, jnp.asarray(tokens, jnp.int32), self.cfg,
+                self.params, jnp.asarray(tokens_arr, jnp.int32), self.cfg,
                 mesh=self.mesh, max_new_tokens=max_new_tokens,
             )
             self.stats["tokens_generated"] += int(out.shape[0] * max_new_tokens)
@@ -361,16 +368,16 @@ class ModelServer:
         if self.family.decode_fns is None:
             raise ValueError(f"family {self.family.name} does not support streaming")
         tokens_arr = np.asarray(tokens, np.int32)
-        if (
-            self.speculative_k > 0
-            and tokens_arr.shape[0] == 1
-            and temperature == 0.0
-        ):
-            # single-row greedy stream: speculation's exact target — chunks
-            # flush per device step (accepted run + bonus token), and the
-            # concatenation still equals the plain stream token-for-token.
+        if self.speculative_k > 0 and tokens_arr.shape[0] == 1:
+            # single-row stream: speculation's target — chunks flush per
+            # device step (accepted run + bonus token). Greedy concatenates
+            # to the plain stream token-for-token; sampled streams keep the
+            # plain sampler's distribution (modified rejection).
             # (yield from, not return: this function is itself a generator)
-            yield from self._generate_stream_speculative(tokens_arr, max_new_tokens)
+            yield from self._generate_stream_speculative(
+                tokens_arr, max_new_tokens, temperature=temperature,
+                top_k=top_k, top_p=top_p, seed=seed,
+            )
             return
         dec = self._decoders.get(chunk_size)
         if dec is None:
@@ -410,14 +417,18 @@ class ModelServer:
             self.stats.get("spec_accepted", 0) + stats["accepted"]
         )
 
-    def _generate_stream_speculative(self, tokens: np.ndarray, max_new_tokens: int):
+    def _generate_stream_speculative(self, tokens: np.ndarray, max_new_tokens: int,
+                                     temperature: float = 0.0, top_k: int = 0,
+                                     top_p: float = 1.0, seed: int = 0):
         dec = self._speculative_decoder()
         stats = {"device_steps": 0, "proposed": 0, "accepted": 0}
         try:
             with trace.span("serve.generate_stream_spec", model=self.name,
                             new_tokens=max_new_tokens):
                 for piece in dec.stream(self.params, tokens[0].tolist(),
-                                        max_new_tokens, stats=stats):
+                                        max_new_tokens, stats=stats,
+                                        temperature=temperature, top_k=top_k,
+                                        top_p=top_p, seed=seed):
                     self.stats["tokens_generated"] += int(piece.size)
                     yield piece
         finally:
@@ -757,11 +768,11 @@ class ServerSet:
         if (
             server.speculative_k > 0
             and n_rows == 1
-            and temperature == 0.0
             and server.family.decode_fns is not None
         ):
-            # speculation's exact target shape; it must not be silently
-            # inert under --dynamic-batch
+            # speculation's target shape (greedy = token-exact, sampled =
+            # modified rejection); it must not be silently inert under
+            # --dynamic-batch
             return server
         batcher = self.batcher_for(server)
         if batcher is not None and server.family.generate_ragged is not None:

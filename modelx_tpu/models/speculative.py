@@ -116,6 +116,8 @@ class SpeculativeDecoder:
         self.max_ngram = int(max_ngram)
         self._prefill = jax.jit(self._prefill_impl, donate_argnums=(2,))
         self._verify = jax.jit(self._verify_impl, donate_argnums=(2,))
+        self._prefill_sampled = jax.jit(self._prefill_sampled_impl, donate_argnums=(2,))
+        self._verify_sampled = jax.jit(self._verify_sampled_impl, donate_argnums=(2,))
 
     def _prefill_impl(self, params, prompt, cache, last):
         """``prompt`` is right-padded to a 16-aligned length so prompt-size
@@ -133,29 +135,124 @@ class SpeculativeDecoder:
         logits, cache = self.forward(params, block, kv_cache=cache, cache_offset=offset)
         return cache, jnp.argmax(logits[0], axis=-1)  # [k+1]
 
+    # -- sampled speculation (modified rejection) -----------------------------
+    #
+    # The n-gram draft is a POINT MASS q = delta(prop_i), so the standard
+    # speculative-sampling acceptance (Leviathan/Chen: accept x ~ q with
+    # prob min(1, p(x)/q(x)); on rejection resample from norm(max(p-q, 0)))
+    # reduces to: accept prop_i with prob p_i(prop_i); on rejection sample
+    # from p_i with prop_i struck out, renormalized. Each emitted token is
+    # then distributed EXACTLY as p_i — the distribution the plain sampler
+    # draws from (identical scale_and_filter + softmax) — regardless of
+    # what the draft proposed. The sampled SEQUENCE differs from the plain
+    # path's for the same seed (randomness is consumed differently); the
+    # guarantee is distributional, and tests/test_speculative.py proves it
+    # empirically against a known target distribution.
+
+    def _spec_keys(self, seed, step0, tag):
+        """One independent PRNG stream per (request seed, absolute draw
+        position, use): use 0 = accept uniforms, 1 = resampling draws."""
+        base = jax.random.fold_in(jax.random.PRNGKey(0), seed)
+
+        def key_at(i):
+            return jax.random.fold_in(jax.random.fold_in(base, step0 + i), tag)
+
+        return key_at
+
+    def _prefill_sampled_impl(self, params, prompt, cache, last,
+                              temp, top_k, top_p, seed):
+        """Like _prefill but the first token SAMPLES from the filtered
+        target distribution (draw position 0 of the request's stream)."""
+        from modelx_tpu.ops import sampling as sampling_ops
+
+        logits, cache = self.forward(params, prompt, kv_cache=cache, cache_offset=0)
+        filtered = sampling_ops.scale_and_filter(
+            logits[0, last, :][None].astype(jnp.float32), temp, top_k, top_p
+        )
+        key = self._spec_keys(seed, jnp.int32(0), 1)(0)
+        tok = jax.random.categorical(key, filtered[0])
+        return cache, tok[None].astype(jnp.int32)  # [1]
+
+    def _verify_sampled_impl(self, params, block, cache, offset,
+                             temp, top_k, top_p, seed, step0):
+        """Sampled verify over one [1, k+1] block. Returns per position i
+        (the distribution for the token AFTER block[:i+1]):
+        - accept[i]:  u_i < p_i(block[i+1])  (valid for i < k — whether the
+          NEXT block token would be accepted as a draft);
+        - resample[i]: draw from p_i with the proposed token struck out and
+          renormalized (used at the first rejection);
+        - plain[i]:   draw from p_i itself (used when the step runs past
+          the proposal: bonus token, or an unspeculated step).
+        All draws use the request's deterministic (seed, draw position)
+        streams, so the same seed reproduces the same output."""
+        from modelx_tpu.ops import sampling as sampling_ops
+
+        logits, cache = self.forward(params, block, kv_cache=cache, cache_offset=offset)
+        n = self.k + 1
+        filt = sampling_ops.scale_and_filter(
+            logits[0].astype(jnp.float32),
+            jnp.broadcast_to(temp, (n,)),
+            None if top_k is None else jnp.broadcast_to(top_k, (n,)),
+            None if top_p is None else jnp.broadcast_to(top_p, (n,)),
+        )  # [k+1, V]
+        probs = jax.nn.softmax(filt, axis=-1)
+        proposed_next = jnp.concatenate([block[0, 1:], jnp.zeros((1,), jnp.int32)])
+        p_prop = jnp.take_along_axis(probs, proposed_next[:, None], axis=1)[:, 0]
+        accept_key = self._spec_keys(seed, step0, 0)
+        draw_key = self._spec_keys(seed, step0, 1)
+        idx = jnp.arange(n)
+        u = jax.vmap(lambda i: jax.random.uniform(accept_key(i)))(idx)
+        accept = u < p_prop
+        # strike the proposed token out for the rejection resample
+        struck = jnp.where(
+            jax.nn.one_hot(proposed_next, filt.shape[-1], dtype=bool),
+            sampling_ops.NEG_INF, filt,
+        )
+        resample = jax.vmap(
+            lambda i: jax.random.categorical(draw_key(i), struck[i])
+        )(idx)
+        plain = jax.vmap(
+            lambda i: jax.random.categorical(draw_key(i), filt[i])
+        )(idx)
+        return cache, accept, resample.astype(jnp.int32), plain.astype(jnp.int32)
+
     def generate(
-        self, params, prompt_ids, max_new_tokens: int
+        self, params, prompt_ids, max_new_tokens: int,
+        temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+        seed: int = 0,
     ) -> tuple[list[int], dict]:
-        """Greedy-decode ``max_new_tokens`` tokens after ``prompt_ids``
-        (a 1-D int sequence). Token-exact vs plain greedy decode."""
+        """Decode ``max_new_tokens`` tokens after ``prompt_ids`` (a 1-D int
+        sequence). Greedy (temperature 0) is token-exact vs plain greedy
+        decode; temperature > 0 samples with modified-rejection acceptance
+        (output distribution provably unchanged, see _verify_sampled_impl)."""
         stats = {"device_steps": 0, "proposed": 0, "accepted": 0}
         out: list[int] = []
-        for chunk in self.stream(params, prompt_ids, max_new_tokens, stats=stats):
+        for chunk in self.stream(params, prompt_ids, max_new_tokens, stats=stats,
+                                 temperature=temperature, top_k=top_k,
+                                 top_p=top_p, seed=seed):
             out.extend(chunk[0].tolist())
         return out, stats
 
-    def stream(self, params, prompt_ids, max_new_tokens: int, stats: dict | None = None):
+    def stream(self, params, prompt_ids, max_new_tokens: int, stats: dict | None = None,
+               temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+               seed: int = 0):
         """Yields [1, c] arrays of NEW tokens — one chunk per device step
         (first token, then each verify step's accepted run + bonus token).
-        The concatenation equals ``generate``'s output exactly, which in
-        turn equals plain greedy decode; a speculative stream flushes
-        FASTER precisely when acceptance is high. ``stats`` (optional dict)
-        accumulates device_steps/proposed/accepted."""
+        The concatenation equals ``generate``'s output exactly; greedy
+        output in turn equals plain greedy decode. A speculative stream
+        flushes FASTER precisely when acceptance is high. ``stats``
+        (optional dict) accumulates device_steps/proposed/accepted."""
         prompt_ids = [int(t) for t in prompt_ids]
         if stats is None:
             stats = {"device_steps": 0, "proposed": 0, "accepted": 0}
         if max_new_tokens <= 0:
             return
+        sampled = float(temperature) > 0.0
+        if sampled:
+            temp = jnp.asarray([float(temperature)], jnp.float32)
+            tk = jnp.asarray([int(top_k)], jnp.int32) if int(top_k) > 0 else None
+            tp = jnp.asarray([float(top_p)], jnp.float32) if float(top_p) < 1.0 else None
+            seed_ = jnp.int32(int(seed))
         s = len(prompt_ids)
         # pad the prompt to the shared decode bucket: distinct prompt
         # lengths must not each compile a fresh prefill program
@@ -172,7 +269,12 @@ class SpeculativeDecoder:
         cache_len = 1 << (need - 1).bit_length()
         cache = self.init_kv_cache(1, cache_len)
         prompt = jnp.asarray([padded], jnp.int32)
-        cache, first = self._prefill(params, prompt, cache, jnp.int32(s - 1))
+        if sampled:
+            cache, first = self._prefill_sampled(
+                params, prompt, cache, jnp.int32(s - 1), temp, tk, tp, seed_
+            )
+        else:
+            cache, first = self._prefill(params, prompt, cache, jnp.int32(s - 1))
         stats["device_steps"] += 1
         out = [int(first[0])]
         yield np.asarray([[out[0]]], np.int32)
@@ -180,6 +282,7 @@ class SpeculativeDecoder:
         index = _NgramIndex(self.max_ngram)
         index.extend(seq, 0)
         offset = s  # cache holds [0, offset) verified positions
+        draws = 1  # absolute draw position (prefill consumed 0); sampled only
         while len(out) < max_new_tokens:
             prop = index.propose(seq, self.k)
             stats["proposed"] += len(prop)
@@ -187,17 +290,36 @@ class SpeculativeDecoder:
             block[0, 0] = seq[-1]
             if prop:
                 block[0, 1:1 + len(prop)] = prop
-            cache, argm = self._verify(
-                params, jnp.asarray(block), cache, jnp.int32(offset)
-            )
-            stats["device_steps"] += 1
-            argm = np.asarray(argm)
-            # accept while the model agrees with the proposal, then take the
-            # model's own token at the first disagreement (always correct)
-            a = 0
-            while a < len(prop) and int(argm[a]) == prop[a]:
-                a += 1
-            new = prop[:a] + [int(argm[a])]
+            if sampled:
+                cache, accept, resample, plain = self._verify_sampled(
+                    params, jnp.asarray(block), cache, jnp.int32(offset),
+                    temp, tk, tp, seed_, jnp.int32(draws),
+                )
+                stats["device_steps"] += 1
+                accept = np.asarray(accept)
+                resample = np.asarray(resample)
+                plain = np.asarray(plain)
+                # accept proposals while their rejection coin passes; the
+                # first rejected position resamples from the residual, a
+                # fully-accepted run takes a plain draw at the next position
+                a = 0
+                while a < len(prop) and bool(accept[a]):
+                    a += 1
+                nxt = int(resample[a]) if a < len(prop) else int(plain[a])
+                new = prop[:a] + [nxt]
+                draws += a + 1
+            else:
+                cache, argm = self._verify(
+                    params, jnp.asarray(block), cache, jnp.int32(offset)
+                )
+                stats["device_steps"] += 1
+                argm = np.asarray(argm)
+                # accept while the model agrees with the proposal, then take
+                # the model's own token at the first disagreement (correct)
+                a = 0
+                while a < len(prop) and int(argm[a]) == prop[a]:
+                    a += 1
+                new = prop[:a] + [int(argm[a])]
             new = new[: max_new_tokens - len(out)]
             # count only EMITTED accepted tokens: a final step may accept
             # more than the budget has room for, and the advertised accept

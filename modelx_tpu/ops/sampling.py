@@ -21,6 +21,47 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def scale_and_filter(
+    logits: jax.Array,  # [B, V] float
+    temperature: jax.Array,  # [B] float; <=0 rows pass through at scale 1
+    top_k: jax.Array | None = None,  # [B] int32; 0 = off; None = skip filter
+    top_p: jax.Array | None = None,  # [B] float; >=1 = off; None = skip filter
+) -> jax.Array:
+    """Temperature-scaled, top-k/top-p-filtered logits — softmax of the
+    result IS the distribution ``sample`` draws from. Exposed separately so
+    speculative sampling's acceptance rule (models/speculative.py) verifies
+    against byte-identical target distributions."""
+    b, v = logits.shape
+    temperature = jnp.asarray(temperature, logits.dtype)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / safe_t[:, None]
+
+    if top_k is None and top_p is None:
+        return scaled
+    # one descending sort serves both filters
+    sorted_logits = -jnp.sort(-scaled, axis=-1)  # [B, V] desc
+    keep = jnp.ones_like(scaled, bool)
+    if top_k is not None:
+        # top-k: keep logits >= the k-th largest (per-row k)
+        k = jnp.clip(jnp.asarray(top_k, jnp.int32), 0, v)
+        k_idx = jnp.clip(k - 1, 0, v - 1)[:, None]
+        kth = jnp.take_along_axis(sorted_logits, k_idx, axis=1)  # [B,1]
+        keep &= jnp.where(k[:, None] > 0, scaled >= kth, True)
+    if top_p is not None:
+        # top-p (nucleus): smallest prefix of the sorted distribution
+        # with cumulative probability >= p; keep logits >= its last
+        # member's value
+        probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs_sorted, axis=-1)
+        p = jnp.asarray(top_p, logits.dtype)[:, None]
+        # prefix including the item that crosses p (cum[-1]=1 always)
+        in_nucleus = cum - probs_sorted < p
+        cut_idx = jnp.maximum(jnp.sum(in_nucleus, axis=-1) - 1, 0)[:, None]
+        pth = jnp.take_along_axis(sorted_logits, cut_idx, axis=1)
+        keep &= jnp.where(p < 1.0, scaled >= pth, True)
+    return jnp.where(keep, scaled, NEG_INF)
+
+
 def sample(
     logits: jax.Array,  # [B, V] float
     key: jax.Array,  # base PRNG key
@@ -41,34 +82,7 @@ def sample(
         seeds = jnp.zeros((b,), jnp.int32)
 
     temperature = jnp.asarray(temperature, logits.dtype)
-    safe_t = jnp.where(temperature > 0, temperature, 1.0)
-    scaled = logits / safe_t[:, None]
-
-    if top_k is None and top_p is None:
-        filtered = scaled
-    else:
-        # one descending sort serves both filters
-        sorted_logits = -jnp.sort(-scaled, axis=-1)  # [B, V] desc
-        keep = jnp.ones_like(scaled, bool)
-        if top_k is not None:
-            # top-k: keep logits >= the k-th largest (per-row k)
-            k = jnp.clip(jnp.asarray(top_k, jnp.int32), 0, v)
-            k_idx = jnp.clip(k - 1, 0, v - 1)[:, None]
-            kth = jnp.take_along_axis(sorted_logits, k_idx, axis=1)  # [B,1]
-            keep &= jnp.where(k[:, None] > 0, scaled >= kth, True)
-        if top_p is not None:
-            # top-p (nucleus): smallest prefix of the sorted distribution
-            # with cumulative probability >= p; keep logits >= its last
-            # member's value
-            probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
-            cum = jnp.cumsum(probs_sorted, axis=-1)
-            p = jnp.asarray(top_p, logits.dtype)[:, None]
-            # prefix including the item that crosses p (cum[-1]=1 always)
-            in_nucleus = cum - probs_sorted < p
-            cut_idx = jnp.maximum(jnp.sum(in_nucleus, axis=-1) - 1, 0)[:, None]
-            pth = jnp.take_along_axis(sorted_logits, cut_idx, axis=1)
-            keep &= jnp.where(p < 1.0, scaled >= pth, True)
-        filtered = jnp.where(keep, scaled, NEG_INF)
+    filtered = scale_and_filter(logits, temperature, top_k, top_p)
 
     # per-row streams: fold the row's request seed and the step into the key
     # (scalar step broadcasts — identical fold_in values to the scalar form)
