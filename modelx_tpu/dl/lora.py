@@ -69,7 +69,26 @@ def parse_adapter_dir(adapter_dir: str) -> tuple[float, dict[str, dict[str, np.n
     if os.path.isfile(cfg_path):
         with open(cfg_path) as f:
             cfg = json.load(f)
+        # per-module ranks/alphas mean a single global alpha/r scale would
+        # silently mis-scale some targets — refuse rather than mis-serve
+        # (same stance as modules_to_save / rslora above)
+        for key in ("rank_pattern", "alpha_pattern"):
+            if cfg.get(key):
+                raise ValueError(
+                    f"adapter_config.json has {key}: per-module LoRA "
+                    "scales are not supported (a single global scale "
+                    "would silently mis-merge some targets)"
+                )
         r = cfg.get("r") or next(iter(pairs.values()))["A"].shape[0]
+        mismatched = {
+            t: ab["A"].shape[0] for t, ab in pairs.items() if ab["A"].shape[0] != r
+        }
+        if mismatched:
+            raise ValueError(
+                f"adapter ranks differ from adapter_config.json r={r}: "
+                f"{dict(list(mismatched.items())[:3])} — refusing to merge "
+                "with a wrong global scale"
+            )
         alpha = cfg.get("lora_alpha", r)
         if cfg.get("use_rslora"):
             # rank-stabilized LoRA scales by alpha/sqrt(r); using alpha/r

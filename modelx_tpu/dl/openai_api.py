@@ -126,7 +126,15 @@ def parse_sampling(req: dict, limit: int) -> tuple[int, dict]:
         if not asks_nothing:
             raise APIError(400, f"{key!r} is not supported")
     try:
-        n_tokens = int(req.get("max_tokens", 16))
+        # max_completion_tokens is the current OpenAI chat param (newer SDKs
+        # send it instead of the deprecated max_tokens); honoring only one
+        # would silently cap a 1000-token ask at the default 16, violating
+        # the module's 400-or-honor principle. Current name wins when both
+        # are present (matching OpenAI's own precedence).
+        if "max_completion_tokens" in req and req["max_completion_tokens"] is not None:
+            n_tokens = int(req["max_completion_tokens"])
+        else:
+            n_tokens = int(req.get("max_tokens", 16))
         if "seed" in req:
             seed = int(req["seed"])
         else:
@@ -142,9 +150,13 @@ def parse_sampling(req: dict, limit: int) -> tuple[int, dict]:
             "seed": seed,
         }
     except (TypeError, ValueError):
-        raise APIError(400, "max_tokens/temperature/top_k/top_p/seed must be numbers") from None
+        raise APIError(
+            400,
+            "max_tokens/max_completion_tokens/temperature/top_k/top_p/seed "
+            "must be numbers",
+        ) from None
     if not (1 <= n_tokens <= limit):
-        raise APIError(400, f"max_tokens must be in [1, {limit}]")
+        raise APIError(400, f"max_tokens/max_completion_tokens must be in [1, {limit}]")
     if not (0.0 <= samp["temperature"] <= 2.0):
         raise APIError(400, "temperature must be in [0, 2]")
     if not (0.0 < samp["top_p"] <= 1.0):
@@ -181,13 +193,22 @@ def apply_stop(text: str, stops: list[str]) -> tuple[str, str]:
     return text, "length"
 
 
-def encode_prompt(tok, server, text: str) -> list[int]:
+def encode_prompt(tok, server, text: str, n_tokens: int = 0) -> list[int]:
     ids = tok.encode(text)
     if not ids:
         raise APIError(400, "prompt tokenized to zero tokens")
     vocab = getattr(server.cfg, "vocab_size", 0) or 0
     if vocab and (min(ids) < 0 or max(ids) >= vocab):
         raise APIError(400, f"tokenizer produced ids outside the model vocab [0, {vocab})")
+    n_pos = getattr(server.cfg, "n_positions", 0) or 0
+    if n_pos and len(ids) + n_tokens > n_pos:
+        # absolute-position families (gpt2): decoding past n_positions
+        # silently clamps the wpe gather inside jit — 400 like /v1/generate
+        raise APIError(
+            400,
+            f"prompt ({len(ids)} tokens) + max_tokens ({n_tokens}) exceeds "
+            f"the model's {n_pos}-position context",
+        )
     return ids
 
 
@@ -216,7 +237,7 @@ def run_completion(sset, req: dict, chat: bool) -> dict:
     # routing policy lives in ONE place: continuous > speculation > batcher
     engine = sset.engine_for(server, len(prompts), samp["temperature"])
     server.stats["requests"] += 1
-    id_rows = [encode_prompt(tok, server, text) for text in prompts]
+    id_rows = [encode_prompt(tok, server, text, n_tokens) for text in prompts]
 
     def _one(ids: list[int]) -> list[int]:
         out = engine.generate(np.asarray([ids], np.int32), max_new_tokens=n_tokens, **samp)
@@ -268,7 +289,7 @@ def stream_completion(sset, req: dict, chat: bool) -> Iterator[dict]:
         raise APIError(400, "stream supports a single prompt")
     n_tokens, samp = parse_sampling(req, sset.max_new_tokens_limit)
     stops = parse_stop(req)
-    ids = encode_prompt(tok, server, prompts[0])
+    ids = encode_prompt(tok, server, prompts[0], n_tokens)
     if server.family.decode_fns is None:
         # fail before any SSE bytes hit the wire, not mid-stream
         raise APIError(400, f"model family {server.family.name!r} does not support streaming")

@@ -1056,6 +1056,15 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                 # garbage); this also catches a tokenizer.json whose vocab
                 # outgrew the checkpoint's embedding table
                 return self._json(400, {"error": f"token ids must be in [0, {vocab})"})
+            n_pos = getattr(server.cfg, "n_positions", 0) or 0
+            if n_pos and tokens.shape[1] > n_pos:
+                # absolute-position families (gpt2 wpe): the position gather
+                # would clamp inside jit past n_positions and return
+                # plausible garbage — same failure mode as the vocab check
+                return self._json(400, {
+                    "error": f"prompt length {tokens.shape[1]} exceeds the "
+                    f"model's {n_pos}-position context"
+                })
             server.stats["requests"] += 1
             try:
                 if verb == "forward":
@@ -1077,6 +1086,14 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                                 f"[1, {sset.max_new_tokens_limit}]"
                             },
                         )
+                    if n_pos and tokens.shape[1] + n > n_pos:
+                        # decode past n_positions would silently clamp the
+                        # wpe gather (ADVICE r3, gpt2.py:101)
+                        return self._json(400, {
+                            "error": f"prompt ({tokens.shape[1]}) + "
+                            f"max_new_tokens ({n}) exceeds the model's "
+                            f"{n_pos}-position context"
+                        })
                     try:
                         samp = {
                             "temperature": float(req.get("temperature", 0.0)),

@@ -38,9 +38,10 @@ class TestParse:
         np.testing.assert_array_equal(pairs["model.q.weight"]["B"], b)
 
     def test_rslora_scale(self, tmp_path):
-        """use_rslora scales by alpha/sqrt(r), not alpha/r."""
-        a = np.ones((4, 16), np.float32)
-        b = np.ones((8, 4), np.float32)
+        """use_rslora scales by alpha/sqrt(r), not alpha/r. (Pair rank must
+        match config r — mismatches are refused, TestPerModuleScaleRefusal.)"""
+        a = np.ones((64, 16), np.float32)
+        b = np.ones((8, 64), np.float32)
         _write_adapter(tmp_path / "ad", {"q.weight": (a, b)})
         (tmp_path / "ad" / "adapter_config.json").write_text(
             json.dumps({"lora_alpha": 16, "r": 64, "use_rslora": True})
@@ -169,3 +170,50 @@ class TestServeIntegration:
         qt = QTensor(jnp.zeros((3, 4), jnp.int8), jnp.ones((3,), jnp.float32))
         with pytest.raises(ValueError, match="quantize"):
             merge_adapter({"q.weight": qt}, str(tmp_path / "ad"))
+
+
+class TestPerModuleScaleRefusal:
+    """ADVICE r3: adapters with per-module ranks/alphas must refuse to
+    merge with a single global scale, not silently mis-scale targets."""
+
+    def test_rank_pattern_rejected(self, tmp_path):
+        a = np.ones((4, 16), np.float32)
+        b = np.ones((8, 4), np.float32)
+        _write_adapter(tmp_path / "ad", {"q.weight": (a, b)})
+        (tmp_path / "ad" / "adapter_config.json").write_text(
+            json.dumps({"lora_alpha": 8, "r": 4, "rank_pattern": {"q": 8}})
+        )
+        with pytest.raises(ValueError, match="rank_pattern"):
+            parse_adapter_dir(str(tmp_path / "ad"))
+
+    def test_alpha_pattern_rejected(self, tmp_path):
+        a = np.ones((4, 16), np.float32)
+        b = np.ones((8, 4), np.float32)
+        _write_adapter(tmp_path / "ad", {"q.weight": (a, b)})
+        (tmp_path / "ad" / "adapter_config.json").write_text(
+            json.dumps({"lora_alpha": 8, "r": 4, "alpha_pattern": {"q": 32}})
+        )
+        with pytest.raises(ValueError, match="alpha_pattern"):
+            parse_adapter_dir(str(tmp_path / "ad"))
+
+    def test_pair_rank_mismatch_rejected(self, tmp_path):
+        """Pairs whose actual rank differs from config r merge with the
+        wrong scale — refuse."""
+        a4 = np.ones((4, 16), np.float32)
+        b4 = np.ones((8, 4), np.float32)
+        a8 = np.ones((8, 16), np.float32)
+        b8 = np.ones((8, 8), np.float32)
+        _write_adapter(tmp_path / "ad", {"q.weight": (a4, b4), "k.weight": (a8, b8)},
+                       alpha=8, r=4)
+        with pytest.raises(ValueError, match="ranks differ"):
+            parse_adapter_dir(str(tmp_path / "ad"))
+
+    def test_empty_patterns_fine(self, tmp_path):
+        a = np.ones((4, 16), np.float32)
+        b = np.ones((8, 4), np.float32)
+        _write_adapter(tmp_path / "ad", {"q.weight": (a, b)})
+        (tmp_path / "ad" / "adapter_config.json").write_text(
+            json.dumps({"lora_alpha": 8, "r": 4, "rank_pattern": {}, "alpha_pattern": {}})
+        )
+        scale, _ = parse_adapter_dir(str(tmp_path / "ad"))
+        assert scale == 2.0

@@ -346,3 +346,55 @@ class TestHelpers:
         assert a["seed"] != b["seed"]  # 2^-31 collision odds
         _, c = parse_sampling({"seed": 7}, 1024)
         assert c["seed"] == 7
+
+
+class TestMaxCompletionTokens:
+    """ADVICE r3: max_completion_tokens (the current OpenAI chat param) is
+    an alias for max_tokens, preferred when both are present."""
+
+    def _sampling(self, req):
+        from modelx_tpu.dl.openai_api import parse_sampling
+
+        return parse_sampling(req, 64)
+
+    def test_alias_honored(self):
+        n, _ = self._sampling({"max_completion_tokens": 33})
+        assert n == 33
+
+    def test_current_name_wins_over_deprecated(self):
+        n, _ = self._sampling({"max_completion_tokens": 33, "max_tokens": 5})
+        assert n == 33
+
+    def test_null_falls_back(self):
+        n, _ = self._sampling({"max_completion_tokens": None, "max_tokens": 5})
+        assert n == 5
+
+    def test_non_numeric_400(self):
+        from modelx_tpu.dl.openai_api import APIError
+
+        with pytest.raises(APIError):
+            self._sampling({"max_completion_tokens": "many"})
+
+    def test_limit_applies(self):
+        from modelx_tpu.dl.openai_api import APIError
+
+        with pytest.raises(APIError, match="max_completion_tokens"):
+            self._sampling({"max_completion_tokens": 100000})
+
+
+class TestContextBound:
+    def test_encode_prompt_400s_past_n_positions(self):
+        """gpt2-style absolute-position models: prompt + max_tokens past
+        n_positions must 400 on the OpenAI path too (ADVICE r3)."""
+        from types import SimpleNamespace
+
+        from modelx_tpu.dl.openai_api import encode_prompt
+
+        class Tok:
+            def encode(self, text):
+                return list(range(1, 11))  # 10 tokens
+
+        server = SimpleNamespace(cfg=SimpleNamespace(vocab_size=100, n_positions=16))
+        assert encode_prompt(Tok(), server, "x", n_tokens=6)  # 10+6 = 16 fits
+        with pytest.raises(APIError, match="position context"):
+            encode_prompt(Tok(), server, "x", n_tokens=7)  # 17 > 16

@@ -622,3 +622,47 @@ class TestTextAPI:
         r = requests.post(base + "/v1/forward", json={"text": "hello"})
         assert r.status_code == 400
         assert "generate" in r.json()["error"]
+
+
+class TestGPT2PositionBound:
+    """ADVICE r3: decode past gpt2's n_positions silently clamps the wpe
+    gather inside jit; both the cache constructor and the serving layer
+    must refuse instead."""
+
+    def test_decode_entry_points_refuse_past_n_positions(self):
+        """The bound is on positions USED (prompt + max_new), not cache
+        capacity: bucketed paths deliberately over-allocate cache."""
+        import jax as _jax
+
+        from modelx_tpu.models import gpt2
+
+        cfg = gpt2.GPT2Config.tiny()  # n_positions=64
+        params = gpt2.init_params(cfg, _jax.random.PRNGKey(0))
+        prompt = np.ones((1, 60), np.int32)
+        with pytest.raises(ValueError, match="position context"):
+            gpt2.greedy_generate(params, prompt, cfg, max_new_tokens=5)
+        with pytest.raises(ValueError, match="position context"):
+            gpt2.ragged_greedy_generate(
+                params, prompt, np.asarray([60], np.int32), cfg, max_new_tokens=5
+            )
+        # over-allocated cache alone is fine (bucketing does this)
+        gpt2.init_kv_cache(cfg, 1, cfg.n_positions + 8)
+
+    def test_serving_400s_past_context(self, checkpoints):
+        server = ModelServer(checkpoints["gpt2"], mesh_spec="dp=1", dtype="float32", name="g")
+        sset = ServerSet({"g": server})
+        base = f"http://127.0.0.1:{free_port()}"
+        httpd = serve(sset, listen=base.rsplit("//", 1)[1])
+        try:
+            sset.load_all()
+            n_pos = server.cfg.n_positions
+            r = requests.post(base + "/v1/generate", json={
+                "tokens": [[1] * 10], "max_new_tokens": n_pos})
+            assert r.status_code == 400 and "context" in r.json()["error"]
+            r = requests.post(base + "/v1/forward", json={"tokens": [[1] * (n_pos + 1)]})
+            assert r.status_code == 400 and "context" in r.json()["error"]
+            r = requests.post(base + "/v1/generate", json={
+                "tokens": [[1, 2, 3]], "max_new_tokens": 4})
+            assert r.status_code == 200
+        finally:
+            httpd.shutdown()
