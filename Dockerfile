@@ -1,9 +1,15 @@
 # Registry daemon image (reference parity: Dockerfile — scratch+binary there,
-# slim python + wheel here).
+# slim python + wheel here). Multi-arch: the native IO engine compiles in the
+# build stage for the image's own architecture via the ONE build recipe
+# (native.build), and ships inside the wheel via package-data — no prebuilt
+# single-ABI blob, no toolchain in the runtime image.
 FROM python:3.12-slim AS build
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
 WORKDIR /src
 COPY . .
-RUN pip install --no-cache-dir build && python -m build --wheel
+RUN python -c "from modelx_tpu import native; assert native.build(force=True)" \
+    && pip install --no-cache-dir build && python -m build --wheel
 
 FROM python:3.12-slim
 RUN pip install --no-cache-dir requests click rich pyyaml cryptography

@@ -37,12 +37,19 @@ logger = logging.getLogger("modelx")
 
 @click.group(name="modelx")
 @click.option("--debug", is_flag=True, envvar="DEBUG", help="verbose logging (model.go:32-35)")
-def main(debug: bool) -> None:
+@click.option("--insecure", is_flag=True,
+              help="skip TLS certificate verification (self-signed "
+                   "registries; modelx.go:29-36)")
+def main(debug: bool, insecure: bool) -> None:
     """modelx — TPU-native model registry CLI."""
     logging.basicConfig(
         level=logging.DEBUG if debug else logging.WARNING,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    if insecure:
+        from modelx_tpu.client.remote import set_insecure
+
+        set_insecure(True)
 
 
 def _fail(e: BaseException) -> None:

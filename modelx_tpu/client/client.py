@@ -9,8 +9,19 @@ from modelx_tpu.types import Index, Manifest
 
 
 class Client:
-    def __init__(self, registry: str, authorization: str = "", quiet: bool = False):
-        self.remote = RegistryClient(registry, authorization)
+    def __init__(self, registry: str, authorization: str = "", quiet: bool = False,
+                 insecure: bool | None = None):
+        """``insecure=True`` disables TLS verification PROCESS-WIDE
+        (remote.set_insecure) — the reference's semantics, where --insecure
+        flips the default transport (modelx.go:29-36). Process-wide because
+        push/pull data-plane transfers (presigned/location URLs) go through
+        shared transports a per-client toggle cannot reach; a half-insecure
+        client that pings but fails mid-pull would be worse."""
+        if insecure:
+            from modelx_tpu.client.remote import set_insecure
+
+            set_insecure(True)
+        self.remote = RegistryClient(registry, authorization, insecure=insecure)
         self.quiet = quiet
 
     def ping(self) -> Index:

@@ -61,6 +61,15 @@ _no_redirect = requests.Session()
 _no_redirect.max_redirects = 0
 
 
+def _tls_kwargs() -> dict:
+    """Per-request verify=False when the process-wide --insecure flag is
+    set (session-level verify loses to a REQUESTS_CA_BUNDLE env var in
+    requests' settings merge)."""
+    from modelx_tpu.client.remote import insecure_default
+
+    return {"verify": False} if insecure_default() else {}
+
+
 def http_download(
     url: str,
     writer: BinaryIO,
@@ -69,7 +78,7 @@ def http_download(
     chunk_size: int = 1024 * 1024,
 ) -> int:
     """extension_http.go:11-29 — stream a (presigned) GET into writer."""
-    with _no_redirect.get(url, headers=headers or {}, stream=True, allow_redirects=False) as r:
+    with _no_redirect.get(url, headers=headers or {}, stream=True, allow_redirects=False, **_tls_kwargs()) as r:
         if r.status_code >= 400:
             raise errors.ErrorInfo.decode(r.content, r.status_code)
         n = 0
@@ -104,7 +113,7 @@ def http_upload(
                 data.seek(0)  # GetBody-style rewind for retry (extension_http.go:50)
             sent = 0
             body = data
-            r = _no_redirect.request(method, url, data=body, headers=headers or {}, allow_redirects=False)
+            r = _no_redirect.request(method, url, data=body, headers=headers or {}, allow_redirects=False, **_tls_kwargs())
             if r.status_code >= 400:
                 raise errors.ErrorInfo.decode(r.content, r.status_code)
             if progress:

@@ -17,16 +17,44 @@ from modelx_tpu import errors
 from modelx_tpu.types import BlobLocation, Descriptor, Index, Manifest
 
 
+_INSECURE = False  # process-wide default, set by the CLI root --insecure
+
+
+def set_insecure(insecure: bool = True) -> None:
+    """Skip TLS certificate verification for every client transport —
+    reference parity with the CLI's ``--insecure`` wiring
+    InsecureSkipVerify into the default transport
+    (cmd/modelx/modelx.go:29-36). Covers RegistryClient sessions created
+    after the call, the extension data-plane session (presigned
+    transfers), and the loader's ranged HTTPS sources."""
+    global _INSECURE
+    _INSECURE = insecure
+    if insecure:
+        import urllib3
+
+        # the operator explicitly asked; one warning per request is noise
+        urllib3.disable_warnings(urllib3.exceptions.InsecureRequestWarning)
+
+
+def insecure_default() -> bool:
+    return _INSECURE
+
+
 class RegistryClient:
     # (connect, read) defaults: generous read for blob streams, bounded
     # connect so unreachable hosts fail instead of hanging
     DEFAULT_TIMEOUT = (10, 300)
 
-    def __init__(self, registry: str, authorization: str = "", timeout=None) -> None:
+    def __init__(self, registry: str, authorization: str = "", timeout=None,
+                 insecure: bool | None = None) -> None:
         self.registry = registry.rstrip("/")
         self.authorization = authorization
         self.timeout = timeout or self.DEFAULT_TIMEOUT
         self.session = requests.Session()
+        # None = follow the process-wide flag at request time. NB verify
+        # must be passed PER REQUEST: a session-level verify=False loses to
+        # a REQUESTS_CA_BUNDLE env var in requests' settings merge.
+        self._insecure = insecure
 
     # -- plumbing -------------------------------------------------------------
 
@@ -49,10 +77,13 @@ class RegistryClient:
     ) -> requests.Response:
         """registry.go:146-191 — raise typed ErrorInfo from error bodies."""
         url = self.registry + path
+        kwargs = {}
+        if self._insecure if self._insecure is not None else _INSECURE:
+            kwargs["verify"] = False
         try:
             resp = self.session.request(
                 method, url, params=params, data=data, headers=self._headers(headers),
-                stream=stream, timeout=self.timeout,
+                stream=stream, timeout=self.timeout, **kwargs,
             )
         except requests.RequestException as e:
             raise errors.ErrorInfo(502, errors.ErrCodeUnknown, f"request failed: {e}") from e

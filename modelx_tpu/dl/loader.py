@@ -183,8 +183,19 @@ class HTTPSource:
 
         conn = getattr(self._local, "conn", None)
         if conn is None:
-            cls = http.client.HTTPSConnection if self._scheme == "https" else http.client.HTTPConnection
-            conn = cls(self._host, self._port, timeout=300)
+            if self._scheme == "https":
+                kwargs = {}
+                from modelx_tpu.client.remote import insecure_default
+
+                if insecure_default():  # CLI --insecure covers ranged loads too
+                    import ssl
+
+                    kwargs["context"] = ssl._create_unverified_context()
+                conn = http.client.HTTPSConnection(
+                    self._host, self._port, timeout=300, **kwargs
+                )
+            else:
+                conn = http.client.HTTPConnection(self._host, self._port, timeout=300)
             self._local.conn = conn
         return conn
 

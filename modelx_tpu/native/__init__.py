@@ -55,11 +55,17 @@ def build(force: bool = False) -> str | None:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _SO)
     except (OSError, subprocess.SubprocessError) as e:
-        logger.debug("native build unavailable: %s", e)
         try:
             os.unlink(tmp)
         except OSError:
             pass
+        if os.path.exists(_SO):
+            # a container image bakes the arch-correct .so but ships no
+            # toolchain, and install mtimes can make the source look newer
+            # — an existing library beats the pure-Python fallback
+            logger.debug("native rebuild unavailable (%s); using existing .so", e)
+            return _SO
+        logger.debug("native build unavailable: %s", e)
         return None
     return _SO
 

@@ -523,7 +523,8 @@ class RegistryServer:
         host, port = self.httpd.server_address[:2]
         if isinstance(host, bytes):
             host = host.decode()
-        return f"http://{host if host != '0.0.0.0' else '127.0.0.1'}:{port}"
+        scheme = "https" if (self.opts.tls_cert and self.opts.tls_key) else "http"
+        return f"{scheme}://{host if host != '0.0.0.0' else '127.0.0.1'}:{port}"
 
     def serve_background(self) -> str:
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)

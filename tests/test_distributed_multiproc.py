@@ -1,0 +1,68 @@
+"""Two-process jax.distributed smoke test (VERDICT r3 item 9): the
+coordinator handshake in parallel/distributed.initialize actually EXECUTES
+— two CPU-backend processes form one runtime, see each other's devices,
+and agree on a psum across process boundaries. The mocked unit tests in
+test_distributed.py cover env parsing; this covers the wire."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from modelx_tpu.parallel.distributed import host_local_slice, initialize
+
+initialize()  # MODELX_* env vars carry coordinator/count/id
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 2 * jax.local_device_count()
+
+# a real cross-process collective: psum of each process's id over all devices
+import jax.numpy as jnp
+from jax.experimental import multihost_utils
+
+val = multihost_utils.process_allgather(jnp.int32(jax.process_index()))
+assert sorted(val.tolist()) == [0, 1], val
+
+# host-local planning helper splits work across the two processes
+start, stop = host_local_slice(10)
+expected = (0, 5) if jax.process_index() == 0 else (5, 10)
+assert (start, stop) == expected, (start, stop)
+print(f"proc {jax.process_index()} OK", flush=True)
+"""
+
+
+@pytest.mark.skipif(os.environ.get("MODELX_SKIP_MULTIPROC") == "1",
+                    reason="multiprocess smoke disabled")
+def test_two_process_initialize_and_collective(tmp_path):
+    from modelx_tpu.registry.server import free_port
+
+    port = free_port()
+    procs = []
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for pid in range(2):
+        env = dict(os.environ,
+                   PYTHONPATH=here,
+                   JAX_PLATFORMS="cpu",
+                   MODELX_COORDINATOR=f"127.0.0.1:{port}",
+                   MODELX_NUM_PROCESSES="2",
+                   MODELX_PROCESS_ID=str(pid))
+        # each process presents ONE cpu device (no virtual 8-mesh): the
+        # assertion device_count == 2*local proves cross-process fusion
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        ))
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=240)
+        outs.append(out)
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-3000:]}"
+        assert f"proc {i} OK" in out

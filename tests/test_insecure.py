@@ -1,0 +1,90 @@
+"""Client --insecure TLS toggle (reference parity: the CLI wires
+InsecureSkipVerify into the default transport, cmd/modelx/modelx.go:29-36).
+A self-signed TLS registry must reject a default client and accept an
+--insecure one, end to end through push/pull."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from modelx_tpu import errors
+from modelx_tpu.client.client import Client
+from modelx_tpu.client.remote import insecure_default, set_insecure
+from modelx_tpu.registry.fs import MemoryFSProvider
+from modelx_tpu.registry.server import Options, RegistryServer, free_port
+from modelx_tpu.registry.store_fs import FSRegistryStore
+
+
+@pytest.fixture(scope="module")
+def tls_registry(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tls")
+    cert, key = str(d / "cert.pem"), str(d / "key.pem")
+    p = subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", cert, "-days", "1",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        capture_output=True, text=True,
+    )
+    if p.returncode != 0:
+        pytest.skip(f"openssl unavailable: {p.stderr[:200]}")
+    srv = RegistryServer(
+        Options(listen=f"127.0.0.1:{free_port()}", tls_cert=cert, tls_key=key),
+        store=FSRegistryStore(MemoryFSProvider()),
+    )
+    base = srv.serve_background()
+    assert base.startswith("https://")
+    yield base
+    srv.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_flag():
+    yield
+    set_insecure(False)
+
+
+class TestInsecure:
+    def test_default_client_rejects_self_signed(self, tls_registry):
+        with pytest.raises(errors.ErrorInfo, match="request failed"):
+            Client(tls_registry, quiet=True).ping()
+
+    def test_insecure_round_trips(self, tls_registry, tmp_path):
+        """push/pull end-to-end: Client(insecure=True) disables verification
+        process-wide (the reference's default-transport semantics), which
+        the data-plane location/presigned transfers require."""
+        src = tmp_path / "model"
+        src.mkdir()
+        (src / "weights.bin").write_bytes(np.arange(64, dtype=np.int32).tobytes())
+        client = Client(tls_registry, quiet=True, insecure=True)
+        assert insecure_default()  # kwarg sets the process-wide flag
+        client.push("library/tls", "v1", str(src))
+        dest = tmp_path / "out"
+        client.pull("library/tls", "v1", str(dest))
+        assert (dest / "weights.bin").read_bytes() == (src / "weights.bin").read_bytes()
+
+    def test_global_flag_applies_to_new_clients(self, tls_registry):
+        assert not insecure_default()
+        set_insecure(True)
+        assert insecure_default()
+        Client(tls_registry, quiet=True).ping()  # no per-client kwarg needed
+
+    def test_cli_root_flag_wires_global(self, tls_registry, tmp_path):
+        """modelx --insecure list <registry> against the TLS server."""
+        import sys
+
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {"PATH": "/usr/bin:/bin", "PYTHONPATH": here,
+               "HOME": str(tmp_path), "JAX_PLATFORMS": "cpu"}
+        fail = subprocess.run(
+            [sys.executable, "-m", "modelx_tpu.cli", "list", tls_registry],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert fail.returncode != 0
+        ok = subprocess.run(
+            [sys.executable, "-m", "modelx_tpu.cli", "--insecure", "list", tls_registry],
+            capture_output=True, text=True, env=env, timeout=120,
+        )
+        assert ok.returncode == 0, ok.stderr[-500:]
