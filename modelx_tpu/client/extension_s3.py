@@ -20,7 +20,7 @@ from typing import BinaryIO, Callable
 import requests
 
 from modelx_tpu import errors
-from modelx_tpu.client.extension import http_upload, register_extension
+from modelx_tpu.client.extension import _tls_kwargs, http_upload, register_extension
 from modelx_tpu.types import BlobLocation, Descriptor
 
 # extension_s3.go:17-20 fixes these at 3; larger keeps the pipe full on
@@ -122,7 +122,7 @@ class S3Extension:
                     # whole blob, which must not be read into RAM here
                     with requests.get(
                         url, headers={"Range": f"bytes={off}-{off + ln - 1}"},
-                        timeout=300, stream=True,
+                        timeout=300, stream=True, **_tls_kwargs(),
                     ) as r:
                         if r.status_code == 200:
                             range_ignored.set()
@@ -164,7 +164,7 @@ def _is_seekable(writer) -> bool:
 
 
 def _stream_get(url: str, writer, progress) -> None:
-    with requests.get(url, stream=True, timeout=300) as r:
+    with requests.get(url, stream=True, timeout=300, **_tls_kwargs()) as r:
         if r.status_code >= 400:
             raise errors.ErrorInfo.decode(r.content, r.status_code)
         for chunk in r.iter_content(chunk_size=1024 * 1024):

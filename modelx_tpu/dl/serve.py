@@ -116,11 +116,20 @@ class ModelServer:
         quantize: str | None = None,
         speculative_k: int = 0,
         lora_dir: str = "",
+        prefix_cache_size: int = 0,
     ) -> None:
         self.name = name
         self.model_dir = model_dir
         self.quantize = quantize
         self.lora_dir = lora_dir
+        # > 0 keeps the prefill KV of the last N single-row stream prompts
+        # on device (models/decode.PrefixKVCache): multi-turn chats that
+        # re-send their history prefill only the new suffix
+        self._prefix_cache = None
+        if int(prefix_cache_size) > 0:
+            from modelx_tpu.models.decode import PrefixKVCache
+
+            self._prefix_cache = PrefixKVCache(int(prefix_cache_size))
         # > 0 turns on prompt-lookup speculative decoding for single-row
         # greedy requests (models/speculative.py): token-exact, fewer
         # device steps on self-repeating continuations
@@ -387,7 +396,9 @@ class ModelServer:
                     from modelx_tpu.models.decode import ChunkedDecoder
 
                     fwd, init = self.family.decode_fns(self.cfg, mesh=self.mesh)
-                    dec = self._decoders[chunk_size] = ChunkedDecoder(fwd, init, chunk_size)
+                    dec = self._decoders[chunk_size] = ChunkedDecoder(
+                        fwd, init, chunk_size, prefix_cache=self._prefix_cache
+                    )
         from modelx_tpu.models.decode import pad_seq_len
 
         b, s = tokens_arr.shape
@@ -957,6 +968,8 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                     cb = sset.cbatchers.get(n)
                     if cb is not None:
                         d["continuous"] = dict(cb.stats)
+                    if s._prefix_cache is not None:
+                        d["prefix_cache"] = s._prefix_cache.stats()
                     payload[n] = d
                 self._json(200, payload)
             elif self.path == "/v1/models":

@@ -47,6 +47,10 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
 @click.option("--stream-chunk-size", default=8, type=int,
               help="tokens decoded per flush on streaming responses (also "
                    "the continuous engine's chunk length)")
+@click.option("--prefix-cache", default=0, type=int,
+              help="keep the prefill KV of the last N single-row stream "
+                   "prompts on device: multi-turn chats that re-send their "
+                   "history prefill only the new suffix (0 = off)")
 @click.option("--quantize", type=click.Choice(["int8"]), default=None,
               help="weight-only int8: half the HBM/transfer bytes for the big matmuls")
 @click.option("--speculative-k", default=0, type=int,
@@ -63,7 +67,7 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
          max_seq_len: int, compile_cache: bool, concurrent_load: bool, trace_dir: str,
          dynamic_batch: bool, continuous_batch: bool, max_slots: int,
          max_batch: int, batch_window_ms: float, stream_chunk_size: int,
-         quantize: str | None, speculative_k: int,
+         prefix_cache: int, quantize: str | None, speculative_k: int,
          loras: tuple[str, ...], drain_seconds: float) -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     from modelx_tpu.parallel.distributed import initialize
@@ -107,12 +111,20 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
         name: ModelServer(path, dtype=dtype, max_seq_len=max_seq_len,
                           name=name, mesh=shared_mesh, quantize=quantize,
                           speculative_k=speculative_k,
-                          lora_dir=lora_dirs.get(name, ""))
+                          lora_dir=lora_dirs.get(name, ""),
+                          prefix_cache_size=prefix_cache)
         for name, path in entries.items()
     }
     if continuous_batch and speculative_k:
         logging.getLogger("modelx.serve").warning(
             "--continuous-batch supersedes --speculative-k for generate traffic"
+        )
+    if prefix_cache and (continuous_batch or speculative_k):
+        # both alternatives own single-row streams before the ChunkedDecoder
+        # (the prefix cache's seam) is ever consulted
+        logging.getLogger("modelx.serve").warning(
+            "--prefix-cache is inert under --continuous-batch/--speculative-k "
+            "(those engines handle the streams it would accelerate)"
         )
     sset = ServerSet(servers, trace_dir=trace_dir, dynamic_batch=dynamic_batch,
                      continuous_batch=continuous_batch, max_slots=max_slots,
