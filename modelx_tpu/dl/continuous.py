@@ -52,9 +52,10 @@ _DONE = object()  # end-of-stream sentinel on per-request output queues
 class _Row:
     """One admitted request row bound to a slot."""
 
-    __slots__ = ("slot", "budget", "emitted", "out", "skip")
+    __slots__ = ("slot", "budget", "emitted", "out", "skip", "stops", "closed")
 
-    def __init__(self, slot: int, budget: int, out: "queue.Queue") -> None:
+    def __init__(self, slot: int, budget: int, out: "queue.Queue",
+                 stops: frozenset = frozenset()) -> None:
         self.slot = slot
         self.budget = budget
         self.emitted = 0
@@ -63,6 +64,10 @@ class _Row:
         # admitted row's first chunk re-emits the prefill token the
         # admission already delivered — skip it once
         self.skip = 1
+        self.stops = stops  # stop token ids; hit = end the row early
+        # set by delivery on a stop hit (value-dependent, so it lags the
+        # value-independent plan by <= 1 chunk); plan retires closed rows
+        self.closed = False
 
 
 class ContinuousBatcher:
@@ -172,6 +177,7 @@ class ContinuousBatcher:
 
     def _admit(self, item) -> None:
         ids, n, samp, out = item
+        stops = frozenset(samp.get("stop_token_ids") or ())
         slot = self._free.pop()
         s = len(ids)
         pad_s = pad_seq_len(s)
@@ -195,7 +201,7 @@ class ContinuousBatcher:
         self._top_p[slot] = p_val
         self._seeds[slot] = seed[0]
         self._use_filters[slot] = filters
-        row = _Row(slot, n, out)
+        row = _Row(slot, n, out, stops=stops)
         # the prefill's first token is delivered ASYNC (with the next
         # delivery batch): syncing here would serialize a full dispatch
         # round-trip per admission, where dispatching N prefills
@@ -258,8 +264,12 @@ class ContinuousBatcher:
         them), so N admissions pay one round-trip, not N."""
         firsts, self._first_pending = self._first_pending, []
         for row, first, done in firsts:
-            row.out.put(np.asarray(first).reshape(1, 1))
-            if done:
+            first_np = np.asarray(first).reshape(1, 1)
+            row.out.put(first_np)
+            if row.stops and int(first_np[0, 0]) in row.stops and not done:
+                row.out.put(_DONE)
+                row.closed = True  # plan retires the slot next dispatch
+            elif done:
                 row.out.put(_DONE)
 
     @staticmethod
@@ -270,19 +280,46 @@ class ContinuousBatcher:
         toks_dev, plan = pending
         toks = np.asarray(toks_dev)
         for slot, row, skip, take, done in plan:
-            if take > 0:
-                row.out.put(toks[slot : slot + 1, skip : skip + take])
+            if row.closed:
+                continue  # stop token already ended the row (and its queue)
+            piece = toks[slot : slot + 1, skip : skip + take] if take > 0 else None
+            if piece is not None and row.stops:
+                from modelx_tpu.models.decode import stop_cut
+
+                cut = stop_cut(piece[0].tolist(), row.stops)
+                if cut is not None:
+                    row.out.put(piece[:, :cut])  # include the stop
+                    row.out.put(_DONE)
+                    row.closed = True
+                    continue
+            if piece is not None:
+                row.out.put(piece)
             if done:
                 row.out.put(_DONE)
+
+    def _sweep_closed(self) -> None:
+        """Free the slots of rows a stop token ended at delivery time —
+        BEFORE admission and the next dispatch, so a waiting request takes
+        the slot immediately and no dead-row chunk is dispatched."""
+        for slot, row in list(self._rows.items()):
+            if row.closed:
+                del self._rows[slot]
+                self._free.append(slot)
+                self._offsets[slot] = 0
 
     def _loop(self) -> None:
         pending: tuple | None = None  # depth-1 pipeline: one chunk in flight
         try:
             while True:
+                self._sweep_closed()
                 # admit everything waiting (up to free slots); block only
-                # when fully idle with nothing in flight
+                # when fully idle with nothing in flight AND no admitted
+                # row still owed its (async) first token — a lone budget-1
+                # request admits, frees its slot, and would otherwise hang
+                # its waiter by blocking here before _deliver_firsts runs
                 while True:
-                    block = not self._rows and pending is None
+                    block = (not self._rows and pending is None
+                             and not self._first_pending)
                     try:
                         item = self._q.get(block=block)
                     except queue.Empty:
@@ -374,41 +411,60 @@ class ContinuousBatcher:
 
     def generate(self, tokens: np.ndarray, max_new_tokens: int = 16,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
-                 seed: int = 0) -> np.ndarray:
-        """[B, S + max_new_tokens], matching ModelServer.generate: rows of a
-        multi-row request become independent slots with seeds seed+i (the
-        same per-row streams the ragged path derives)."""
+                 seed: int = 0, stop_token_ids=None) -> np.ndarray:
+        """[B, S + m], matching ModelServer.generate: rows of a multi-row
+        request become independent slots with seeds seed+i (the same
+        per-row streams the ragged path derives). With ``stop_token_ids``,
+        every row's SLOT frees at its stop (concurrent requests stop
+        starving behind rows that already finished); m is the longest
+        row's emitted length, shorter rows padded by repeating their stop
+        token — the serving layer's inclusive-trim cuts at the FIRST stop,
+        so padding is invisible in responses."""
         tokens = np.asarray(tokens, np.int32)
         b, s = tokens.shape
+        stops = list(stop_token_ids or ())
         outs = [
             self.submit_row(
                 tokens[i].tolist(), max_new_tokens,
                 {"temperature": temperature, "top_k": top_k, "top_p": top_p,
-                 "seed": (seed + i) % (2**31)},
+                 "seed": (seed + i) % (2**31), "stop_token_ids": stops},
             )
             for i in range(b)
         ]
         rows = []
+        emitted = 0
         for out in outs:
             pieces = list(self._drain_row(out))
-            rows.append(np.concatenate(pieces, axis=1))
+            row = np.concatenate(pieces, axis=1)
+            emitted += int(row.size)
+            rows.append(row)
+        width = max(r.shape[1] for r in rows)
+        rows = [
+            r if r.shape[1] == width else np.pad(
+                r, ((0, 0), (0, width - r.shape[1])), constant_values=int(r[0, -1])
+            )
+            for r in rows
+        ]
         gen = np.concatenate(rows, axis=0)
-        self.server.stats["tokens_generated"] += int(gen.size)
+        self.server.stats["tokens_generated"] += emitted
         return np.concatenate([tokens, gen], axis=1)
 
     def stream(self, tokens: np.ndarray, max_new_tokens: int = 16,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
-               seed: int = 0, chunk_size: int = 0) -> Iterator[np.ndarray]:
+               seed: int = 0, chunk_size: int = 0,
+               stop_token_ids=None) -> Iterator[np.ndarray]:
         """Single-row streaming: yields [1, k] arrays of new tokens as the
         engine decodes them (k == 1 for the prefill token, then up to the
         ENGINE's chunk size — the per-request chunk_size arg is accepted for
-        interface parity and ignored)."""
+        interface parity and ignored). A stop-token hit ends the stream
+        early and frees the slot."""
         tokens = np.asarray(tokens, np.int32)
         if tokens.shape[0] != 1:
             raise ValueError("continuous stream is single-row")
         out = self.submit_row(
             tokens[0].tolist(), max_new_tokens,
-            {"temperature": temperature, "top_k": top_k, "top_p": top_p, "seed": seed},
+            {"temperature": temperature, "top_k": top_k, "top_p": top_p,
+             "seed": seed, "stop_token_ids": list(stop_token_ids or ())},
         )
         for piece in self._drain_row(out):
             self.server.stats["tokens_generated"] += int(piece.size)

@@ -368,6 +368,7 @@ class ModelServer:
         top_p: float = 1.0,
         seed: int = 0,
         chunk_size: int = 8,
+        stop_token_ids=None,
     ):
         """Yields [B, k] arrays of new tokens as they decode — the transport
         behind streaming /v1/generate. On the plain path k <= chunk_size;
@@ -386,6 +387,7 @@ class ModelServer:
             yield from self._generate_stream_speculative(
                 tokens_arr, max_new_tokens, temperature=temperature,
                 top_k=top_k, top_p=top_p, seed=seed,
+                stop_token_ids=stop_token_ids,
             )
             return
         dec = self._decoders.get(chunk_size)
@@ -414,6 +416,7 @@ class ModelServer:
                 top_k=np.full((b,), top_k, np.int32),
                 top_p=np.full((b,), top_p, np.float32),
                 seeds=((seed + np.arange(b)) % (2**31)).astype(np.int32),
+                stop_token_ids=stop_token_ids,
             ):
                 # account as chunks leave: a client disconnect must not
                 # erase the decode work the device already did
@@ -430,9 +433,11 @@ class ModelServer:
 
     def _generate_stream_speculative(self, tokens: np.ndarray, max_new_tokens: int,
                                      temperature: float = 0.0, top_k: int = 0,
-                                     top_p: float = 1.0, seed: int = 0):
+                                     top_p: float = 1.0, seed: int = 0,
+                                     stop_token_ids=None):
         dec = self._speculative_decoder()
         stats = {"device_steps": 0, "proposed": 0, "accepted": 0}
+        stops = set(stop_token_ids or ())
         try:
             with trace.span("serve.generate_stream_spec", model=self.name,
                             new_tokens=max_new_tokens):
@@ -440,6 +445,15 @@ class ModelServer:
                                         max_new_tokens, stats=stats,
                                         temperature=temperature, top_k=top_k,
                                         top_p=top_p, seed=seed):
+                    if stops:
+                        from modelx_tpu.models.decode import stop_cut
+
+                        cut = stop_cut(piece[0].tolist(), stops)
+                        if cut is not None:  # emit through the stop, then end
+                            piece = piece[:, :cut]
+                            self.stats["tokens_generated"] += int(piece.size)
+                            yield piece
+                            return
                     self.stats["tokens_generated"] += int(piece.size)
                     yield piece
         finally:
@@ -790,15 +804,19 @@ class ServerSet:
             return batcher
         return server
 
-    def stream_source(self, server: ModelServer, tokens, n: int, samp: dict):
+    def stream_source(self, server: ModelServer, tokens, n: int, samp: dict,
+                      stop_token_ids=None):
         """Streaming analogue of engine_for: a token-chunk iterator.
         Single-row streams join the continuous engine when enabled; all
-        paths honor the operator's --stream-chunk-size."""
+        paths honor the operator's --stream-chunk-size and end early on a
+        stop-token hit."""
         cb = self.continuous_for(server)
         if cb is not None and tokens.shape[0] == 1:
-            return cb.stream(tokens, max_new_tokens=n, **samp)
+            return cb.stream(tokens, max_new_tokens=n,
+                             stop_token_ids=stop_token_ids, **samp)
         return server.generate_stream(
-            tokens, max_new_tokens=n, chunk_size=self.stream_chunk_size, **samp
+            tokens, max_new_tokens=n, chunk_size=self.stream_chunk_size,
+            stop_token_ids=stop_token_ids, **samp
         )
 
     @property
@@ -890,13 +908,13 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                 except OSError:
                     pass
 
-        def _stream_generate(self, server, tokens, n, samp) -> None:
+        def _stream_generate(self, server, tokens, n, samp, stop_ids=None) -> None:
             """One NDJSON line of NEW tokens per decoded chunk, then
             {"done": true}; concatenates to the non-streaming result.
             Single-row streams ride the continuous engine when enabled, so
             N concurrent SSE clients share one running decode instead of
             contending with N independent loops."""
-            gen = sset.stream_source(server, tokens, n, samp)
+            gen = sset.stream_source(server, tokens, n, samp, stop_token_ids=stop_ids)
             try:
                 # pull the first chunk BEFORE committing a 200: an
                 # unsupported family / bad request must still be a 4xx
@@ -1130,15 +1148,59 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                             "error": "temperature in [0,100], top_k/seed in "
                             "[0, 2^31), top_p in (0,1] required"
                         })
+                    stop_ids = req.get("stop_token_ids")
+                    if stop_ids is not None:
+                        if (
+                            not isinstance(stop_ids, list)
+                            or len(stop_ids) > 16
+                            or not all(isinstance(t, int) and not isinstance(t, bool)
+                                       and 0 <= t < (vocab or 2**31) for t in stop_ids)
+                        ):
+                            return self._json(400, {
+                                "error": "stop_token_ids must be a list of up "
+                                "to 16 in-vocab token ids"
+                            })
                     if bool(req.get("stream", False)):
-                        return self._stream_generate(server, tokens, n, samp)
+                        if stop_ids and tokens.shape[0] > 1:
+                            # per-row early stop breaks the [B, k]-aligned
+                            # stream contract; refuse rather than silently
+                            # return untrimmed rows
+                            return self._json(400, {
+                                "error": "stop_token_ids with stream is "
+                                "single-row only"
+                            })
+                        return self._stream_generate(server, tokens, n, samp, stop_ids)
                     engine = sset.engine_for(
                         server, tokens.shape[0], samp["temperature"]
                     )
-                    out = engine.generate(tokens, max_new_tokens=n, **samp)
-                    resp = {"tokens": out.tolist()}
+                    if engine is sset.cbatchers.get(server.name):
+                        # the continuous engine honors stops server-side:
+                        # every row's slot frees at its stop token (short
+                        # rows come back padded with the stop; the trim
+                        # below cuts at the FIRST stop either way)
+                        out = engine.generate(tokens, max_new_tokens=n,
+                                              stop_token_ids=stop_ids, **samp)
+                    else:
+                        out = engine.generate(tokens, max_new_tokens=n, **samp)
+                    rows = out.tolist()
+                    if stop_ids:
+                        # trim each row's GENERATED portion at the first stop
+                        # token (inclusive) — response rows may be ragged
+                        from modelx_tpu.models.decode import stop_cut
+
+                        stops = set(stop_ids)
+                        plen = tokens.shape[1]
+                        trimmed = []
+                        for row in rows:
+                            gen_part = row[plen:]
+                            cut = stop_cut(gen_part, stops)
+                            if cut is not None:
+                                gen_part = gen_part[:cut]
+                            trimmed.append(row[:plen] + gen_part)
+                        rows = trimmed
+                    resp = {"tokens": rows}
                     if tok is not None:  # text request: decode the new tokens
-                        resp["text"] = tok.decode(out[0, tokens.shape[1]:].tolist())
+                        resp["text"] = tok.decode(rows[0][tokens.shape[1]:])
                     self._json(200, resp)
             except ValueError as e:  # e.g. generate on a non-generative family
                 self._json(400, {"error": str(e)})

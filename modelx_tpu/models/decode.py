@@ -18,6 +18,19 @@ def pad_seq_len(s: int) -> int:
     return -(-s // SEQ_BUCKET) * SEQ_BUCKET
 
 
+def stop_cut(tokens, stops) -> int | None:
+    """Index AFTER the first stop token in ``tokens`` (i.e. the inclusive
+    trim length), or None when no stop matches. THE stop_token_ids
+    contract, shared by every decode path (plain/speculative/continuous
+    streams and the response trimmer) so the inclusive bound can't drift."""
+    if not stops:
+        return None
+    for i, t in enumerate(tokens):
+        if t in stops:
+            return i + 1
+    return None
+
+
 def greedy_generate(
     forward,  # (params, tokens, kv_cache=, cache_offset=, mesh=) -> (logits, cache)
     init_kv_cache,  # (batch, max_len) -> cache
@@ -269,9 +282,13 @@ class ChunkedDecoder:
         return cache, tok, toks.T  # emitted [B, chunk_size]
 
     def stream(self, params, prompt, row_lens, max_new_tokens: int,
-               temperature=None, top_k=None, top_p=None, seeds=None):
+               temperature=None, top_k=None, top_p=None, seeds=None,
+               stop_token_ids=None):
         """Yields [B, k] arrays of new tokens (k <= chunk_size), totalling
-        exactly max_new_tokens per row."""
+        exactly max_new_tokens per row — or FEWER when ``stop_token_ids``
+        (single-row streams only) matches: the stream emits up to and
+        including the stop token, then ends, skipping the remaining
+        chunks' device work entirely."""
         b, s = prompt.shape
         if max_new_tokens <= 0:
             return
@@ -331,6 +348,11 @@ class ChunkedDecoder:
             # store THIS prompt's KV (trimmed copy) — the next turn's prompt
             # extends it, so multi-turn chats keep hitting as they grow
             self.prefix_cache.put(ids, self._trim(cache, pad_seq_len(len(ids))))
+        # no first-token stop check here: chunk 1's first emitted element IS
+        # the prefill token (the scan below cuts it to a [1, 1] piece), and
+        # syncing the prefill early would serialize prefill -> chunk-1
+        # dispatch on every stop-bearing stream to optimize the rare case
+        stops = set(stop_token_ids or ()) if b == 1 else set()
         emitted = 0
         start = jnp.int32(0)
         while emitted < max_new_tokens:
@@ -339,5 +361,11 @@ class ChunkedDecoder:
             )
             start = start + self.chunk_size
             take = min(self.chunk_size, max_new_tokens - emitted)
-            yield np.asarray(toks[:, :take])
+            piece = np.asarray(toks[:, :take])
+            if stops:
+                cut = stop_cut(piece[0].tolist(), stops)
+                if cut is not None:
+                    yield piece[:, :cut]  # include the stop token
+                    return
+            yield piece
             emitted += take

@@ -226,3 +226,41 @@ class TestServingIntegration:
             assert m["m"]["continuous"]["admitted"] >= 1
         finally:
             httpd.shutdown()
+
+
+class TestLoneShortRequests:
+    def test_lone_budget_one_request_completes(self, server):
+        """Regression (caught live): a lone 1-token request admits, frees
+        its slot immediately, and the loop must still deliver its async
+        first token instead of blocking for the next request."""
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4)
+        try:
+            import concurrent.futures
+
+            tokens = np.array([[7, 8, 9]], np.int32)
+            with concurrent.futures.ThreadPoolExecutor(1) as pool:
+                fut = pool.submit(cb.generate, tokens, 1)
+                out = fut.result(timeout=60)  # hang = the bug
+            np.testing.assert_array_equal(
+                out, server.generate(tokens, max_new_tokens=1))
+            # and again: the engine must be idle-but-healthy afterwards
+            out2 = cb.generate(tokens, max_new_tokens=1)
+            np.testing.assert_array_equal(out, out2)
+        finally:
+            cb.close()
+
+    def test_lone_stop_on_first_token_completes(self, server):
+        """Same shape with stop_token_ids hitting the prefill token."""
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4)
+        try:
+            import concurrent.futures
+
+            tokens = np.array([[7, 8, 9]], np.int32)
+            first = int(server.generate(tokens, max_new_tokens=1)[0, -1])
+            with concurrent.futures.ThreadPoolExecutor(1) as pool:
+                fut = pool.submit(
+                    lambda: cb.generate(tokens, 8, stop_token_ids=[first]))
+                out = fut.result(timeout=60)
+            assert out.tolist() == [[7, 8, 9, first]]
+        finally:
+            cb.close()

@@ -1,0 +1,180 @@
+"""stop_token_ids: decode ends at a stop token instead of burning the full
+budget — streams (plain/speculative/continuous) end early (continuous
+frees the slot), non-stream responses trim rows at the stop id."""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+import requests
+
+import jax
+import jax.numpy as jnp
+
+from modelx_tpu.dl import safetensors as st
+from modelx_tpu.dl.serve import ModelServer, ServerSet, serve
+from modelx_tpu.registry.server import free_port
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    from modelx_tpu.models import llama
+
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64), dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    d = tmp_path_factory.mktemp("stop")
+    st.write_safetensors(str(d / "model.safetensors"),
+                         {k: np.asarray(v) for k, v in params.items()})
+    return str(d)
+
+
+def _serve(server, **sset_kw):
+    sset = ServerSet({"m": server}, **sset_kw)
+    port = free_port()
+    httpd = serve(sset, listen=f"127.0.0.1:{port}")
+    return sset, httpd, f"http://127.0.0.1:{port}"
+
+
+def _stream_tokens(base, body):
+    r = requests.post(base + "/v1/generate", stream=True, json=body)
+    assert r.status_code == 200, r.text
+    got = []
+    for line in r.iter_lines():
+        o = json.loads(line)
+        if o.get("done"):
+            break
+        got.extend(o["tokens"][0])
+    return got
+
+
+class TestStopTokens:
+    @pytest.fixture(scope="class")
+    def plain(self, ckpt):
+        server = ModelServer(ckpt, mesh_spec="dp=1", dtype="float32")
+        sset, httpd, base = _serve(server)
+        server.load()
+        yield server, base
+        httpd.shutdown()
+
+    def _full(self, server, prompt, n):
+        return server.generate(np.asarray([prompt], np.int32),
+                               max_new_tokens=n)[0, len(prompt):].tolist()
+
+    def test_stream_ends_at_stop_inclusive(self, plain):
+        server, base = plain
+        prompt = [1, 2, 3]
+        full = self._full(server, prompt, 12)
+        stop = full[4]  # a token the greedy stream will hit mid-way
+        got = _stream_tokens(base, {"tokens": [prompt], "max_new_tokens": 12,
+                                    "stream": True, "stop_token_ids": [stop]})
+        cut = full.index(stop) + 1
+        assert got == full[:cut]
+
+    def test_nonstream_rows_trimmed(self, plain):
+        server, base = plain
+        prompt = [1, 2, 3]
+        full = self._full(server, prompt, 12)
+        stop = full[4]
+        r = requests.post(base + "/v1/generate", json={
+            "tokens": [prompt], "max_new_tokens": 12, "stop_token_ids": [stop]})
+        assert r.status_code == 200, r.text
+        got = r.json()["tokens"][0]
+        assert got == prompt + full[: full.index(stop) + 1]
+
+    def test_no_stop_match_runs_full_budget(self, plain):
+        server, base = plain
+        prompt = [1, 2, 3]
+        full = self._full(server, prompt, 8)
+        unused = next(t for t in range(1, 64) if t not in full)
+        got = _stream_tokens(base, {"tokens": [prompt], "max_new_tokens": 8,
+                                    "stream": True, "stop_token_ids": [unused]})
+        assert got == full
+
+    def test_validation_400s(self, plain):
+        _server, base = plain
+        for bad in ("eos", [1, "x"], [True], [-1], [99999], list(range(20))):
+            r = requests.post(base + "/v1/generate", json={
+                "tokens": [[1, 2]], "max_new_tokens": 2, "stop_token_ids": bad})
+            assert r.status_code == 400, bad
+
+    def test_multirow_stream_with_stops_rejected(self, plain):
+        """Per-row early stop breaks the [B, k]-aligned stream contract;
+        refusal beats silently untrimmed rows."""
+        _server, base = plain
+        r = requests.post(base + "/v1/generate", json={
+            "tokens": [[1, 2], [3, 4]], "max_new_tokens": 4,
+            "stream": True, "stop_token_ids": [5]})
+        assert r.status_code == 400
+        assert "single-row" in r.json()["error"]
+        # multi-row NON-stream trims per row fine
+        r = requests.post(base + "/v1/generate", json={
+            "tokens": [[1, 2], [3, 4]], "max_new_tokens": 4,
+            "stop_token_ids": [5]})
+        assert r.status_code == 200
+
+    def test_speculative_stream_stops(self, ckpt):
+        server = ModelServer(ckpt, mesh_spec="dp=1", dtype="float32",
+                             speculative_k=4)
+        sset, httpd, base = _serve(server)
+        try:
+            server.load()
+            prompt = [3, 4, 5, 3, 4]
+            full = self._full(server, prompt, 10)
+            stop = full[3]
+            got = _stream_tokens(base, {"tokens": [prompt], "max_new_tokens": 10,
+                                        "stream": True, "stop_token_ids": [stop]})
+            assert got == full[: full.index(stop) + 1]
+        finally:
+            httpd.shutdown()
+
+    def test_continuous_stops_and_frees_slot(self, ckpt):
+        server = ModelServer(ckpt, mesh_spec="dp=1", dtype="float32", max_seq_len=96)
+        sset, httpd, base = _serve(server, continuous_batch=True, max_slots=2,
+                                   stream_chunk_size=4)
+        try:
+            server.load()
+            prompt = [1, 2, 3]
+            full = self._full(server, prompt, 12)
+            stop = full[4]
+            got = _stream_tokens(base, {"tokens": [prompt], "max_new_tokens": 12,
+                                        "stream": True, "stop_token_ids": [stop]})
+            assert got == full[: full.index(stop) + 1]
+            # non-stream via the engine honors stops server-side too
+            r = requests.post(base + "/v1/generate", json={
+                "tokens": [prompt], "max_new_tokens": 12, "stop_token_ids": [stop]})
+            assert r.json()["tokens"][0] == prompt + full[: full.index(stop) + 1]
+            cb = sset.cbatchers["m"]
+            # engine still healthy and slots all free after early retirement
+            out = cb.generate(np.asarray([prompt], np.int32), max_new_tokens=4)
+            np.testing.assert_array_equal(
+                out, server.generate(np.asarray([prompt], np.int32), max_new_tokens=4))
+        finally:
+            for cb in sset.cbatchers.values():
+                cb.close()
+            httpd.shutdown()
+
+    def test_continuous_multirow_stops_per_row(self, ckpt):
+        """Every row's slot frees at ITS stop; the response trims per row."""
+        server = ModelServer(ckpt, mesh_spec="dp=1", dtype="float32", max_seq_len=96)
+        sset, httpd, base = _serve(server, continuous_batch=True, max_slots=4,
+                                   stream_chunk_size=4)
+        try:
+            server.load()
+            p1, p2 = [1, 2, 3], [9, 8, 7]
+            f1 = self._full(server, p1, 12)
+            f2 = self._full(server, p2, 12)
+            stop = f1[2]
+            r = requests.post(base + "/v1/generate", json={
+                "tokens": [p1, p2], "max_new_tokens": 12,
+                "stop_token_ids": [stop]})
+            assert r.status_code == 200, r.text
+            rows = r.json()["tokens"]
+            c1 = f1[: f1.index(stop) + 1]
+            c2 = f2[: f2.index(stop) + 1] if stop in f2 else f2
+            assert rows[0] == p1 + c1
+            assert rows[1] == p2 + c2
+        finally:
+            for cb in sset.cbatchers.values():
+                cb.close()
+            httpd.shutdown()
