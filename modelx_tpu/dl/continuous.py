@@ -81,13 +81,16 @@ class ContinuousBatcher:
     """
 
     def __init__(self, server, max_slots: int = 8, chunk_size: int = 8,
-                 max_len: int = 0) -> None:
+                 max_len: int = 0, prefix_cache=None) -> None:
         if server.family.decode_fns is None:
             raise ValueError(f"family {server.family.name} has no cached decode")
         self.server = server
         self.max_slots = int(max_slots)
         self.chunk_size = int(chunk_size)
         self.max_len = int(max_len) or int(server.max_seq_len)
+        # models/decode.PrefixKVCache: admissions whose prompt extends a
+        # stored prefix prefill only the suffix (multi-turn chat fast path)
+        self.prefix_cache = prefix_cache
         self._fwd, self._init_cache = server.family.decode_fns(
             server.cfg, mesh=server.mesh
         )
@@ -109,8 +112,28 @@ class ContinuousBatcher:
 
         # admission is ONE program (prefill + first token + insert-at-slot):
         # on a tunneled device every call costs a host round-trip, so the
-        # two-call prefill-then-insert shape would double admission latency
-        self._admit_prog = jax.jit(self._admit_impl, donate_argnums=(2, 3))
+        # two-call prefill-then-insert shape would double admission latency.
+        # Without a prefix cache the scratch KV stays internal (no output
+        # buffer materialized just to be dropped on the host).
+        if prefix_cache is None:
+            def _admit_nosmall(params, prompt, cache, tok, row_len, slot,
+                               temp, top_k, top_p, seed):
+                cache, tok, first, _small = self._admit_impl(
+                    params, prompt, cache, tok, row_len, slot,
+                    temp, top_k, top_p, seed,
+                )
+                return cache, tok, first
+
+            self._admit_prog = jax.jit(_admit_nosmall, donate_argnums=(2, 3))
+        else:
+            self._admit_prog = jax.jit(self._admit_impl, donate_argnums=(2, 3))
+        # prefix-hit variant: stored KV rides in as an argument (never
+        # donated — the cache entry outlives the admission); trim_len is
+        # static so stored entries stay bucketed to the PROMPT's bucket
+        # (entries must not grow by a bucket per conversation turn)
+        self._admit_cached_prog = jax.jit(
+            self._admit_cached_impl, static_argnums=(12,), donate_argnums=(2, 3)
+        )
         self._chunk = jax.jit(self._chunk_impl, donate_argnums=(1, 2))
 
         self._q: "queue.Queue" = queue.Queue()
@@ -123,18 +146,16 @@ class ContinuousBatcher:
 
     # -- compiled programs ----------------------------------------------------
 
-    def _admit_impl(self, params, prompt, cache, tok, row_len, slot,
-                    temp, top_k, top_p, seed):
-        """One program per admission: prefill the [1, S] prompt into a
-        scratch cache (allocated INSIDE the jit — zeros fuse, no host
-        transfer), sample the row's first token (step 0 of its sample
-        stream, matching ragged/stream decode byte-for-byte), and insert
-        both into ``slot`` of the donated engine state."""
+    def _finish_admit(self, small, logits, cache, tok, last_idx, slot,
+                      temp, top_k, top_p, seed):
+        """Shared admit tail: sample the row's first token (step 0 of its
+        sample stream, matching ragged/stream decode byte-for-byte) and
+        insert the scratch cache + token into ``slot`` of the donated
+        engine state. Returns (cache, tok, first, small) — ``small`` goes
+        back to the host for the prefix cache."""
         from modelx_tpu.ops import sampling as sampling_ops
 
-        small = self._init_cache(1, prompt.shape[1])
-        logits, small = self._fwd(params, prompt, kv_cache=small, cache_offset=0)
-        idx = jnp.broadcast_to((row_len - 1)[:, None, None], (1, 1, logits.shape[-1]))
+        idx = jnp.broadcast_to(last_idx[:, None, None], (1, 1, logits.shape[-1]))
         last = jnp.take_along_axis(logits, idx, axis=1)[:, 0, :]
         first = sampling_ops.sample(
             last.astype(jnp.float32), jax.random.PRNGKey(0), temp,
@@ -148,7 +169,42 @@ class ContinuousBatcher:
 
         cache = jax.tree_util.tree_map(put, cache, small)
         tok = jax.lax.dynamic_update_slice(tok, first[:, None], (slot, 0))
-        return cache, tok, first
+        return cache, tok, first, small
+
+    def _admit_impl(self, params, prompt, cache, tok, row_len, slot,
+                    temp, top_k, top_p, seed):
+        """One program per admission: prefill the [1, S] prompt into a
+        scratch cache (allocated INSIDE the jit — zeros fuse, no host
+        transfer), then the shared admit tail."""
+        small = self._init_cache(1, prompt.shape[1])
+        logits, small = self._fwd(params, prompt, kv_cache=small, cache_offset=0)
+        return self._finish_admit(small, logits, cache, tok, row_len - 1, slot,
+                                  temp, top_k, top_p, seed)
+
+    def _admit_cached_impl(self, params, suffix, cache, tok, suffix_len, plen,
+                           slot, stored, temp, top_k, top_p, seed,
+                           trim_len: int):
+        """Prefix-hit admission: the scratch cache starts as the STORED
+        prefix KV (extended with zeros for the suffix bucket) and only the
+        [1, Sb] suffix block prefills, at offset ``plen``. KV values are a
+        deterministic function of the token prefix, so the admitted row is
+        byte-identical to a full prefill. Junk in the stored bucket past
+        the real prefix is overwritten by the suffix write (each layer
+        writes its k/v BEFORE attending), and junk past the suffix span
+        sits beyond every query position until decode overwrites it.
+        ``trim_len`` (static, = the full prompt's 16-bucket) cuts the
+        scratch back down before insertion/storage."""
+        sb = suffix.shape[1]
+        small = jax.tree_util.tree_map(
+            lambda s: jnp.concatenate(
+                [s, jnp.zeros((1, sb) + s.shape[2:], s.dtype)], axis=1
+            ),
+            stored,
+        )
+        logits, small = self._fwd(params, suffix, kv_cache=small, cache_offset=plen)
+        small = jax.tree_util.tree_map(lambda c: c[:, :trim_len], small)
+        return self._finish_admit(small, logits, cache, tok, suffix_len - 1, slot,
+                                  temp, top_k, top_p, seed)
 
     def _chunk_impl(self, params, cache, tok, offsets, steps, temp, top_k, top_p, seeds):
         """``chunk_size`` decode steps over ALL slots; offsets/steps are
@@ -180,9 +236,6 @@ class ContinuousBatcher:
         stops = frozenset(samp.get("stop_token_ids") or ())
         slot = self._free.pop()
         s = len(ids)
-        pad_s = pad_seq_len(s)
-        prompt = np.zeros((1, pad_s), np.int32)
-        prompt[0, :s] = ids
         temp = np.asarray([samp.get("temperature", 0.0)], np.float32)
         k_val = int(samp.get("top_k", 0))
         p_val = float(samp.get("top_p", 1.0))
@@ -190,10 +243,41 @@ class ContinuousBatcher:
         top_k = np.asarray([k_val], np.int32) if filters else None
         top_p = np.asarray([p_val], np.float32) if filters else None
         seed = np.asarray([samp.get("seed", 0)], np.int32)
-        self._cache, self._tok, first = self._admit_prog(
-            self.server.params, jnp.asarray(prompt), self._cache, self._tok,
-            jnp.asarray([s], np.int32), jnp.int32(slot), temp, top_k, top_p, seed,
-        )
+        hit = None
+        if self.prefix_cache is not None:
+            # fit-aware lookup: entries whose bucket + suffix bucket exceed
+            # the slot cache are skipped (shorter fitting prefixes still win)
+            hit = self.prefix_cache.lookup(ids, max_total=self.max_len)
+        if hit is not None:
+            plen, stored = hit
+            suffix = ids[plen:]
+            sb = pad_seq_len(len(suffix))
+            block = np.zeros((1, sb), np.int32)
+            block[0, : len(suffix)] = suffix
+            self._cache, self._tok, first, small = self._admit_cached_prog(
+                self.server.params, jnp.asarray(block), self._cache, self._tok,
+                jnp.asarray([len(suffix)], np.int32), jnp.int32(plen),
+                jnp.int32(slot), stored, temp, top_k, top_p, seed,
+                pad_seq_len(s),
+            )
+        else:
+            pad_s = pad_seq_len(s)
+            prompt = np.zeros((1, pad_s), np.int32)
+            prompt[0, :s] = ids
+            admitted = self._admit_prog(
+                self.server.params, jnp.asarray(prompt), self._cache, self._tok,
+                jnp.asarray([s], np.int32), jnp.int32(slot), temp, top_k, top_p, seed,
+            )
+            if self.prefix_cache is None:
+                self._cache, self._tok, first = admitted
+                small = None
+            else:
+                self._cache, self._tok, first, small = admitted
+        if self.prefix_cache is not None:
+            # the scratch cache IS this prompt's prefill KV (bucketed to the
+            # prompt's 16-quantum): store it so the conversation's next turn
+            # prefills only its new suffix
+            self.prefix_cache.put(ids, small)
         self._offsets[slot] = s
         self._steps[slot] = 1  # prefill consumed step 0
         self._temp[slot] = temp[0]

@@ -779,6 +779,7 @@ class ServerSet:
                 cb = ContinuousBatcher(
                     server, max_slots=self.max_slots,
                     chunk_size=self.stream_chunk_size, max_len=max_len,
+                    prefix_cache=server._prefix_cache,
                 )
                 self.cbatchers[server.name] = cb
         return cb

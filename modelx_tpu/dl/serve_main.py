@@ -119,12 +119,14 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
         logging.getLogger("modelx.serve").warning(
             "--continuous-batch supersedes --speculative-k for generate traffic"
         )
-    if prefix_cache and (continuous_batch or speculative_k):
-        # both alternatives own single-row streams before the ChunkedDecoder
-        # (the prefix cache's seam) is ever consulted
+    if prefix_cache and speculative_k and not continuous_batch:
+        # the speculative decoder owns single-row streams before the
+        # ChunkedDecoder (the prefix cache's stream seam) is consulted;
+        # under --continuous-batch the engine's ADMISSION path uses the
+        # prefix cache, so that combination is first-class
         logging.getLogger("modelx.serve").warning(
-            "--prefix-cache is inert under --continuous-batch/--speculative-k "
-            "(those engines handle the streams it would accelerate)"
+            "--prefix-cache is inert under --speculative-k "
+            "(the speculative decoder handles the streams it would accelerate)"
         )
     sset = ServerSet(servers, trace_dir=trace_dir, dynamic_batch=dynamic_batch,
                      continuous_batch=continuous_batch, max_slots=max_slots,

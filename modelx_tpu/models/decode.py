@@ -163,17 +163,28 @@ class PrefixKVCache:
         self.hits = 0
         self.misses = 0
 
-    def lookup(self, ids) -> tuple[int, object] | None:
+    def lookup(self, ids, max_total: int | None = None) -> tuple[int, object] | None:
         """Longest stored key that is a STRICT prefix of ``ids`` (the
         suffix prefill needs >= 1 real token to produce first-token
-        logits). Returns (prefix_len, cache pytree) or None."""
+        logits) AND whose stored bucket + the remaining suffix's bucket
+        fits ``max_total`` (a fixed-size consumer like the continuous
+        engine's slot cache). Returns (prefix_len, cache pytree) or None.
+        hits/misses count USABLE lookups only — an entry discarded for
+        size is not a hit, and shorter fitting prefixes still win."""
+        import jax as _jax
+
         ids = tuple(int(t) for t in ids)
         best_key = None
         with self._lock:
-            for key in self._od:
-                if len(key) < len(ids) and ids[: len(key)] == key:
-                    if best_key is None or len(key) > len(best_key):
-                        best_key = key
+            for key, cache in self._od.items():
+                if len(key) >= len(ids) or ids[: len(key)] != key:
+                    continue
+                if max_total is not None:
+                    stored_len = int(_jax.tree_util.tree_leaves(cache)[0].shape[1])
+                    if stored_len + pad_seq_len(len(ids) - len(key)) > max_total:
+                        continue
+                if best_key is None or len(key) > len(best_key):
+                    best_key = key
             if best_key is None:
                 self.misses += 1
                 return None

@@ -264,3 +264,72 @@ class TestLoneShortRequests:
             assert out.tolist() == [[7, 8, 9, first]]
         finally:
             cb.close()
+
+
+class TestContinuousPrefixCache:
+    """The engine's admission path uses the PrefixKVCache: a prompt
+    extending a stored prefix prefills only its suffix, byte-identically."""
+
+    @pytest.fixture()
+    def cached_engine(self, server):
+        from modelx_tpu.models.decode import PrefixKVCache
+
+        cb = ContinuousBatcher(server, max_slots=4, chunk_size=4,
+                               prefix_cache=PrefixKVCache(4))
+        yield cb
+        cb.close()
+
+    def test_second_turn_matches_plain(self, server, cached_engine):
+        cb = cached_engine
+        turn1 = np.array([[3, 4, 5, 6, 7]], np.int32)
+        out1 = cb.generate(turn1, max_new_tokens=6)
+        np.testing.assert_array_equal(out1, server.generate(turn1, max_new_tokens=6))
+        turn2 = np.concatenate([out1, np.array([[9, 9]], np.int32)], axis=1)
+        out2 = cb.generate(turn2, max_new_tokens=6)
+        np.testing.assert_array_equal(out2, server.generate(turn2, max_new_tokens=6))
+        assert cb.prefix_cache.hits == 1
+        # sampled turn too (same (seed, step) streams from the suffix admit)
+        out3 = cb.generate(turn2, max_new_tokens=5, temperature=0.8, seed=13)
+        np.testing.assert_array_equal(
+            out3, server.generate(turn2, max_new_tokens=5, temperature=0.8, seed=13))
+
+    def test_entries_stay_prompt_bucketed(self, server, cached_engine):
+        """Stored entries must be trimmed to the PROMPT's 16-bucket on both
+        the miss and hit admission paths — per-turn bucket growth would
+        bloat HBM and eventually evict conversations from the fast path."""
+        import jax as _jax
+
+        cb = cached_engine
+        t1 = np.array([[3, 4, 5, 6, 7]], np.int32)  # 5 -> bucket 16
+        out1 = cb.generate(t1, max_new_tokens=6)
+        t2 = np.concatenate([out1, np.array([[9]], np.int32)], axis=1)  # 12 -> 16
+        cb.generate(t2, max_new_tokens=6)  # hit path stores too
+        with cb.prefix_cache._lock:
+            lens = {
+                len(k): int(_jax.tree_util.tree_leaves(v)[0].shape[1])
+                for k, v in cb.prefix_cache._od.items()
+            }
+        from modelx_tpu.models.decode import pad_seq_len
+
+        assert lens == {n: pad_seq_len(n) for n in lens}
+
+    def test_oversize_prefix_falls_back_to_full_prefill(self, server):
+        """A stored bucket + suffix bucket that exceeds max_len must
+        full-prefill (correctness over reuse) and count as a MISS."""
+        from modelx_tpu.models.decode import PrefixKVCache
+
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4, max_len=56,
+                               prefix_cache=PrefixKVCache(4))
+        try:
+            t1 = np.array([[(i % 60) + 1 for i in range(17)]], np.int32)
+            cb.generate(t1, max_new_tokens=4)  # stores a 32-bucket prefix
+            # 17 new tokens: suffix bucket 32; 32 + 32 = 64 > 56 -> unusable
+            t2 = np.concatenate(
+                [t1, np.array([[(i % 60) + 1 for i in range(17)]], np.int32)], axis=1)
+            out2 = cb.generate(t2, max_new_tokens=4)
+            np.testing.assert_array_equal(
+                out2, server.generate(t2, max_new_tokens=4))
+            assert cb.prefix_cache.hits == 0
+            assert cb.prefix_cache.misses == 2
+        finally:
+            cb.close()
