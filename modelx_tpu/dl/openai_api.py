@@ -221,6 +221,19 @@ def _envelope(obj_type: str, model: str) -> dict:
     }
 
 
+def eos_for(tok, req: dict) -> tuple[int, ...]:
+    """The tokenizer's end-of-sequence ids, unless the request opts out
+    with the ``ignore_eos`` extension (vLLM-compatible). OpenAI semantics:
+    generation ends at EOS with finish_reason "stop" and the EOS token
+    never appears in the content."""
+    ignore = req.get("ignore_eos", False)
+    if not isinstance(ignore, bool):
+        raise APIError(400, "ignore_eos must be a boolean")
+    if ignore or not hasattr(tok, "eos_ids"):
+        return ()
+    return tok.eos_ids()
+
+
 def run_completion(sset, req: dict, chat: bool) -> dict:
     """Non-streaming completions/chat: returns the OpenAI response body."""
     server = resolve_model(sset, req)
@@ -228,6 +241,7 @@ def run_completion(sset, req: dict, chat: bool) -> dict:
     prompts = parse_prompts(req, chat)
     n_tokens, samp = parse_sampling(req, sset.max_new_tokens_limit)
     stops = parse_stop(req)
+    eos = eos_for(tok, req)
 
     if req.get("stream_options") is not None:
         # OpenAI contract: only valid with stream=true — silently accepting
@@ -238,9 +252,17 @@ def run_completion(sset, req: dict, chat: bool) -> dict:
     engine = sset.engine_for(server, len(prompts), samp["temperature"])
     server.stats["requests"] += 1
     id_rows = [encode_prompt(tok, server, text, n_tokens) for text in prompts]
+    # the continuous engine can retire a row's slot AT its EOS; other
+    # engines decode the full budget and the EOS trim happens below
+    stops_kw = (
+        {"stop_token_ids": list(eos)}
+        if eos and engine is sset.cbatchers.get(server.name)
+        else {}
+    )
 
     def _one(ids: list[int]) -> list[int]:
-        out = engine.generate(np.asarray([ids], np.int32), max_new_tokens=n_tokens, **samp)
+        out = engine.generate(np.asarray([ids], np.int32), max_new_tokens=n_tokens,
+                              **stops_kw, **samp)
         return out[0, len(ids):].tolist()
 
     if len(id_rows) > 1 and engine is not server:
@@ -253,12 +275,24 @@ def run_completion(sset, req: dict, chat: bool) -> dict:
     else:
         rows_out = [_one(ids) for ids in id_rows]
 
+    from modelx_tpu.models.decode import stop_cut
+
+    eos_set = set(eos)
     choices = []
     prompt_tokens = completion_tokens = 0
     for i, (ids, new_ids) in enumerate(zip(id_rows, rows_out)):
+        cut = stop_cut(new_ids, eos_set)
+        hit_eos = cut is not None
+        if hit_eos:
+            # usage counts the EOS (it was generated); content excludes it
+            new_ids = new_ids[:cut]
         prompt_tokens += len(ids)
         completion_tokens += len(new_ids)
-        text_out, finish = apply_stop(tok.decode(new_ids), stops)
+        text_out, finish = apply_stop(
+            tok.decode(new_ids[:-1] if hit_eos else new_ids), stops
+        )
+        if hit_eos and finish == "length":
+            finish = "stop"
         if chat:
             choices.append({
                 "index": i,
@@ -298,6 +332,7 @@ def stream_completion(sset, req: dict, chat: bool) -> Iterator[dict]:
         raise APIError(400, "stream_options must be an object")
     include_usage = bool((opts or {}).get("include_usage", False))
 
+    eos = eos_for(tok, req)  # validates ignore_eos BEFORE counting
     server.stats["requests"] += 1
     # a stop sequence can straddle decode chunks ("hello wo" + "rld"):
     # hold back the longest prefix a stop could still complete, so no text
@@ -305,8 +340,14 @@ def stream_completion(sset, req: dict, chat: bool) -> Iterator[dict]:
     reserve = max((len(s) for s in stops), default=1) - 1
 
     def events() -> Iterator[dict]:
-        # continuous engine when enabled, operator chunk size either way
-        gen = sset.stream_source(server, np.asarray([ids], np.int32), n_tokens, samp)
+        from modelx_tpu.models.decode import stop_cut
+
+        eos_set = set(eos)
+        # continuous engine when enabled, operator chunk size either way;
+        # an EOS hit ends decode early (the stream layer drops the EOS
+        # token from the content and reports finish_reason "stop")
+        gen = sset.stream_source(server, np.asarray([ids], np.int32), n_tokens,
+                                 samp, stop_token_ids=list(eos) or None)
         # prime generation BEFORE yielding anything: the transport commits
         # its 200 after the first event, and a compile/decode failure must
         # surface as a real status even for chat (whose first event is the
@@ -330,10 +371,18 @@ def stream_completion(sset, req: dict, chat: bool) -> Iterator[dict]:
         sent = ""
         text = ""
         new_ids: list[int] = []
+        eos_count = 0
         finish = "length"
         pieces = gen if first_piece is None else itertools.chain((first_piece,), gen)
         for piece in pieces:
-            new_ids.extend(piece[0].tolist())
+            piece_ids = piece[0].tolist()
+            tcut = stop_cut(piece_ids, eos_set)
+            hit_eos = tcut is not None
+            if hit_eos:
+                # usage counts the EOS; the content never includes it
+                eos_count = 1
+                piece_ids = piece_ids[: tcut - 1]
+            new_ids.extend(piece_ids)
             # decode the FULL generated prefix each chunk and emit the tail:
             # per-chunk decode would split multi-token glyphs at chunk edges
             text = tok.decode(new_ids)
@@ -347,6 +396,8 @@ def stream_completion(sset, req: dict, chat: bool) -> Iterator[dict]:
                 # an emitted prefix changed on re-decode (an incomplete glyph
                 # slipped out); bytes on the wire can't be retracted — hold
                 # everything until the decode re-extends what was sent
+                if hit_eos:
+                    break  # the flush below emits the re-decoded remainder
                 continue
             # trailing U+FFFD means the last glyph's bytes are still split
             # across tokens: provisional, the next chunk may resolve it
@@ -357,8 +408,12 @@ def stream_completion(sset, req: dict, chat: bool) -> Iterator[dict]:
             if cut[len(sent):safe]:
                 yield content_event(cut[len(sent):safe])
                 sent = cut[:safe]
+            if hit_eos:
+                break  # the engine already stopped; flush the tail below
         if finish != "stop" and text.startswith(sent) and text[len(sent):]:
             yield content_event(text[len(sent):])  # flush the held-back tail
+        if eos_count and finish == "length":
+            finish = "stop"
         yield {
             **envelope,
             "choices": [
@@ -374,8 +429,8 @@ def stream_completion(sset, req: dict, chat: bool) -> Iterator[dict]:
                 "choices": [],
                 "usage": {
                     "prompt_tokens": len(ids),
-                    "completion_tokens": len(new_ids),
-                    "total_tokens": len(ids) + len(new_ids),
+                    "completion_tokens": len(new_ids) + eos_count,
+                    "total_tokens": len(ids) + len(new_ids) + eos_count,
                 },
             }
 

@@ -62,11 +62,20 @@ MAX_PROFILE_SECONDS = 60
 _UNSET = object()  # tokenizer not probed yet (absent is cached as None)
 
 
+_EOS_CANDIDATES = (
+    # the end-of-sequence spellings of the supported families' tokenizers:
+    # llama2/mistral, gpt2/gpt-j, llama3, chatml/qwen2, llama3 base, gemma
+    "</s>", "<|endoftext|>", "<|eot_id|>", "<|im_end|>", "<|end_of_text|>",
+    "<eos>", "<|end|>",
+)
+
+
 class _Tokenizer:
     """list[int]-in/str-out facade over a raw ``tokenizers.Tokenizer``."""
 
     def __init__(self, tok) -> None:
         self._tok = tok
+        self._eos: tuple[int, ...] | None = None
 
     def encode(self, text: str) -> list[int]:
         return self._tok.encode(text).ids
@@ -75,6 +84,19 @@ class _Tokenizer:
         # keep special tokens: clients watch for e.g. "</s>" in the text,
         # and tokenizers' own default (skip=True) would silently strip them
         return self._tok.decode(list(ids), skip_special_tokens=False)
+
+    def eos_ids(self) -> tuple[int, ...]:
+        """End-of-sequence token ids, discovered from the vocab's
+        well-known spellings (tokenizer.json carries no explicit EOS
+        marker). Empty = unknown: callers then keep budget-only decode."""
+        if self._eos is None:
+            ids = []
+            for cand in _EOS_CANDIDATES:
+                tid = self._tok.token_to_id(cand)
+                if tid is not None:
+                    ids.append(int(tid))
+            self._eos = tuple(dict.fromkeys(ids))
+        return self._eos
 
 
 _compile_cache_dir = ""  # set by enable_compile_cache; "" = cold every start

@@ -398,3 +398,155 @@ class TestContextBound:
         assert encode_prompt(Tok(), server, "x", n_tokens=6)  # 10+6 = 16 fits
         with pytest.raises(APIError, match="position context"):
             encode_prompt(Tok(), server, "x", n_tokens=7)  # 17 > 16
+
+
+class TestAutoEOS:
+    """The OpenAI layer ends generation at the tokenizer's EOS: content
+    excludes the EOS token, finish_reason is "stop", usage counts it, and
+    ignore_eos (vLLM-compatible extension) opts out."""
+
+    def test_eos_ids_discovered_from_vocab(self):
+        tokenizers = pytest.importorskip("tokenizers")
+        from modelx_tpu.dl.serve import _Tokenizer
+
+        vocab = {"<unk>": 0, "hello": 1, "</s>": 2, "<|im_end|>": 3}
+        tok = tokenizers.Tokenizer(tokenizers.models.WordLevel(vocab, unk_token="<unk>"))
+        t = _Tokenizer(tok)
+        assert set(t.eos_ids()) == {2, 3}
+        vocab2 = {"<unk>": 0, "hello": 1}
+        tok2 = tokenizers.Tokenizer(tokenizers.models.WordLevel(vocab2, unk_token="<unk>"))
+        assert _Tokenizer(tok2).eos_ids() == ()
+
+    def _eos_sset(self, pieces, eos=(50,)):
+        """TestStopStraddle's fake harness, with an EOS-aware tokenizer."""
+        from types import SimpleNamespace
+        import types as _types
+
+        from modelx_tpu.dl.serve import ServerSet
+
+        class Tok:
+            def encode(self, text):
+                return [1, 2]
+
+            def decode(self, ids):
+                return " ".join(f"w{i}" for i in ids)
+
+            def eos_ids(self):
+                return tuple(eos)
+
+        consumed = []
+
+        def gen_stream(tokens, max_new_tokens, **samp):
+            # the real engines stop AT the eos; mimic by ending the piece
+            # stream there (and record what the layer asked for)
+            consumed.append(samp.get("stop_token_ids"))
+            import numpy as _np
+
+            for p in pieces:
+                yield _np.asarray(p)
+                if any(t in eos for t in p[0]):
+                    return
+
+        server = SimpleNamespace(
+            name="f", ready=True, speculative_k=0,
+            cfg=SimpleNamespace(vocab_size=100),
+            family=SimpleNamespace(decode_fns=object(), name="fake",
+                                   generate_ragged=None),
+            stats={"requests": 0},
+            tokenizer=lambda: Tok(),
+            generate_stream=gen_stream,
+        )
+        sset = SimpleNamespace(servers={"f": server}, default="f",
+                               max_new_tokens_limit=64, stream_chunk_size=8,
+                               batcher_for=lambda s: None,
+                               continuous_for=lambda s: None)
+        sset.stream_source = _types.MethodType(ServerSet.stream_source, sset)
+        sset.engine_for = _types.MethodType(ServerSet.engine_for, sset)
+        return sset, consumed
+
+    def _collect(self, sset, req):
+        from modelx_tpu.dl.openai_api import stream_completion
+
+        events = list(stream_completion(sset, req, chat=False))
+        text = "".join(c.get("text", "") for e in events for c in e["choices"])
+        finish = [c["finish_reason"] for e in events for c in e["choices"]
+                  if c["finish_reason"]]
+        usage = [e["usage"] for e in events if e.get("usage")]
+        return text, finish, usage
+
+    def test_stream_stops_at_eos_excluding_it(self):
+        sset, consumed = self._eos_sset([[[5]], [[6, 50]], [[7]]])
+        text, finish, usage = self._collect(
+            sset, {"prompt": "x", "max_tokens": 8,
+                   "stream_options": {"include_usage": True}})
+        assert text == "w5 w6"  # no w50, no w7
+        assert finish == ["stop"]
+        assert usage[0]["completion_tokens"] == 3  # w5, w6, and the EOS
+        assert consumed == [[50]]  # the engine was asked to stop there
+
+    def test_ignore_eos_runs_full_budget(self):
+        sset, consumed = self._eos_sset([[[5]], [[6, 50]], [[7]]])
+        text, finish, _ = self._collect(
+            sset, {"prompt": "x", "max_tokens": 8, "ignore_eos": True})
+        # the fake engine still ends its piece stream, but the layer asked
+        # for NO stop ids and keeps the eos token's text in the content
+        assert consumed == [None]
+        assert "w50" in text
+        assert finish == ["length"]
+
+    def test_ignore_eos_type_validated(self):
+        sset, _ = self._eos_sset([[[5]]])
+        from modelx_tpu.dl.openai_api import stream_completion
+
+        with pytest.raises(APIError, match="ignore_eos"):
+            list(stream_completion(sset, {"prompt": "x", "ignore_eos": "yes"},
+                                   chat=False))
+
+    def test_nonstream_trims_at_eos(self, front, tmp_path):
+        """Full stack: serve a model whose tokenizer maps </s> to a token
+        the greedy continuation actually produces; the completion must end
+        there with finish_reason stop."""
+        tokenizers = pytest.importorskip("tokenizers")
+        import dataclasses
+
+        from modelx_tpu.dl import safetensors as st
+        from modelx_tpu.dl.serve import ModelServer, ServerSet
+        from modelx_tpu.dl.openai_api import run_completion
+        from modelx_tpu.models import llama
+
+        base, ref_server = front
+        ids = ref_server.tokenizer().encode("hello world tpu")
+        full = ref_server.generate(np.asarray([ids], np.int32),
+                                   max_new_tokens=6)[0, len(ids):].tolist()
+        eos_id = full[3]
+        if eos_id < 4:
+            pytest.skip("greedy continuation collides with reserved vocab ids")
+        # same weights, but the tokenizer now names eos_id "</s>"
+        d = ref_server.model_dir
+        import shutil
+
+        d2 = str(tmp_path)
+        shutil.copy(d + "/model.safetensors", d2 + "/model.safetensors")
+        vocab = {"<unk>": 0, "hello": 1, "world": 2, "tpu": 3}
+        vocab.update({f"w{i}": i for i in range(4, 64) if i != eos_id})
+        vocab["</s>"] = eos_id
+        tok = tokenizers.Tokenizer(tokenizers.models.WordLevel(vocab, unk_token="<unk>"))
+        tok.pre_tokenizer = tokenizers.pre_tokenizers.Whitespace()
+        tok.save(d2 + "/tokenizer.json")
+        server = ModelServer(d2, mesh_spec="dp=1", dtype="float32", name="e")
+        server.load()
+        sset = ServerSet({"e": server})
+        body = run_completion(sset, {"prompt": "hello world tpu",
+                                     "max_tokens": 6, "temperature": 0}, chat=False)
+        (choice,) = body["choices"]
+        assert choice["finish_reason"] == "stop"
+        assert "</s>" not in choice["text"]
+        # content = the tokens before the eos
+        expect = server.tokenizer().decode(full[:3])
+        assert choice["text"] == expect
+        assert body["usage"]["completion_tokens"] == 4  # 3 content + eos
+        # ignore_eos: full budget, eos text present
+        body2 = run_completion(sset, {"prompt": "hello world tpu", "max_tokens": 6,
+                                      "temperature": 0, "ignore_eos": True}, chat=False)
+        assert body2["choices"][0]["finish_reason"] == "length"
+        assert "</s>" in body2["choices"][0]["text"]
