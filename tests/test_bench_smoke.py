@@ -1,0 +1,90 @@
+"""bench.py smoke: the driver runs it unattended at round end on real
+hardware — import errors, signature drift between bench and the library,
+or a broken checkpoint builder must fail HERE, in CI, not there."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+
+class TestBenchSmoke:
+    def test_checkpoint_builder_and_loader_roundtrip(self, tmp_path):
+        import jax
+
+        from bench import build_checkpoint
+        from modelx_tpu.dl.loader import LocalFileSource, load_safetensors
+        from modelx_tpu.dl.sharding import LLAMA_RULES
+        from modelx_tpu.parallel.mesh import make_mesh
+
+        ckpt = str(tmp_path / "m.safetensors")
+        target = 1 << 20
+        size = build_checkpoint(ckpt, target, hidden=64, inter=128, vocab=256)
+        # independent checks: roughly the asked-for size (base tensors can
+        # exceed a tiny target, never 4x it at these shapes), real layers
+        assert 0 < size < 4 * target
+        src = LocalFileSource(ckpt)
+        try:
+            arrays, stats = load_safetensors(src, make_mesh("dp=1"), LLAMA_RULES)
+        finally:
+            src.close()
+        assert stats.tensors == len(arrays) > 0
+        assert "model.layers.0.self_attn.q_proj.weight" in arrays
+        jax.block_until_ready(arrays)
+
+    def test_measure_continuous_signature(self):
+        """measure_continuous drives the engine through the same shim the
+        bench uses — catches ContinuousBatcher API drift."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        import bench
+        from modelx_tpu.models import llama
+        from modelx_tpu.parallel.mesh import make_mesh
+
+        cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64),
+                                  dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        out = bench.measure_continuous(params, make_mesh("dp=1"), 100.0)
+        assert out["continuous_clients"] == 8
+        assert out["continuous_agg_tokens_per_s"] > 0
+        assert out["continuous_vs_sequential"] > 0
+
+    def test_pull_snippets_run(self, tmp_path):
+        """The stdlib-only multitenant pullers must keep working against a
+        live registry (they run as bare -S subprocesses in the bench)."""
+        from bench import _PULL_SNIPPET
+        from modelx_tpu.client.client import Client
+        from modelx_tpu.client.helper import descriptor_for_file
+        from modelx_tpu.registry.fs import LocalFSProvider
+        from modelx_tpu.registry.server import Options, RegistryServer, free_port
+        from modelx_tpu.registry.store_fs import FSRegistryStore
+        from modelx_tpu.types import Manifest
+
+        blob = tmp_path / "blob.bin"
+        blob.write_bytes(np.arange(65536, dtype=np.uint8).tobytes())
+        srv = RegistryServer(
+            Options(listen=f"127.0.0.1:{free_port()}"),
+            store=FSRegistryStore(LocalFSProvider(str(tmp_path / "store"))),
+        )
+        base = srv.serve_background()
+        try:
+            client = Client(base, quiet=True)
+            desc = descriptor_for_file(str(blob), "blob.bin", "application/octet-stream")
+            with open(blob, "rb") as f:
+                client.remote.upload_blob_content("library/smoke", desc, f)
+            client.remote.put_manifest("library/smoke", "v1", Manifest(blobs=[desc]))
+            url = f"{base}/library/smoke/blobs/{desc.digest}"
+            p = subprocess.run(
+                [sys.executable, "-S", "-c", _PULL_SNIPPET, url],
+                capture_output=True, text=True, timeout=60,
+                env={"PATH": os.environ.get("PATH", "")},
+            )
+            assert p.returncode == 0, p.stderr[-500:]
+            assert int(p.stdout.split()[1]) == blob.stat().st_size
+        finally:
+            srv.shutdown()

@@ -333,3 +333,36 @@ class TestContinuousPrefixCache:
             assert cb.prefix_cache.misses == 2
         finally:
             cb.close()
+
+
+class TestOtherFamilies:
+    def test_gpt2_engine_clamps_to_n_positions_and_matches(self, tmp_path):
+        """ServerSet.continuous_for must cap the engine's max_len at gpt2's
+        wpe table (positions past it clamp silently inside jit), and the
+        engine's output must still match the plain path."""
+        from modelx_tpu.models import gpt2
+
+        cfg = gpt2.GPT2Config.tiny()  # n_positions=64
+        params = gpt2.init_params(cfg, jax.random.PRNGKey(2))
+        d = tmp_path / "g"
+        d.mkdir()
+        st.write_safetensors(str(d / "model.safetensors"),
+                             {k: np.asarray(v) for k, v in params.items()})
+        srv = ModelServer(str(d), mesh_spec="dp=1", dtype="float32",
+                          max_seq_len=2048, name="g")
+        srv.load()
+        sset = ServerSet({"g": srv}, continuous_batch=True, max_slots=2,
+                         stream_chunk_size=4)
+        try:
+            cb = sset.continuous_for(srv)
+            assert cb.max_len == cfg.n_positions
+            tokens = np.array([[5, 6, 7]], np.int32)
+            np.testing.assert_array_equal(
+                cb.generate(tokens, max_new_tokens=6),
+                srv.generate(tokens, max_new_tokens=6))
+            # budget past the clamped context is refused, not garbage
+            with pytest.raises(ValueError, match="max_len"):
+                cb.generate(tokens, max_new_tokens=64)
+        finally:
+            for c in sset.cbatchers.values():
+                c.close()
