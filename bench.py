@@ -17,9 +17,23 @@ then measures:
   "first decoded token", warm persistent XLA cache (BASELINE.md north star);
 - serving: prefill/decode tokens/s and MFU for the pushed model.
 
-Both timed legs alternate with settle pauses: the TPU tunnel on this rig is
-token-bucket shaped (a burst allowance, then a lower sustained rate), so
-back-to-back legs would hand whichever ran first an unearned advantage.
+Leg isolation (BENCH_r04 post-mortem): every TIMED leg runs in its own
+FRESH subprocess (``python bench.py --leg <kind> ...``). Measured on this
+rig, the TPU tunnel's throttle state is per-process and sticky — one
+process's link can sit collapsed 15-20x below another's — so in-process
+best-of-3 loops can record a number that says nothing about the code.
+Each child also probes the raw link AFTER its load (same process, still
+pre-first-execution), so every leg carries its own ceiling context. A
+collapsed-leg guard then rechecks the verdict: if the best loader leg
+still lost 4x to the baseline AND sat under 10% of the measured link, that
+leg reruns once more in another fresh process, and the JSON records which
+legs were retried (``legs_retried``).
+
+Legs alternate with settle pauses: beyond the per-process state, the
+tunnel is token-bucket shaped (a burst allowance, then a lower sustained
+rate), so back-to-back legs would hand whichever ran first an unearned
+advantage; baseline-first ordering gives leftover credit to the
+reference's shape, not ours.
 
 Prints ONE JSON line; "value" stays registry->HBM GB/s (the BASELINE
 metric), extras carry the rest.
@@ -588,6 +602,66 @@ def measure_continuous(params: dict, mesh, decode_tps: float | None) -> dict:
         cb.close()
 
 
+def run_leg(kind: str, base: str, repo: str, workdir: str) -> dict:
+    """One timed leg in a FRESH subprocess (fresh per-process tunnel
+    throttle state — see module docstring). Returns the child's JSON."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    existing = os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ,
+               PYTHONPATH=here + (os.pathsep + existing if existing else ""))
+    env.pop("JAX_PLATFORMS", None)  # children use the real device
+    p = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--leg", kind, base, repo, workdir],
+        capture_output=True, text=True, env=env, timeout=900,
+    )
+    if p.returncode != 0:
+        raise RuntimeError(f"{kind} leg failed: {p.stderr[-2000:]}")
+    return json.loads(p.stdout.strip().splitlines()[-1])
+
+
+def leg_main(kind: str, base: str, repo: str, workdir: str) -> int:
+    """Child entry for one timed leg. Loads, then probes the raw link in
+    the SAME process (still pre-first-execution, so the probe reflects the
+    state the leg actually saw)."""
+    from modelx_tpu.client.client import Client
+
+    client = Client(base, quiet=True)
+    manifest = client.get_manifest(repo, "v1")
+    desc = next(b for b in manifest.blobs if b.name.endswith(".safetensors"))
+    size = desc.size
+
+    import jax
+
+    devices = jax.devices()
+    if kind == "baseline":
+        secs = run_baseline(base, repo, desc, workdir, devices)
+        print(json.dumps({
+            "seconds": round(secs, 3),
+            "link_gbps": round(probe_link_gbps(devices[0]), 3),
+        }))
+        return 0
+    from modelx_tpu import native
+    from modelx_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(f"dp={len(devices)}")
+    secs, src, stats = run_ours(
+        client, repo, desc, mesh, size,
+        quantize="int8" if kind == "int8" else None,
+    )
+    print(json.dumps({
+        "seconds": round(secs, 3),
+        "source": src,
+        "native": native.available(),
+        "bytes_fetched": stats.bytes_fetched,
+        "fetch_seconds": round(stats.fetch_seconds, 3),
+        "bytes_to_device": stats.bytes_to_device,
+        "fetch_width": stats.fetch_width,
+        "fetch_backoffs": stats.fetch_backoffs,
+        "link_gbps": round(probe_link_gbps(devices[0]), 3),
+    }))
+    return 0
+
+
 def main() -> None:
     workdir = tempfile.mkdtemp(prefix="modelx-bench-")
     settle_s = float(os.environ.get("BENCH_SETTLE_S", 8.0))
@@ -605,55 +679,76 @@ def main() -> None:
         build_checkpoint(ttft_ckpt, 48 * 1024 * 1024, hidden=512, inter=1408, vocab=8192)
         push_checkpoint(base, "library/ttft", ttft_ckpt)
 
-        # TTFT first and subprocess-per-run, BEFORE this process touches the
-        # device at all: executing any program collapses a process's
-        # host->device bandwidth ~15x on this rig's relay, so the deploy
-        # number must come from fresh processes and the loader legs below
-        # must run before this process's first execution (the serving legs).
+        # TTFT first and subprocess-per-run; like every timed leg below, the
+        # children own the device — this parent must not touch the TPU until
+        # all measured subprocesses are done.
         ttft = measure_ttft(base, "library/ttft", workdir)
 
-        import jax
-
-        from modelx_tpu import native
-        from modelx_tpu.dl.loader import load_safetensors
-        from modelx_tpu.dl.sharding import LLAMA_RULES
-        from modelx_tpu.dl.initializer import _blob_source
-        from modelx_tpu.parallel.mesh import make_mesh
-
-        devices = jax.devices()
-        device_kind = getattr(devices[0], "device_kind", str(devices[0]))
-        mesh = make_mesh(f"dp={len(devices)}")
-
-        # warm up the device transfer path so neither leg pays setup costs
-        link_gbps = probe_link_gbps(devices[0])
-
-        # alternate legs with settle pauses (token-bucket tunnel; see module
-        # docstring), baseline first = any leftover burst credit goes to the
-        # reference's shape, not ours
-        baseline_ts, ours_ts, engine_src = [], [], ""
-        fetch_stats, int8_ts = [], []
-        int8_stats = None
-        for _ in range(3):  # best-of-3: the tunnel throttles unpredictably
+        # alternate subprocess legs with settle pauses (token-bucket tunnel;
+        # see module docstring), baseline first = any leftover burst credit
+        # goes to the reference's shape, not ours
+        baseline_recs: list[dict] = []
+        ours_recs: list[dict] = []
+        int8_recs: list[dict] = []
+        for i in range(3):  # best-of-3: the tunnel throttles unpredictably
             time.sleep(settle_s)
-            baseline_ts.append(run_baseline(base, "library/bench", desc, workdir, devices))
+            baseline_recs.append(run_leg("baseline", base, "library/bench", workdir))
             time.sleep(settle_s)
-            s, engine_src, stats = run_ours(client, "library/bench", desc, mesh, size)
-            ours_ts.append(s)
-            fetch_stats.append(stats)
-            # int8 load leg inside the same loop + settles (one sample after
-            # the bandwidth-heavy legs would expose it alone to a drained
-            # burst bucket): the loader quantizes on the host, so HALF the
-            # bytes cross the link and the model decodes faster once
-            # resident (int8_decode_speedup below) — the deploy shape
-            # `--quantize int8` ships. Effective GB/s counts SOURCE bytes.
-            time.sleep(settle_s)
-            qs, _src, int8_stats = run_ours(
-                client, "library/bench", desc, mesh, size, quantize="int8"
+            ours_recs.append(run_leg("ours", base, "library/bench", workdir))
+            if i < 2:
+                # int8 deploy leg (2 samples): the loader quantizes on the
+                # host (native fused kernel), so HALF the bytes cross the
+                # link and the model decodes faster once resident
+                # (int8_decode_speedup below). Effective GB/s counts SOURCE
+                # bytes.
+                time.sleep(settle_s)
+                int8_recs.append(run_leg("int8", base, "library/bench", workdir))
+
+        legs_retried: list[str] = []
+
+        def best(recs: list[dict]) -> dict:
+            return min(recs, key=lambda r: r["seconds"])
+
+        def link_ceiling() -> float:
+            return max(
+                (r.get("link_gbps") or 0.0)
+                for r in baseline_recs + ours_recs + int8_recs
             )
-            int8_ts.append(qs)
-        ours_s, baseline_s = min(ours_ts), min(baseline_ts)
-        int8_s = min(int8_ts)
-        best_stats = fetch_stats[ours_ts.index(ours_s)]
+
+        # collapsed-leg guard (VERDICT r4): a leg that lost 4x to the
+        # same-round baseline AND sat under 10% of the rig's measured link
+        # is a throttled capture, not a code result — rerun it once in
+        # another fresh process and keep the best.
+        def collapsed(rec: dict, baseline_gbps: float) -> bool:
+            gbps = size / rec["seconds"] / 1e9
+            link = link_ceiling()
+            return gbps < 0.25 * baseline_gbps and (
+                not link or gbps < 0.10 * link
+            )
+
+        base_gbps = size / best(baseline_recs)["seconds"] / 1e9
+        if base_gbps < 0.10 * link_ceiling():
+            # the baseline itself collapsed: an inflated ratio would flatter
+            # us dishonestly — rerun the baseline too
+            time.sleep(settle_s)
+            baseline_recs.append(run_leg("baseline", base, "library/bench", workdir))
+            legs_retried.append("baseline")
+            base_gbps = size / best(baseline_recs)["seconds"] / 1e9
+        if collapsed(best(ours_recs), base_gbps):
+            time.sleep(settle_s)
+            ours_recs.append(run_leg("ours", base, "library/bench", workdir))
+            legs_retried.append("ours")
+        if collapsed(best(int8_recs), base_gbps):
+            time.sleep(settle_s)
+            int8_recs.append(run_leg("int8", base, "library/bench", workdir))
+            legs_retried.append("int8")
+
+        ours_s = best(ours_recs)["seconds"]
+        baseline_s = best(baseline_recs)["seconds"]
+        int8_s = best(int8_recs)["seconds"]
+        best_rec = best(ours_recs)
+        int8_rec = best(int8_recs)
+        link_gbps = link_ceiling()
 
         multitenant = measure_multitenant(base, "library/bench", desc, size)
         multitenant.update(
@@ -670,6 +765,19 @@ def main() -> None:
             multitenant["mt_redirect_aggregate_gbps"]
             >= 0.9 * multitenant["mt_single_gbps"]
         )
+
+        # the measured subprocesses are done: the parent may now touch the
+        # device for the serving legs (its own link state no longer matters)
+        import jax
+
+        from modelx_tpu.dl.loader import load_safetensors
+        from modelx_tpu.dl.sharding import LLAMA_RULES
+        from modelx_tpu.dl.initializer import _blob_source
+        from modelx_tpu.parallel.mesh import make_mesh
+
+        devices = jax.devices()
+        device_kind = getattr(devices[0], "device_kind", str(devices[0]))
+        mesh = make_mesh(f"dp={len(devices)}")
 
         # serving: load once more (cheap assert it still works), reuse arrays
         source = _blob_source(client, "library/bench", desc)
@@ -718,24 +826,33 @@ def main() -> None:
             "bytes": size,
             "seconds": round(ours_s, 3),
             "baseline_seconds": round(baseline_s, 3),
-            "seconds_runs": [round(t, 3) for t in ours_ts],
-            "baseline_seconds_runs": [round(t, 3) for t in baseline_ts],
+            "seconds_runs": [round(r["seconds"], 3) for r in ours_recs],
+            "baseline_seconds_runs": [round(r["seconds"], 3) for r in baseline_recs],
+            # every timed leg ran in its own fresh subprocess; the guard
+            # reruns collapsed captures once (see module docstring)
+            "leg_isolation": "subprocess",
+            "legs_retried": legs_retried,
+            # per-leg link probes (same process as the leg, post-load):
+            # the ceiling each leg actually had
+            "leg_link_gbps": [r.get("link_gbps") for r in ours_recs],
             # decomposition of the winning leg: aggregate fetch-thread rate
             # vs bytes that crossed the host->device link (fetch and
             # transfer overlap, so the pieces don't sum to wall time)
             "fetch_gbps": round(
-                best_stats.bytes_fetched / max(best_stats.fetch_seconds, 1e-9) / 1e9, 3
+                best_rec["bytes_fetched"] / max(best_rec["fetch_seconds"], 1e-9) / 1e9, 3
             ),
-            "fetch_thread_seconds": round(best_stats.fetch_seconds, 3),
-            "bytes_to_device": best_stats.bytes_to_device,
+            "fetch_thread_seconds": best_rec["fetch_seconds"],
+            "bytes_to_device": best_rec["bytes_to_device"],
+            "fetch_width": best_rec.get("fetch_width"),
+            "fetch_backoffs": best_rec.get("fetch_backoffs"),
             # int8 deploy leg: same source checkpoint, half the link bytes
             "int8_load_seconds": round(int8_s, 3),
             "int8_load_gbps_effective": round(size / int8_s / 1e9, 3),
             "int8_vs_baseline": round(baseline_s / int8_s, 3),
-            "int8_bytes_to_device": int8_stats.bytes_to_device,
+            "int8_bytes_to_device": int8_rec["bytes_to_device"],
             "link_gbps": round(link_gbps, 3),
             "link_utilization": round(ours_gbps / link_gbps, 3) if link_gbps else None,
-            "engine": {"native": native.available(), "source": engine_src},
+            "engine": {"native": best_rec.get("native"), "source": best_rec.get("source")},
             **ttft,
             **multitenant,
             **serving,
@@ -750,4 +867,6 @@ def main() -> None:
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "--leg":
+        sys.exit(leg_main(sys.argv[2], sys.argv[3], sys.argv[4], sys.argv[5]))
     sys.exit(main())

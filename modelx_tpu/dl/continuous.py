@@ -13,6 +13,12 @@ scheduling (the vLLM/Orca idea), built the TPU way:
   requests; per-slot prompt lengths, decode depths, and sampling controls
   are traced VECTOR inputs, never shapes. Prefills compile per 16-bucketed
   prompt length, exactly like the stream/batcher paths.
+- **Paged KV (``page_size`` > 0)**: the per-layer state becomes a POOL of
+  fixed-size pages plus a host-managed block table, so HBM scales with
+  LIVE tokens instead of ``max_slots x max_len`` — slot count can grow
+  (32+) without a quadratic HBM bill, admissions reserve their span's
+  pages up front (waiting FIFO when the pool is full), retirements recycle
+  them. Still one compiled chunk program: the table is a traced input.
 - **Admission = prefill into a fresh [1, S] cache + one
   dynamic_update_slice of that cache into the slot's rows.** The running
   batch never re-prefills, and the prefill cost is one [S]-length row copy
@@ -49,17 +55,33 @@ from modelx_tpu.utils import trace
 _DONE = object()  # end-of-stream sentinel on per-request output queues
 
 
+class _Ticket:
+    """One submitted request: its output queue + a cancellation flag.
+    ``cancel()`` (idempotent, any thread) tells the engine the consumer is
+    gone — the row's slot frees at the next chunk boundary instead of
+    decoding to its full budget into a queue nobody drains (ADVICE r4)."""
+
+    __slots__ = ("out", "cancelled")
+
+    def __init__(self) -> None:
+        self.out: "queue.Queue" = queue.Queue()
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
 class _Row:
     """One admitted request row bound to a slot."""
 
-    __slots__ = ("slot", "budget", "emitted", "out", "skip", "stops", "closed")
+    __slots__ = ("slot", "budget", "emitted", "ticket", "skip", "stops", "closed")
 
-    def __init__(self, slot: int, budget: int, out: "queue.Queue",
+    def __init__(self, slot: int, budget: int, ticket: _Ticket,
                  stops: frozenset = frozenset()) -> None:
         self.slot = slot
         self.budget = budget
         self.emitted = 0
-        self.out = out
+        self.ticket = ticket
         # the chunk scan emits each step's ENTRY carry token, so a freshly
         # admitted row's first chunk re-emits the prefill token the
         # admission already delivered — skip it once
@@ -68,6 +90,10 @@ class _Row:
         # set by delivery on a stop hit (value-dependent, so it lags the
         # value-independent plan by <= 1 chunk); plan retires closed rows
         self.closed = False
+
+    @property
+    def out(self) -> "queue.Queue":
+        return self.ticket.out
 
 
 class ContinuousBatcher:
@@ -81,7 +107,8 @@ class ContinuousBatcher:
     """
 
     def __init__(self, server, max_slots: int = 8, chunk_size: int = 8,
-                 max_len: int = 0, prefix_cache=None) -> None:
+                 max_len: int = 0, prefix_cache=None, page_size: int = 0,
+                 max_live_tokens: int = 0) -> None:
         if server.family.decode_fns is None:
             raise ValueError(f"family {server.family.name} has no cached decode")
         self.server = server
@@ -94,9 +121,48 @@ class ContinuousBatcher:
         self._fwd, self._init_cache = server.family.decode_fns(
             server.cfg, mesh=server.mesh
         )
-        # engine-owned device state: the big cache (donated through every
-        # program so HBM holds exactly one copy) + last-token vector
-        self._cache = self._init_cache(self.max_slots, self.max_len)
+        # -- paged KV (page_size > 0): HBM scales with LIVE tokens ----------
+        # The dense engine state is [max_slots, max_len] per layer whether a
+        # slot is used or not, so slot count multiplies straight into HBM.
+        # Paged mode replaces it with a POOL of fixed-size pages
+        # ([num_pages, page_size, ...] per layer) plus a host-managed block
+        # table [max_slots, max_len/page_size]: each admission reserves
+        # exactly the pages its prompt+budget span needs and returns them at
+        # retirement, so 32 slots cost the pool's token budget, not
+        # 32 x max_len. Page 0 is a TRASH page no slot owns: idle table
+        # entries point there, so idle rows' writes land harmlessly and
+        # their reads sit beyond the causal horizon (the dense engine's
+        # idle-row trick, relocated). One chunk program serves every mix of
+        # lengths — the table is a traced input, never a shape.
+        self.page_size = int(page_size)
+        if self.page_size > 0:
+            if self.max_len % self.page_size:
+                raise ValueError(
+                    f"max_len {self.max_len} must be a multiple of "
+                    f"page_size {self.page_size}"
+                )
+            budget = int(max_live_tokens) or max(
+                self.max_len + self.chunk_size + self.page_size,
+                self.max_slots * self.max_len // 4,
+            )
+            self.num_pages = 1 + -(-budget // self.page_size)  # +1: trash
+            self._pages_per_slot = self.max_len // self.page_size
+            self._free_pages = list(range(1, self.num_pages))
+            self._table = np.zeros(
+                (self.max_slots, self._pages_per_slot), np.int32
+            )
+            self._row_pages: dict[int, list[int]] = {}  # slot -> owned pages
+            self._cache = jax.tree_util.tree_map(
+                lambda leaf: jnp.zeros(
+                    (self.num_pages, self.page_size) + leaf.shape[2:], leaf.dtype
+                ),
+                self._init_cache(1, self.page_size),
+            )
+        else:
+            self.num_pages = 0
+            # engine-owned device state: the big cache (donated through
+            # every program so HBM holds exactly one copy)
+            self._cache = self._init_cache(self.max_slots, self.max_len)
         self._tok = jnp.zeros((self.max_slots, 1), jnp.int32)
         # host-side per-slot state (tiny vectors, traced as inputs)
         self._offsets = np.zeros(self.max_slots, np.int32)
@@ -115,52 +181,86 @@ class ContinuousBatcher:
         # two-call prefill-then-insert shape would double admission latency.
         # Without a prefix cache the scratch KV stays internal (no output
         # buffer materialized just to be dropped on the host).
-        if prefix_cache is None:
-            def _admit_nosmall(params, prompt, cache, tok, row_len, slot,
-                               temp, top_k, top_p, seed):
-                cache, tok, first, _small = self._admit_impl(
-                    params, prompt, cache, tok, row_len, slot,
-                    temp, top_k, top_p, seed,
-                )
-                return cache, tok, first
+        if self.page_size > 0:
+            if prefix_cache is None:
+                def _admit_paged_nosmall(params, prompt, pool, tok, row_len,
+                                         slot, page_ids, temp, top_k, top_p, seed):
+                    pool, tok, first, _small = self._admit_paged_impl(
+                        params, prompt, pool, tok, row_len, slot, page_ids,
+                        temp, top_k, top_p, seed,
+                    )
+                    return pool, tok, first
 
-            self._admit_prog = jax.jit(_admit_nosmall, donate_argnums=(2, 3))
+                self._admit_prog = jax.jit(_admit_paged_nosmall, donate_argnums=(2, 3))
+            else:
+                self._admit_prog = jax.jit(
+                    self._admit_paged_impl, donate_argnums=(2, 3)
+                )
+            self._admit_cached_prog = jax.jit(
+                self._admit_cached_paged_impl, static_argnums=(13,),
+                donate_argnums=(2, 3),
+            )
+            self._chunk = jax.jit(self._chunk_paged_impl, donate_argnums=(1, 2))
         else:
-            self._admit_prog = jax.jit(self._admit_impl, donate_argnums=(2, 3))
-        # prefix-hit variant: stored KV rides in as an argument (never
-        # donated — the cache entry outlives the admission); trim_len is
-        # static so stored entries stay bucketed to the PROMPT's bucket
-        # (entries must not grow by a bucket per conversation turn)
-        self._admit_cached_prog = jax.jit(
-            self._admit_cached_impl, static_argnums=(12,), donate_argnums=(2, 3)
-        )
-        self._chunk = jax.jit(self._chunk_impl, donate_argnums=(1, 2))
+            if prefix_cache is None:
+                def _admit_nosmall(params, prompt, cache, tok, row_len, slot,
+                                   temp, top_k, top_p, seed):
+                    cache, tok, first, _small = self._admit_impl(
+                        params, prompt, cache, tok, row_len, slot,
+                        temp, top_k, top_p, seed,
+                    )
+                    return cache, tok, first
+
+                self._admit_prog = jax.jit(_admit_nosmall, donate_argnums=(2, 3))
+            else:
+                self._admit_prog = jax.jit(self._admit_impl, donate_argnums=(2, 3))
+            # prefix-hit variant: stored KV rides in as an argument (never
+            # donated — the cache entry outlives the admission); trim_len is
+            # static so stored entries stay bucketed to the PROMPT's bucket
+            # (entries must not grow by a bucket per conversation turn)
+            self._admit_cached_prog = jax.jit(
+                self._admit_cached_impl, static_argnums=(12,), donate_argnums=(2, 3)
+            )
+            self._chunk = jax.jit(self._chunk_impl, donate_argnums=(1, 2))
 
         self._q: "queue.Queue" = queue.Queue()
+        # FIFO admission backlog: items popped from the queue while no slot
+        # was free wait HERE (in arrival order) — re-putting them at the
+        # back of the queue would let later arrivals jump them under slot
+        # contention (ADVICE r4)
+        self._waiting: list = []
         self._closed = False
         self._broken: BaseException | None = None
         self._close_lock = threading.Lock()
         self.stats = {"chunks": 0, "admitted": 0, "active_peak": 0}
+        if self.page_size > 0:
+            self.stats["page_size"] = self.page_size
+            self.stats["pages_total"] = self.num_pages - 1  # excl. trash
+            self.stats["pages_free"] = len(self._free_pages)
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
     # -- compiled programs ----------------------------------------------------
 
-    def _finish_admit(self, small, logits, cache, tok, last_idx, slot,
-                      temp, top_k, top_p, seed):
-        """Shared admit tail: sample the row's first token (step 0 of its
-        sample stream, matching ragged/stream decode byte-for-byte) and
-        insert the scratch cache + token into ``slot`` of the donated
-        engine state. Returns (cache, tok, first, small) — ``small`` goes
-        back to the host for the prefix cache."""
+    def _sample_first(self, logits, last_idx, temp, top_k, top_p, seed):
+        """The row's first token: step 0 of its sample stream, matching
+        ragged/stream decode byte-for-byte."""
         from modelx_tpu.ops import sampling as sampling_ops
 
         idx = jnp.broadcast_to(last_idx[:, None, None], (1, 1, logits.shape[-1]))
         last = jnp.take_along_axis(logits, idx, axis=1)[:, 0, :]
-        first = sampling_ops.sample(
+        return sampling_ops.sample(
             last.astype(jnp.float32), jax.random.PRNGKey(0), temp,
             top_k=top_k, top_p=top_p, seeds=seed, step=0,
         )
+
+    def _finish_admit(self, small, logits, cache, tok, last_idx, slot,
+                      temp, top_k, top_p, seed):
+        """Shared admit tail: sample the row's first token and insert the
+        scratch cache + token into ``slot`` of the donated engine state.
+        Returns (cache, tok, first, small) — ``small`` goes back to the
+        host for the prefix cache."""
+        first = self._sample_first(logits, last_idx, temp, top_k, top_p, seed)
 
         def put(big, little):
             return jax.lax.dynamic_update_slice(
@@ -170,6 +270,63 @@ class ContinuousBatcher:
         cache = jax.tree_util.tree_map(put, cache, small)
         tok = jax.lax.dynamic_update_slice(tok, first[:, None], (slot, 0))
         return cache, tok, first, small
+
+    def _finish_admit_paged(self, small, logits, pool, tok, last_idx, slot,
+                            page_ids, temp, top_k, top_p, seed, span: int):
+        """Paged admit tail: sample the first token, then write the scratch
+        cache's first ``span`` rows into the slot's reserved pages. ``span``
+        is STATIC (the prompt bucket / trim length), so the write unrolls
+        to ceil(span/page_size) dynamic_update_slices — compiled once per
+        prompt bucket, exactly like the prefill itself."""
+        first = self._sample_first(logits, last_idx, temp, top_k, top_p, seed)
+        tok = jax.lax.dynamic_update_slice(tok, first[:, None], (slot, 0))
+        ps = self.page_size
+
+        def write(pool_leaf, small_leaf):
+            out = pool_leaf
+            for j in range(0, span, ps):
+                # the final block may be a partial page (span need not be a
+                # page multiple): the page's tail stays junk past every
+                # query position until decode overwrites it
+                blk = jax.lax.slice_in_dim(small_leaf, j, min(j + ps, span), axis=1)
+                out = jax.lax.dynamic_update_slice(
+                    out, blk, (page_ids[j // ps],) + (0,) * (out.ndim - 1)
+                )
+            return out
+
+        pool = jax.tree_util.tree_map(write, pool, small)
+        return pool, tok, first, small
+
+    def _admit_paged_impl(self, params, prompt, pool, tok, row_len, slot,
+                          page_ids, temp, top_k, top_p, seed):
+        """Paged admission: prefill into a [1, Sb] scratch cache, then the
+        paged admit tail (pages instead of a slot-row insert)."""
+        small = self._init_cache(1, prompt.shape[1])
+        logits, small = self._fwd(params, prompt, kv_cache=small, cache_offset=0)
+        return self._finish_admit_paged(
+            small, logits, pool, tok, row_len - 1, slot, page_ids,
+            temp, top_k, top_p, seed, span=prompt.shape[1],
+        )
+
+    def _admit_cached_paged_impl(self, params, suffix, pool, tok, suffix_len,
+                                 plen, slot, stored, page_ids, temp, top_k,
+                                 top_p, seed, trim_len: int):
+        """Prefix-hit paged admission: stored KV + suffix prefill (the
+        dense cached-admit's semantics, see _admit_cached_impl), written
+        out page by page."""
+        sb = suffix.shape[1]
+        small = jax.tree_util.tree_map(
+            lambda s: jnp.concatenate(
+                [s, jnp.zeros((1, sb) + s.shape[2:], s.dtype)], axis=1
+            ),
+            stored,
+        )
+        logits, small = self._fwd(params, suffix, kv_cache=small, cache_offset=plen)
+        small = jax.tree_util.tree_map(lambda c: c[:, :trim_len], small)
+        return self._finish_admit_paged(
+            small, logits, pool, tok, suffix_len - 1, slot, page_ids,
+            temp, top_k, top_p, seed, span=trim_len,
+        )
 
     def _admit_impl(self, params, prompt, cache, tok, row_len, slot,
                     temp, top_k, top_p, seed):
@@ -229,13 +386,103 @@ class ContinuousBatcher:
         )
         return cache, tok, toks.T  # [max_slots, chunk_size]
 
+    def _chunk_paged_impl(self, params, pool, tok, table, offsets, steps,
+                          temp, top_k, top_p, seeds):
+        """Paged chunk: each step gathers every slot's pages into a dense
+        [max_slots, max_len] view (a TRANSIENT the scheduler frees layer by
+        layer — the persistent state is only the pool), runs the family
+        forward against it unchanged, then scatters the one row each slot
+        wrote back into its current page. Idle slots' table rows are all
+        zeros, so their writes land on the trash page and their reads sit
+        beyond the causal horizon. The table is a traced input: one
+        compiled program serves every page assignment."""
+        from modelx_tpu.ops import sampling as sampling_ops
+
+        ps = self.page_size
+
+        def step_fn(carry, _i):
+            pool, tok, offsets, steps = carry
+            dense = jax.tree_util.tree_map(
+                lambda p: p[table].reshape(
+                    self.max_slots, self.max_len, *p.shape[2:]
+                ),
+                pool,
+            )
+            logits, dense = self._fwd(params, tok, kv_cache=dense, cache_offset=offsets)
+            page_idx = jnp.take_along_axis(table, (offsets // ps)[:, None], axis=1)[:, 0]
+            off_in = offsets % ps
+
+            def put_back(p, d):
+                rows = jax.vmap(
+                    lambda row, o: jax.lax.dynamic_slice_in_dim(row, o, 1, axis=0)
+                )(d, offsets)  # [slots, 1, ...] — the row each slot wrote
+                # exclusive page ownership makes the scatter collision-free
+                # (idle slots all hit the trash page — garbage over garbage)
+                return p.at[page_idx, off_in].set(rows[:, 0])
+
+            pool = jax.tree_util.tree_map(put_back, pool, dense)
+            nxt = sampling_ops.sample(
+                logits[:, -1, :].astype(jnp.float32), jax.random.PRNGKey(0), temp,
+                top_k=top_k, top_p=top_p, seeds=seeds, step=steps,
+            )
+            return (pool, nxt[:, None], offsets + 1, steps + 1), tok[:, 0]
+
+        (pool, tok, offsets, steps), toks = jax.lax.scan(
+            step_fn, (pool, tok, offsets, steps), jnp.arange(self.chunk_size)
+        )
+        return pool, tok, toks.T  # [max_slots, chunk_size]
+
     # -- engine loop ----------------------------------------------------------
 
+    def _need_pages(self, ids, n: int) -> int:
+        """Pages covering the row's full write span (prompt bucket + budget
+        + the chunk-overrun margin — the same ``need`` submit validates)."""
+        need = pad_seq_len(len(ids)) + n + self.chunk_size
+        return -(-need // self.page_size)
+
+    def _admits_now(self, item) -> bool:
+        """A free slot — and, in paged mode, enough free pages for the
+        item's whole span (reserved up front so a mid-decode pool
+        exhaustion cannot strand a half-decoded row)."""
+        if not self._free:
+            return False
+        if self.page_size > 0 and not item[3].cancelled:
+            if self._need_pages(item[0], item[1]) > len(self._free_pages):
+                return False
+        return True
+
+    def _release_slot(self, slot: int) -> None:
+        """Return a retired row's slot (and, paged, its pages) to the free
+        sets. Table zeroing points the slot's entries back at the trash
+        page; the chunk possibly still in flight dispatched with a
+        SNAPSHOT of the table, so reuse stays data-ordered."""
+        self._free.append(slot)
+        self._offsets[slot] = 0
+        if self.page_size > 0:
+            self._free_pages.extend(self._row_pages.pop(slot, ()))
+            self._table[slot, :] = 0
+            self.stats["pages_free"] = len(self._free_pages)
+
     def _admit(self, item) -> None:
-        ids, n, samp, out = item
+        ids, n, samp, ticket = item
+        if ticket.cancelled:  # consumer left while the request queued
+            ticket.out.put(_DONE)
+            return
         stops = frozenset(samp.get("stop_token_ids") or ())
         slot = self._free.pop()
         s = len(ids)
+        prompt_pages = None
+        if self.page_size > 0:
+            # reserve the row's WHOLE span now; the admit program only
+            # writes the prompt-bucket pages, decode fills the rest
+            need_pages = self._need_pages(ids, n)
+            pages = [self._free_pages.pop() for _ in range(need_pages)]
+            self._row_pages[slot] = pages
+            self._table[slot, :] = 0
+            self._table[slot, :need_pages] = pages
+            self.stats["pages_free"] = len(self._free_pages)
+            n_prompt = -(-pad_seq_len(s) // self.page_size)
+            prompt_pages = jnp.asarray(pages[:n_prompt], jnp.int32)
         temp = np.asarray([samp.get("temperature", 0.0)], np.float32)
         k_val = int(samp.get("top_k", 0))
         p_val = float(samp.get("top_p", 1.0))
@@ -254,20 +501,35 @@ class ContinuousBatcher:
             sb = pad_seq_len(len(suffix))
             block = np.zeros((1, sb), np.int32)
             block[0, : len(suffix)] = suffix
-            self._cache, self._tok, first, small = self._admit_cached_prog(
-                self.server.params, jnp.asarray(block), self._cache, self._tok,
-                jnp.asarray([len(suffix)], np.int32), jnp.int32(plen),
-                jnp.int32(slot), stored, temp, top_k, top_p, seed,
-                pad_seq_len(s),
-            )
+            if self.page_size > 0:
+                self._cache, self._tok, first, small = self._admit_cached_prog(
+                    self.server.params, jnp.asarray(block), self._cache,
+                    self._tok, jnp.asarray([len(suffix)], np.int32),
+                    jnp.int32(plen), jnp.int32(slot), stored, prompt_pages,
+                    temp, top_k, top_p, seed, pad_seq_len(s),
+                )
+            else:
+                self._cache, self._tok, first, small = self._admit_cached_prog(
+                    self.server.params, jnp.asarray(block), self._cache, self._tok,
+                    jnp.asarray([len(suffix)], np.int32), jnp.int32(plen),
+                    jnp.int32(slot), stored, temp, top_k, top_p, seed,
+                    pad_seq_len(s),
+                )
         else:
             pad_s = pad_seq_len(s)
             prompt = np.zeros((1, pad_s), np.int32)
             prompt[0, :s] = ids
-            admitted = self._admit_prog(
-                self.server.params, jnp.asarray(prompt), self._cache, self._tok,
-                jnp.asarray([s], np.int32), jnp.int32(slot), temp, top_k, top_p, seed,
-            )
+            if self.page_size > 0:
+                admitted = self._admit_prog(
+                    self.server.params, jnp.asarray(prompt), self._cache,
+                    self._tok, jnp.asarray([s], np.int32), jnp.int32(slot),
+                    prompt_pages, temp, top_k, top_p, seed,
+                )
+            else:
+                admitted = self._admit_prog(
+                    self.server.params, jnp.asarray(prompt), self._cache, self._tok,
+                    jnp.asarray([s], np.int32), jnp.int32(slot), temp, top_k, top_p, seed,
+                )
             if self.prefix_cache is None:
                 self._cache, self._tok, first = admitted
                 small = None
@@ -285,7 +547,7 @@ class ContinuousBatcher:
         self._top_p[slot] = p_val
         self._seeds[slot] = seed[0]
         self._use_filters[slot] = filters
-        row = _Row(slot, n, out, stops=stops)
+        row = _Row(slot, n, ticket, stops=stops)
         # the prefill's first token is delivered ASYNC (with the next
         # delivery batch): syncing here would serialize a full dispatch
         # round-trip per admission, where dispatching N prefills
@@ -294,7 +556,7 @@ class ContinuousBatcher:
         done = row.emitted >= row.budget
         self._first_pending.append((row, first, done))
         if done:
-            self._free.append(slot)
+            self._release_slot(slot)
         else:
             self._rows[slot] = row
         self.stats["admitted"] += 1
@@ -317,13 +579,17 @@ class ContinuousBatcher:
             # mutates the originals (retirement resets, next admissions)
             # possibly BEFORE the in-flight chunk reads them — each dispatch
             # gets private snapshots nobody mutates
-            self._cache, self._tok, toks_dev = self._chunk(
-                self.server.params, self._cache, self._tok,
+            args = [
                 jnp.asarray(self._offsets.copy()), jnp.asarray(self._steps.copy()),
                 jnp.asarray(self._temp.copy()),
                 jnp.asarray(self._top_k.copy()) if filtered else None,
                 jnp.asarray(self._top_p.copy()) if filtered else None,
                 jnp.asarray(self._seeds.copy()),
+            ]
+            if self.page_size > 0:
+                args.insert(0, jnp.asarray(self._table.copy()))
+            self._cache, self._tok, toks_dev = self._chunk(
+                self.server.params, self._cache, self._tok, *args
             )
         self.stats["chunks"] += 1
         self._offsets += self.chunk_size
@@ -338,8 +604,7 @@ class ContinuousBatcher:
             if done:  # slot reuse is safe: a re-admission's cache insert is
                 # data-ordered after the in-flight chunk's writes
                 del self._rows[slot]
-                self._free.append(slot)
-                self._offsets[slot] = 0  # idle rows write harmlessly at 0
+                self._release_slot(slot)  # idle rows write harmlessly at 0
         return toks_dev, plan
 
     def _deliver_firsts(self) -> None:
@@ -348,6 +613,10 @@ class ContinuousBatcher:
         them), so N admissions pay one round-trip, not N."""
         firsts, self._first_pending = self._first_pending, []
         for row, first, done in firsts:
+            if row.ticket.cancelled:  # consumer gone: free the slot, no put
+                row.out.put(_DONE)
+                row.closed = True
+                continue
             first_np = np.asarray(first).reshape(1, 1)
             row.out.put(first_np)
             if row.stops and int(first_np[0, 0]) in row.stops and not done:
@@ -366,6 +635,12 @@ class ContinuousBatcher:
         for slot, row, skip, take, done in plan:
             if row.closed:
                 continue  # stop token already ended the row (and its queue)
+            if row.ticket.cancelled:
+                # client disconnected mid-stream: stop piling tokens into a
+                # queue nobody drains; the sweep frees the slot next round
+                row.out.put(_DONE)
+                row.closed = True
+                continue
             piece = toks[slot : slot + 1, skip : skip + take] if take > 0 else None
             if piece is not None and row.stops:
                 from modelx_tpu.models.decode import stop_cut
@@ -382,26 +657,37 @@ class ContinuousBatcher:
                 row.out.put(_DONE)
 
     def _sweep_closed(self) -> None:
-        """Free the slots of rows a stop token ended at delivery time —
-        BEFORE admission and the next dispatch, so a waiting request takes
-        the slot immediately and no dead-row chunk is dispatched."""
+        """Free the slots of rows a stop token ended at delivery time or a
+        client abandoned (ticket.cancelled) — BEFORE admission and the next
+        dispatch, so a waiting request takes the slot immediately and no
+        dead-row chunk is dispatched."""
         for slot, row in list(self._rows.items()):
+            if row.ticket.cancelled and not row.closed:
+                row.out.put(_DONE)  # unblock any racing drain
+                row.closed = True
             if row.closed:
                 del self._rows[slot]
-                self._free.append(slot)
-                self._offsets[slot] = 0
+                self._release_slot(slot)
 
     def _loop(self) -> None:
         pending: tuple | None = None  # depth-1 pipeline: one chunk in flight
         try:
             while True:
                 self._sweep_closed()
-                # admit everything waiting (up to free slots); block only
-                # when fully idle with nothing in flight AND no admitted
-                # row still owed its (async) first token — a lone budget-1
-                # request admits, frees its slot, and would otherwise hang
-                # its waiter by blocking here before _deliver_firsts runs
+                # admit everything waiting (up to free slots), FIFO: the
+                # backlog of earlier arrivals that found no slot goes first.
+                # Block on the queue only when fully idle with nothing in
+                # flight AND no admitted row still owed its (async) first
+                # token — a lone budget-1 request admits, frees its slot,
+                # and would otherwise hang its waiter by blocking here
+                # before _deliver_firsts runs
                 while True:
+                    if self._waiting:
+                        if not self._admits_now(self._waiting[0]):
+                            break  # still contended: decode on, retry later
+                        with trace.span("continuous.admit"):
+                            self._admit(self._waiting.pop(0))
+                        continue
                     block = (not self._rows and pending is None
                              and not self._first_pending)
                     try:
@@ -413,10 +699,11 @@ class ContinuousBatcher:
                         self._deliver(pending)
                         self._fail_active(RuntimeError("continuous batcher closed"))
                         return
-                    if not self._free:
-                        # no slot free: requeue and decode on — a retire
-                        # this chunk frees a slot for it
-                        self._q.put(item)
+                    if not self._admits_now(item):
+                        # no slot (or, paged, not enough free pages): hold in
+                        # the FIFO backlog and decode on — a retire this
+                        # chunk frees capacity for it
+                        self._waiting.append(item)
                         break
                     with trace.span("continuous.admit"):
                         self._admit(item)
@@ -450,17 +737,23 @@ class ContinuousBatcher:
         for row in self._rows.values():
             row.out.put(err)
         self._rows.clear()
+        for item in self._waiting:  # FIFO backlog items have waiters too
+            item[3].out.put(err)
+        self._waiting.clear()
         while True:
             try:
                 item = self._q.get_nowait()
             except queue.Empty:
                 return
             if item is not None:
-                item[3].put(err)
+                item[3].out.put(err)
 
     # -- public API -----------------------------------------------------------
 
-    def submit_row(self, ids: list[int], max_new_tokens: int, samp: dict) -> "queue.Queue":
+    def submit(self, ids: list[int], max_new_tokens: int, samp: dict) -> _Ticket:
+        """Enqueue one prompt row; the returned ticket carries the output
+        queue and a ``cancel()`` the transport calls when its client goes
+        away (the engine then frees the slot at the next chunk boundary)."""
         s = len(ids)
         if s < 1:
             raise ValueError("empty prompt row")
@@ -472,7 +765,13 @@ class ContinuousBatcher:
                 f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds the "
                 f"engine's max_len {self.max_len} (margin {self.chunk_size})"
             )
-        out: "queue.Queue" = queue.Queue()
+        if self.page_size > 0 and self._need_pages(ids, max_new_tokens) > self.num_pages - 1:
+            raise ValueError(
+                f"prompt ({s}) + max_new_tokens ({max_new_tokens}) needs more "
+                f"pages than the engine's pool holds "
+                f"({self.num_pages - 1} x {self.page_size} tokens)"
+            )
+        ticket = _Ticket()
         with self._close_lock:
             if self._closed:
                 raise RuntimeError("continuous batcher closed")
@@ -481,8 +780,11 @@ class ContinuousBatcher:
                 # its final queue drain — a put here either precedes the
                 # drain (and gets failed by it) or raises
                 raise RuntimeError("continuous batcher is broken") from self._broken
-            self._q.put((list(ids), int(max_new_tokens), dict(samp), out))
-        return out
+            self._q.put((list(ids), int(max_new_tokens), dict(samp), ticket))
+        return ticket
+
+    def submit_row(self, ids: list[int], max_new_tokens: int, samp: dict) -> "queue.Queue":
+        return self.submit(ids, max_new_tokens, samp).out
 
     def _drain_row(self, out: "queue.Queue") -> Iterator[np.ndarray]:
         while True:
@@ -545,14 +847,20 @@ class ContinuousBatcher:
         tokens = np.asarray(tokens, np.int32)
         if tokens.shape[0] != 1:
             raise ValueError("continuous stream is single-row")
-        out = self.submit_row(
+        ticket = self.submit(
             tokens[0].tolist(), max_new_tokens,
             {"temperature": temperature, "top_k": top_k, "top_p": top_p,
              "seed": seed, "stop_token_ids": list(stop_token_ids or ())},
         )
-        for piece in self._drain_row(out):
-            self.server.stats["tokens_generated"] += int(piece.size)
-            yield piece
+        try:
+            for piece in self._drain_row(ticket.out):
+                self.server.stats["tokens_generated"] += int(piece.size)
+                yield piece
+        finally:
+            # a consumer that stops early (client disconnect closes the
+            # generator) cancels the row so its slot frees at the next
+            # chunk boundary; after a full drain this is a no-op
+            ticket.cancel()
 
     def close(self) -> None:
         with self._close_lock:

@@ -40,7 +40,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from modelx_tpu.dl import safetensors as st
 from modelx_tpu.dl.sharding import Rules, sharding_for
 
-DEFAULT_FETCH_CONCURRENCY = 16
+DEFAULT_FETCH_CONCURRENCY = 0  # 0 = auto (auto_fetch_concurrency)
 FETCH_RETRIES = 3  # per-shard retry budget (SURVEY §5: loader retries per shard)
 # packed-transfer default: OFF. Small tensors CAN ride one concatenated
 # uint8 buffer + on-device bitcast (pack_threshold>0), but measured on a
@@ -83,17 +83,81 @@ class _ByteBudget:
 
 
 def _read_with_retry(source: "ByteSource", offset: int, length: int, out=None,
-                     retries: int = FETCH_RETRIES):
+                     retries: int = FETCH_RETRIES, slept=None):
     """Ranged read with exponential backoff — a transient fetch error must
     not kill a multi-hundred-shard load (mirrors the reference's per-part
-    retry x3, extension_s3.go:133-148)."""
+    retry x3, extension_s3.go:133-148). ``slept`` (a 1-element list)
+    accumulates backoff sleep so callers timing the read can exclude it —
+    the fetch governor must judge transfer throughput, not retry waits."""
     for attempt in range(retries):
         try:
             return source.read_range(offset, length, out)
         except OSError:
             if attempt == retries - 1:
                 raise
-            time.sleep(0.2 * (2 ** attempt))
+            delay = 0.2 * (2 ** attempt)
+            if slept is not None:
+                slept[0] += delay
+            time.sleep(delay)
+
+
+def auto_fetch_concurrency(source) -> int:
+    """Fetch width derived from the HOST, not a constant (BENCH_r04: a
+    hard-coded 16 local-file fetchers + the transfer pool thrashed a 1-core
+    host to 25 MB/s aggregate — 6.5x WORSE than one sequential stream).
+
+    Local files: pread from page cache is memcpy-bound, so width beyond a
+    couple of threads per core only adds scheduler churn; 2/core, max 8.
+    HTTP: threads block on sockets (native path holds no GIL), so width
+    buys round-trip overlap — 4/core in [8, 16]."""
+    cpu = os.cpu_count() or 1
+    if isinstance(source, LocalFileSource):
+        return max(2, min(8, 2 * cpu))
+    return max(8, min(16, 4 * cpu))
+
+
+class _FetchGovernor:
+    """Admission gate for fetch reads that HALVES its width when measured
+    per-thread throughput collapses (the r4 failure signature: local reads
+    at ~1.5 MB/s per thread while the same file streams at 1+ GB/s). Width
+    only shrinks — a governor that grows again would oscillate against the
+    scheduler conditions that caused the collapse. Gating happens per READ,
+    so shrinking takes effect mid-load without tearing down pool threads."""
+
+    def __init__(self, width: int, floor_bps: float, min_width: int = 2) -> None:
+        self.width = max(1, int(width))
+        self.floor_bps = float(floor_bps)
+        self.min_width = min(min_width, self.width)
+        self._cv = threading.Condition()
+        self._active = 0
+        self._bytes = 0
+        self._busy_s = 0.0
+        self.backoffs = 0  # observability: how often the governor fired
+
+    def acquire(self) -> None:
+        with self._cv:
+            while self._active >= self.width:
+                self._cv.wait()
+            self._active += 1
+
+    def release(self, nbytes: int, seconds: float) -> None:
+        with self._cv:
+            self._active -= 1
+            self._bytes += nbytes
+            self._busy_s += seconds
+            if self.floor_bps and self._busy_s >= 0.25:
+                # per-busy-thread-second rate: busy seconds sum across
+                # threads, so this is throughput per active thread
+                if (
+                    self._bytes / self._busy_s < self.floor_bps
+                    and self.width > self.min_width
+                ):
+                    self.width = max(self.min_width, self.width // 2)
+                    self.backoffs += 1
+                # decay: recent reads dominate the next verdict
+                self._bytes //= 2
+                self._busy_s /= 2
+            self._cv.notify_all()
 
 
 class ByteSource(Protocol):
@@ -188,9 +252,16 @@ class HTTPSource:
                 from modelx_tpu.client.remote import insecure_default
 
                 if insecure_default():  # CLI --insecure covers ranged loads too
+                    # NB set_insecure/Client(insecure=True) is PROCESS-WIDE
+                    # (documented in docs/api.md): every source built after
+                    # the flag flips skips verification. Public-API context
+                    # construction, not ssl's private helper.
                     import ssl
 
-                    kwargs["context"] = ssl._create_unverified_context()
+                    ctx = ssl.create_default_context()
+                    ctx.check_hostname = False
+                    ctx.verify_mode = ssl.CERT_NONE
+                    kwargs["context"] = ctx
                 conn = http.client.HTTPSConnection(
                     self._host, self._port, timeout=300, **kwargs
                 )
@@ -300,6 +371,8 @@ class LoadStats:
     tensors: int = 0
     fetch_seconds: float = 0.0
     total_seconds: float = 0.0
+    fetch_width: int = 0  # governor's final width (== initial when healthy)
+    fetch_backoffs: int = 0  # times the governor halved the width
 
     @property
     def gbps(self) -> float:
@@ -444,6 +517,10 @@ def load_safetensors(
 
     ``tensors``/``data_offset`` come from the manifest annotation when
     available; otherwise the header is fetched with two small ranged reads.
+    ``concurrency`` <= 0 (the default) derives the fetch width from the
+    host and source type (auto_fetch_concurrency), and a governor halves
+    the ACTIVE width mid-load if per-thread throughput collapses
+    (_FetchGovernor — thrash protection for small-core hosts).
     ``dtype`` optionally casts on the host before transfer (halves PCIe bytes
     when serving bf16 from an f32 checkpoint). ``transfer_concurrency``
     bounds concurrent host->device dispatches (0 = auto: 8, or 2 per local
@@ -473,6 +550,29 @@ def load_safetensors(
         tensors = st.parse_header(bytes(_read_with_retry(source, 8, hlen)))
         data_offset = 8 + hlen
     tensors = fuse_expert_tensors(tensors, rules)
+
+    if concurrency <= 0:
+        concurrency = auto_fetch_concurrency(source)
+    # collapse floor: local page-cache reads under ~32 MB/s PER THREAD mean
+    # the threads are fighting the scheduler, not the disk (healthy is
+    # 300+ MB/s; the r4 collapse was 1.5 MB/s). HTTP sources skip the
+    # governor's floor — a genuinely slow remote link must not trigger a
+    # width collapse that makes it slower still.
+    governor = _FetchGovernor(
+        concurrency,
+        floor_bps=32e6 if isinstance(source, LocalFileSource) else 0.0,
+    )
+
+    def _gated_read(offset: int, length: int, out=None):
+        governor.acquire()
+        rt0 = time.monotonic()
+        slept = [0.0]
+        try:
+            return _read_with_retry(source, offset, length, out, slept=slept)
+        finally:
+            # exclude retry-backoff sleeps: a transient I/O hiccup must not
+            # read as a throughput collapse and permanently shed width
+            governor.release(length, max(0.0, time.monotonic() - rt0 - slept[0]))
 
     stats = LoadStats()
     lock = threading.Lock()
@@ -516,7 +616,7 @@ def load_safetensors(
             cached = _full_cache.get(info.name)
         if cached is not None:
             return cached
-        raw = _read_with_retry(source, data_offset + info.start, info.nbytes)
+        raw = _gated_read(data_offset + info.start, info.nbytes)
         with _full_lock:
             _full_cache[info.name] = raw
         return raw
@@ -534,7 +634,7 @@ def load_safetensors(
         if info.shape and inner_full:
             lead = full_spec[0]
             b0, b1 = st.row_range(info, lead.start, lead.stop)
-            raw = _read_with_retry(source, data_offset + b0, b1 - b0)
+            raw = _gated_read(data_offset + b0, b1 - b0)
             return _as_np(raw, np_dtype, (lead.stop - lead.start, *info.shape[1:])), b1 - b0
         raw = _cached_full_tensor(info)
         arr = _as_np(raw, np_dtype, info.shape)
@@ -609,8 +709,9 @@ def load_safetensors(
                 inner = full_spec[1].start == 0 and full_spec[1].stop == info.shape[1]
                 if inner:
                     # this group's rows are complete channels: local scales
-                    # ARE the global per-channel scales
-                    scale = qt.channel_scales(arr)
+                    # ARE the global per-channel scales — fused single-pass
+                    # quantize (native when available)
+                    arr, scale = qt.quantize_fused(arr)
                 else:
                     # input dim sharded: scales must span the full contraction
                     # axis — compute once from the cached full tensor
@@ -621,8 +722,10 @@ def load_safetensors(
                         scale_full = qt.channel_scales(full)
                         with _full_lock:
                             _scale_cache[info.name] = scale_full
-                    scale = scale_full[full_spec[0].start : full_spec[0].stop]
-                arr = qt.quantize_rows(arr, scale)
+                    scale = np.ascontiguousarray(
+                        scale_full[full_spec[0].start : full_spec[0].stop]
+                    )
+                    arr = qt.quantize_rows(arr, scale)
             elif dtype is not None and arr.dtype != np.dtype(dtype):
                 arr = arr.astype(dtype)
             if progress:
@@ -740,6 +843,8 @@ def load_safetensors(
 
     jax.block_until_ready(results)  # QTensor entries are pytrees
     stats.total_seconds = time.monotonic() - t0
+    stats.fetch_width = governor.width
+    stats.fetch_backoffs = governor.backoffs
     from modelx_tpu.utils import trace
 
     trace.tracer().record({

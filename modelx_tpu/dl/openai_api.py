@@ -410,8 +410,24 @@ def stream_completion(sset, req: dict, chat: bool) -> Iterator[dict]:
                 sent = cut[:safe]
             if hit_eos:
                 break  # the engine already stopped; flush the tail below
-        if finish != "stop" and text.startswith(sent) and text[len(sent):]:
-            yield content_event(text[len(sent):])  # flush the held-back tail
+        if finish != "stop":
+            if text.startswith(sent):
+                if text[len(sent):]:
+                    yield content_event(text[len(sent):])  # flush the held tail
+            elif text:
+                # the final re-decode DIVERGED from bytes already on the wire
+                # (an incomplete glyph slipped out before an EOS/stream end).
+                # The wire can't be retracted, so emit everything past the
+                # longest common prefix: content arrives complete (matching
+                # usage.completion_tokens) at the cost of one rewritten
+                # glyph region, instead of being silently dropped
+                lcp = 0
+                for a, b in zip(sent, text):
+                    if a != b:
+                        break
+                    lcp += 1
+                if text[lcp:]:
+                    yield content_event(text[lcp:])
         if eos_count and finish == "length":
             finish = "stop"
         yield {

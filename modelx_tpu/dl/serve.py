@@ -70,12 +70,64 @@ _EOS_CANDIDATES = (
 )
 
 
-class _Tokenizer:
-    """list[int]-in/str-out facade over a raw ``tokenizers.Tokenizer``."""
+def _eos_from_config(model_dir: str, tok) -> tuple[int, ...] | None:
+    """Explicit end-of-sequence ids from the checkpoint's sidecar configs
+    (pulled alongside the weights like tokenizer.json). Precedence follows
+    the HF convention: generation_config.json > config.json eos_token_id,
+    then tokenizer_config.json's eos_token spelling resolved through the
+    vocab. None = no explicit declaration (callers fall back to the
+    well-known-spelling probe). An explicit id beats the probe because
+    vocabs can carry probe spellings as NON-eos specials (e.g. chatml
+    models where <|endoftext|> is pad while <|im_end|> ends turns)."""
 
-    def __init__(self, tok) -> None:
+    def ids_from(val) -> tuple[int, ...] | None:
+        if isinstance(val, bool):
+            return None
+        if isinstance(val, int):
+            return (int(val),)
+        if (
+            isinstance(val, list) and val
+            and all(isinstance(v, int) and not isinstance(v, bool) for v in val)
+        ):
+            return tuple(dict.fromkeys(int(v) for v in val))
+        return None
+
+    for fname in ("generation_config.json", "config.json"):
+        path = os.path.join(model_dir, fname)
+        if not os.path.isfile(path):
+            continue
+        try:
+            with open(path, encoding="utf-8") as f:
+                got = ids_from(json.load(f).get("eos_token_id"))
+        except (OSError, ValueError):
+            continue  # malformed sidecar must not kill the tokenizer load
+        if got:
+            return got
+    path = os.path.join(model_dir, "tokenizer_config.json")
+    if os.path.isfile(path):
+        try:
+            with open(path, encoding="utf-8") as f:
+                eos = json.load(f).get("eos_token")
+        except (OSError, ValueError):
+            eos = None
+        if isinstance(eos, dict):  # added-token object form
+            eos = eos.get("content")
+        if isinstance(eos, str):
+            tid = tok.token_to_id(eos)
+            if tid is not None:
+                return (int(tid),)
+    return None
+
+
+class _Tokenizer:
+    """list[int]-in/str-out facade over a raw ``tokenizers.Tokenizer``.
+
+    ``eos_override``: explicit eos ids from the model's config sidecars
+    (_eos_from_config); when present the spelling probe is skipped."""
+
+    def __init__(self, tok, eos_override: tuple[int, ...] | None = None) -> None:
         self._tok = tok
-        self._eos: tuple[int, ...] | None = None
+        self._eos: tuple[int, ...] | None = eos_override
 
     def encode(self, text: str) -> list[int]:
         return self._tok.encode(text).ids
@@ -86,9 +138,11 @@ class _Tokenizer:
         return self._tok.decode(list(ids), skip_special_tokens=False)
 
     def eos_ids(self) -> tuple[int, ...]:
-        """End-of-sequence token ids, discovered from the vocab's
-        well-known spellings (tokenizer.json carries no explicit EOS
-        marker). Empty = unknown: callers then keep budget-only decode."""
+        """End-of-sequence token ids: the config sidecars' explicit
+        declaration when the model ships one, otherwise discovered from
+        the vocab's well-known spellings (tokenizer.json alone carries no
+        EOS marker). Empty = unknown: callers then keep budget-only
+        decode; ``ignore_eos`` is the per-request escape hatch."""
         if self._eos is None:
             ids = []
             for cand in _EOS_CANDIDATES:
@@ -369,8 +423,10 @@ class ModelServer:
                             import tokenizers  # rust core; loads in ms where
                             # transformers' wrapper costs a multi-second import
 
+                            raw = tokenizers.Tokenizer.from_file(path)
                             self._tokenizer = _Tokenizer(
-                                tokenizers.Tokenizer.from_file(path)
+                                raw,
+                                eos_override=_eos_from_config(self.model_dir, raw),
                             )
                         except Exception as e:
                             # NOT cached: a missing optional dep or transient
@@ -731,7 +787,8 @@ class ServerSet:
                  max_new_tokens_limit: int = DEFAULT_MAX_NEW_TOKENS_LIMIT,
                  continuous_batch: bool = False, max_slots: int = 8,
                  max_batch: int = 32, batch_window_ms: float = 3.0,
-                 stream_chunk_size: int = 8) -> None:
+                 stream_chunk_size: int = 8, kv_page_size: int = 0,
+                 kv_live_tokens: int = 0) -> None:
         if not servers:
             raise ValueError("no models")
         self.max_new_tokens_limit = max_new_tokens_limit
@@ -744,6 +801,11 @@ class ServerSet:
         self._dynamic_batch = dynamic_batch
         self._continuous_batch = continuous_batch
         self.max_slots = max_slots
+        # paged KV for the continuous engine: page_size > 0 switches the
+        # engine's per-layer state to a page pool sized by kv_live_tokens
+        # (see dl/continuous.py) — required for max_slots much beyond 8
+        self.kv_page_size = kv_page_size
+        self.kv_live_tokens = kv_live_tokens
         self.max_batch = max_batch
         self.batch_window_ms = batch_window_ms
         self.stream_chunk_size = stream_chunk_size
@@ -798,10 +860,30 @@ class ServerSet:
                 n_pos = getattr(server.cfg, "n_positions", 0) or 0
                 if n_pos:  # gpt2: positions past wpe silently clamp
                     max_len = min(max_len, n_pos)
+                page_size = self.kv_page_size
+                if page_size > 0 and max_len % page_size:
+                    # gpt2-style clamped max_len may not be a page multiple:
+                    # clamp max_len DOWN (losing < one page of context)
+                    # rather than degrading to an arbitrary tiny page size
+                    clamped = (max_len // page_size) * page_size
+                    if clamped <= 0:
+                        logger.warning(
+                            "kv_page_size %d exceeds max_len %d for %s; "
+                            "paged KV disabled", page_size, max_len, server.name,
+                        )
+                        page_size = 0
+                    else:
+                        logger.warning(
+                            "max_len %d -> %d for %s (kv_page_size %d multiple)",
+                            max_len, clamped, server.name, page_size,
+                        )
+                        max_len = clamped
                 cb = ContinuousBatcher(
                     server, max_slots=self.max_slots,
                     chunk_size=self.stream_chunk_size, max_len=max_len,
                     prefix_cache=server._prefix_cache,
+                    page_size=page_size,
+                    max_live_tokens=self.kv_live_tokens,
                 )
                 self.cbatchers[server.name] = cb
         return cb

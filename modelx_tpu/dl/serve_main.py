@@ -39,6 +39,14 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
 @click.option("--max-slots", default=8, type=int,
               help="continuous batching: concurrent decode slots (KV cache "
                    "rows held on device)")
+@click.option("--kv-page-size", default=0, type=int,
+              help="continuous batching: paged KV — the engine state becomes "
+                   "a pool of PAGE_SIZE-token pages + a block table, so HBM "
+                   "scales with live tokens instead of max_slots x "
+                   "max_seq_len (use with --max-slots 16+; 0 = dense)")
+@click.option("--kv-live-tokens", default=0, type=int,
+              help="paged KV: pool capacity in tokens (default "
+                   "max_slots x max_seq_len / 4)")
 @click.option("--max-batch", default=32, type=int,
               help="dynamic batching: max requests coalesced per device call")
 @click.option("--batch-window-ms", default=3.0, type=float,
@@ -66,6 +74,7 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
 def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen: str,
          max_seq_len: int, compile_cache: bool, concurrent_load: bool, trace_dir: str,
          dynamic_batch: bool, continuous_batch: bool, max_slots: int,
+         kv_page_size: int, kv_live_tokens: int,
          max_batch: int, batch_window_ms: float, stream_chunk_size: int,
          prefix_cache: int, quantize: str | None, speculative_k: int,
          loras: tuple[str, ...], drain_seconds: float) -> None:
@@ -131,7 +140,8 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
     sset = ServerSet(servers, trace_dir=trace_dir, dynamic_batch=dynamic_batch,
                      continuous_batch=continuous_batch, max_slots=max_slots,
                      max_batch=max_batch, batch_window_ms=batch_window_ms,
-                     stream_chunk_size=stream_chunk_size)
+                     stream_chunk_size=stream_chunk_size,
+                     kv_page_size=kv_page_size, kv_live_tokens=kv_live_tokens)
     httpd = serve(sset, listen=listen)  # starts serving 503s while loading
     stats = sset.load_all(concurrent=concurrent_load)
     logging.getLogger("modelx.serve").info("models loaded: %s", stats)

@@ -50,7 +50,7 @@ def build(force: bool = False) -> str | None:
     # per-process temp output so concurrent builds can't corrupt each other;
     # os.replace publishes atomically and last-writer-wins is fine (same src)
     tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-o", tmp, _SRC, "-ldl"]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-o", tmp, _SRC, "-ldl"]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _SO)
@@ -109,6 +109,17 @@ def lib() -> ctypes.CDLL | None:
             ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
         ]
         l.mx_http_get_range.restype = ctypes.c_int
+        try:
+            # a baked .so from an older build may predate this entry point;
+            # the quantize wrapper then falls back to numpy — the rest of
+            # the engine must keep working (degrade, don't raise)
+            l.mx_quantize_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ]
+            l.mx_quantize_rows.restype = ctypes.c_int
+        except AttributeError:
+            logger.debug("native quantize unavailable (stale .so)")
         _lib = l
         return _lib
 
@@ -183,6 +194,69 @@ def pread_scatter(path: str, ranges: list[tuple[int, int, memoryview]], threads:
     rc = l.mx_pread_scatter(path.encode(), arr, len(ranges), threads)
     if rc != 0:
         raise OSError(-rc, f"mx_pread_scatter({path}): {os.strerror(-rc)}")
+
+
+def _quant_dtype_code(dtype) -> int | None:
+    """mx_quantize_rows dtype code for a numpy dtype, or None (unsupported)."""
+    import numpy as np
+
+    if dtype == np.float32:
+        return 0
+    if dtype == np.float16:
+        return 2
+    try:
+        import ml_dtypes
+
+        if dtype == ml_dtypes.bfloat16:
+            return 1
+    except ImportError:
+        pass
+    return None
+
+
+def quantize_rows(arr, scales=None, want_q: bool = True, threads: int = 0):
+    """Fused rowwise int8 quantization of a 2-D float array, GIL-free.
+
+    Returns (q int8 [rows, cols] or None, scales f32 [rows]) — numerically
+    identical to ops/quant.py's numpy path — or None when the native engine
+    is unavailable or the dtype/layout is unsupported (callers fall back).
+    ``scales`` given = quantize with the caller's scales (sharded loads);
+    absent = compute them (absmax/127). ``want_q=False`` = scales only.
+    """
+    import numpy as np
+
+    l = lib()
+    if l is None or not hasattr(l, "mx_quantize_rows"):
+        return None
+    arr = np.asarray(arr)
+    if arr.ndim != 2:
+        return None
+    code = _quant_dtype_code(arr.dtype)
+    if code is None:
+        return None
+    if not arr.flags.c_contiguous:
+        return None
+    rows, cols = arr.shape
+    if rows == 0 or cols == 0:  # degenerate shapes keep the numpy semantics
+        return None
+    if threads <= 0:
+        threads = min(4, os.cpu_count() or 1)
+    q = np.empty((rows, cols), np.int8) if want_q else None
+    if scales is not None:
+        scales_arr = np.ascontiguousarray(scales, np.float32)
+        if scales_arr.shape != (rows,):
+            raise ValueError(f"scales shape {scales_arr.shape} != ({rows},)")
+        scales_in, scales_out = scales_arr.ctypes.data, None
+    else:
+        scales_arr = np.empty((rows,), np.float32)
+        scales_in, scales_out = None, scales_arr.ctypes.data
+    rc = l.mx_quantize_rows(
+        arr.ctypes.data, code, rows, cols, scales_in, scales_out,
+        q.ctypes.data if q is not None else None, threads,
+    )
+    if rc != 0:
+        raise OSError(-rc, f"mx_quantize_rows: {os.strerror(-rc)}")
+    return q, scales_arr
 
 
 class NativeHTTPConnection:

@@ -69,23 +69,59 @@ except (AttributeError, ValueError):  # older jax / double registration
     pass
 
 
+def _native_quant(w, scales=None, want_q: bool = True):
+    """The native fused kernel (modelx_io.cc mx_quantize_rows) when the
+    engine + dtype allow, else None. One GIL-free pass replaces several
+    numpy passes — decisive for bfloat16 sources, whose ml_dtypes ufuncs
+    are generic element loops (BENCH_r04: int8 host quantize cost more
+    than the link bytes it saved on a 1-core host)."""
+    try:
+        from modelx_tpu import native
+
+        return native.quantize_rows(w, scales=scales, want_q=want_q)
+    except ImportError:
+        return None
+
+
 def channel_scales(w: np.ndarray) -> np.ndarray:
     """Per-output-channel symmetric scale (f32 [out]) for an [out, in] weight."""
+    got = _native_quant(w, want_q=False)
+    if got is not None:
+        return got[1]
     w32 = np.asarray(w, np.float32)
     amax = np.max(np.abs(w32), axis=1)
     return (amax / 127.0 + (amax == 0)).astype(np.float32)  # avoid /0 for zero rows
 
 
 def quantize_rows(w: np.ndarray, scale: np.ndarray) -> np.ndarray:
-    """int8 rows of an [out_rows, in] slice given those rows' scales."""
+    """int8 rows of an [out_rows, in] slice given those rows' scales.
+    Multiplies by the f32 reciprocal (not a divide): bit-identical to the
+    native kernel, so sharded/native/fallback loads of the same checkpoint
+    produce the same q bytes."""
+    got = _native_quant(w, scales=scale)
+    if got is not None:
+        return got[0]
     w32 = np.asarray(w, np.float32)
-    return np.clip(np.rint(w32 / scale[:, None]), -127, 127).astype(np.int8)
+    inv = (np.float32(1.0) / np.asarray(scale, np.float32))[:, None]
+    return np.clip(np.rint(w32 * inv), -127, 127).astype(np.int8)
+
+
+def quantize_fused(w: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """(q int8, scales f32) in one pass over ``w`` when the rows' local
+    absmax IS the global per-channel scale (inner dims unsharded — the
+    loader's common case). Identical results to channel_scales +
+    quantize_rows, but the native path reads the source once."""
+    got = _native_quant(w)
+    if got is not None:
+        return got
+    scale = channel_scales(w)
+    return quantize_rows(w, scale), scale
 
 
 def quantize(w: np.ndarray) -> QTensor:
     """Host-side quantize of a full [out, in] weight (tests / serve-time)."""
-    scale = channel_scales(w)
-    return QTensor(q=jnp.asarray(quantize_rows(w, scale)), scale=jnp.asarray(scale))
+    q, scale = quantize_fused(np.ascontiguousarray(w))
+    return QTensor(q=jnp.asarray(q), scale=jnp.asarray(scale))
 
 
 def dequantize(t: QTensor, dtype=jnp.float32) -> jax.Array:

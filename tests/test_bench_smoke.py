@@ -88,3 +88,38 @@ class TestBenchSmoke:
             assert int(p.stdout.split()[1]) == blob.stat().st_size
         finally:
             srv.shutdown()
+
+    def test_leg_subprocess_roundtrip(self, tmp_path):
+        """The timed legs run as `bench.py --leg <kind>` children on the
+        driver's rig; each must load against a live registry and print one
+        JSON line with the fields the parent consumes (CPU backend here)."""
+        from bench import build_checkpoint, push_checkpoint, start_registry
+
+        import shutil
+
+        workdir = str(tmp_path)
+        ckpt = os.path.join(workdir, "model.safetensors")
+        build_checkpoint(ckpt, 1 << 20, hidden=64, inter=128, vocab=256)
+        srv, base = start_registry(workdir)
+        try:
+            push_checkpoint(base, "library/smoke", ckpt)
+            here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            env = dict(os.environ, PYTHONPATH=here, JAX_PLATFORMS="cpu")
+            for kind, fields in (
+                ("ours", ("seconds", "source", "fetch_width", "bytes_to_device", "link_gbps")),
+                ("baseline", ("seconds", "link_gbps")),
+                ("int8", ("seconds", "bytes_to_device")),
+            ):
+                p = subprocess.run(
+                    [sys.executable, os.path.join(here, "bench.py"),
+                     "--leg", kind, base, "library/smoke", workdir],
+                    capture_output=True, text=True, timeout=300, env=env,
+                )
+                assert p.returncode == 0, f"{kind}: {p.stderr[-1000:]}"
+                rec = json.loads(p.stdout.strip().splitlines()[-1])
+                for f in fields:
+                    assert f in rec, (kind, f, rec)
+                assert rec["seconds"] > 0
+        finally:
+            srv.terminate()
+            shutil.rmtree(os.path.join(workdir, "registry"), ignore_errors=True)

@@ -175,6 +175,62 @@ class TestScheduling:
         with pytest.raises(RuntimeError, match="closed"):
             cb.submit_row([1], 4, {})
 
+    def test_fifo_admission_under_slot_contention(self, server):
+        """Requests that find no free slot wait in ARRIVAL order — the old
+        requeue-at-the-back would admit the LATER arrival first each time
+        the queue was contended (ADVICE r4)."""
+        cb = ContinuousBatcher(server, max_slots=1, chunk_size=4)
+        try:
+            a = cb.submit([7, 7, 7], 48, {})
+            first = a.out.get(timeout=30)  # A holds the only slot
+            assert isinstance(first, np.ndarray)
+            b = cb.submit([1, 2], 4, {})
+            time.sleep(0.05)  # order the queue arrivals deterministically
+            c = cb.submit([3, 4], 4, {})
+            done: dict[str, float] = {}
+
+            def drain(name, t):
+                while True:
+                    item = t.out.get(timeout=60)
+                    if not isinstance(item, np.ndarray):
+                        done[name] = time.monotonic()
+                        return
+
+            tb = threading.Thread(target=drain, args=("b", b))
+            tc = threading.Thread(target=drain, args=("c", c))
+            tb.start()
+            tc.start()
+            drain("a", a)
+            tb.join(60)
+            tc.join(60)
+            assert done["b"] < done["c"], (
+                "later arrival was admitted before an earlier one"
+            )
+        finally:
+            cb.close()
+
+    def test_stream_close_cancels_row_and_frees_slot(self, server):
+        """Closing a stream generator mid-flight (client disconnect) cancels
+        the row: the slot frees at a chunk boundary instead of decoding the
+        full budget into a queue nobody drains (ADVICE r4)."""
+        cb = ContinuousBatcher(server, max_slots=1, chunk_size=4)
+        try:
+            gen = cb.stream(np.array([[5, 6]], np.int32), max_new_tokens=60)
+            next(gen)  # admitted and decoding
+            gen.close()  # GeneratorExit -> ticket.cancel()
+            deadline = time.monotonic() + 20
+            while cb._rows and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert not cb._rows, "cancelled row still holds its slot"
+            # the freed slot serves the next request promptly and exactly
+            t = np.array([[9, 1]], np.int32)
+            np.testing.assert_array_equal(
+                cb.generate(t, max_new_tokens=4),
+                server.generate(t, max_new_tokens=4),
+            )
+        finally:
+            cb.close()
+
 
 class TestServingIntegration:
     @pytest.fixture()

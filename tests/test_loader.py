@@ -373,3 +373,84 @@ class TestPackedTransfer:
         )
         q = arrays["model.layers.0.self_attn.q_proj.weight"]
         assert {s.data.shape for s in q.addressable_shards} == {(8, 16)}
+
+
+class TestAdaptiveFetchWidth:
+    """BENCH_r04 regression: fetch width must derive from the host, and the
+    governor must shed width when per-thread throughput collapses."""
+
+    def test_auto_concurrency_scales_with_host(self, tmp_path, monkeypatch):
+        import modelx_tpu.dl.loader as ldr
+
+        p = tmp_path / "f.bin"
+        p.write_bytes(b"x" * 64)
+        src = ldr.LocalFileSource(str(p))
+        try:
+            monkeypatch.setattr(ldr.os, "cpu_count", lambda: 1)
+            assert ldr.auto_fetch_concurrency(src) == 2  # not 16 on 1 core
+            monkeypatch.setattr(ldr.os, "cpu_count", lambda: 16)
+            assert ldr.auto_fetch_concurrency(src) == 8  # local cap
+        finally:
+            src.close()
+        http = ldr.HTTPSource("http://example.invalid/blob", total=64)
+        monkeypatch.setattr(ldr.os, "cpu_count", lambda: 1)
+        assert ldr.auto_fetch_concurrency(http) == 8
+        monkeypatch.setattr(ldr.os, "cpu_count", lambda: 8)
+        assert ldr.auto_fetch_concurrency(http) == 16
+
+    def test_governor_halves_width_on_collapse(self):
+        from modelx_tpu.dl.loader import _FetchGovernor
+
+        gov = _FetchGovernor(16, floor_bps=32e6, min_width=2)
+        # simulate reads at 1 MB/s per thread (the r4 collapse signature)
+        for _ in range(8):
+            gov.acquire()
+            gov.release(nbytes=1 << 20, seconds=1.0)
+        assert gov.width < 16
+        assert gov.backoffs >= 1
+        # keeps shedding down to the floor width, never below
+        for _ in range(64):
+            gov.acquire()
+            gov.release(nbytes=1 << 20, seconds=1.0)
+        assert gov.width == 2
+
+    def test_governor_keeps_width_when_healthy(self):
+        from modelx_tpu.dl.loader import _FetchGovernor
+
+        gov = _FetchGovernor(8, floor_bps=32e6)
+        for _ in range(32):  # 400 MB/s per thread: healthy page-cache reads
+            gov.acquire()
+            gov.release(nbytes=100 << 20, seconds=0.25)
+        assert gov.width == 8
+        assert gov.backoffs == 0
+
+    def test_governor_disabled_floor_never_fires(self):
+        from modelx_tpu.dl.loader import _FetchGovernor
+
+        gov = _FetchGovernor(16, floor_bps=0.0)  # HTTP sources: no floor
+        for _ in range(32):
+            gov.acquire()
+            gov.release(nbytes=1024, seconds=1.0)  # 1 KB/s would trip any floor
+        assert gov.width == 16
+
+    def test_load_reports_governor_stats(self, tmp_path):
+        """End-to-end: a local load records the width it ran at."""
+        import jax
+
+        from modelx_tpu.dl import safetensors as st_mod
+        from modelx_tpu.dl.loader import LocalFileSource, load_safetensors
+        from modelx_tpu.dl.sharding import LLAMA_RULES
+        from modelx_tpu.parallel.mesh import make_mesh
+
+        rng = np.random.RandomState(0)
+        tensors = {"model.embed_tokens.weight": rng.rand(64, 16).astype(np.float32)}
+        path = str(tmp_path / "m.safetensors")
+        st_mod.write_safetensors(path, tensors)
+        src = LocalFileSource(path)
+        try:
+            mesh = make_mesh(f"dp={len(jax.devices())}")
+            _loaded, stats = load_safetensors(src, mesh, LLAMA_RULES)
+        finally:
+            src.close()
+        assert stats.fetch_width >= 2
+        assert stats.fetch_backoffs == 0

@@ -266,3 +266,70 @@ class TestBakedSoFallback:
 
         monkeypatch.setattr(subprocess, "run", no_gxx)
         assert native.build() == native._SO
+
+
+class TestQuantizeRows:
+    """mx_quantize_rows: the fused int8 kernel must be bit-identical to
+    ops/quant.py's numpy fallback (same f32 reciprocal, same round-half-even)
+    so native and fallback loads of one checkpoint agree byte-for-byte."""
+
+    @pytest.fixture(autouse=True)
+    def _need_native(self):
+        from modelx_tpu import native
+
+        if not native.available():
+            pytest.skip("native engine unavailable")
+
+    def _numpy_ref(self, w):
+        w32 = np.asarray(w, np.float32)
+        amax = np.max(np.abs(w32), axis=1)
+        scale = (amax / 127.0 + (amax == 0)).astype(np.float32)
+        inv = (np.float32(1.0) / scale)[:, None]
+        q = np.clip(np.rint(w32 * inv), -127, 127).astype(np.int8)
+        return q, scale
+
+    def test_parity_all_dtypes(self):
+        import ml_dtypes
+
+        from modelx_tpu import native
+
+        rng = np.random.RandomState(7)
+        for dt in (np.float32, ml_dtypes.bfloat16, np.float16):
+            w = rng.randn(37, 129).astype(dt)
+            w[5] = 0  # all-zero row: scale pins to 1.0
+            ref_q, ref_s = self._numpy_ref(w)
+            q, s = native.quantize_rows(w)
+            np.testing.assert_array_equal(s, ref_s)
+            np.testing.assert_array_equal(q, ref_q)
+            # caller-provided scales (sharded loads)
+            q2, _ = native.quantize_rows(w, scales=ref_s)
+            np.testing.assert_array_equal(q2, ref_q)
+            # scales-only pass
+            q3, s3 = native.quantize_rows(w, want_q=False)
+            assert q3 is None
+            np.testing.assert_array_equal(s3, ref_s)
+            # threaded split must not change results
+            q4, s4 = native.quantize_rows(w, threads=4)
+            np.testing.assert_array_equal(q4, ref_q)
+            np.testing.assert_array_equal(s4, ref_s)
+
+    def test_half_integer_rounding(self):
+        """Values landing exactly on .5 boundaries take round-half-even,
+        matching np.rint (the magic-number rounding in quant1)."""
+        from modelx_tpu import native
+
+        w = (np.arange(-508, 508, dtype=np.float32).reshape(4, 254)) / 2.0
+        s_in = np.ones((4,), np.float32)
+        ref = np.clip(np.rint(w), -127, 127).astype(np.int8)
+        q, _ = native.quantize_rows(w, scales=s_in)
+        np.testing.assert_array_equal(q, ref)
+
+    def test_unsupported_shapes_fall_back(self):
+        from modelx_tpu import native
+
+        assert native.quantize_rows(np.zeros((3,), np.float32)) is None  # 1-D
+        assert native.quantize_rows(np.zeros((2, 2), np.int8)) is None  # int
+        assert native.quantize_rows(np.zeros((0, 4), np.float32)) is None
+        # non-contiguous views fall back rather than misread strides
+        base = np.zeros((4, 8), np.float32)
+        assert native.quantize_rows(base[:, ::2]) is None

@@ -417,6 +417,81 @@ class TestAutoEOS:
         tok2 = tokenizers.Tokenizer(tokenizers.models.WordLevel(vocab2, unk_token="<unk>"))
         assert _Tokenizer(tok2).eos_ids() == ()
 
+    def test_eos_override_from_config_sidecars(self, tmp_path):
+        """An explicit eos_token_id in the checkpoint's config sidecars
+        beats the spelling probe (ADVICE r4: chatml-style vocabs carry probe
+        spellings as NON-eos specials, e.g. <|endoftext|> as pad)."""
+        tokenizers = pytest.importorskip("tokenizers")
+        import json as _json
+        import os as _os
+
+        from modelx_tpu.dl.serve import _Tokenizer, _eos_from_config
+
+        vocab = {"<unk>": 0, "<|endoftext|>": 1, "<|im_end|>": 2}
+        tok = tokenizers.Tokenizer(tokenizers.models.WordLevel(vocab, unk_token="<unk>"))
+        d = str(tmp_path)
+        assert _eos_from_config(d, tok) is None  # no sidecars: probe rules
+        (tmp_path / "config.json").write_text(_json.dumps({"eos_token_id": 2}))
+        assert _eos_from_config(d, tok) == (2,)
+        # generation_config.json wins over config.json; int lists pass
+        (tmp_path / "generation_config.json").write_text(
+            _json.dumps({"eos_token_id": [2, 1]})
+        )
+        assert _eos_from_config(d, tok) == (2, 1)
+        # tokenizer_config's eos spelling resolves through the vocab
+        for f in ("config.json", "generation_config.json"):
+            _os.unlink(tmp_path / f)
+        (tmp_path / "tokenizer_config.json").write_text(
+            _json.dumps({"eos_token": "<|im_end|>"})
+        )
+        assert _eos_from_config(d, tok) == (2,)
+        # added-token object form
+        (tmp_path / "tokenizer_config.json").write_text(
+            _json.dumps({"eos_token": {"content": "<|im_end|>"}})
+        )
+        assert _eos_from_config(d, tok) == (2,)
+        # malformed sidecars / bool ids never raise, fall through
+        (tmp_path / "config.json").write_text("{broken")
+        (tmp_path / "generation_config.json").write_text(
+            _json.dumps({"eos_token_id": True})
+        )
+        assert _eos_from_config(d, tok) == (2,)
+        # the override short-circuits the probe in the facade
+        assert _Tokenizer(tok, eos_override=(2,)).eos_ids() == (2,)
+        # WITHOUT an override, the probe on this vocab would say {1, 2} —
+        # the chatml failure the override exists to prevent
+        assert set(_Tokenizer(tok).eos_ids()) == {1, 2}
+
+    def test_stream_divergent_final_flush_not_dropped(self):
+        """When the final re-decode no longer extends the bytes already on
+        the wire (split glyph before an EOS), the held-back remainder is
+        emitted past the longest common prefix instead of silently dropped
+        (ADVICE r4)."""
+        sset, _ = self._eos_sset([[[5]], [[6, 50]]], eos=(50,))
+        server = sset.servers["f"]
+
+        class DivergingTok:
+            def encode(self, text):
+                return [1, 2]
+
+            def decode(self, ids):
+                # one token decodes provisionally (trailing replacement
+                # char); the full sequence re-decodes to different text
+                if list(ids) == [5]:
+                    return "a�"
+                return "X rewritten"
+
+            def eos_ids(self):
+                return (50,)
+
+        server.tokenizer = lambda: DivergingTok()
+        text, finish, _ = self._collect(
+            sset, {"prompt": "x", "max_tokens": 8})
+        # "a" went out first (stable prefix); the divergent remainder must
+        # still arrive — content ends with the re-decoded tail
+        assert text.endswith("X rewritten")
+        assert finish == ["stop"]
+
     def _eos_sset(self, pieces, eos=(50,)):
         """TestStopStraddle's fake harness, with an EOS-aware tokenizer."""
         from types import SimpleNamespace

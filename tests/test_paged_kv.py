@@ -1,0 +1,238 @@
+"""Paged KV for the continuous engine (dl/continuous.py, page_size > 0).
+
+The exactness oracle is unchanged: a request decoded by the PAGED engine
+must yield byte-identical tokens to the plain paths. On top of that, the
+paged mode's contract: per-layer device state is a page pool (scales with
+the live-token budget, NOT max_slots x max_len), admissions reserve pages
+and wait FIFO when the pool is full, retirements recycle pages.
+
+VERDICT r4 item 2: "engine runs 32 slots on the gpt2 CPU tests without a
+[32, max_len] dense alloc; admission/chunk tests cover page recycling".
+"""
+
+import dataclasses
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from modelx_tpu.dl import safetensors as st
+from modelx_tpu.dl.continuous import ContinuousBatcher
+from modelx_tpu.dl.serve import ModelServer
+from modelx_tpu.models.decode import PrefixKVCache
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    from modelx_tpu.models import llama
+
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64), dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    d = tmp_path_factory.mktemp("paged")
+    st.write_safetensors(
+        str(d / "model.safetensors"), {k: np.asarray(v) for k, v in params.items()}
+    )
+    srv = ModelServer(str(d), mesh_spec="dp=1", dtype="float32", max_seq_len=96)
+    srv.load()
+    return srv
+
+
+@pytest.fixture(scope="module")
+def gpt2_server(tmp_path_factory):
+    from modelx_tpu.models import gpt2
+
+    cfg = gpt2.GPT2Config(
+        vocab_size=96, n_positions=128, hidden_size=64, num_layers=2,
+        num_heads=4, dtype=jnp.float32,
+    )
+    params = gpt2.init_params(cfg, jax.random.PRNGKey(1))
+    d = tmp_path_factory.mktemp("paged-gpt2")
+    st.write_safetensors(
+        str(d / "model.safetensors"), {k: np.asarray(v) for k, v in params.items()}
+    )
+    srv = ModelServer(str(d), mesh_spec="dp=1", dtype="float32", max_seq_len=128)
+    srv.load()
+    return srv
+
+
+class TestPagedExactness:
+    @pytest.fixture()
+    def engine(self, server):
+        cb = ContinuousBatcher(server, max_slots=4, chunk_size=4, page_size=16)
+        yield cb
+        cb.close()
+
+    def test_greedy_matches_plain(self, server, engine):
+        tokens = np.array([[5, 9, 2, 7, 1]], np.int32)
+        expected = server.generate(tokens, max_new_tokens=11)
+        got = engine.generate(tokens, max_new_tokens=11)
+        np.testing.assert_array_equal(got, expected)
+
+    def test_sampled_matches_plain(self, server, engine):
+        tokens = np.array([[3, 4, 5]], np.int32)
+        kw = dict(max_new_tokens=9, temperature=0.8, top_k=12, top_p=0.9, seed=41)
+        np.testing.assert_array_equal(
+            engine.generate(tokens, **kw), server.generate(tokens, **kw)
+        )
+
+    def test_concurrent_mixed_requests_match_solo(self, server, engine):
+        import concurrent.futures
+
+        reqs = [
+            (np.array([[1, 2, 3]], np.int32), 5, dict()),
+            (np.array([[9, 8, 7, 6, 5, 4, 3]], np.int32), 9,
+             dict(temperature=0.7, seed=3)),
+            (np.array([[11, 12]], np.int32), 3,
+             dict(temperature=1.1, top_p=0.8, seed=8)),
+            (np.array([[30]], np.int32), 1, dict()),
+            (np.array([[4, 4, 4, 4]], np.int32), 12,
+             dict(temperature=0.5, top_k=7, seed=5)),
+        ]
+        expected = [server.generate(t, max_new_tokens=n, **s) for t, n, s in reqs]
+        with concurrent.futures.ThreadPoolExecutor(len(reqs)) as pool:
+            got = list(pool.map(
+                lambda r: engine.generate(r[0], max_new_tokens=r[1], **r[2]), reqs
+            ))
+        for e, g in zip(expected, got):
+            np.testing.assert_array_equal(g, e)
+
+    def test_stream_concatenates_to_generate(self, server, engine):
+        tokens = np.array([[2, 4, 6]], np.int32)
+        pieces = list(engine.stream(tokens, max_new_tokens=10))
+        got = np.concatenate(pieces, axis=1)
+        expected = server.generate(tokens, max_new_tokens=10)[:, 3:]
+        np.testing.assert_array_equal(got, expected)
+
+    def test_stop_tokens_free_slot_early(self, server, engine):
+        """stop_token_ids semantics carry over to paged mode."""
+        tokens = np.array([[5, 9, 2]], np.int32)
+        full = server.generate(tokens, max_new_tokens=12)[0, 3:].tolist()
+        stop = full[4]
+        got = engine.generate(tokens, max_new_tokens=12, stop_token_ids=[stop])
+        gen = got[0, 3:].tolist()
+        cut = gen.index(stop)
+        assert gen[:cut + 1] == full[:full.index(stop) + 1]
+
+
+class TestPagedPool:
+    def test_32_slots_without_dense_alloc(self, gpt2_server):
+        """32 slots on the gpt2 model with a pool an eighth the dense size:
+        per-layer state must NOT be a [32, max_len] allocation."""
+        max_len, slots, ps = 128, 32, 16
+        cb = ContinuousBatcher(
+            gpt2_server, max_slots=slots, chunk_size=4, max_len=max_len,
+            page_size=ps, max_live_tokens=slots * max_len // 8,
+        )
+        try:
+            leaves = jax.tree_util.tree_leaves(cb._cache)
+            dense_rows = slots * max_len
+            for leaf in leaves:
+                pool_rows = leaf.shape[0] * leaf.shape[1]
+                assert pool_rows < dense_rows // 4, (
+                    f"pool leaf {leaf.shape} is not materially smaller than "
+                    f"the dense [{slots}, {max_len}] state"
+                )
+            # and it still serves correct tokens across many concurrent rows
+            import concurrent.futures
+
+            reqs = [np.array([[i % 90 + 1, (2 * i) % 90 + 1]], np.int32)
+                    for i in range(12)]
+            expected = [gpt2_server.generate(t, max_new_tokens=6) for t in reqs]
+            with concurrent.futures.ThreadPoolExecutor(12) as pool:
+                got = list(pool.map(
+                    lambda t: cb.generate(t, max_new_tokens=6), reqs
+                ))
+            for e, g in zip(expected, got):
+                np.testing.assert_array_equal(g, e)
+        finally:
+            cb.close()
+
+    def test_pages_recycled_after_retirement(self, server):
+        cb = ContinuousBatcher(
+            server, max_slots=4, chunk_size=4, page_size=16,
+            max_live_tokens=4 * 96 // 2,
+        )
+        try:
+            free0 = len(cb._free_pages)
+            assert cb.stats["pages_free"] == free0
+            for i in range(6):  # sequential requests reuse the same pages
+                t = np.array([[i + 1, i + 2, i + 3]], np.int32)
+                np.testing.assert_array_equal(
+                    cb.generate(t, max_new_tokens=5),
+                    server.generate(t, max_new_tokens=5),
+                )
+            deadline = time.monotonic() + 10
+            while cb._rows and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert len(cb._free_pages) == free0, "pages leaked across retirements"
+            assert not cb._row_pages
+        finally:
+            cb.close()
+
+    def test_admission_waits_for_pages_fifo(self, server):
+        """A pool sized for ~one request at a time: concurrent requests
+        must serialize on page availability and still return exact tokens
+        (nobody deadlocks, nobody reads another row's pages)."""
+        cb = ContinuousBatcher(
+            server, max_slots=4, chunk_size=4, page_size=16,
+            max_live_tokens=48,  # 3 pages + trash: one 16+24+4 request's worth
+        )
+        try:
+            import concurrent.futures
+
+            reqs = [np.array([[i + 1, i + 5]], np.int32) for i in range(4)]
+            expected = [server.generate(t, max_new_tokens=20) for t in reqs]
+            with concurrent.futures.ThreadPoolExecutor(4) as pool:
+                got = list(pool.map(
+                    lambda t: cb.generate(t, max_new_tokens=20), reqs
+                ))
+            for e, g in zip(expected, got):
+                np.testing.assert_array_equal(g, e)
+        finally:
+            cb.close()
+
+    def test_oversized_request_rejected(self, server):
+        cb = ContinuousBatcher(
+            server, max_slots=2, chunk_size=4, page_size=16, max_live_tokens=32
+        )
+        try:
+            with pytest.raises(ValueError, match="pages"):
+                cb.generate(np.array([[1, 2]], np.int32), max_new_tokens=60)
+        finally:
+            cb.close()
+
+    def test_bad_page_size_rejected(self, server):
+        with pytest.raises(ValueError, match="multiple"):
+            ContinuousBatcher(server, max_slots=2, max_len=96, page_size=13)
+
+
+class TestPagedPrefixCache:
+    def test_cached_admission_is_byte_exact(self, server):
+        """Prefix-cache hits ride the paged cached-admit program: the
+        resumed row must match an uncached decode exactly."""
+        pc = PrefixKVCache(capacity=4)
+        cb = ContinuousBatcher(
+            server, max_slots=4, chunk_size=4, page_size=16, prefix_cache=pc
+        )
+        try:
+            history = [7, 3, 9, 1]
+            t1 = np.array([history], np.int32)
+            np.testing.assert_array_equal(
+                cb.generate(t1, max_new_tokens=5),
+                server.generate(t1, max_new_tokens=5),
+            )
+            assert pc.stats()["entries"] >= 1
+            # second turn extends the stored prefix -> cached admit path
+            t2 = np.array([history + [4, 4, 2]], np.int32)
+            np.testing.assert_array_equal(
+                cb.generate(t2, max_new_tokens=7),
+                server.generate(t2, max_new_tokens=7),
+            )
+            assert pc.stats()["hits"] >= 1
+        finally:
+            cb.close()

@@ -131,3 +131,19 @@ class TestQuantizedServe:
         assert stats["load_bytes"] < full_bytes
         out = server.forward_argmax(np.array([[1, 2, 3]], np.int32))
         assert out.shape == (1, 3)
+
+
+class TestFusedQuantize:
+    def test_fused_matches_two_pass(self):
+        """quantize_fused (the loader's single-pass path, native when
+        available) must equal channel_scales + quantize_rows exactly."""
+        import ml_dtypes
+
+        from modelx_tpu.ops import quant as qt
+
+        rng = np.random.RandomState(3)
+        for dt in (np.float32, ml_dtypes.bfloat16):
+            w = rng.randn(33, 65).astype(dt)
+            q, s = qt.quantize_fused(w)
+            np.testing.assert_array_equal(s, qt.channel_scales(w))
+            np.testing.assert_array_equal(q, qt.quantize_rows(w, s))
