@@ -217,7 +217,7 @@ def run_baseline(base: str, repo: str, desc, workdir: str, devices) -> float:
 
 
 def measure_ttft(base: str, repo: str, workdir: str, runs: int = 5,
-                 int8_runs: int = 2) -> dict:
+                 int8_runs: int = 2, settle_s: float = 4.0) -> dict:
     """p50 registry->first-token (BASELINE north star), subprocess-per-run.
 
     Each run is a FRESH process (``python -m modelx_tpu.dl.ttft``) with the
@@ -255,6 +255,11 @@ def measure_ttft(base: str, repo: str, workdir: str, runs: int = 5,
 
     records = []
     for i in range(runs + 1):  # run 0 warms the persistent caches, unscored
+        # settle between children: the link's burst bucket is GLOBAL, and
+        # back-to-back fresh processes progressively drain it — without the
+        # pause, later runs measure the drained sustained rate and the
+        # median drifts up with run count rather than converging
+        time.sleep(settle_s)
         rec = run_once()
         if i > 0:
             records.append(rec)
@@ -274,7 +279,11 @@ def measure_ttft(base: str, repo: str, workdir: str, runs: int = 5,
         "ttft_weights_ready_ms": med("weights_ready_ms"),
     }
     if int8_runs > 0:
-        q_records = [run_once("int8") for _ in range(int8_runs + 1)][1:]
+        q_records = []
+        for _ in range(int8_runs + 1):
+            time.sleep(settle_s)
+            q_records.append(run_once("int8"))
+        q_records = q_records[1:]
         out["ttft_int8_ms"] = round(
             statistics.median(r["ttft_ms"] for r in q_records), 1
         )
@@ -682,7 +691,9 @@ def main() -> None:
         # TTFT first and subprocess-per-run; like every timed leg below, the
         # children own the device — this parent must not touch the TPU until
         # all measured subprocesses are done.
-        ttft = measure_ttft(base, "library/ttft", workdir)
+        # half the leg settle: the 48 MB TTFT children sip the burst bucket
+        # where the 512 MB legs gulp it, but BENCH_SETTLE_S must scale both
+        ttft = measure_ttft(base, "library/ttft", workdir, settle_s=settle_s / 2)
 
         # alternate subprocess legs with settle pauses (token-bucket tunnel;
         # see module docstring), baseline first = any leftover burst credit

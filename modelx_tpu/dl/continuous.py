@@ -74,10 +74,12 @@ class _Ticket:
 class _Row:
     """One admitted request row bound to a slot."""
 
-    __slots__ = ("slot", "budget", "emitted", "ticket", "skip", "stops", "closed")
+    __slots__ = ("slot", "budget", "emitted", "ticket", "skip", "stops",
+                 "closed", "seq", "greedy", "ngram", "ng_len", "tok_pending")
 
     def __init__(self, slot: int, budget: int, ticket: _Ticket,
-                 stops: frozenset = frozenset()) -> None:
+                 stops: frozenset = frozenset(), seq: list | None = None,
+                 greedy: bool = True) -> None:
         self.slot = slot
         self.budget = budget
         self.emitted = 0
@@ -90,6 +92,17 @@ class _Row:
         # set by delivery on a stop hit (value-dependent, so it lags the
         # value-independent plan by <= 1 chunk); plan retires closed rows
         self.closed = False
+        # speculation bookkeeping (engine speculative_k > 0): the row's
+        # full token history + a lazily built n-gram index over it
+        self.seq = seq
+        self.greedy = greedy
+        self.ngram = None
+        self.ng_len = 0
+        # True when the engine's tok vector holds this row's NEXT token,
+        # computed by a chunk but not yet delivered (chunks emit entry
+        # carries, so the freshest token always rides in tok). The spec
+        # step must emit it before verifying past it.
+        self.tok_pending = False
 
     @property
     def out(self) -> "queue.Queue":
@@ -108,13 +121,27 @@ class ContinuousBatcher:
 
     def __init__(self, server, max_slots: int = 8, chunk_size: int = 8,
                  max_len: int = 0, prefix_cache=None, page_size: int = 0,
-                 max_live_tokens: int = 0) -> None:
+                 max_live_tokens: int = 0, speculative_k: int = 0,
+                 max_ngram: int = 3) -> None:
         if server.family.decode_fns is None:
             raise ValueError(f"family {server.family.name} has no cached decode")
         self.server = server
         self.max_slots = int(max_slots)
         self.chunk_size = int(chunk_size)
         self.max_len = int(max_len) or int(server.max_seq_len)
+        # prompt-lookup speculation INSIDE the engine (speculative_k > 0):
+        # whenever exactly one greedy row is active, the loop swaps the
+        # chunk program for a [max_slots, k+1] verify step — propose k
+        # tokens from the row's own n-gram history, verify them in ONE
+        # device call, accept the agreeing prefix (token-exact by argmax
+        # determinism, like models/speculative.py). More than one active
+        # row (or a sampled one) falls back to pipelined chunks, where
+        # cross-row batching is the better use of each weight read.
+        self.speculative_k = int(speculative_k)
+        self.max_ngram = int(max_ngram)
+        # a verify block writes up to k+1 positions past a row's offset;
+        # the per-row cache span must cover whichever engine writes deepest
+        self._overrun = max(self.chunk_size, self.speculative_k + 1)
         # models/decode.PrefixKVCache: admissions whose prompt extends a
         # stored prefix prefill only the suffix (multi-turn chat fast path)
         self.prefix_cache = prefix_cache
@@ -180,48 +207,34 @@ class ContinuousBatcher:
         # on a tunneled device every call costs a host round-trip, so the
         # two-call prefill-then-insert shape would double admission latency.
         # Without a prefix cache the scratch KV stays internal (no output
-        # buffer materialized just to be dropped on the host).
-        if self.page_size > 0:
-            if prefix_cache is None:
-                def _admit_paged_nosmall(params, prompt, pool, tok, row_len,
-                                         slot, page_ids, temp, top_k, top_p, seed):
-                    pool, tok, first, _small = self._admit_paged_impl(
-                        params, prompt, pool, tok, row_len, slot, page_ids,
-                        temp, top_k, top_p, seed,
-                    )
-                    return pool, tok, first
+        # buffer materialized just to be dropped on the host). Dense and
+        # paged wire identically — only the impls (and the cached variant's
+        # extra page_ids arg before its static trim_len) differ.
+        paged = self.page_size > 0
+        admit_impl = self._admit_paged_impl if paged else self._admit_impl
+        if prefix_cache is None:
+            def _admit_nosmall(*args):
+                return admit_impl(*args)[:3]  # drop the scratch KV output
 
-                self._admit_prog = jax.jit(_admit_paged_nosmall, donate_argnums=(2, 3))
-            else:
-                self._admit_prog = jax.jit(
-                    self._admit_paged_impl, donate_argnums=(2, 3)
-                )
-            self._admit_cached_prog = jax.jit(
-                self._admit_cached_paged_impl, static_argnums=(13,),
-                donate_argnums=(2, 3),
-            )
-            self._chunk = jax.jit(self._chunk_paged_impl, donate_argnums=(1, 2))
+            self._admit_prog = jax.jit(_admit_nosmall, donate_argnums=(2, 3))
         else:
-            if prefix_cache is None:
-                def _admit_nosmall(params, prompt, cache, tok, row_len, slot,
-                                   temp, top_k, top_p, seed):
-                    cache, tok, first, _small = self._admit_impl(
-                        params, prompt, cache, tok, row_len, slot,
-                        temp, top_k, top_p, seed,
-                    )
-                    return cache, tok, first
-
-                self._admit_prog = jax.jit(_admit_nosmall, donate_argnums=(2, 3))
-            else:
-                self._admit_prog = jax.jit(self._admit_impl, donate_argnums=(2, 3))
-            # prefix-hit variant: stored KV rides in as an argument (never
-            # donated — the cache entry outlives the admission); trim_len is
-            # static so stored entries stay bucketed to the PROMPT's bucket
-            # (entries must not grow by a bucket per conversation turn)
-            self._admit_cached_prog = jax.jit(
-                self._admit_cached_impl, static_argnums=(12,), donate_argnums=(2, 3)
-            )
-            self._chunk = jax.jit(self._chunk_impl, donate_argnums=(1, 2))
+            self._admit_prog = jax.jit(admit_impl, donate_argnums=(2, 3))
+        # prefix-hit variant: stored KV rides in as an argument (never
+        # donated — the cache entry outlives the admission); trim_len is
+        # static so stored entries stay bucketed to the PROMPT's bucket
+        # (entries must not grow by a bucket per conversation turn)
+        self._admit_cached_prog = jax.jit(
+            self._admit_cached_paged_impl if paged else self._admit_cached_impl,
+            static_argnums=(13 if paged else 12,), donate_argnums=(2, 3),
+        )
+        self._chunk = jax.jit(
+            self._chunk_paged_impl if paged else self._chunk_impl,
+            donate_argnums=(1, 2),
+        )
+        self._spec_prog = jax.jit(
+            self._spec_verify_paged_impl if paged else self._spec_verify_impl,
+            donate_argnums=(1,),
+        )
 
         self._q: "queue.Queue" = queue.Queue()
         # FIFO admission backlog: items popped from the queue while no slot
@@ -432,12 +445,144 @@ class ContinuousBatcher:
         )
         return pool, tok, toks.T  # [max_slots, chunk_size]
 
+    # -- speculative verify (single-occupied greedy slot) ---------------------
+
+    def _spec_verify_impl(self, params, cache, block, offsets):
+        """One verify step over the engine's FULL slot array: ``block`` is
+        [max_slots, k+1] (the active slot carries last-token + proposals;
+        idle slots carry zeros whose writes land at their offset-0 garbage
+        rows). Returns the model's argmax at every position — position i is
+        its pick for the token AFTER block[:, :i+1]. Rejected positions
+        leave garbage KV; the host rewinds offsets past them, and the
+        causal mask (kpos <= qpos) hides them until overwritten."""
+        logits, cache = self._fwd(params, block, kv_cache=cache, cache_offset=offsets)
+        return cache, jnp.argmax(logits, axis=-1)  # [max_slots, k+1]
+
+    def _spec_verify_paged_impl(self, params, pool, block, table, offsets):
+        """Paged verify: gather -> forward -> scatter each of the k+1
+        written rows back to its page (static unroll over the block width,
+        like the admit tail's page writes)."""
+        ps = self.page_size
+        dense = jax.tree_util.tree_map(
+            lambda p: p[table].reshape(self.max_slots, self.max_len, *p.shape[2:]),
+            pool,
+        )
+        logits, dense = self._fwd(params, block, kv_cache=dense, cache_offset=offsets)
+        width = block.shape[1]
+
+        def put_back(p, d):
+            for j in range(width):
+                off = offsets + j
+                page_idx = jnp.take_along_axis(table, (off // ps)[:, None], axis=1)[:, 0]
+                rows = jax.vmap(
+                    lambda row, o: jax.lax.dynamic_slice_in_dim(row, o, 1, axis=0)
+                )(d, off)
+                p = p.at[page_idx, off % ps].set(rows[:, 0])
+            return p
+
+        pool = jax.tree_util.tree_map(put_back, pool, dense)
+        return pool, jnp.argmax(logits, axis=-1)
+
+    def _spec_ok(self) -> bool:
+        """Speculate iff exactly one greedy row is active and nothing is
+        waiting for a slot (admissions beat speculation — cross-row
+        batching uses each weight read better than lookahead does)."""
+        if self.speculative_k <= 0 or len(self._rows) != 1 or self._waiting:
+            return False
+        row = next(iter(self._rows.values()))
+        return (row.greedy and not row.closed and not row.ticket.cancelled
+                and row.seq is not None)
+
+    def _spec_step(self) -> None:
+        """Propose -> verify -> accept -> deliver, synchronously (the spec
+        regime trades the chunk pipeline's depth for fewer device steps per
+        token; it only runs when there is no other row to pipeline with).
+
+        Block convention: the engine invariant says the cache holds
+        [0, offsets) and ``tok`` carries the next token to CONSUME. After
+        admission that token (the prefill's first) is already delivered;
+        after a chunk it is the chunk's lookahead token, not yet delivered
+        (``row.tok_pending``) — the step emits it as part of this round's
+        piece. Either way the verify block is [that token, proposals...] at
+        the row's offset, exactly models/speculative.py's layout."""
+        from modelx_tpu.models.speculative import _NgramIndex
+
+        slot, row = next(iter(self._rows.items()))
+        prefix_emit: list[int] = []
+        if row.tok_pending:
+            # one host sync for the lookahead token's value: spec mode is
+            # synchronous anyway, and this happens only on the single
+            # chunk->spec transition, not per step
+            tok_val = int(np.asarray(self._tok)[slot, 0])
+            row.seq.append(tok_val)
+            prefix_emit = [tok_val]
+        else:
+            tok_val = row.seq[-1]
+        if row.ngram is None:
+            row.ngram = _NgramIndex(self.max_ngram)
+        row.ngram.extend(row.seq, row.ng_len)
+        row.ng_len = len(row.seq)
+        k = self.speculative_k
+        prop = row.ngram.propose(row.seq, k)
+        block = np.zeros((self.max_slots, k + 1), np.int32)
+        block[slot, 0] = tok_val
+        if prop:
+            block[slot, 1:1 + len(prop)] = prop
+        args = [jnp.asarray(block)]
+        if self.page_size > 0:
+            args.append(jnp.asarray(self._table.copy()))
+        args.append(jnp.asarray(self._offsets.copy()))
+        with trace.span("continuous.spec_verify", proposed=len(prop)):
+            self._cache, argm_dev = self._spec_prog(
+                self.server.params, self._cache, *args
+            )
+        argm = np.asarray(argm_dev)[slot]
+        self.stats["spec_steps"] = self.stats.get("spec_steps", 0) + 1
+        self.stats["spec_proposed"] = self.stats.get("spec_proposed", 0) + len(prop)
+        # accept while the model agrees, then its own token at the first
+        # disagreement (exactly models/speculative.py's greedy rule)
+        a = 0
+        while a < len(prop) and int(argm[a]) == prop[a]:
+            a += 1
+        room = row.budget - row.emitted
+        new = (prefix_emit + prop[:a] + [int(argm[a])])[:room]
+        verified = new[len(prefix_emit):]  # tokens the verify itself emitted
+        self.stats["spec_accepted"] = (
+            self.stats.get("spec_accepted", 0) + min(a, len(verified))
+        )
+        # rewind past rejected/padded positions; only verified history stays
+        self._offsets[slot] += a + 1
+        self._steps[slot] += a + 1
+        row.seq.extend(verified)
+        row.emitted += len(new)
+        # engine state for a possible fall-back to chunk mode: tok carries
+        # the row's last DELIVERED token, whose chunk-entry re-emission the
+        # skip swallows
+        tok_np = np.zeros((self.max_slots, 1), np.int32)
+        tok_np[slot, 0] = row.seq[-1]
+        self._tok = jnp.asarray(tok_np)
+        row.skip = 1
+        row.tok_pending = False
+        piece = np.asarray([new], np.int32)
+        done = row.emitted >= row.budget
+        if row.stops:
+            from modelx_tpu.models.decode import stop_cut
+
+            cut = stop_cut(new, row.stops)
+            if cut is not None:
+                piece = piece[:, :cut]
+                done = True
+        row.out.put(piece)
+        if done:
+            row.out.put(_DONE)
+            row.closed = True  # sweep frees the slot before the next step
+
     # -- engine loop ----------------------------------------------------------
 
     def _need_pages(self, ids, n: int) -> int:
         """Pages covering the row's full write span (prompt bucket + budget
-        + the chunk-overrun margin — the same ``need`` submit validates)."""
-        need = pad_seq_len(len(ids)) + n + self.chunk_size
+        + the overrun margin — the same ``need`` submit validates)."""
+        need = pad_seq_len(len(ids)) + n + self._overrun
         return -(-need // self.page_size)
 
     def _admits_now(self, item) -> bool:
@@ -547,7 +692,11 @@ class ContinuousBatcher:
         self._top_p[slot] = p_val
         self._seeds[slot] = seed[0]
         self._use_filters[slot] = filters
-        row = _Row(slot, n, ticket, stops=stops)
+        row = _Row(
+            slot, n, ticket, stops=stops,
+            seq=list(ids) if self.speculative_k > 0 else None,
+            greedy=float(samp.get("temperature", 0.0)) <= 0.0,
+        )
         # the prefill's first token is delivered ASYNC (with the next
         # delivery batch): syncing here would serialize a full dispatch
         # round-trip per admission, where dispatching N prefills
@@ -596,6 +745,9 @@ class ContinuousBatcher:
         self._steps += self.chunk_size
         plan = []
         for slot, row in list(self._rows.items()):
+            # the chunk's final carry is this row's next (undelivered)
+            # token — the spec step must emit it before verifying onward
+            row.tok_pending = True
             take = min(self.chunk_size - row.skip, row.budget - row.emitted)
             row.emitted += max(take, 0)
             done = row.emitted >= row.budget
@@ -618,6 +770,8 @@ class ContinuousBatcher:
                 row.closed = True
                 continue
             first_np = np.asarray(first).reshape(1, 1)
+            if row.seq is not None:
+                row.seq.append(int(first_np[0, 0]))
             row.out.put(first_np)
             if row.stops and int(first_np[0, 0]) in row.stops and not done:
                 row.out.put(_DONE)
@@ -642,6 +796,8 @@ class ContinuousBatcher:
                 row.closed = True
                 continue
             piece = toks[slot : slot + 1, skip : skip + take] if take > 0 else None
+            if piece is not None and row.seq is not None:
+                row.seq.extend(piece[0].tolist())
             if piece is not None and row.stops:
                 from modelx_tpu.models.decode import stop_cut
 
@@ -707,6 +863,19 @@ class ContinuousBatcher:
                         break
                     with trace.span("continuous.admit"):
                         self._admit(item)
+                if self._spec_ok():
+                    # single greedy row: switch to speculative verify steps
+                    # (fewer device steps per token beats pipeline depth
+                    # when there is nothing to pipeline WITH). Drain any
+                    # in-flight chunk + first tokens so the row's history
+                    # is complete, then run one verify round.
+                    self._deliver_firsts()
+                    self._deliver(pending)
+                    pending = None
+                    self._sweep_closed()  # a stop may just have closed it
+                    if self._spec_ok():
+                        self._spec_step()
+                    continue
                 nxt = self._dispatch_chunk() if self._rows else None
                 # both deliveries overlap the chunk just dispatched
                 self._deliver_firsts()
@@ -757,13 +926,14 @@ class ContinuousBatcher:
         s = len(ids)
         if s < 1:
             raise ValueError("empty prompt row")
-        # + chunk_size margin: the slot keeps writing to the end of its last
-        # chunk even past the budget; those positions must exist
-        need = pad_seq_len(s) + max_new_tokens + self.chunk_size
+        # + overrun margin: the slot keeps writing to the end of its last
+        # chunk (or speculative verify block) even past the budget; those
+        # positions must exist
+        need = pad_seq_len(s) + max_new_tokens + self._overrun
         if need > self.max_len:
             raise ValueError(
                 f"prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds the "
-                f"engine's max_len {self.max_len} (margin {self.chunk_size})"
+                f"engine's max_len {self.max_len} (margin {self._overrun})"
             )
         if self.page_size > 0 and self._need_pages(ids, max_new_tokens) > self.num_pages - 1:
             raise ValueError(

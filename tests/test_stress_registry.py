@@ -1,0 +1,131 @@
+"""Registry race/stress battery (SURVEY §5 'race detection' tooling row).
+
+The reference relies on `go test -race`; Python has no thread sanitizer, so
+this is the equivalent discipline: many threads hammering ONE store with the
+full mutation mix (blob pushes, manifest puts, index reads, GC) while
+invariants are asserted continuously and at the end. CI runs this module in
+a loop under PYTHONDEVMODE=1 (the `race-stress` job) so scheduler-dependent
+interleavings get many chances to bite; locally it's one quick pass.
+
+Invariants:
+- no operation raises (mutations are single-writer/atomic by design);
+- after the storm, every pushed manifest is in the index exactly once;
+- GC running CONCURRENTLY with pushes never deletes a blob that any
+  manifest referenced (the grace window's whole purpose);
+- the index rebuild converges to the true manifest set.
+"""
+
+import io
+import threading
+import time
+
+import pytest
+
+from modelx_tpu.registry.fs import LocalFSProvider, MemoryFSProvider
+from modelx_tpu.registry.gc import gc_blobs
+from modelx_tpu.registry.store import BlobContent
+from modelx_tpu.registry.store_fs import FSRegistryStore
+from modelx_tpu.types import Descriptor, Digest, Manifest
+
+REPO = "library/stress"
+
+
+@pytest.fixture(params=["memory", "local"])
+def store(request, tmp_path):
+    if request.param == "memory":
+        return FSRegistryStore(MemoryFSProvider())
+    return FSRegistryStore(LocalFSProvider(str(tmp_path)))
+
+
+def _push_one(store, i: int) -> Descriptor:
+    data = b"payload-%d" % i
+    digest = str(Digest.from_bytes(data))
+    store.put_blob(
+        REPO, digest,
+        BlobContent(io.BytesIO(data), len(data), "application/octet-stream"),
+    )
+    desc = Descriptor(name=f"blob{i}.bin", digest=digest, size=len(data),
+                      modified="2026-01-01T00:00:00Z")
+    store.put_manifest(REPO, f"v{i}", "", Manifest(blobs=[desc]))
+    return desc
+
+
+class TestRegistryStorm:
+    def test_concurrent_push_gc_index(self, store):
+        """16 writers + 4 GC sweepers + 4 index readers, one store."""
+        writers, sweeps, readers = 16, 4, 4
+        errs: list[BaseException] = []
+        pushed: dict[int, Descriptor] = {}
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def write(i):
+            try:
+                desc = _push_one(store, i)
+                with lock:
+                    pushed[i] = desc
+            except BaseException as e:  # pragma: no cover
+                errs.append(e)
+
+        def sweep():
+            try:
+                while not stop.is_set():
+                    # the grace window must protect blobs whose manifest
+                    # put hasn't landed yet (GC-during-push hazard)
+                    gc_blobs(store, REPO, grace_s=3600)
+                    time.sleep(0.001)
+            except BaseException as e:  # pragma: no cover
+                errs.append(e)
+
+        def read():
+            try:
+                while not stop.is_set():
+                    try:
+                        store.get_index(REPO)
+                    except Exception:
+                        pass  # index may not exist yet; must not crash
+                    time.sleep(0.001)
+            except BaseException as e:  # pragma: no cover
+                errs.append(e)
+
+        aux = [threading.Thread(target=sweep) for _ in range(sweeps)]
+        aux += [threading.Thread(target=read) for _ in range(readers)]
+        for t in aux:
+            t.start()
+        ws = [threading.Thread(target=write, args=(i,)) for i in range(writers)]
+        for t in ws:
+            t.start()
+        for t in ws:
+            t.join()
+        stop.set()
+        for t in aux:
+            t.join()
+
+        assert not errs, errs[:3]
+        # every push is durable and exactly-once in the converged index
+        store.refresh_index(REPO)
+        idx = store.get_index(REPO)
+        names = [e.name for e in idx.manifests]
+        assert sorted(names) == sorted(f"v{i}" for i in range(writers))
+        assert len(names) == len(set(names))
+        # no referenced blob was GC'd out from under its manifest
+        for i, desc in pushed.items():
+            assert store.exists_blob(REPO, desc.digest), f"v{i} lost its blob"
+
+    def test_gc_grace_zero_after_quiesce_removes_only_orphans(self, store):
+        """After the storm quiesces, an aggressive GC still only removes
+        unreferenced blobs."""
+        for i in range(6):
+            _push_one(store, i)
+        # orphan: blob without a manifest
+        data = b"orphan"
+        digest = str(Digest.from_bytes(data))
+        store.put_blob(
+            REPO, digest,
+            BlobContent(io.BytesIO(data), len(data), "application/octet-stream"),
+        )
+        result = gc_blobs(store, REPO, grace_s=0)
+        assert result.deleted == 1
+        for i in range(6):
+            data = b"payload-%d" % i
+            assert store.exists_blob(REPO, str(Digest.from_bytes(data)))
