@@ -374,6 +374,12 @@ def cmd_gc(ref: str, grace: float | None) -> None:
 @click.option("--s3-secret-key", default="", envvar="S3_SECRET_KEY")
 @click.option("--s3-bucket", default="registry")
 @click.option("--s3-region", default="us-east-1")
+@click.option("--gcs-url", default="",
+              help="GCS endpoint (e.g. https://storage.googleapis.com); "
+                   "presence selects the GCS store (HMAC keys)")
+@click.option("--gcs-access-key", default="", envvar="GCS_ACCESS_KEY")
+@click.option("--gcs-secret-key", default="", envvar="GCS_SECRET_KEY")
+@click.option("--gcs-bucket", default="registry")
 @click.option("--enable-redirect", is_flag=True, help="presigned load separation")
 @click.option("--local-redirect/--no-local-redirect", default=True,
               help="FS store: redirect colocated clients to blob paths")
@@ -382,7 +388,8 @@ def cmd_gc(ref: str, grace: float | None) -> None:
 @click.option("--gc-interval", default=0.0, type=float, help="seconds between GC sweeps (0=off)")
 def cmd_serve(
     listen, data_dir, tls_cert, tls_key, s3_url, s3_access_key, s3_secret_key,
-    s3_bucket, s3_region, enable_redirect, local_redirect, auth_token, oidc_issuer,
+    s3_bucket, s3_region, gcs_url, gcs_access_key, gcs_secret_key, gcs_bucket,
+    enable_redirect, local_redirect, auth_token, oidc_issuer,
     gc_interval,
 ) -> None:
     """Run the registry daemon (cmd/modelxd/modelxd.go:26-58)."""
@@ -400,6 +407,10 @@ def cmd_serve(
         s3_secret_key=s3_secret_key,
         s3_bucket=s3_bucket,
         s3_region=s3_region,
+        gcs_url=gcs_url,
+        gcs_access_key=gcs_access_key,
+        gcs_secret_key=gcs_secret_key,
+        gcs_bucket=gcs_bucket,
         enable_redirect=enable_redirect,
         local_redirect=local_redirect,
         auth_tokens=tuple(auth_token),

@@ -7,5 +7,6 @@ from modelx_tpu.client.remote import RegistryClient
 
 # register data-plane extensions (extension.go init() side effect parity)
 from modelx_tpu.client import extension_s3 as _extension_s3  # noqa: F401
+from modelx_tpu.client import extension_gcs as _extension_gcs  # noqa: F401
 
 __all__ = ["Client", "RegistryClient"]

@@ -1,0 +1,70 @@
+"""GCS client extension: the signed-URL data plane's third protocol.
+
+Reference seam: pkg/client/extension.go:14-19 — providers are pluggable by
+name, and this registers ``gcs`` next to ``file``/``http``/``s3``.
+
+- upload: GCS's RESUMABLE protocol — POST the server-issued signed
+  initiation URL with ``x-goog-resumable: start`` (the header is part of
+  the signature) to open an upload session, then stream the body to the
+  session URI with no further auth. One protocol for every blob size; an
+  interrupted push retries against the same session.
+- download: identical to the s3 provider (one signed GET, parallelized
+  with ranged GETs) — inherited.
+"""
+
+from __future__ import annotations
+
+from typing import BinaryIO, Callable
+
+import requests
+
+from modelx_tpu import errors
+from modelx_tpu.client.extension import _tls_kwargs, http_upload, register_extension
+from modelx_tpu.client.extension_s3 import S3Extension
+from modelx_tpu.types import BlobLocation, Descriptor
+
+
+class GCSExtension(S3Extension):
+    def upload(
+        self,
+        location: BlobLocation,
+        desc: Descriptor,
+        reader: BinaryIO,
+        progress: Callable[[int], None] | None = None,
+    ) -> None:
+        props = location.properties
+        start_url = props.get("resumableUrl")
+        if not start_url:
+            # plain signed PUT (small blobs / older servers)
+            http_upload(props["url"], reader, method="PUT", progress=progress)
+            return
+        last: Exception | None = None
+        for _ in range(3):
+            try:
+                r = requests.post(
+                    start_url,
+                    # signed header: must be sent exactly as promised
+                    headers={"x-goog-resumable": "start", "content-length": "0"},
+                    timeout=300, **_tls_kwargs(),
+                )
+                if r.status_code >= 400:
+                    raise errors.ErrorInfo.decode(r.content, r.status_code)
+                session = r.headers.get("Location", "")
+                if not session:
+                    raise OSError("resumable start returned no session URI")
+                break
+            except (errors.ErrorInfo, requests.RequestException, OSError) as e:
+                last = e
+        else:
+            assert last is not None
+            raise last
+        headers = {}
+        if desc.size:
+            headers["content-length"] = str(desc.size)
+        # http_upload rewinds the reader per attempt, so a failed session
+        # PUT restarts the body (GCS accepts a full re-PUT on a session)
+        http_upload(session, reader, headers=headers, method="PUT",
+                    progress=progress)
+
+
+register_extension("gcs", GCSExtension())

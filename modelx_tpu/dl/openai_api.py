@@ -13,7 +13,15 @@ Scope (documented, deliberate):
   server, so the rendering is fixed and documented rather than guessed.
 - ``max_tokens``, ``temperature``, ``top_p``, ``seed``, ``stop`` (up to 4
   strings), ``stream`` (SSE). ``top_k`` accepted as an extension.
-- ``n``, ``logprobs``, ``echo``, tool calls: rejected with a clear 400.
+- ``n``: each prompt decodes n samples (per-row seed streams — the same
+  derivation multi-row native requests use), non-streaming.
+- ``logprobs``: completions take the classic integer form (0-5 alternatives
+  per position), chat takes ``logprobs: true`` + ``top_logprobs`` (0-20).
+  Values come from scoring forwards over prompt+completion after
+  generation (ModelServer.score_logprobs_rows — a request's choices batch
+  into shared device calls); non-streaming only — stream=true with
+  logprobs (or n > 1) gets a clear 400.
+- ``echo``, tool calls: rejected with a clear 400.
 
 Requires the model to ship a ``tokenizer.json`` (the registry stores it as
 an ordinary blob next to the weights).
@@ -34,7 +42,7 @@ OBJ_COMPLETION = "text_completion"
 OBJ_CHAT = "chat.completion"
 OBJ_CHAT_CHUNK = "chat.completion.chunk"
 
-_UNSUPPORTED = ("n", "logprobs", "echo", "tools", "tool_choice", "functions")
+_UNSUPPORTED = ("echo", "tools", "tool_choice", "functions")
 
 
 class APIError(Exception):
@@ -113,17 +121,10 @@ def parse_sampling(req: dict, limit: int) -> tuple[int, dict]:
         # ignoring these would silently change semantics the caller asked
         # for — but values that ask for nothing (None/False, empty
         # containers like LiteLLM's tools: [], the default n=1) must pass.
-        # NB bool checks come first: True == 1 in Python, and logprobs:
-        # true must 400, not slip through an n-style ==1 comparison.
+        # values that ask for nothing (None/False, empty containers like
+        # LiteLLM's tools: []) must pass
         val = req.get(key)
-        asks_nothing = (
-            val is None
-            or val is False
-            or val == []
-            or val == {}
-            or (key == "n" and not isinstance(val, bool) and val == 1)
-        )
-        if not asks_nothing:
+        if not (val is None or val is False or val == [] or val == {}):
             raise APIError(400, f"{key!r} is not supported")
     try:
         # max_completion_tokens is the current OpenAI chat param (newer SDKs
@@ -164,6 +165,100 @@ def parse_sampling(req: dict, limit: int) -> tuple[int, dict]:
     if not (0 <= samp["top_k"] < 2**31) or not (0 <= samp["seed"] < 2**31):
         raise APIError(400, "top_k/seed must be in [0, 2^31)")
     return n_tokens, samp
+
+
+def parse_n(req: dict, prompts: int, limit: int = MAX_PROMPTS) -> int:
+    """``n`` samples per prompt; prompts x n stays one bounded unit of
+    device work (the MAX_PROMPTS cap the prompt list already obeys).
+    An explicit null asks for nothing (LiteLLM-style serialized defaults)
+    and means the default 1."""
+    n = req.get("n")
+    if n is None:
+        return 1
+    if isinstance(n, bool) or not isinstance(n, int) or n < 1:
+        raise APIError(400, "n must be a positive integer")
+    if prompts * n > limit:
+        raise APIError(400, f"prompt count x n must not exceed {limit}")
+    return n
+
+
+def parse_logprobs(req: dict, chat: bool) -> int | None:
+    """Requested alternatives-per-position, or None when logprobs are off.
+
+    Completions: the classic integer form (``logprobs: k``, 0 <= k <= 5 —
+    0 still returns the chosen tokens' logprobs). Chat: ``logprobs: true``
+    with optional ``top_logprobs`` (0-20); OpenAI requires top_logprobs to
+    ride only with logprobs=true, and so does this. Explicit null/false
+    ask for nothing and mean off (clients that serialize defaults)."""
+    val = req.get("logprobs")
+    if chat:
+        if val is None or val is False:
+            if req.get("top_logprobs") is not None:
+                raise APIError(400, "top_logprobs requires logprobs: true")
+            return None
+        if val is not True:
+            raise APIError(400, "logprobs must be a boolean for chat")
+        k = req.get("top_logprobs")
+        if k is None:
+            return 0
+        if isinstance(k, bool) or not isinstance(k, int) or not (0 <= k <= 20):
+            raise APIError(400, "top_logprobs must be an integer in [0, 20]")
+        return k
+    if val is None or val is False:
+        return None
+    if isinstance(val, bool) or not isinstance(val, int) or not (0 <= val <= 5):
+        raise APIError(400, "logprobs must be an integer in [0, 5]")
+    return val
+
+
+def logprobs_trim(tok, new_ids: list[int], text_len: int):
+    """(kept_ids, token_strs, offsets): the content tokens whose text
+    survived stop-sequence truncation (``text_len`` < 0 keeps all;
+    cumulative per-token offsets, best-effort for tokenizers whose full
+    decode differs from per-token concatenation)."""
+    token_strs = [tok.decode([int(t)]) for t in new_ids]
+    offsets, off, keep = [], 0, 0
+    for s in token_strs:
+        if 0 <= text_len <= off:
+            break
+        offsets.append(off)
+        off += len(s)
+        keep += 1
+    return new_ids[:keep], token_strs[:keep], offsets
+
+
+def logprobs_shape(tok, token_strs: list[str], offsets: list[int],
+                   scores, k: int, chat: bool) -> dict:
+    """OpenAI-shaped logprobs for one choice from precomputed ``scores``
+    ((token_lps, top_ids, top_lps) — ModelServer.score_logprobs_rows;
+    empty token lists produce valid empty shapes)."""
+    token_lps, top_ids, top_lps = scores
+    if chat:
+        content = []
+        for i, s in enumerate(token_strs):
+            content.append({
+                "token": s,
+                "logprob": float(token_lps[i]),
+                "bytes": list(s.encode()),
+                "top_logprobs": [
+                    {"token": tok.decode([int(tid)]), "logprob": float(tlp),
+                     "bytes": list(tok.decode([int(tid)]).encode())}
+                    for tid, tlp in zip(top_ids[i], top_lps[i])
+                ] if k else [],
+            })
+        return {"content": content}
+    return {
+        "tokens": token_strs,
+        "token_logprobs": [float(x) for x in token_lps],
+        "top_logprobs": (
+            [
+                {tok.decode([int(tid)]): float(tlp)
+                 for tid, tlp in zip(row_i, row_l)}
+                for row_i, row_l in zip(top_ids, top_lps)
+            ] if k else None
+        ),
+        "text_offset": offsets,
+    }
 
 
 def parse_stop(req: dict) -> list[str]:
@@ -240,6 +335,8 @@ def run_completion(sset, req: dict, chat: bool) -> dict:
     tok = tokenizer_for(server)
     prompts = parse_prompts(req, chat)
     n_tokens, samp = parse_sampling(req, sset.max_new_tokens_limit)
+    n_samples = parse_n(req, len(prompts))
+    top_lp = parse_logprobs(req, chat)
     stops = parse_stop(req)
     eos = eos_for(tok, req)
 
@@ -249,7 +346,7 @@ def run_completion(sset, req: dict, chat: bool) -> dict:
         # (An explicit null matches the streaming path's "absent" handling.)
         raise APIError(400, "stream_options is only allowed when stream is true")
     # routing policy lives in ONE place: continuous > speculation > batcher
-    engine = sset.engine_for(server, len(prompts), samp["temperature"])
+    engine = sset.engine_for(server, len(prompts) * n_samples, samp["temperature"])
     server.stats["requests"] += 1
     id_rows = [encode_prompt(tok, server, text, n_tokens) for text in prompts]
     # the continuous engine can retire a row's slot AT its EOS; other
@@ -260,10 +357,13 @@ def run_completion(sset, req: dict, chat: bool) -> dict:
         else {}
     )
 
-    def _one(ids: list[int]) -> list[int]:
-        out = engine.generate(np.asarray([ids], np.int32), max_new_tokens=n_tokens,
-                              **stops_kw, **samp)
-        return out[0, len(ids):].tolist()
+    def _one(ids: list[int]) -> list[list[int]]:
+        # n samples of one prompt = n rows of the same ids in ONE engine
+        # call: every engine derives per-row (seed + i) streams for
+        # multi-row requests, which is exactly OpenAI's n semantics
+        batch = np.asarray([ids] * n_samples, np.int32)
+        out = engine.generate(batch, max_new_tokens=n_tokens, **stops_kw, **samp)
+        return [row[len(ids):].tolist() for row in out]
 
     if len(id_rows) > 1 and engine is not server:
         # concurrent submissions ride the batcher's coalescing window and
@@ -278,29 +378,56 @@ def run_completion(sset, req: dict, chat: bool) -> dict:
     from modelx_tpu.models.decode import stop_cut
 
     eos_set = set(eos)
-    choices = []
-    prompt_tokens = completion_tokens = 0
-    for i, (ids, new_ids) in enumerate(zip(id_rows, rows_out)):
+    # usage counts each PROMPT once, however many samples it produced
+    prompt_tokens = sum(len(ids) for ids in id_rows)
+    completion_tokens = 0
+    flat = [
+        (ids, new_ids)
+        for ids, samples in zip(id_rows, rows_out)
+        for new_ids in samples
+    ]
+    built = []  # (ids, kept token strs, offsets, text, finish)
+    score_rows = []
+    for ids, new_ids in flat:
         cut = stop_cut(new_ids, eos_set)
         hit_eos = cut is not None
         if hit_eos:
             # usage counts the EOS (it was generated); content excludes it
             new_ids = new_ids[:cut]
-        prompt_tokens += len(ids)
         completion_tokens += len(new_ids)
-        text_out, finish = apply_stop(
-            tok.decode(new_ids[:-1] if hit_eos else new_ids), stops
-        )
+        content_ids = new_ids[:-1] if hit_eos else new_ids
+        text_out, finish = apply_stop(tok.decode(content_ids), stops)
+        stop_truncated = finish == "stop"  # apply_stop cut the text itself
         if hit_eos and finish == "length":
             finish = "stop"
+        strs, offsets = [], []
+        if top_lp is not None:
+            kept, strs, offsets = logprobs_trim(
+                tok, content_ids, len(text_out) if stop_truncated else -1
+            )
+            score_rows.append((ids, kept))
+        built.append((strs, offsets, text_out, finish))
+    scores = (
+        server.score_logprobs_rows(score_rows, top_k=top_lp)
+        if top_lp is not None else None
+    )
+    choices = []
+    for i, (strs, offsets, text_out, finish) in enumerate(built):
+        lp = None
+        if scores is not None:
+            lp = logprobs_shape(tok, strs, offsets, scores[i], top_lp, chat)
         if chat:
             choices.append({
                 "index": i,
                 "message": {"role": "assistant", "content": text_out},
+                "logprobs": lp,
                 "finish_reason": finish,
             })
         else:
-            choices.append({"index": i, "text": text_out, "finish_reason": finish})
+            choices.append({
+                "index": i, "text": text_out, "logprobs": lp,
+                "finish_reason": finish,
+            })
 
     body = _envelope(OBJ_CHAT if chat else OBJ_COMPLETION, server.name)
     body["choices"] = choices
@@ -321,6 +448,14 @@ def stream_completion(sset, req: dict, chat: bool) -> Iterator[dict]:
     prompts = parse_prompts(req, chat)
     if len(prompts) != 1:
         raise APIError(400, "stream supports a single prompt")
+    if parse_n(req, 1) != 1:
+        raise APIError(400, "n > 1 is not supported with stream")
+    if parse_logprobs(req, chat) is not None:
+        # logprobs come from a post-generation scoring forward
+        # (ModelServer.score_logprobs); per-chunk values would need the
+        # decode programs to emit them — honor the non-streaming form
+        raise APIError(400, "logprobs are not supported with stream; "
+                            "use stream: false")
     n_tokens, samp = parse_sampling(req, sset.max_new_tokens_limit)
     stops = parse_stop(req)
     ids = encode_prompt(tok, server, prompts[0], n_tokens)

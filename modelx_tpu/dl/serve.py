@@ -223,6 +223,7 @@ class ModelServer:
         self.params: dict | None = None
         self._forward_aot: dict[tuple, object] = {}
         self._decoders: dict[int, object] = {}  # chunk_size -> ChunkedDecoder
+        self._score_progs: dict[tuple, object] = {}  # (len bucket, top_k)
         self._decoders_lock = threading.Lock()
         # separate lock: tokenizer loading must not block streaming-decoder
         # creation (unrelated caches)
@@ -397,6 +398,83 @@ class ModelServer:
             )
             self.stats["tokens_generated"] += int(out.shape[0] * max_new_tokens)
             return np.asarray(out)
+
+    def score_logprobs_rows(self, rows, top_k: int = 0) -> list:
+        """Per-token log-probabilities for completed generations: a prefill
+        over [prompt + generated] and a log-softmax gather — the values the
+        decode programs saw when they picked each token (the forward is
+        deterministic; no decode-path surgery needed, logprobs requests
+        just pay scoring forwards). ``rows`` is [(ids, new_ids), ...]; rows
+        sharing a length bucket score as ONE batched device call — a
+        request's n samples of one prompt all ride one program. Returns,
+        per row, (token_logprobs [m], top_ids [m, top_k], top_logprobs
+        [m, top_k]); the top_* pair is None when top_k == 0.
+
+        Programs compile per (16-bucketed length, pow2 batch, top_k) — the
+        same shape discipline as every other serving path."""
+        from modelx_tpu.models.decode import pad_seq_len
+
+        empty = (
+            (np.zeros((0,), np.float32),) + (
+                (np.zeros((0, top_k), np.int32), np.zeros((0, top_k), np.float32))
+                if top_k else (None, None)
+            )
+        )
+        out: list = [empty] * len(rows)
+        groups: dict[int, list[int]] = {}
+        for i, (ids, new_ids) in enumerate(rows):
+            if new_ids:
+                groups.setdefault(pad_seq_len(len(ids) + len(new_ids)), []).append(i)
+        for lb, idxs in groups.items():
+            bb = 1 << (len(idxs) - 1).bit_length()  # pow2 batch bucket
+            key = (lb, bb, int(top_k))
+            prog = self._score_progs.get(key)
+            if prog is None:
+                with self._decoders_lock:
+                    prog = self._score_progs.get(key)
+                    if prog is None:
+                        family, cfg, mesh = self.family, self.cfg, self.mesh
+
+                        def _score(params, toks, k=int(top_k)):
+                            logits = family.forward(params, toks, cfg, mesh=mesh)
+                            lp = jax.nn.log_softmax(
+                                logits.astype(jnp.float32), axis=-1
+                            )  # [B, Lb, V]
+                            nxt = jnp.concatenate(
+                                [toks[:, 1:], jnp.zeros((toks.shape[0], 1), jnp.int32)],
+                                axis=1,
+                            )
+                            chosen = jnp.take_along_axis(
+                                lp, nxt[..., None], axis=-1
+                            )[..., 0]  # position j scores token j+1
+                            if k:
+                                top_lp, top_id = jax.lax.top_k(lp, k)
+                                return chosen, top_id, top_lp
+                            return chosen, None, None
+
+                        prog = self._score_progs[key] = jax.jit(_score)
+            padded = np.zeros((bb, lb), np.int32)
+            for r, i in enumerate(idxs):
+                ids, new_ids = rows[i]
+                full = list(ids) + list(new_ids)
+                padded[r, : len(full)] = full
+            chosen, top_id, top_lp = prog(self.params, jnp.asarray(padded))
+            chosen = np.asarray(chosen)
+            if top_k:
+                top_id, top_lp = np.asarray(top_id), np.asarray(top_lp)
+            for r, i in enumerate(idxs):
+                ids, new_ids = rows[i]
+                lo, hi = len(ids) - 1, len(ids) + len(new_ids) - 1
+                if top_k:
+                    out[i] = (chosen[r, lo:hi], top_id[r, lo:hi], top_lp[r, lo:hi])
+                else:
+                    out[i] = (chosen[r, lo:hi], None, None)
+        return out
+
+    def score_logprobs(self, ids: list[int], new_ids: list[int],
+                       top_k: int = 0):
+        """Single-row convenience over score_logprobs_rows."""
+        return self.score_logprobs_rows([(ids, new_ids)], top_k=top_k)[0]
 
     def _speculative_decoder(self):
         if self._spec_decoder is None:

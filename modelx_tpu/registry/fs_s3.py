@@ -58,10 +58,15 @@ class S3Client:
     Path-style addressing throughout (fs_s3.go custom endpoint resolver is
     for minio compatibility; path-style is what minio speaks)."""
 
+    # provider-specific V4 spelling; the GCS subclass swaps in GOOG_SIG
+    sig_spec = sigv4.AWS_SIG
+    service = "s3"
+
     def __init__(self, opts: S3Options) -> None:
         self.opts = opts
         self.creds = sigv4.Credentials(
-            access_key=opts.access_key, secret_key=opts.secret_key, region=opts.region
+            access_key=opts.access_key, secret_key=opts.secret_key,
+            region=opts.region, service=self.service,
         )
         self.session = requests.Session()
         self.endpoint = opts.url.rstrip("/")
@@ -85,7 +90,9 @@ class S3Client:
         stream: bool = False,
     ) -> requests.Response:
         url = self._url(key, query)
-        signed = sigv4.sign_headers(self.creds, method, url, headers=headers or {})
+        signed = sigv4.sign_headers(
+            self.creds, method, url, headers=headers or {}, spec=self.sig_spec
+        )
         resp = self.session.request(method, url, data=data, headers=signed, stream=stream)
         if resp.status_code == 404:
             resp.close()
@@ -208,12 +215,16 @@ class S3Client:
 
     # -- presign --------------------------------------------------------------
 
-    def presign(self, method: str, key: str, expires_s: int | None = None, query: dict[str, str] | None = None) -> str:
+    def presign(self, method: str, key: str, expires_s: int | None = None,
+                query: dict[str, str] | None = None,
+                signed_headers: dict[str, str] | None = None) -> str:
         url = self._url(key)
         if query:
             url += "?" + sigv4.canonical_query(query)
         return sigv4.presign_url(
-            self.creds, method, url, expires_s=expires_s or self.opts.presign_expire_s
+            self.creds, method, url,
+            expires_s=expires_s or self.opts.presign_expire_s,
+            spec=self.sig_spec, signed_headers=signed_headers,
         )
 
 

@@ -70,6 +70,12 @@ class Options:
     s3_bucket: str = "registry"
     s3_region: str = "us-east-1"
     s3_presign_expire_s: int = 3600
+    # GCS backend (presence of gcs_url selects the GCS store; HMAC keys)
+    gcs_url: str = ""
+    gcs_access_key: str = ""
+    gcs_secret_key: str = ""
+    gcs_bucket: str = "registry"
+    gcs_region: str = "auto"
     enable_redirect: bool = False
     # FS store: advertise blobs' local paths as ``file`` download locations so
     # colocated clients (shared volume / same host) read them directly instead
@@ -549,11 +555,16 @@ class RegistryServer:
 
 
 def new_store(opts: Options) -> RegistryStore:
-    """server.go:46-68 — S3 store iff s3_url set, else local FS."""
+    """server.go:46-68 — S3 store iff s3_url set (GCS iff gcs_url), else
+    local FS."""
     if opts.s3_url:
         from modelx_tpu.registry.store_s3 import S3RegistryStore
 
         return S3RegistryStore(opts)
+    if opts.gcs_url:
+        from modelx_tpu.registry.store_gcs import GCSRegistryStore
+
+        return GCSRegistryStore(opts)
     return FSRegistryStore(LocalFSProvider(opts.data_dir), local_redirect=opts.local_redirect)
 
 

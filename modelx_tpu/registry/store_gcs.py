@@ -1,0 +1,69 @@
+"""GCS signed-URL layer: "load separation" over Google Cloud Storage.
+
+The third provider through the reference's pluggable-location seam
+(extension.go:14-19): the server coordinates, the bulk bytes flow
+client<->GCS directly. Subclasses the S3 store — GCS's XML surface is
+S3-wire-compatible under HMAC keys (fs_gcs.py), so the commit-point
+verification (size check + quarantine), download locations, and index
+handling are INHERITED; the deltas are the signature spelling
+(GOOG4-HMAC) and the upload shape:
+
+- upload: a signed RESUMABLE-initiation URL (POST + ``x-goog-resumable:
+  start`` -> session URI -> unauthenticated PUTs), GCS's native answer to
+  S3 multipart — one protocol serves every blob size;
+- download: one V4-signed GET the client parallelizes with ranged GETs
+  (inherited, provider-tagged ``gcs``).
+
+The inherited commit path probes for in-progress multipart uploads; our
+upload flow never creates any, so that probe is a cheap no-op and the
+single-object size verification does the work.
+
+Server bootstrap: ``modelx registry --gcs-url ...`` (cli.py) selects this
+store the same way --s3-url selects the S3 one.
+"""
+
+from __future__ import annotations
+
+from modelx_tpu.registry.fs_gcs import GCSFSProvider, GCSOptions
+from modelx_tpu.registry.store_fs import FSRegistryStore
+from modelx_tpu.registry.store_s3 import S3RegistryStore
+from modelx_tpu.types import BlobLocation, BlobLocationPurposeUpload
+
+
+class GCSRegistryStore(S3RegistryStore):
+    provider = "gcs"
+
+    def __init__(self, opts, refresh_on_init: bool = True, enable_redirect: bool = True) -> None:
+        if not isinstance(opts, GCSOptions):
+            enable_redirect = bool(getattr(opts, "enable_redirect", True))
+            opts = GCSOptions(
+                url=opts.gcs_url,
+                access_key=opts.gcs_access_key,
+                secret_key=opts.gcs_secret_key,
+                bucket=opts.gcs_bucket,
+                region=getattr(opts, "gcs_region", "auto") or "auto",
+                presign_expire_s=getattr(opts, "s3_presign_expire_s", 3600),
+            )
+        self.enable_redirect = enable_redirect
+        self.gcs = GCSFSProvider(opts)
+        self.s3 = self.gcs  # the inherited S3 code paths address self.s3
+        self.client = self.gcs.client
+        # skip S3RegistryStore.__init__ (it would build an S3 provider)
+        FSRegistryStore.__init__(self, self.gcs, refresh_on_init=refresh_on_init)
+
+    def get_blob_location(
+        self, repository: str, digest: str, purpose: str, properties: dict[str, str]
+    ) -> BlobLocation | None:
+        if purpose == BlobLocationPurposeUpload and self.enable_redirect:
+            key = self._blob_key(repository, digest)
+            return BlobLocation(
+                provider=self.provider,
+                purpose=purpose,
+                properties={
+                    # the client POSTs this with x-goog-resumable: start
+                    # (signed) and streams the body to the session URI
+                    "resumableUrl": self.client.presign_resumable_start(key),
+                    "size": int(properties.get("size", 0) or 0),
+                },
+            )
+        return super().get_blob_location(repository, digest, purpose, properties)

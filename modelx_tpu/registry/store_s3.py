@@ -72,6 +72,8 @@ class S3RegistryStore(FSRegistryStore):
     """store_s3.go:26-29 — FSRegistryStore + presign. Accepts either a
     registry ``Options`` (server bootstrap) or an ``S3Options``."""
 
+    provider = "s3"  # BlobLocation provider name (store_gcs subclasses)
+
     def __init__(self, opts, refresh_on_init: bool = True, enable_redirect: bool = True) -> None:
         if not isinstance(opts, S3Options):
             enable_redirect = bool(getattr(opts, "enable_redirect", True))
@@ -108,7 +110,7 @@ class S3RegistryStore(FSRegistryStore):
             if size > MULTIPART_THRESHOLD:
                 return self._upload_location_multipart(key, size, content_type)
             return BlobLocation(
-                provider="s3",
+                provider=self.provider,
                 purpose=purpose,
                 properties={"url": self.client.presign("PUT", key)},
             )
@@ -120,7 +122,7 @@ class S3RegistryStore(FSRegistryStore):
             except FSNotFound:
                 raise errors.blob_unknown(digest) from None
             return BlobLocation(
-                provider="s3",
+                provider=self.provider,
                 purpose=purpose,
                 properties={"url": self.client.presign("GET", key), "size": total},
             )
@@ -146,7 +148,7 @@ class S3RegistryStore(FSRegistryStore):
                 }
             )
         return BlobLocation(
-            provider="s3",
+            provider=self.provider,
             purpose=BlobLocationPurposeUpload,
             properties={"uploadId": upload_id, "size": size, "parts": parts},
         )

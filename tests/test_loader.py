@@ -454,3 +454,89 @@ class TestAdaptiveFetchWidth:
             src.close()
         assert stats.fetch_width >= 2
         assert stats.fetch_backoffs == 0
+
+
+class TestByteAccounting2DMesh:
+    """BASELINE config #4's core claim, asserted in CI (VERDICT r4 item 7):
+    on a dp x tp mesh, the loader's fetch plan reads each tensor's bytes
+    EXACTLY ONCE in total, and a leading-axis tp-sharded tensor's reads are
+    the tp disjoint row slices — i.e. each host-equivalent fetch group pulls
+    only the rows its devices own (dp replicas share one fetch), never the
+    whole tensor per device."""
+
+    class CountingSource:
+        def __init__(self, path):
+            self.inner = LocalFileSource(path)
+            self.reads: list[tuple[int, int]] = []
+            import threading as _t
+
+            self._lock = _t.Lock()
+
+        def read_range(self, offset, length, out=None):
+            with self._lock:
+                self.reads.append((offset, length))
+            return self.inner.read_range(offset, length, out)
+
+        def size(self):
+            return self.inner.size()
+
+        def close(self):
+            self.inner.close()
+
+    def test_dp_tp_pull_fetches_owned_shard_bytes_once(self, tmp_path):
+        mesh = make_mesh("dp=2,tp=4")
+        rng = np.random.RandomState(5)
+        tensors = {
+            # leading axis over tp: the per-shard ranged-read case
+            "model.layers.0.self_attn.q_proj.weight": rng.rand(64, 32).astype(np.float32),
+            "model.embed_tokens.weight": rng.rand(128, 32).astype(np.float32),
+            # inner axis over tp: one full fetch, sliced in memory
+            "model.layers.0.mlp.down_proj.weight": rng.rand(32, 64).astype(np.float32),
+            # replicated: one fetch for all 8 devices
+            "model.norm.weight": rng.rand(32).astype(np.float32),
+        }
+        path = str(tmp_path / "acct.safetensors")
+        st.write_safetensors(path, tensors)
+        infos, data_offset = st.read_header_from_file(path)
+        src = self.CountingSource(path)
+        try:
+            loaded, stats = load_safetensors(
+                src, mesh, LLAMA_RULES, tensors=infos, data_offset=data_offset
+            )
+        finally:
+            src.close()
+        # correctness first: the assembled arrays equal the originals
+        for name, arr in tensors.items():
+            np.testing.assert_array_equal(np.asarray(loaded[name]), arr)
+
+        total_bytes = sum(a.nbytes for a in tensors.values())
+        fetched = sum(n for _off, n in src.reads)
+        # THE config-#4 assertion: fetched bytes ~ owned shard bytes, not
+        # devices x bytes (a per-device refetch would be 4-8x)
+        assert fetched / total_bytes <= 1.1, (fetched, total_bytes)
+
+        # exactly-once coverage: reads tile the data section without
+        # overlap or holes
+        spans = sorted((off, off + n) for off, n in src.reads)
+        for (a0, a1), (b0, b1) in zip(spans, spans[1:]):
+            assert a1 <= b0, f"overlapping reads {a0}-{a1} / {b0}-{b1}"
+        assert fetched == total_bytes
+
+        # the leading-axis tp-sharded tensor fetched as tp=4 disjoint row
+        # slices of nbytes/4 each (dp replicas shared their group's fetch)
+        q = infos["model.layers.0.self_attn.q_proj.weight"]
+        q_reads = [
+            (off - data_offset - q.start, n)
+            for off, n in src.reads
+            if q.start <= off - data_offset < q.end
+        ]
+        assert len(q_reads) == 4, q_reads
+        assert {n for _o, n in q_reads} == {q.nbytes // 4}
+        # the inner-sharded and replicated tensors fetched once, whole
+        for name in ("model.layers.0.mlp.down_proj.weight", "model.norm.weight"):
+            info = infos[name]
+            n_reads = [
+                n for off, n in src.reads
+                if info.start <= off - data_offset < info.end
+            ]
+            assert n_reads == [info.nbytes], (name, n_reads)

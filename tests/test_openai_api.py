@@ -117,9 +117,9 @@ class TestCompletions:
             {"prompt": "hello", "temperature": 3.0},
             {"prompt": "hello", "top_p": 0.0},
             {"prompt": "hello", "stop": ["a", "b", "c", "d", "e"]},
-            {"prompt": "hello", "n": 3},
-            {"prompt": "hello", "logprobs": 5},
-            {"prompt": "hello", "logprobs": True},  # True == 1 must not slip
+            {"prompt": "hello", "n": 0},  # n itself is supported now
+            {"prompt": "hello", "logprobs": 6},  # > the completions cap
+            {"prompt": "hello", "logprobs": True},  # bool is the CHAT form
             {"prompt": ["hello"] * 33},  # prompt-list cap
         ]
         for req in cases:
@@ -625,3 +625,182 @@ class TestAutoEOS:
                                       "temperature": 0, "ignore_eos": True}, chat=False)
         assert body2["choices"][0]["finish_reason"] == "length"
         assert "</s>" in body2["choices"][0]["text"]
+
+
+class TestNAndLogprobs:
+    """OpenAI n + logprobs (VERDICT r4 item 5): n rides the per-row-seed
+    multi-row decode; logprobs come from one scoring forward over
+    prompt+completion (ModelServer.score_logprobs)."""
+
+    def test_n_greedy_returns_identical_choices(self, front):
+        base, _ = front
+        r = requests.post(base + "/v1/completions",
+                          json={"prompt": "hello world tpu", "max_tokens": 4,
+                                "temperature": 0, "n": 3})
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert [c["index"] for c in body["choices"]] == [0, 1, 2]
+        texts = [c["text"] for c in body["choices"]]
+        assert texts[0] == texts[1] == texts[2]  # greedy: same stream
+        assert body["usage"]["completion_tokens"] == 12  # 3 x 4
+
+    def test_n_sampled_choices_use_distinct_streams(self, front):
+        base, _ = front
+        r = requests.post(base + "/v1/completions",
+                          json={"prompt": "hello world tpu", "max_tokens": 8,
+                                "temperature": 1.0, "seed": 7, "n": 4})
+        assert r.status_code == 200, r.text
+        texts = [c["text"] for c in r.json()["choices"]]
+        assert len(texts) == 4
+        assert len(set(texts)) > 1, "n samples came from one stream"
+        # deterministic per request seed: same request, same set of samples
+        r2 = requests.post(base + "/v1/completions",
+                           json={"prompt": "hello world tpu", "max_tokens": 8,
+                                 "temperature": 1.0, "seed": 7, "n": 4})
+        assert [c["text"] for c in r2.json()["choices"]] == texts
+
+    def test_completions_logprobs_shape_and_greedy_argmax(self, front):
+        base, _ = front
+        r = requests.post(base + "/v1/completions",
+                          json={"prompt": "hello world tpu", "max_tokens": 5,
+                                "temperature": 0, "logprobs": 3})
+        assert r.status_code == 200, r.text
+        (choice,) = r.json()["choices"]
+        lp = choice["logprobs"]
+        assert len(lp["tokens"]) == 5
+        assert len(lp["token_logprobs"]) == 5
+        assert len(lp["top_logprobs"]) == 5
+        assert lp["text_offset"][0] == 0
+        for i, (tlp, top) in enumerate(zip(lp["token_logprobs"], lp["top_logprobs"])):
+            assert tlp <= 0.0
+            assert len(top) == 3
+            # greedy: the chosen token IS the argmax, so its logprob equals
+            # the best alternative's (same scoring forward)
+            assert abs(tlp - max(top.values())) < 1e-4, (i, tlp, top)
+            assert lp["tokens"][i] in top
+
+    def test_completions_logprobs_zero_keeps_chosen_only(self, front):
+        base, _ = front
+        r = requests.post(base + "/v1/completions",
+                          json={"prompt": "hello world", "max_tokens": 3,
+                                "temperature": 0, "logprobs": 0})
+        assert r.status_code == 200, r.text
+        lp = r.json()["choices"][0]["logprobs"]
+        assert len(lp["token_logprobs"]) == 3
+        assert lp["top_logprobs"] is None
+
+    def test_chat_logprobs_shape(self, front):
+        base, _ = front
+        r = requests.post(base + "/v1/chat/completions",
+                          json={"messages": [{"role": "user", "content": "hello world"}],
+                                "max_tokens": 4, "temperature": 0,
+                                "logprobs": True, "top_logprobs": 2})
+        assert r.status_code == 200, r.text
+        (choice,) = r.json()["choices"]
+        content = choice["logprobs"]["content"]
+        assert len(content) == 4
+        for entry in content:
+            assert set(entry) == {"token", "logprob", "bytes", "top_logprobs"}
+            assert entry["logprob"] <= 0.0
+            assert len(entry["top_logprobs"]) == 2
+            assert bytes(entry["bytes"]).decode() == entry["token"]
+
+    def test_validation_400s(self, front):
+        base, _ = front
+        bad = [
+            {"prompt": "hello", "n": 0},
+            {"prompt": "hello", "n": True},
+            {"prompt": "hello", "n": 999},
+            {"prompt": "hello", "logprobs": 6},
+            {"prompt": "hello", "logprobs": True},  # bool is the CHAT form
+            {"prompt": "hello", "n": 2, "stream": True},
+            {"prompt": "hello", "logprobs": 2, "stream": True},
+        ]
+        for body in bad:
+            r = requests.post(base + "/v1/completions",
+                              json={"max_tokens": 2, **body})
+            assert r.status_code == 400, (body, r.text)
+        bad_chat = [
+            {"logprobs": 3},  # int is the COMPLETIONS form
+            {"top_logprobs": 2},  # requires logprobs: true
+            {"logprobs": True, "top_logprobs": 21},
+        ]
+        for body in bad_chat:
+            r = requests.post(base + "/v1/chat/completions",
+                              json={"messages": [{"role": "user", "content": "hello"}],
+                                    "max_tokens": 2, **body})
+            assert r.status_code == 400, (body, r.text)
+
+    def test_score_logprobs_matches_direct_forward(self, front):
+        """The scoring program's values equal a hand-computed log-softmax
+        over the same forward."""
+        import jax.numpy as jnp_
+
+        _, server = front
+        ids, new_ids = [1, 2, 3], [5, 9]
+        token_lps, top_ids, top_lps = server.score_logprobs(ids, new_ids, top_k=2)
+        full = np.asarray([ids + new_ids], np.int32)
+        from modelx_tpu.models.decode import pad_seq_len
+
+        padded = np.zeros((1, pad_seq_len(full.shape[1])), np.int32)
+        padded[0, : full.shape[1]] = full
+        logits = server.family.forward(
+            server.params, jnp_.asarray(padded), server.cfg, mesh=server.mesh
+        )
+        lp = np.asarray(jax.nn.log_softmax(np.asarray(logits, np.float32), axis=-1))
+        for j, t in enumerate(new_ids):
+            pos = len(ids) - 1 + j
+            np.testing.assert_allclose(token_lps[j], lp[0, pos, t], rtol=1e-5)
+            # top-2 from the same distribution
+            order = np.argsort(lp[0, pos])[::-1][:2]
+            np.testing.assert_array_equal(top_ids[j], order)
+
+
+class TestNLogprobsEdges:
+    """Review regressions: usage semantics under n, empty-content logprobs,
+    and explicit-null defaults."""
+
+    def test_prompt_tokens_counted_once_per_prompt(self, front):
+        base, _ = front
+        r = requests.post(base + "/v1/completions",
+                          json={"prompt": "hello world tpu", "max_tokens": 2,
+                                "temperature": 0, "n": 4, "ignore_eos": True})
+        u = r.json()["usage"]
+        assert u["prompt_tokens"] == 3  # not 12
+        assert u["completion_tokens"] == 8
+        assert u["total_tokens"] == 11
+
+    def test_explicit_null_and_false_defaults_pass(self, front):
+        base, _ = front
+        r = requests.post(base + "/v1/completions",
+                          json={"prompt": "hello", "max_tokens": 2,
+                                "n": None, "logprobs": False})
+        assert r.status_code == 200, r.text
+        r = requests.post(base + "/v1/chat/completions",
+                          json={"messages": [{"role": "user", "content": "hello"}],
+                                "max_tokens": 2, "logprobs": True,
+                                "top_logprobs": None})
+        assert r.status_code == 200, r.text
+
+    def test_logprobs_with_stop_at_offset_zero(self, front):
+        """A stop sequence matching the first generated text keeps the
+        logprobs shape valid (empty lists, not a 500)."""
+        _, server = front
+        tok = server.tokenizer()
+        ids = tok.encode("hello world tpu")
+        out = server.generate(np.asarray([ids], np.int32), max_new_tokens=3)
+        first_word = tok.decode(out[0, 3:4].tolist())
+        from modelx_tpu.dl.openai_api import run_completion
+        from modelx_tpu.dl.serve import ServerSet
+
+        sset = ServerSet({"m": server})
+        body = run_completion(sset, {"prompt": "hello world tpu",
+                                     "max_tokens": 3, "temperature": 0,
+                                     "logprobs": 2, "stop": [first_word],
+                                     "ignore_eos": True}, chat=False)
+        (choice,) = body["choices"]
+        assert choice["finish_reason"] == "stop"
+        assert choice["text"] == ""
+        lp = choice["logprobs"]
+        assert lp["tokens"] == [] and lp["token_logprobs"] == []
+        assert lp["top_logprobs"] == [] and lp["text_offset"] == []
