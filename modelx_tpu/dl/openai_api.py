@@ -119,10 +119,8 @@ def parse_sampling(req: dict, limit: int) -> tuple[int, dict]:
         if key not in req:
             continue
         # ignoring these would silently change semantics the caller asked
-        # for — but values that ask for nothing (None/False, empty
-        # containers like LiteLLM's tools: [], the default n=1) must pass.
-        # values that ask for nothing (None/False, empty containers like
-        # LiteLLM's tools: []) must pass
+        # for; values that ask for nothing (None/False, empty containers
+        # like LiteLLM's serialized-default tools: []) pass
         val = req.get(key)
         if not (val is None or val is False or val == [] or val == {}):
             raise APIError(400, f"{key!r} is not supported")
@@ -250,6 +248,10 @@ def logprobs_shape(tok, token_strs: list[str], offsets: list[int],
     return {
         "tokens": token_strs,
         "token_logprobs": [float(x) for x in token_lps],
+        # the classic completions format keys alternatives by token TEXT —
+        # inherently lossy when distinct ids decode to the same string
+        # (byte-fallback tokens); entries can number fewer than k, exactly
+        # as OpenAI's own dict-shaped responses do
         "top_logprobs": (
             [
                 {tok.decode([int(tid)]): float(tlp)
@@ -386,7 +388,7 @@ def run_completion(sset, req: dict, chat: bool) -> dict:
         for ids, samples in zip(id_rows, rows_out)
         for new_ids in samples
     ]
-    built = []  # (ids, kept token strs, offsets, text, finish)
+    built = []  # (kept token strs, offsets, text, finish)
     score_rows = []
     for ids, new_ids in flat:
         cut = stop_cut(new_ids, eos_set)

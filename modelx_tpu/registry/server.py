@@ -557,6 +557,10 @@ class RegistryServer:
 def new_store(opts: Options) -> RegistryStore:
     """server.go:46-68 — S3 store iff s3_url set (GCS iff gcs_url), else
     local FS."""
+    if opts.s3_url and opts.gcs_url:
+        # silently picking one would strand the other's bucket empty — a
+        # migration misconfiguration that must fail at boot, not in prod
+        raise ValueError("--s3-url and --gcs-url are mutually exclusive")
     if opts.s3_url:
         from modelx_tpu.registry.store_s3 import S3RegistryStore
 

@@ -53,6 +53,10 @@ class TestBenchSmoke:
         assert out["continuous_clients"] == 8
         assert out["continuous_agg_tokens_per_s"] > 0
         assert out["continuous_vs_sequential"] > 0
+        # the in-engine speculation leg: device-steps/token on a
+        # self-repeating continuation, < 1.0 when acceptance works
+        assert out["continuous_spec_device_steps"] > 0
+        assert out["continuous_spec_steps_per_token"] < 1.0, out
 
     def test_pull_snippets_run(self, tmp_path):
         """The stdlib-only multitenant pullers must keep working against a

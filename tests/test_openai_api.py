@@ -673,7 +673,8 @@ class TestNAndLogprobs:
         assert lp["text_offset"][0] == 0
         for i, (tlp, top) in enumerate(zip(lp["token_logprobs"], lp["top_logprobs"])):
             assert tlp <= 0.0
-            assert len(top) == 3
+            # dict keyed by token text: <= k when decoded strings collide
+            assert 1 <= len(top) <= 3
             # greedy: the chosen token IS the argmax, so its logprob equals
             # the best alternative's (same scoring forward)
             assert abs(tlp - max(top.values())) < 1e-4, (i, tlp, top)
