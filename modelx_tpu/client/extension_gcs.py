@@ -14,6 +14,7 @@ name, and this registers ``gcs`` next to ``file``/``http``/``s3``.
 
 from __future__ import annotations
 
+import time
 from typing import BinaryIO, Callable
 
 import requests
@@ -22,6 +23,11 @@ from modelx_tpu import errors
 from modelx_tpu.client.extension import _tls_kwargs, http_upload, register_extension
 from modelx_tpu.client.extension_s3 import S3Extension
 from modelx_tpu.types import BlobLocation, Descriptor
+
+
+class _Transient(Exception):
+    """Wraps a retryable initiation failure (5xx / malformed response);
+    deterministic 4xx responses raise straight through."""
 
 
 class GCSExtension(S3Extension):
@@ -39,7 +45,7 @@ class GCSExtension(S3Extension):
             http_upload(props["url"], reader, method="PUT", progress=progress)
             return
         last: Exception | None = None
-        for _ in range(3):
+        for attempt in range(3):
             try:
                 r = requests.post(
                     start_url,
@@ -48,13 +54,18 @@ class GCSExtension(S3Extension):
                     timeout=300, **_tls_kwargs(),
                 )
                 if r.status_code >= 400:
-                    raise errors.ErrorInfo.decode(r.content, r.status_code)
+                    err = errors.ErrorInfo.decode(r.content, r.status_code)
+                    if r.status_code < 500:
+                        raise err  # deterministic (expired/denied): no retry
+                    raise _Transient(err)
                 session = r.headers.get("Location", "")
                 if not session:
-                    raise OSError("resumable start returned no session URI")
+                    raise _Transient(OSError("resumable start returned no session URI"))
                 break
-            except (errors.ErrorInfo, requests.RequestException, OSError) as e:
-                last = e
+            except (_Transient, requests.RequestException) as e:
+                last = e.args[0] if isinstance(e, _Transient) else e
+                if attempt < 2:
+                    time.sleep(0.2 * (2 ** attempt))
         else:
             assert last is not None
             raise last

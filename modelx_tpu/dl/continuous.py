@@ -148,6 +148,14 @@ class ContinuousBatcher:
         self._fwd, self._init_cache = server.family.decode_fns(
             server.cfg, mesh=server.mesh
         )
+        # paged fast path: a forward whose attention reads the page pools
+        # IN PLACE (ops/paged_attention.py) — no per-step dense gather.
+        # Families without one fall back to the generic gather chunk.
+        self._fwd_paged = (
+            server.family.paged_decode_fns(server.cfg, mesh=server.mesh)
+            if page_size > 0 and server.family.paged_decode_fns is not None
+            else None
+        )
         # -- paged KV (page_size > 0): HBM scales with LIVE tokens ----------
         # The dense engine state is [max_slots, max_len] per layer whether a
         # slot is used or not, so slot count multiplies straight into HBM.
@@ -250,6 +258,9 @@ class ContinuousBatcher:
             self.stats["page_size"] = self.page_size
             self.stats["pages_total"] = self.num_pages - 1  # excl. trash
             self.stats["pages_free"] = len(self._free_pages)
+            self.stats["paged_attention"] = (
+                "in-place" if self._fwd_paged is not None else "gather"
+            )
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
@@ -415,25 +426,36 @@ class ContinuousBatcher:
 
         def step_fn(carry, _i):
             pool, tok, offsets, steps = carry
-            dense = jax.tree_util.tree_map(
-                lambda p: p[table].reshape(
-                    self.max_slots, self.max_len, *p.shape[2:]
-                ),
-                pool,
-            )
-            logits, dense = self._fwd(params, tok, kv_cache=dense, cache_offset=offsets)
-            page_idx = jnp.take_along_axis(table, (offsets // ps)[:, None], axis=1)[:, 0]
-            off_in = offsets % ps
+            if self._fwd_paged is not None:
+                # fast path: the family forward scatters this step's k/v
+                # into the pools and attends over them IN PLACE
+                logits, pool = self._fwd_paged(
+                    params, tok, kv_cache=pool, cache_offset=offsets, table=table
+                )
+            else:
+                dense = jax.tree_util.tree_map(
+                    lambda p: p[table].reshape(
+                        self.max_slots, self.max_len, *p.shape[2:]
+                    ),
+                    pool,
+                )
+                logits, dense = self._fwd(
+                    params, tok, kv_cache=dense, cache_offset=offsets
+                )
+                page_idx = jnp.take_along_axis(
+                    table, (offsets // ps)[:, None], axis=1
+                )[:, 0]
+                off_in = offsets % ps
 
-            def put_back(p, d):
-                rows = jax.vmap(
-                    lambda row, o: jax.lax.dynamic_slice_in_dim(row, o, 1, axis=0)
-                )(d, offsets)  # [slots, 1, ...] — the row each slot wrote
-                # exclusive page ownership makes the scatter collision-free
-                # (idle slots all hit the trash page — garbage over garbage)
-                return p.at[page_idx, off_in].set(rows[:, 0])
+                def put_back(p, d):
+                    rows = jax.vmap(
+                        lambda row, o: jax.lax.dynamic_slice_in_dim(row, o, 1, axis=0)
+                    )(d, offsets)  # [slots, 1, ...] — the row each slot wrote
+                    # exclusive page ownership makes the scatter
+                    # collision-free (idle slots all hit the trash page)
+                    return p.at[page_idx, off_in].set(rows[:, 0])
 
-            pool = jax.tree_util.tree_map(put_back, pool, dense)
+                pool = jax.tree_util.tree_map(put_back, pool, dense)
             nxt = sampling_ops.sample(
                 logits[:, -1, :].astype(jnp.float32), jax.random.PRNGKey(0), temp,
                 top_k=top_k, top_p=top_p, seeds=seeds, step=steps,

@@ -47,6 +47,11 @@ class Family:
     # (cfg, mesh) -> (forward-with-cache, init_kv_cache) for streaming decode
     # (models/decode.ChunkedDecoder); cached-decode families only
     decode_fns: Callable[..., tuple] | None = None
+    # (cfg, mesh) -> forward over PAGED kv pools (kv_cache = page pools +
+    # a block table; ops/paged_attention.py reads them in place) — the
+    # continuous engine's fast paged chunk path; None = the engine falls
+    # back to its generic dense-gather chunk for this family
+    paged_decode_fns: Callable[..., Callable] | None = None
 
 
 def _shape(params: dict, name: str) -> tuple[int, ...]:
@@ -139,6 +144,18 @@ def _llama_decode_fns(cfg, mesh=None):
         )
 
     return fwd, (lambda b, max_len: llama.init_kv_cache(cfg, b, max_len))
+
+
+def _llama_paged_decode_fns(cfg, mesh=None):
+    from modelx_tpu.models import llama
+
+    def fwd(p, t, kv_cache, cache_offset, table, mesh=mesh):
+        return llama.forward(
+            p, t, cfg, kv_cache=kv_cache, cache_offset=cache_offset,
+            mesh=mesh, paged_table=table,
+        )
+
+    return fwd
 
 
 # -- mixtral ------------------------------------------------------------------
@@ -302,11 +319,13 @@ def _bert_forward(params, tokens, cfg, mesh=None):
 
 FAMILIES: dict[str, Family] = {
     "llama": Family("llama", LLAMA_RULES, infer_llama_config, _llama_forward,
-                    _llama_generate, _llama_generate_ragged, _llama_decode_fns),
+                    _llama_generate, _llama_generate_ragged, _llama_decode_fns,
+                    _llama_paged_decode_fns),
     # same decoder implementation as llama — the bias params flow through
     # the param dict, so every llama entry point serves qwen2 unchanged
     "qwen2": Family("qwen2", QWEN2_RULES, infer_qwen2_config, _llama_forward,
-                    _llama_generate, _llama_generate_ragged, _llama_decode_fns),
+                    _llama_generate, _llama_generate_ragged, _llama_decode_fns,
+                    _llama_paged_decode_fns),
     "mixtral": Family("mixtral", MIXTRAL_RULES, infer_mixtral_config, _mixtral_forward,
                       _mixtral_generate, _mixtral_generate_ragged, _mixtral_decode_fns),
     "gpt2": Family("gpt2", GPT2_RULES, infer_gpt2_config, _gpt2_forward,

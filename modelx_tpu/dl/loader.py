@@ -83,17 +83,24 @@ class _ByteBudget:
 
 
 def _read_with_retry(source: "ByteSource", offset: int, length: int, out=None,
-                     retries: int = FETCH_RETRIES):
+                     retries: int = FETCH_RETRIES, timer=None):
     """Ranged read with exponential backoff — a transient fetch error must
     not kill a multi-hundred-shard load (mirrors the reference's per-part
-    retry x3, extension_s3.go:133-148)."""
+    retry x3, extension_s3.go:133-148). ``timer(nbytes, seconds)`` fires
+    for the SUCCESSFUL attempt only: throughput consumers (the fetch
+    governor) must see transfer time, not backoff sleeps or failed I/O."""
     for attempt in range(retries):
+        t0 = time.monotonic()
         try:
-            return source.read_range(offset, length, out)
+            result = source.read_range(offset, length, out)
         except OSError:
             if attempt == retries - 1:
                 raise
             time.sleep(0.2 * (2 ** attempt))
+        else:
+            if timer is not None:
+                timer(length, time.monotonic() - t0)
+            return result
 
 
 def auto_fetch_concurrency(source) -> int:
@@ -533,27 +540,21 @@ def load_safetensors(
     )
 
     def _gated_read(offset: int, length: int, out=None):
-        """Ranged read under the governor's gate. Only the SUCCESSFUL
-        attempt's transfer time feeds the throughput sample — backoff
-        sleeps and failed attempts' I/O are a retry story, not a width
-        story, and must not read as a collapse that permanently sheds
-        fetch parallelism."""
+        """Ranged read under the governor's gate; the retry policy stays
+        single-sourced in _read_with_retry, whose timer reports only the
+        successful attempt — backoff sleeps and failed attempts' I/O are a
+        retry story, not a width story, and must not read as a collapse
+        that permanently sheds fetch parallelism."""
         governor.acquire()
-        nbytes, busy = 0, 0.0
+        sample = [0, 0.0]
+
+        def timer(n: int, secs: float) -> None:
+            sample[0], sample[1] = n, secs
+
         try:
-            for attempt in range(FETCH_RETRIES):
-                rt0 = time.monotonic()
-                try:
-                    result = source.read_range(offset, length, out)
-                except OSError:
-                    if attempt == FETCH_RETRIES - 1:
-                        raise
-                    time.sleep(0.2 * (2 ** attempt))
-                else:
-                    nbytes, busy = length, time.monotonic() - rt0
-                    return result
+            return _read_with_retry(source, offset, length, out, timer=timer)
         finally:
-            governor.release(nbytes, busy)
+            governor.release(sample[0], sample[1])
 
     stats = LoadStats()
     lock = threading.Lock()

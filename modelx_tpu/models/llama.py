@@ -205,6 +205,7 @@ def decoder_layer(
     mesh: Mesh | None = None,
     attention_impl: str = "auto",
     mlp_fn=None,
+    paged_table: jax.Array | None = None,
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None]:
     """One transformer block. ``lp`` holds the layer's params keyed by the
     unprefixed HF suffix ("self_attn.q_proj.weight", ...). Returns
@@ -212,7 +213,13 @@ def decoder_layer(
 
     ``mlp_fn(h)`` replaces the dense SwiGLU FFN when given (the post-norm
     hidden states go in, the FFN output comes out) — Mixtral passes its
-    sparse-MoE block here so the attention half stays shared."""
+    sparse-MoE block here so the attention half stays shared.
+
+    ``paged_table`` switches the cached-decode path to PAGED layout: the
+    cache leaves are page pools [P, page_size, Hkv, D], the table maps each
+    row to its pages, and attention reads the pool in place
+    (ops/paged_attention.py) — single-token steps only (s == 1), the shape
+    the continuous engine's chunk scan drives."""
     b, s = x.shape[:2]
     h = _rms_norm(x, lp["input_layernorm.weight"], cfg.rms_eps)
     q = _linear(h, lp["self_attn.q_proj.weight"], lp.get("self_attn.q_proj.bias"))
@@ -225,7 +232,24 @@ def decoder_layer(
     k = ctx.constrain(_rope(k, positions, cfg.rope_theta), "dp", "sp", "tp", None)
 
     new_cache: tuple[jax.Array, jax.Array] | None = None
-    if cache is not None:
+    if cache is not None and paged_table is not None:
+        from modelx_tpu.ops.paged_attention import paged_attention
+
+        ck, cv = cache  # pools [P, ps, Hkv, D]
+        ps = ck.shape[1]
+        # scatter this step's k/v into each row's current page (exclusive
+        # ownership makes it collision-free; idle rows hit the trash page)
+        page_idx = jnp.take_along_axis(
+            paged_table, (cache_offset // ps)[:, None], axis=1
+        )[:, 0]
+        off_in = cache_offset % ps
+        ck = ck.at[page_idx, off_in].set(k[:, 0])
+        cv = cv.at[page_idx, off_in].set(v[:, 0])
+        new_cache = (ck, cv)
+        attn_out = paged_attention(
+            q[:, 0], ck, cv, paged_table, cache_offset + 1
+        )[:, None]  # [B, 1, Hq, D]
+    elif cache is not None:
         ck, cv = cache
         if jnp.ndim(cache_offset) == 0:
             ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_offset, 0, 0))
@@ -288,11 +312,13 @@ def forward(
     cache_offset: int | jax.Array = 0,
     mesh: Mesh | None = None,
     attention_impl: str = "auto",
+    paged_table: jax.Array | None = None,
 ) -> tuple[jax.Array, dict | None]:
     """Returns (logits [B,S,V], updated kv_cache).
 
     Prefill: kv_cache=None. Decode: pass the cache and the current offset;
-    tokens is [B, 1].
+    tokens is [B, 1]. With ``paged_table``, kv_cache holds PAGE POOLS and
+    attention reads them in place (see decoder_layer; single-token decode).
     """
     ctx = ShardingCtx(mesh)
     b, s = tokens.shape
@@ -314,7 +340,7 @@ def forward(
         cache = (kv_cache[f"k{i}"], kv_cache[f"v{i}"]) if kv_cache is not None else None
         x, updated = decoder_layer(
             lp, x, positions, cfg, ctx, cache=cache, cache_offset=cache_offset,
-            mesh=mesh, attention_impl=attention_impl,
+            mesh=mesh, attention_impl=attention_impl, paged_table=paged_table,
         )
         if updated is not None:
             new_cache[f"k{i}"], new_cache[f"v{i}"] = updated

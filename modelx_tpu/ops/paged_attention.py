@@ -1,0 +1,71 @@
+"""Paged decode attention: online softmax over page blocks, in place.
+
+The paged continuous engine's generic chunk program gathers every slot's
+pages into a dense [slots, max_len] view per step and runs the family
+forward against it — correct for any family, but the gather is a
+materialized transient the scheduler must carry. This op removes it for
+families that wire it (llama/qwen2 via ``forward(..., paged_table=...)``):
+attention reads the page pool DIRECTLY, one page block at a time, with the
+flash-attention accumulation (running max / normalizer), so the per-step
+transient is one [slots, page_size] block instead of [slots, max_len].
+
+Built on ``lax.scan`` + gathers rather than a hand-written pallas kernel:
+the loop body is three einsums over a page block — XLA schedules that fine
+on TPU and identically on CPU (where the engine's exactness tests run); a
+pallas kernel would add MXU-tile control, not a different memory story.
+
+Numerics: the blockwise accumulation is algebraically the softmax but not
+bit-identical to a full-width softmax (different reduction order) — same
+property as the prefill flash kernel. fp32 accumulation throughout.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def paged_attention(
+    q: jax.Array,       # [S, Hq, D] — one decode step per slot
+    pool_k: jax.Array,  # [P, ps, Hkv, D]
+    pool_v: jax.Array,  # [P, ps, Hkv, D]
+    table: jax.Array,   # [S, pages_per_slot] int32 (0 = trash page)
+    lengths: jax.Array,  # [S] valid positions per slot (= offset + 1)
+) -> jax.Array:
+    """Returns [S, Hq, D]. Positions >= lengths[s] (junk pages, partial
+    tails) contribute exactly zero weight; every slot has >= 1 valid
+    position (idle slots attend to their trash-page write at 0)."""
+    s, hq, d = q.shape
+    _p, ps, hkv, _d = pool_k.shape
+    rep = hq // hkv
+    qg = (q.astype(jnp.float32) / jnp.sqrt(jnp.float32(d))).reshape(s, hkv, rep, d)
+
+    def body(carry, j):
+        m, l, acc = carry
+        pids = table[:, j]                       # [S]
+        kb = pool_k[pids].astype(jnp.float32)    # [S, ps, Hkv, D]
+        vb = pool_v[pids].astype(jnp.float32)
+        scores = jnp.einsum("skrd,spkd->skrp", qg, kb)  # [S, Hkv, rep, ps]
+        pos = j * ps + jnp.arange(ps)
+        mask = pos[None, :] < lengths[:, None]   # [S, ps]
+        scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
+        m_new = jnp.maximum(m, scores.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        # multiply by the mask AFTER exp: an all-masked block would
+        # otherwise contribute exp(NEG_INF - NEG_INF) = 1 per position
+        p = jnp.exp(scores - m_new[..., None]) * mask[:, None, None, :]
+        l = l * corr + p.sum(axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum("skrp,spkd->skrd", p, vb)
+        return (m_new, l, acc), None
+
+    pages_per_slot = table.shape[1]
+    init = (
+        jnp.full((s, hkv, rep), NEG_INF, jnp.float32),
+        jnp.zeros((s, hkv, rep), jnp.float32),
+        jnp.zeros((s, hkv, rep, d), jnp.float32),
+    )
+    (m, l, acc), _ = jax.lax.scan(body, init, jnp.arange(pages_per_slot))
+    out = acc / l[..., None]
+    return out.reshape(s, hq, d).astype(q.dtype)

@@ -196,3 +196,22 @@ class TestGCSEndToEnd:
         out = tmp_path / "out"
         client.pull("library/m", "v1", str(out))
         assert (out / "weights.bin").read_bytes() == (src / "weights.bin").read_bytes()
+
+
+class TestExtensionRetryPolicy:
+    def test_denied_initiation_fails_fast(self, gcs):
+        """A deterministic 403 on resumable start raises immediately —
+        no triple-POST of an expired/invalid signed URL."""
+        import io as _io
+        import time as _time
+
+        from modelx_tpu import errors
+        from modelx_tpu.client.extension_gcs import GCSExtension
+        from modelx_tpu.types import BlobLocation, Descriptor
+
+        loc = BlobLocation(provider="gcs", purpose="upload",
+                           properties={"resumableUrl": gcs + "/testbucket/k"})
+        t0 = _time.monotonic()
+        with pytest.raises(errors.ErrorInfo):
+            GCSExtension().upload(loc, Descriptor(size=3), _io.BytesIO(b"abc"))
+        assert _time.monotonic() - t0 < 0.5  # no backoff sleeps happened
