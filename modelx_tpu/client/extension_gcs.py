@@ -55,8 +55,10 @@ class GCSExtension(S3Extension):
                 )
                 if r.status_code >= 400:
                     err = errors.ErrorInfo.decode(r.content, r.status_code)
-                    if r.status_code < 500:
-                        raise err  # deterministic (expired/denied): no retry
+                    # 408/429 are documented-retryable; other 4xx
+                    # (expired/denied signature) are deterministic
+                    if r.status_code < 500 and r.status_code not in (408, 429):
+                        raise err
                     raise _Transient(err)
                 session = r.headers.get("Location", "")
                 if not session:

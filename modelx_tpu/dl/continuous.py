@@ -122,7 +122,7 @@ class ContinuousBatcher:
     def __init__(self, server, max_slots: int = 8, chunk_size: int = 8,
                  max_len: int = 0, prefix_cache=None, page_size: int = 0,
                  max_live_tokens: int = 0, speculative_k: int = 0,
-                 max_ngram: int = 3) -> None:
+                 max_ngram: int = 3, paged_attention: str = "gather") -> None:
         if server.family.decode_fns is None:
             raise ValueError(f"family {server.family.name} has no cached decode")
         self.server = server
@@ -148,12 +148,24 @@ class ContinuousBatcher:
         self._fwd, self._init_cache = server.family.decode_fns(
             server.cfg, mesh=server.mesh
         )
-        # paged fast path: a forward whose attention reads the page pools
-        # IN PLACE (ops/paged_attention.py) — no per-step dense gather.
-        # Families without one fall back to the generic gather chunk.
+        # paged chunk attention: "gather" (default) rebuilds a dense view
+        # per step — bit-identical logits to every other decode path, so
+        # the engine's cross-engine token-exactness guarantee holds
+        # unconditionally; "in-place" reads the page pools directly
+        # (ops/paged_attention.py, per-step transient = one page block —
+        # the long-context/HBM-bound deployment shape) at the cost of
+        # blockwise-softmax numerics: greedy matches in practice, sampled
+        # rows can flip at bf16 near-boundaries (measured on v5e). The
+        # operator picks the trade (--kv-attention).
+        if paged_attention not in ("gather", "in-place"):
+            raise ValueError(f"unknown paged_attention mode {paged_attention!r}")
         self._fwd_paged = (
             server.family.paged_decode_fns(server.cfg, mesh=server.mesh)
-            if page_size > 0 and server.family.paged_decode_fns is not None
+            if (
+                page_size > 0
+                and paged_attention == "in-place"
+                and server.family.paged_decode_fns is not None
+            )
             else None
         )
         # -- paged KV (page_size > 0): HBM scales with LIVE tokens ----------

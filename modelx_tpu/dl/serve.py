@@ -866,7 +866,8 @@ class ServerSet:
                  continuous_batch: bool = False, max_slots: int = 8,
                  max_batch: int = 32, batch_window_ms: float = 3.0,
                  stream_chunk_size: int = 8, kv_page_size: int = 0,
-                 kv_live_tokens: int = 0) -> None:
+                 kv_live_tokens: int = 0,
+                 kv_attention: str = "gather") -> None:
         if not servers:
             raise ValueError("no models")
         self.max_new_tokens_limit = max_new_tokens_limit
@@ -884,6 +885,9 @@ class ServerSet:
         # (see dl/continuous.py) — required for max_slots much beyond 8
         self.kv_page_size = kv_page_size
         self.kv_live_tokens = kv_live_tokens
+        # "gather" = bit-exact dense view per step; "in-place" = blockwise
+        # paged attention reading pools directly (see ContinuousBatcher)
+        self.kv_attention = kv_attention
         self.max_batch = max_batch
         self.batch_window_ms = batch_window_ms
         self.stream_chunk_size = stream_chunk_size
@@ -962,6 +966,7 @@ class ServerSet:
                     prefix_cache=server._prefix_cache,
                     page_size=page_size,
                     max_live_tokens=self.kv_live_tokens,
+                    paged_attention=self.kv_attention,
                     # --speculative-k composes with continuous batching:
                     # the engine speculates whenever exactly one greedy row
                     # is active (VERDICT r4: the flags must not be

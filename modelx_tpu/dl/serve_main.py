@@ -48,6 +48,13 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
 @click.option("--kv-live-tokens", default=0, type=int,
               help="paged KV: pool capacity in tokens (default "
                    "max_slots x max_seq_len / 4)")
+@click.option("--kv-attention", default="gather",
+              type=click.Choice(["gather", "in-place"]),
+              help="paged KV chunk attention: 'gather' (default) is "
+                   "bit-exact vs every other decode path; 'in-place' reads "
+                   "the page pools directly (blockwise softmax, per-step "
+                   "transient = one page block — long-context deployments; "
+                   "sampled rows may flip at bf16 near-ties)")
 @click.option("--max-batch", default=32, type=int,
               help="dynamic batching: max requests coalesced per device call")
 @click.option("--batch-window-ms", default=3.0, type=float,
@@ -75,7 +82,7 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
 def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen: str,
          max_seq_len: int, compile_cache: bool, concurrent_load: bool, trace_dir: str,
          dynamic_batch: bool, continuous_batch: bool, max_slots: int,
-         kv_page_size: int, kv_live_tokens: int,
+         kv_page_size: int, kv_live_tokens: int, kv_attention: str,
          max_batch: int, batch_window_ms: float, stream_chunk_size: int,
          prefix_cache: int, quantize: str | None, speculative_k: int,
          loras: tuple[str, ...], drain_seconds: float) -> None:
@@ -143,7 +150,8 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
                      continuous_batch=continuous_batch, max_slots=max_slots,
                      max_batch=max_batch, batch_window_ms=batch_window_ms,
                      stream_chunk_size=stream_chunk_size,
-                     kv_page_size=kv_page_size, kv_live_tokens=kv_live_tokens)
+                     kv_page_size=kv_page_size, kv_live_tokens=kv_live_tokens,
+                     kv_attention=kv_attention)
     httpd = serve(sset, listen=listen)  # starts serving 503s while loading
     stats = sset.load_all(concurrent=concurrent_load)
     logging.getLogger("modelx.serve").info("models loaded: %s", stats)

@@ -235,6 +235,11 @@ def decoder_layer(
     if cache is not None and paged_table is not None:
         from modelx_tpu.ops.paged_attention import paged_attention
 
+        if s != 1:  # static shape: fails clearly at trace time
+            raise ValueError(
+                f"paged decode is single-token only (got seq len {s}); "
+                "multi-token blocks (spec verify) take the dense path"
+            )
         ck, cv = cache  # pools [P, ps, Hkv, D]
         ps = ck.shape[1]
         # scatter this step's k/v into each row's current page (exclusive

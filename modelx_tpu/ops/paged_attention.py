@@ -24,7 +24,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-NEG_INF = -1e30
+from modelx_tpu.ops.attention import NEG_INF  # one masking sentinel everywhere
 
 
 def paged_attention(

@@ -199,19 +199,61 @@ class TestGCSEndToEnd:
 
 
 class TestExtensionRetryPolicy:
-    def test_denied_initiation_fails_fast(self, gcs):
-        """A deterministic 403 on resumable start raises immediately —
+    @staticmethod
+    def _counting_server(status: int):
+        """A server that answers every POST with ``status`` and counts hits."""
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        hits = [0]
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                hits[0] += 1
+                self.send_response(status)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, f"http://127.0.0.1:{httpd.server_address[1]}/b/k", hits
+
+    def test_denied_initiation_fails_fast(self):
+        """A deterministic 403 on resumable start raises after ONE attempt —
         no triple-POST of an expired/invalid signed URL."""
         import io as _io
-        import time as _time
 
         from modelx_tpu import errors
         from modelx_tpu.client.extension_gcs import GCSExtension
         from modelx_tpu.types import BlobLocation, Descriptor
 
-        loc = BlobLocation(provider="gcs", purpose="upload",
-                           properties={"resumableUrl": gcs + "/testbucket/k"})
-        t0 = _time.monotonic()
-        with pytest.raises(errors.ErrorInfo):
-            GCSExtension().upload(loc, Descriptor(size=3), _io.BytesIO(b"abc"))
-        assert _time.monotonic() - t0 < 0.5  # no backoff sleeps happened
+        httpd, url, hits = self._counting_server(403)
+        try:
+            loc = BlobLocation(provider="gcs", purpose="upload",
+                               properties={"resumableUrl": url})
+            with pytest.raises(errors.ErrorInfo):
+                GCSExtension().upload(loc, Descriptor(size=3), _io.BytesIO(b"abc"))
+            assert hits[0] == 1, hits
+        finally:
+            httpd.shutdown()
+
+    def test_rate_limited_initiation_retries(self):
+        """429 is documented-retryable: all three attempts fire."""
+        import io as _io
+
+        from modelx_tpu import errors
+        from modelx_tpu.client.extension_gcs import GCSExtension
+        from modelx_tpu.types import BlobLocation, Descriptor
+
+        httpd, url, hits = self._counting_server(429)
+        try:
+            loc = BlobLocation(provider="gcs", purpose="upload",
+                               properties={"resumableUrl": url})
+            with pytest.raises(errors.ErrorInfo):
+                GCSExtension().upload(loc, Descriptor(size=3), _io.BytesIO(b"abc"))
+            assert hits[0] == 3, hits
+        finally:
+            httpd.shutdown()

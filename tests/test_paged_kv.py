@@ -61,9 +61,13 @@ def gpt2_server(tmp_path_factory):
 
 
 class TestPagedExactness:
-    @pytest.fixture()
-    def engine(self, server):
-        cb = ContinuousBatcher(server, max_slots=4, chunk_size=4, page_size=16)
+    # both chunk-attention modes must be token-exact on the f32 CPU
+    # fixtures ("gather" is bit-exact by construction; "in-place" is
+    # blockwise-softmax and the operator's long-context opt-in)
+    @pytest.fixture(params=["gather", "in-place"])
+    def engine(self, server, request):
+        cb = ContinuousBatcher(server, max_slots=4, chunk_size=4, page_size=16,
+                               paged_attention=request.param)
         yield cb
         cb.close()
 
@@ -308,10 +312,11 @@ class TestPagedAttentionOp:
 
 class TestInPlaceFastPath:
     def test_llama_engine_uses_in_place_attention(self, server):
-        """The llama paged engine wires the in-place forward (no per-step
-        dense gather) and stays token-exact — the suite's exactness tests
-        above all ran THROUGH this path."""
-        cb = ContinuousBatcher(server, max_slots=4, chunk_size=4, page_size=16)
+        """With --kv-attention in-place the llama paged engine wires the
+        pool-reading forward (no per-step dense gather) and stays
+        token-exact on the f32 fixtures."""
+        cb = ContinuousBatcher(server, max_slots=4, chunk_size=4, page_size=16,
+                               paged_attention="in-place")
         try:
             assert cb._fwd_paged is not None
             t = np.array([[5, 9, 2]], np.int32)
@@ -324,9 +329,10 @@ class TestInPlaceFastPath:
 
     def test_gpt2_engine_falls_back_to_gather(self, gpt2_server):
         cb = ContinuousBatcher(gpt2_server, max_slots=4, chunk_size=4,
-                               max_len=128, page_size=16)
+                               max_len=128, page_size=16,
+                               paged_attention="in-place")
         try:
-            assert cb._fwd_paged is None  # generic dense-gather chunk
+            assert cb._fwd_paged is None  # no paged fwd: dense-gather chunk
             t = np.array([[7, 8, 9]], np.int32)
             np.testing.assert_array_equal(
                 cb.generate(t, max_new_tokens=8),
