@@ -41,6 +41,7 @@ them); this is the serving half of the BASELINE north star. Bench target:
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 from typing import Iterator
@@ -168,6 +169,18 @@ class ContinuousBatcher:
             )
             else None
         )
+        if (
+            page_size > 0
+            and paged_attention == "in-place"
+            and self._fwd_paged is None
+        ):
+            # an operator asking for in-place did so for the HBM budget;
+            # a silent fallback would surface only as an OOM later
+            logging.getLogger("modelx.serve").warning(
+                "--kv-attention in-place: family %s has no paged decode; "
+                "falling back to the dense-gather chunk (higher per-step "
+                "transient HBM)", server.family.name,
+            )
         # -- paged KV (page_size > 0): HBM scales with LIVE tokens ----------
         # The dense engine state is [max_slots, max_len] per layer whether a
         # slot is used or not, so slot count multiplies straight into HBM.

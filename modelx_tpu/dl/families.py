@@ -223,6 +223,18 @@ def _mixtral_decode_fns(cfg, mesh=None):
     return fwd, (lambda b, max_len: mixtral.init_kv_cache(cfg, b, max_len))
 
 
+def _mixtral_paged_decode_fns(cfg, mesh=None):
+    from modelx_tpu.models import mixtral
+
+    def fwd(p, t, kv_cache, cache_offset, table, mesh=mesh):
+        return mixtral.forward(
+            p, t, cfg, kv_cache=kv_cache, cache_offset=cache_offset,
+            mesh=mesh, paged_table=table,
+        )
+
+    return fwd
+
+
 # -- gpt2 ---------------------------------------------------------------------
 
 
@@ -327,7 +339,8 @@ FAMILIES: dict[str, Family] = {
                     _llama_generate, _llama_generate_ragged, _llama_decode_fns,
                     _llama_paged_decode_fns),
     "mixtral": Family("mixtral", MIXTRAL_RULES, infer_mixtral_config, _mixtral_forward,
-                      _mixtral_generate, _mixtral_generate_ragged, _mixtral_decode_fns),
+                      _mixtral_generate, _mixtral_generate_ragged, _mixtral_decode_fns,
+                      _mixtral_paged_decode_fns),
     "gpt2": Family("gpt2", GPT2_RULES, infer_gpt2_config, _gpt2_forward,
                    _gpt2_generate, _gpt2_generate_ragged, _gpt2_decode_fns),
     "bert": Family("bert", BERT_RULES, infer_bert_config, _bert_forward, None),

@@ -134,9 +134,11 @@ def forward(
     cache_offset: int | jax.Array = 0,
     mesh: Mesh | None = None,
     attention_impl: str = "auto",
+    paged_table: jax.Array | None = None,
 ) -> tuple[jax.Array, dict | None]:
     """Returns (logits [B,S,V], updated kv_cache). Same contract as
-    llama.forward; the FFN is the sparse-MoE block (ops/moe.py)."""
+    llama.forward (paged_table included — MoE serving gets the in-place
+    paged decode too); the FFN is the sparse-MoE block (ops/moe.py)."""
     ctx = llama.ShardingCtx(mesh)
     acfg = llama.LlamaConfig(
         vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
@@ -178,6 +180,7 @@ def forward(
         x, updated = llama.decoder_layer(
             lp, x, positions, acfg, ctx, cache=cache, cache_offset=cache_offset,
             mesh=mesh, attention_impl=attention_impl, mlp_fn=moe_fn,
+            paged_table=paged_table,
         )
         if updated is not None:
             new_cache[f"k{i}"], new_cache[f"v{i}"] = updated

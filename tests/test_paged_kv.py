@@ -340,3 +340,32 @@ class TestInPlaceFastPath:
             )
         finally:
             cb.close()
+
+
+class TestMixtralInPlace:
+    def test_moe_engine_in_place_exact(self, tmp_path_factory):
+        """Mixtral rides the same decoder_layer paged wiring: in-place
+        paged decode stays token-exact on the f32 fixture."""
+        from modelx_tpu.models import mixtral
+
+        cfg = dataclasses.replace(mixtral.MixtralConfig.tiny(vocab_size=64),
+                                  dtype=jnp.float32)
+        params = mixtral.init_params(cfg, jax.random.PRNGKey(2))
+        d = tmp_path_factory.mktemp("paged-moe")
+        st.write_safetensors(
+            str(d / "model.safetensors"),
+            {k: np.asarray(v) for k, v in params.items()},
+        )
+        srv = ModelServer(str(d), mesh_spec="dp=1", dtype="float32", max_seq_len=96)
+        srv.load()
+        cb = ContinuousBatcher(srv, max_slots=4, chunk_size=4, page_size=16,
+                               paged_attention="in-place")
+        try:
+            assert cb._fwd_paged is not None
+            t = np.array([[5, 9, 2]], np.int32)
+            np.testing.assert_array_equal(
+                cb.generate(t, max_new_tokens=14),
+                srv.generate(t, max_new_tokens=14),
+            )
+        finally:
+            cb.close()
