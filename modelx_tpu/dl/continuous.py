@@ -447,8 +447,6 @@ class ContinuousBatcher:
         compiled program serves every page assignment."""
         from modelx_tpu.ops import sampling as sampling_ops
 
-        ps = self.page_size
-
         def step_fn(carry, _i):
             pool, tok, offsets, steps = carry
             if self._fwd_paged is not None:
@@ -467,18 +465,13 @@ class ContinuousBatcher:
                 logits, dense = self._fwd(
                     params, tok, kv_cache=dense, cache_offset=offsets
                 )
-                page_idx = jnp.take_along_axis(
-                    table, (offsets // ps)[:, None], axis=1
-                )[:, 0]
-                off_in = offsets % ps
+                from modelx_tpu.ops.paged_attention import write_token_kv
 
                 def put_back(p, d):
                     rows = jax.vmap(
                         lambda row, o: jax.lax.dynamic_slice_in_dim(row, o, 1, axis=0)
                     )(d, offsets)  # [slots, 1, ...] — the row each slot wrote
-                    # exclusive page ownership makes the scatter
-                    # collision-free (idle slots all hit the trash page)
-                    return p.at[page_idx, off_in].set(rows[:, 0])
+                    return write_token_kv(p, rows, table, offsets)
 
                 pool = jax.tree_util.tree_map(put_back, pool, dense)
             nxt = sampling_ops.sample(
@@ -509,7 +502,8 @@ class ContinuousBatcher:
         """Paged verify: gather -> forward -> scatter each of the k+1
         written rows back to its page (static unroll over the block width,
         like the admit tail's page writes)."""
-        ps = self.page_size
+        from modelx_tpu.ops.paged_attention import write_token_kv
+
         dense = jax.tree_util.tree_map(
             lambda p: p[table].reshape(self.max_slots, self.max_len, *p.shape[2:]),
             pool,
@@ -520,11 +514,10 @@ class ContinuousBatcher:
         def put_back(p, d):
             for j in range(width):
                 off = offsets + j
-                page_idx = jnp.take_along_axis(table, (off // ps)[:, None], axis=1)[:, 0]
                 rows = jax.vmap(
                     lambda row, o: jax.lax.dynamic_slice_in_dim(row, o, 1, axis=0)
                 )(d, off)
-                p = p.at[page_idx, off % ps].set(rows[:, 0])
+                p = write_token_kv(p, rows, table, off)
             return p
 
         pool = jax.tree_util.tree_map(put_back, pool, dense)

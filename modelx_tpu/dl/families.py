@@ -297,6 +297,18 @@ def _gpt2_decode_fns(cfg, mesh=None):
     return fwd, (lambda b, max_len: gpt2.init_kv_cache(cfg, b, max_len))
 
 
+def _gpt2_paged_decode_fns(cfg, mesh=None):
+    from modelx_tpu.models import gpt2
+
+    def fwd(p, t, kv_cache, cache_offset, table, mesh=mesh):
+        return gpt2.forward(
+            p, t, cfg, kv_cache=kv_cache, cache_offset=cache_offset,
+            paged_table=table,
+        )
+
+    return fwd
+
+
 # -- bert ---------------------------------------------------------------------
 
 
@@ -342,7 +354,8 @@ FAMILIES: dict[str, Family] = {
                       _mixtral_generate, _mixtral_generate_ragged, _mixtral_decode_fns,
                       _mixtral_paged_decode_fns),
     "gpt2": Family("gpt2", GPT2_RULES, infer_gpt2_config, _gpt2_forward,
-                   _gpt2_generate, _gpt2_generate_ragged, _gpt2_decode_fns),
+                   _gpt2_generate, _gpt2_generate_ragged, _gpt2_decode_fns,
+                   _gpt2_paged_decode_fns),
     "bert": Family("bert", BERT_RULES, infer_bert_config, _bert_forward, None),
 }
 

@@ -89,12 +89,15 @@ def forward(
     kv_cache: dict | None = None,
     cache_offset: int | jax.Array = 0,
     mesh=None,
+    paged_table: jax.Array | None = None,
 ) -> tuple[jax.Array, dict | None]:
     """Returns (logits [B, S, V], updated kv_cache or None) — the same
     cached-decode contract as llama.forward, so the shared decode module
     (scan decode, ragged batching, streaming, speculation) serves GPT-2
     unchanged. Prefill: kv_cache=None. Decode: pass the cache and offset
-    (scalar, or [B] for ragged rows)."""
+    (scalar, or [B] for ragged rows). With ``paged_table``, kv_cache holds
+    page pools and attention reads them in place (single-token decode, the
+    continuous engine's --kv-attention in-place path)."""
     b, s = tokens.shape
     if positions is None:
         off = jnp.asarray(cache_offset if kv_cache is not None else 0)
@@ -114,31 +117,46 @@ def forward(
         q = q.reshape(b, s, cfg.num_heads, head_dim)
         k = k.reshape(b, s, cfg.num_heads, head_dim)
         v = v.reshape(b, s, cfg.num_heads, head_dim)
-        if kv_cache is not None:
-            ck, cv = kv_cache[f"k{i}"], kv_cache[f"v{i}"]
-            if jnp.ndim(cache_offset) == 0:
-                ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_offset, 0, 0))
-                cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_offset, 0, 0))
-            else:
-                # ragged batch: each row appends at its own position
-                row_dus = jax.vmap(
-                    lambda c, u, o: jax.lax.dynamic_update_slice(c, u, (o, 0, 0))
+        if kv_cache is not None and paged_table is not None:
+            from modelx_tpu.ops.paged_attention import paged_attention, write_token_kv
+
+            if s != 1:  # static shape: fails clearly at trace time
+                raise ValueError(
+                    f"paged decode is single-token only (got seq len {s})"
                 )
-                ck = row_dus(ck, k, cache_offset)
-                cv = row_dus(cv, v, cache_offset)
+            ck = write_token_kv(kv_cache[f"k{i}"], k, paged_table, cache_offset)
+            cv = write_token_kv(kv_cache[f"v{i}"], v, paged_table, cache_offset)
             new_cache[f"k{i}"], new_cache[f"v{i}"] = ck, cv
-            k_att, v_att = ck, cv
-            q_offset = cache_offset
+            out = paged_attention(
+                q[:, 0], ck, cv, paged_table, cache_offset + 1
+            )[:, None]
         else:
-            k_att, v_att, q_offset = k, v, 0
-        out = attn_ops.attention_reference(
-            q.transpose(0, 2, 1, 3),
-            k_att.transpose(0, 2, 1, 3),
-            v_att.transpose(0, 2, 1, 3),
-            causal=True,
-            q_offset=q_offset,
-        )
-        out = out.transpose(0, 2, 1, 3).reshape(b, s, cfg.hidden_size)
+            if kv_cache is not None:
+                ck, cv = kv_cache[f"k{i}"], kv_cache[f"v{i}"]
+                if jnp.ndim(cache_offset) == 0:
+                    ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_offset, 0, 0))
+                    cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_offset, 0, 0))
+                else:
+                    # ragged batch: each row appends at its own position
+                    row_dus = jax.vmap(
+                        lambda c, u, o: jax.lax.dynamic_update_slice(c, u, (o, 0, 0))
+                    )
+                    ck = row_dus(ck, k, cache_offset)
+                    cv = row_dus(cv, v, cache_offset)
+                new_cache[f"k{i}"], new_cache[f"v{i}"] = ck, cv
+                k_att, v_att = ck, cv
+                q_offset = cache_offset
+            else:
+                k_att, v_att, q_offset = k, v, 0
+            out = attn_ops.attention_reference(
+                q.transpose(0, 2, 1, 3),
+                k_att.transpose(0, 2, 1, 3),
+                v_att.transpose(0, 2, 1, 3),
+                causal=True,
+                q_offset=q_offset,
+            )
+            out = out.transpose(0, 2, 1, 3)
+        out = out.reshape(b, s, cfg.hidden_size)
         x = x + _conv1d(out, params[p + "attn.c_proj.weight"], params[p + "attn.c_proj.bias"])
         h = _layer_norm(x, params[p + "ln_2.weight"], params[p + "ln_2.bias"], cfg.layer_norm_eps)
         h = jax.nn.gelu(_conv1d(h, params[p + "mlp.c_fc.weight"], params[p + "mlp.c_fc.bias"]), approximate=True)

@@ -233,7 +233,7 @@ def decoder_layer(
 
     new_cache: tuple[jax.Array, jax.Array] | None = None
     if cache is not None and paged_table is not None:
-        from modelx_tpu.ops.paged_attention import paged_attention
+        from modelx_tpu.ops.paged_attention import paged_attention, write_token_kv
 
         if s != 1:  # static shape: fails clearly at trace time
             raise ValueError(
@@ -241,15 +241,8 @@ def decoder_layer(
                 "multi-token blocks (spec verify) take the dense path"
             )
         ck, cv = cache  # pools [P, ps, Hkv, D]
-        ps = ck.shape[1]
-        # scatter this step's k/v into each row's current page (exclusive
-        # ownership makes it collision-free; idle rows hit the trash page)
-        page_idx = jnp.take_along_axis(
-            paged_table, (cache_offset // ps)[:, None], axis=1
-        )[:, 0]
-        off_in = cache_offset % ps
-        ck = ck.at[page_idx, off_in].set(k[:, 0])
-        cv = cv.at[page_idx, off_in].set(v[:, 0])
+        ck = write_token_kv(ck, k, paged_table, cache_offset)
+        cv = write_token_kv(cv, v, paged_table, cache_offset)
         new_cache = (ck, cv)
         attn_out = paged_attention(
             q[:, 0], ck, cv, paged_table, cache_offset + 1

@@ -27,6 +27,26 @@ import jax.numpy as jnp
 from modelx_tpu.ops.attention import NEG_INF  # one masking sentinel everywhere
 
 
+def page_coords(table: jax.Array, offsets: jax.Array, page_size: int):
+    """(page_idx [S], off_in_page [S]) locating each row's position
+    ``offsets`` inside its page pool — THE page-addressing convention,
+    shared by every pool write site (model decode branches, the engine's
+    gather fallback and spec verify) so it cannot drift per family."""
+    page_idx = jnp.take_along_axis(
+        table, (offsets // page_size)[:, None], axis=1
+    )[:, 0]
+    return page_idx, offsets % page_size
+
+
+def write_token_kv(pool: jax.Array, block: jax.Array, table: jax.Array,
+                   offsets: jax.Array) -> jax.Array:
+    """Scatter one decode step's [S, 1, H, D] k or v block into each row's
+    current page of the [P, ps, H, D] pool (exclusive page ownership makes
+    it collision-free; idle rows hit the trash page)."""
+    page_idx, off_in = page_coords(table, offsets, pool.shape[1])
+    return pool.at[page_idx, off_in].set(block[:, 0])
+
+
 def paged_attention(
     q: jax.Array,       # [S, Hq, D] — one decode step per slot
     pool_k: jax.Array,  # [P, ps, Hkv, D]

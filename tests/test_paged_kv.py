@@ -327,12 +327,12 @@ class TestInPlaceFastPath:
         finally:
             cb.close()
 
-    def test_gpt2_engine_falls_back_to_gather(self, gpt2_server):
+    def test_gpt2_engine_in_place_exact(self, gpt2_server):
         cb = ContinuousBatcher(gpt2_server, max_slots=4, chunk_size=4,
                                max_len=128, page_size=16,
                                paged_attention="in-place")
         try:
-            assert cb._fwd_paged is None  # no paged fwd: dense-gather chunk
+            assert cb._fwd_paged is not None  # gpt2 wires the paged fwd too
             t = np.array([[7, 8, 9]], np.int32)
             np.testing.assert_array_equal(
                 cb.generate(t, max_new_tokens=8),
