@@ -257,3 +257,23 @@ class TestExtensionRetryPolicy:
             assert hits[0] == 3, hits
         finally:
             httpd.shutdown()
+
+
+class TestGCSGlobalIndex:
+    """GCS-specific store behaviors NOT in the shared battery — the full
+    registry-store contract (manifests, ranged blob GET, GC, index
+    consistency, fault paths) runs against the GCS provider via
+    test_store.py's three-backend ``fs`` fixture."""
+
+    REPO = "library/contract"
+
+    def test_global_index_rebuilds_over_gcs_listings(self, gcs_opts):
+        from modelx_tpu.types import Manifest
+        from tests.test_store import put_blob
+
+        store = GCSRegistryStore(gcs_opts)
+        desc = put_blob(store, self.REPO, b"one", name="a.bin")
+        store.put_manifest(self.REPO, "v1", "", Manifest(blobs=[desc]))
+        store.refresh_global_index()
+        g = store.get_global_index()
+        assert self.REPO in {e.name for e in g.manifests}

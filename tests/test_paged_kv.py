@@ -369,3 +369,22 @@ class TestMixtralInPlace:
             )
         finally:
             cb.close()
+
+
+class TestLongPagedDecode:
+    @pytest.mark.parametrize("mode", ["gather", "in-place"])
+    def test_decode_crossing_many_pages(self, server, mode):
+        """A 76-token decode fills 5 pages (4 prompt + 76 new = 80 tokens
+        at page_size 16, i.e. 4 boundary crossings); both attention modes
+        stay token-exact the whole way (page-to-page handoff of the write
+        position and the growing read span)."""
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4, page_size=16,
+                               paged_attention=mode)
+        try:
+            t = np.array([[5, 9, 2, 7]], np.int32)
+            np.testing.assert_array_equal(
+                cb.generate(t, max_new_tokens=76),
+                server.generate(t, max_new_tokens=76),
+            )
+        finally:
+            cb.close()

@@ -14,11 +14,24 @@ from modelx_tpu.registry.store_fs import FSRegistryStore
 from modelx_tpu.types import Descriptor, Digest, Manifest
 
 
-@pytest.fixture(params=["memory", "local"])
+@pytest.fixture(params=["memory", "local", "gcs"])
 def fs(request, tmp_path):
+    """Every store/GC/contract test below runs against all THREE backends —
+    a new storage provider must not mean new behavior (the S3 provider has
+    its own identically-shaped battery in test_s3.py)."""
     if request.param == "memory":
-        return MemoryFSProvider()
-    return LocalFSProvider(str(tmp_path / "registry"))
+        yield MemoryFSProvider()
+    elif request.param == "local":
+        yield LocalFSProvider(str(tmp_path / "registry"))
+    else:
+        from modelx_tpu.registry.fs_gcs import GCSFSProvider, GCSOptions
+        from tests.fake_gcs import FakeGCS
+
+        srv = FakeGCS()
+        url = srv.start()
+        yield GCSFSProvider(GCSOptions(url=url, access_key="AK",
+                                       secret_key="SK", bucket="contract"))
+        srv.stop()
 
 
 @pytest.fixture
@@ -46,6 +59,12 @@ class TestFSProviderContract:
         assert fs.get("r.bin", offset=2, length=3).size == 3
 
     def test_size_mismatch_rejected(self, fs):
+        if not isinstance(fs, (MemoryFSProvider, LocalFSProvider)):
+            pytest.skip(
+                "object-store providers enforce declared size at the "
+                "store's manifest-commit point (see test_s3/test_gcs "
+                "commit_rejects_size_mismatch), not per put"
+            )
         with pytest.raises(ValueError):
             fs.put("bad.bin", io.BytesIO(b"abc"), 99)
         assert not fs.exists("bad.bin")
