@@ -560,9 +560,12 @@ def measure_continuous(params: dict, mesh, decode_tps: float | None) -> dict:
             rng.randint(1, cfg.vocab_size, (1, 128)).astype(np.int32)
             for _ in range(clients + 1)
         ]
-        # two warm generates: the first compiles admit+chunk, the second
-        # absorbs a measured one-time second-call cost on the tunneled rig
+        # warm generates: the first compiles single-admit+chunk, the
+        # two-row one compiles the size-invariant BATCHED admit program
+        # (one compile per prompt bucket — burst size doesn't retrace), the
+        # last absorbs a measured one-time second-call cost on the tunnel
         cb.generate(prompts[-1], max_new_tokens=8)
+        cb.generate(np.concatenate([prompts[-1], prompts[-1]]), max_new_tokens=8)
         cb.generate(prompts[-1], max_new_tokens=8)
         start = _t.Barrier(clients)
 

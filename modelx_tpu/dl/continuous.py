@@ -123,7 +123,8 @@ class ContinuousBatcher:
     def __init__(self, server, max_slots: int = 8, chunk_size: int = 8,
                  max_len: int = 0, prefix_cache=None, page_size: int = 0,
                  max_live_tokens: int = 0, speculative_k: int = 0,
-                 max_ngram: int = 3, paged_attention: str = "gather") -> None:
+                 max_ngram: int = 3, paged_attention: str = "gather",
+                 pipeline_depth: int = 2) -> None:
         if server.family.decode_fns is None:
             raise ValueError(f"family {server.family.name} has no cached decode")
         self.server = server
@@ -260,10 +261,24 @@ class ContinuousBatcher:
             self._admit_cached_paged_impl if paged else self._admit_cached_impl,
             static_argnums=(13 if paged else 12,), donate_argnums=(2, 3),
         )
+        # batched admission (same-bucket burst arrivals -> one program);
+        # engaged only without a prefix cache — the cached path's per-row
+        # scratch-KV returns would cost k x leaves slice dispatches, and
+        # multi-turn conversations rarely arrive as same-instant bursts
+        self._admit_many_prog = jax.jit(
+            self._admit_many_paged_impl if paged else self._admit_many_impl,
+            donate_argnums=(2, 3),
+        )
         self._chunk = jax.jit(
             self._chunk_paged_impl if paged else self._chunk_impl,
             donate_argnums=(1, 2),
         )
+        # chunks the loop keeps in flight before syncing the oldest: plans
+        # are value-independent (budgets only), so depth-D dispatch is
+        # exact; it hides the per-chunk fetch round-trip behind device
+        # compute. Value-DEPENDENT row exits (stop tokens, client cancels)
+        # lag by up to depth chunks of wasted compute, never wrong tokens.
+        self.pipeline_depth = max(1, int(pipeline_depth))
         self._spec_prog = jax.jit(
             self._spec_verify_paged_impl if paged else self._spec_verify_impl,
             donate_argnums=(1,),
@@ -292,16 +307,67 @@ class ContinuousBatcher:
     # -- compiled programs ----------------------------------------------------
 
     def _sample_first(self, logits, last_idx, temp, top_k, top_p, seed):
-        """The row's first token: step 0 of its sample stream, matching
-        ragged/stream decode byte-for-byte."""
+        """Each row's first token: step 0 of its sample stream, matching
+        ragged/stream decode byte-for-byte. Row-wise: works for the [1, S]
+        single admission and the [k, S] batched admission alike."""
         from modelx_tpu.ops import sampling as sampling_ops
 
-        idx = jnp.broadcast_to(last_idx[:, None, None], (1, 1, logits.shape[-1]))
+        idx = jnp.broadcast_to(
+            last_idx[:, None, None], (logits.shape[0], 1, logits.shape[-1])
+        )
         last = jnp.take_along_axis(logits, idx, axis=1)[:, 0, :]
         return sampling_ops.sample(
             last.astype(jnp.float32), jax.random.PRNGKey(0), temp,
             top_k=top_k, top_p=top_p, seeds=seed, step=0,
         )
+
+    def _admit_many_impl(self, params, prompts, cache, tok, row_lens, slots,
+                         temp, top_k, top_p, seeds):
+        """A burst of same-bucket admissions as ONE program: prefill the
+        [max_slots, Sb] block into a fresh scratch cache, sample every
+        row's first token (step 0 of its own seed stream — identical to k
+        single admits), and scatter the scratch rows into their slots. On
+        a tunneled device each program dispatch costs a host round-trip,
+        so k arrivals admitted one-by-one pay k round-trips where this
+        pays one. The program is SIZE-INVARIANT — the host pads every
+        burst to max_slots rows, pad rows carrying an out-of-bounds slot
+        index whose scatter ``mode="drop"`` discards — so it compiles
+        once per prompt bucket, never per burst size."""
+        small = self._init_cache(prompts.shape[0], prompts.shape[1])
+        logits, small = self._fwd(params, prompts, kv_cache=small, cache_offset=0)
+        firsts = self._sample_first(logits, row_lens - 1, temp, top_k, top_p, seeds)
+        cache = jax.tree_util.tree_map(
+            lambda big, lit: big.at[slots, : lit.shape[1]].set(lit, mode="drop"),
+            cache, small,
+        )
+        tok = tok.at[slots, 0].set(firsts, mode="drop")
+        return cache, tok, firsts
+
+    def _admit_many_paged_impl(self, params, prompts, pool, tok, row_lens,
+                               slots, page_ids, temp, top_k, top_p, seeds):
+        """Paged batched admission: same one-program shape, writing each
+        row's scratch rows into its reserved pages (``page_ids`` is
+        [max_slots, n_prompt_pages] — same bucket means the same page
+        count, so every page column scatters all rows at once). Pad rows'
+        page ids point at the trash page (their writes land harmlessly);
+        their tok scatter drops on the out-of-bounds slot index."""
+        sb = prompts.shape[1]
+        small = self._init_cache(prompts.shape[0], sb)
+        logits, small = self._fwd(params, prompts, kv_cache=small, cache_offset=0)
+        firsts = self._sample_first(logits, row_lens - 1, temp, top_k, top_p, seeds)
+        ps = self.page_size
+
+        def write(pool_leaf, small_leaf):
+            out = pool_leaf
+            for j in range(0, sb, ps):
+                w = min(j + ps, sb) - j
+                blk = jax.lax.slice_in_dim(small_leaf, j, j + w, axis=1)
+                out = out.at[page_ids[:, j // ps], :w].set(blk)
+            return out
+
+        pool = jax.tree_util.tree_map(write, pool, small)
+        tok = tok.at[slots, 0].set(firsts, mode="drop")
+        return pool, tok, firsts
 
     def _finish_admit(self, small, logits, cache, tok, last_idx, slot,
                       temp, top_k, top_p, seed):
@@ -648,12 +714,15 @@ class ContinuousBatcher:
             self._table[slot, :] = 0
             self.stats["pages_free"] = len(self._free_pages)
 
-    def _admit(self, item) -> None:
+    def _prepare_admit(self, item) -> dict | None:
+        """Claim a slot (and, paged, reserve the row's pages) for one
+        admissible item and resolve its prefix-cache hit. Pure host-side
+        bookkeeping — the device dispatch happens in ``_admit_one`` /
+        ``_admit_group`` so a burst of preparations can share a program."""
         ids, n, samp, ticket = item
         if ticket.cancelled:  # consumer left while the request queued
             ticket.out.put(_DONE)
-            return
-        stops = frozenset(samp.get("stop_token_ids") or ())
+            return None
         slot = self._free.pop()
         s = len(ids)
         prompt_pages = None
@@ -667,7 +736,135 @@ class ContinuousBatcher:
             self._table[slot, :need_pages] = pages
             self.stats["pages_free"] = len(self._free_pages)
             n_prompt = -(-pad_seq_len(s) // self.page_size)
-            prompt_pages = jnp.asarray(pages[:n_prompt], jnp.int32)
+            prompt_pages = np.asarray(pages[:n_prompt], np.int32)
+        hit = None
+        if self.prefix_cache is not None:
+            # fit-aware lookup: entries whose bucket + suffix bucket exceed
+            # the slot cache are skipped (shorter fitting prefixes still win)
+            hit = self.prefix_cache.lookup(ids, max_total=self.max_len)
+        return {"ids": ids, "n": n, "samp": samp, "ticket": ticket,
+                "slot": slot, "s": s, "prompt_pages": prompt_pages,
+                "hit": hit, "bucket": pad_seq_len(s), "finished": False}
+
+    def _finish_admit_host(self, prep: dict, first_ref) -> None:
+        """Shared post-dispatch bookkeeping: per-slot vectors, the row
+        object, and its async first-token delivery. ``first_ref`` is a
+        zero-arg callable yielding the row's first token as np [1, 1]."""
+        slot, s, samp = prep["slot"], prep["s"], prep["samp"]
+        k_val = int(samp.get("top_k", 0))
+        p_val = float(samp.get("top_p", 1.0))
+        self._offsets[slot] = s
+        self._steps[slot] = 1  # prefill consumed step 0
+        self._temp[slot] = float(samp.get("temperature", 0.0))
+        self._top_k[slot] = k_val
+        self._top_p[slot] = p_val
+        self._seeds[slot] = int(samp.get("seed", 0))
+        self._use_filters[slot] = k_val > 0 or p_val < 1.0
+        row = _Row(
+            slot, prep["n"], prep["ticket"],
+            stops=frozenset(samp.get("stop_token_ids") or ()),
+            seq=list(prep["ids"]) if self.speculative_k > 0 else None,
+            greedy=float(samp.get("temperature", 0.0)) <= 0.0,
+        )
+        # the prefill's first token is delivered ASYNC (with the next
+        # delivery batch): syncing here would serialize a full dispatch
+        # round-trip per admission, where dispatching N prefills
+        # back-to-back pipelines them
+        row.emitted = 1
+        done = row.emitted >= row.budget
+        self._first_pending.append((row, first_ref, done))
+        if done:
+            self._release_slot(slot)
+        else:
+            self._rows[slot] = row
+        prep["finished"] = True
+        self.stats["admitted"] += 1
+        self.stats["active_peak"] = max(self.stats["active_peak"], len(self._rows))
+
+    def _admit_all(self, preps: list) -> None:
+        """Dispatch a boundary's worth of prepared admissions: same-bucket
+        prefix-cache-free preparations share ONE [k, Sb] program, the rest
+        go one-by-one. If a dispatch dies mid-batch, every not-yet-finished
+        preparation's waiter is failed before the engine unwinds."""
+        try:
+            singles: list = []
+            groups: dict[int, list] = {}
+            for p in preps:
+                if self.prefix_cache is not None:
+                    # single path stores each row's scratch KV (hit or miss)
+                    singles.append(p)
+                else:
+                    groups.setdefault(p["bucket"], []).append(p)
+            for group in groups.values():
+                if len(group) == 1:
+                    singles.append(group[0])
+                    continue
+                with trace.span("continuous.admit_many", rows=len(group)):
+                    self._admit_group(group)
+                self.stats["admit_batches"] = (
+                    self.stats.get("admit_batches", 0) + 1
+                )
+            for p in singles:
+                with trace.span("continuous.admit"):
+                    self._admit_one(p)
+        except BaseException as e:
+            for p in preps:
+                if not p["finished"]:
+                    p["ticket"].out.put(e)
+            raise
+
+    def _admit_group(self, preps: list) -> None:
+        """One size-invariant program admits the whole same-bucket group:
+        always [max_slots, Sb] on the wire, rows past the real burst padded
+        with row_len 1 and an out-of-bounds slot (scatter drops them), so
+        the program compiles once per bucket, never per burst size."""
+        sb, m = preps[0]["bucket"], self.max_slots
+        prompts = np.zeros((m, sb), np.int32)
+        row_lens = np.ones(m, np.int32)  # pad rows: last_idx 0 stays valid
+        slots = np.full(m, m, np.int32)  # pad rows: OOB -> scatter drop
+        temp = np.zeros(m, np.float32)
+        top_k = np.zeros(m, np.int32)
+        top_p = np.ones(m, np.float32)
+        seeds = np.zeros(m, np.int32)
+        for i, p in enumerate(preps):
+            prompts[i, : p["s"]] = p["ids"]
+            row_lens[i] = p["s"]
+            slots[i] = p["slot"]
+            temp[i] = float(p["samp"].get("temperature", 0.0))
+            top_k[i] = int(p["samp"].get("top_k", 0))
+            top_p[i] = float(p["samp"].get("top_p", 1.0))
+            seeds[i] = int(p["samp"].get("seed", 0))
+        filters = bool((top_k > 0).any() or (top_p < 1.0).any())
+        args = [self.server.params, jnp.asarray(prompts), self._cache,
+                self._tok, jnp.asarray(row_lens), jnp.asarray(slots)]
+        if self.page_size > 0:
+            n_prompt = len(preps[0]["prompt_pages"])
+            page_ids = np.zeros((m, n_prompt), np.int32)  # pads -> trash page
+            for i, p in enumerate(preps):
+                page_ids[i] = p["prompt_pages"]
+            args.append(jnp.asarray(page_ids))
+        args += [jnp.asarray(temp),
+                 jnp.asarray(top_k) if filters else None,
+                 jnp.asarray(top_p) if filters else None,
+                 jnp.asarray(seeds)]
+        self._cache, self._tok, firsts = self._admit_many_prog(*args)
+        block = {"dev": firsts, "np": None}
+
+        def first_ref(i: int, block=block):
+            if block["np"] is None:
+                block["np"] = np.asarray(block["dev"])
+            return block["np"][i].reshape(1, 1)
+
+        for i, p in enumerate(preps):
+            self._finish_admit_host(p, lambda i=i: first_ref(i))
+
+    def _admit_one(self, prep: dict) -> None:
+        ids, samp, slot, s = prep["ids"], prep["samp"], prep["slot"], prep["s"]
+        hit = prep["hit"]
+        prompt_pages = (
+            jnp.asarray(prep["prompt_pages"])
+            if prep["prompt_pages"] is not None else None
+        )
         temp = np.asarray([samp.get("temperature", 0.0)], np.float32)
         k_val = int(samp.get("top_k", 0))
         p_val = float(samp.get("top_p", 1.0))
@@ -675,11 +872,6 @@ class ContinuousBatcher:
         top_k = np.asarray([k_val], np.int32) if filters else None
         top_p = np.asarray([p_val], np.float32) if filters else None
         seed = np.asarray([samp.get("seed", 0)], np.int32)
-        hit = None
-        if self.prefix_cache is not None:
-            # fit-aware lookup: entries whose bucket + suffix bucket exceed
-            # the slot cache are skipped (shorter fitting prefixes still win)
-            hit = self.prefix_cache.lookup(ids, max_total=self.max_len)
         if hit is not None:
             plen, stored = hit
             suffix = ids[plen:]
@@ -725,31 +917,9 @@ class ContinuousBatcher:
             # prompt's 16-quantum): store it so the conversation's next turn
             # prefills only its new suffix
             self.prefix_cache.put(ids, small)
-        self._offsets[slot] = s
-        self._steps[slot] = 1  # prefill consumed step 0
-        self._temp[slot] = temp[0]
-        self._top_k[slot] = k_val
-        self._top_p[slot] = p_val
-        self._seeds[slot] = seed[0]
-        self._use_filters[slot] = filters
-        row = _Row(
-            slot, n, ticket, stops=stops,
-            seq=list(ids) if self.speculative_k > 0 else None,
-            greedy=float(samp.get("temperature", 0.0)) <= 0.0,
+        self._finish_admit_host(
+            prep, lambda first=first: np.asarray(first).reshape(1, 1)
         )
-        # the prefill's first token is delivered ASYNC (with the next
-        # delivery batch): syncing here would serialize a full dispatch
-        # round-trip per admission, where dispatching N prefills
-        # back-to-back pipelines them
-        row.emitted = 1
-        done = row.emitted >= row.budget
-        self._first_pending.append((row, first, done))
-        if done:
-            self._release_slot(slot)
-        else:
-            self._rows[slot] = row
-        self.stats["admitted"] += 1
-        self.stats["active_peak"] = max(self.stats["active_peak"], len(self._rows))
 
     def _dispatch_chunk(self) -> tuple:
         """Dispatch one chunk (async) and PLAN its emissions now. Take
@@ -804,12 +974,12 @@ class ContinuousBatcher:
         only on the prefills (ordered before any chunk dispatched after
         them), so N admissions pay one round-trip, not N."""
         firsts, self._first_pending = self._first_pending, []
-        for row, first, done in firsts:
+        for row, first_ref, done in firsts:
             if row.ticket.cancelled:  # consumer gone: free the slot, no put
                 row.out.put(_DONE)
                 row.closed = True
                 continue
-            first_np = np.asarray(first).reshape(1, 1)
+            first_np = first_ref()
             if row.seq is not None:
                 row.seq.append(int(first_np[0, 0]))
             row.out.put(first_np)
@@ -866,34 +1036,56 @@ class ContinuousBatcher:
                 self._release_slot(slot)
 
     def _loop(self) -> None:
-        pending: tuple | None = None  # depth-1 pipeline: one chunk in flight
+        from collections import deque
+
+        pending: "deque[tuple]" = deque()  # in-flight chunks, oldest first
         try:
             while True:
                 self._sweep_closed()
-                # admit everything waiting (up to free slots), FIFO: the
+                # gather everything admissible (up to free slots), FIFO: the
                 # backlog of earlier arrivals that found no slot goes first.
-                # Block on the queue only when fully idle with nothing in
-                # flight AND no admitted row still owed its (async) first
-                # token — a lone budget-1 request admits, frees its slot,
-                # and would otherwise hang its waiter by blocking here
-                # before _deliver_firsts runs
+                # Preparation claims the slot/pages immediately so the
+                # admissibility check for the NEXT item sees true capacity;
+                # the device dispatches happen together below so same-bucket
+                # bursts share one program. Block on the queue only when
+                # fully idle with nothing in flight AND no admitted row
+                # still owed its (async) first token — a lone budget-1
+                # request admits, frees its slot, and would otherwise hang
+                # its waiter by blocking here before _deliver_firsts runs
+                to_admit: list = []
                 while True:
                     if self._waiting:
                         if not self._admits_now(self._waiting[0]):
                             break  # still contended: decode on, retry later
-                        with trace.span("continuous.admit"):
-                            self._admit(self._waiting.pop(0))
+                        prep = self._prepare_admit(self._waiting.pop(0))
+                        if prep is not None:
+                            to_admit.append(prep)
                         continue
-                    block = (not self._rows and pending is None
-                             and not self._first_pending)
+                    block = (not self._rows and not pending
+                             and not self._first_pending and not to_admit)
                     try:
                         item = self._q.get(block=block)
                     except queue.Empty:
                         break
+                    if isinstance(item, list):
+                        # a submit_many burst: route through the FIFO backlog
+                        # so the whole burst hits ONE admission boundary
+                        # (and shares an admit program) regardless of how
+                        # fast this loop drains the queue
+                        self._waiting.extend(item)
+                        continue
                     if item is None:
+                        err = RuntimeError("continuous batcher closed")
+                        for prep in to_admit:  # claimed a slot, never decoded
+                            prep["ticket"].out.put(err)
                         self._deliver_firsts()
-                        self._deliver(pending)
-                        self._fail_active(RuntimeError("continuous batcher closed"))
+                        while pending:
+                            # deliver-then-pop: a chunk that raises stays in
+                            # the deque so the except-path failsafe fails its
+                            # plan rows (they may already be out of _rows)
+                            self._deliver(pending[0])
+                            pending.popleft()
+                        self._fail_active(err)
                         return
                     if not self._admits_now(item):
                         # no slot (or, paged, not enough free pages): hold in
@@ -901,26 +1093,44 @@ class ContinuousBatcher:
                         # chunk frees capacity for it
                         self._waiting.append(item)
                         break
-                    with trace.span("continuous.admit"):
-                        self._admit(item)
+                    prep = self._prepare_admit(item)
+                    if prep is not None:
+                        to_admit.append(prep)
+                if to_admit:
+                    self._admit_all(to_admit)
                 if self._spec_ok():
                     # single greedy row: switch to speculative verify steps
                     # (fewer device steps per token beats pipeline depth
-                    # when there is nothing to pipeline WITH). Drain any
-                    # in-flight chunk + first tokens so the row's history
+                    # when there is nothing to pipeline WITH). Drain all
+                    # in-flight chunks + first tokens so the row's history
                     # is complete, then run one verify round.
                     self._deliver_firsts()
-                    self._deliver(pending)
-                    pending = None
+                    while pending:
+                        self._deliver(pending[0])  # deliver-then-pop: see above
+                        pending.popleft()
                     self._sweep_closed()  # a stop may just have closed it
                     if self._spec_ok():
                         self._spec_step()
                     continue
-                nxt = self._dispatch_chunk() if self._rows else None
-                # both deliveries overlap the chunk just dispatched
+                if self._rows:
+                    # keep up to pipeline_depth chunks in flight: plans are
+                    # value-independent, so deeper dispatch is exact, and the
+                    # oldest chunk's fetch below overlaps the younger chunks'
+                    # device time. Go deep only when nothing is waiting for
+                    # a slot and nothing new sits in the queue — both want
+                    # the next chunk boundary as soon as possible.
+                    pending.append(self._dispatch_chunk())
+                    while (len(pending) < self.pipeline_depth and self._rows
+                           and not self._waiting and self._q.empty()):
+                        pending.append(self._dispatch_chunk())
+                # deliveries overlap the chunks just dispatched.
+                # Deliver-then-pop: a chunk whose fetch raises must stay in
+                # the deque so _deliver_failsafe fails its plan rows (plan
+                # retirees are already out of _rows and _fail_active's reach)
                 self._deliver_firsts()
-                self._deliver(pending)
-                pending = nxt
+                if pending:
+                    self._deliver(pending[0])
+                    pending.popleft()
         except BaseException as e:  # engine death must not hang waiters
             with self._close_lock:
                 # under the lock: submit_row checks _broken inside the same
@@ -930,17 +1140,16 @@ class ContinuousBatcher:
             self._deliver_failsafe(pending, e)
             self._fail_active(e)
 
-    def _deliver_failsafe(self, pending: tuple | None, err: BaseException) -> None:
+    def _deliver_failsafe(self, pending, err: BaseException) -> None:
         """On engine death, rows in an undelivered plan (or with undelivered
         prefill tokens) were possibly already removed from _rows — fail them
         directly so their waiters don't hang."""
         for row, _first, _done in self._first_pending:
             row.out.put(err)
         self._first_pending = []
-        if pending is None:
-            return
-        for _slot, row, _skip, _take, _done in pending[1]:
-            row.out.put(err)
+        for _toks_dev, plan in pending:
+            for _slot, row, _skip, _take, _done in plan:
+                row.out.put(err)
 
     def _fail_active(self, err: BaseException) -> None:
         for row in self._rows.values():
@@ -954,15 +1163,14 @@ class ContinuousBatcher:
                 item = self._q.get_nowait()
             except queue.Empty:
                 return
-            if item is not None:
-                item[3].out.put(err)
+            if item is None:
+                continue
+            for row_item in item if isinstance(item, list) else [item]:
+                row_item[3].out.put(err)
 
     # -- public API -----------------------------------------------------------
 
-    def submit(self, ids: list[int], max_new_tokens: int, samp: dict) -> _Ticket:
-        """Enqueue one prompt row; the returned ticket carries the output
-        queue and a ``cancel()`` the transport calls when its client goes
-        away (the engine then frees the slot at the next chunk boundary)."""
+    def _validate(self, ids: list[int], max_new_tokens: int) -> None:
         s = len(ids)
         if s < 1:
             raise ValueError("empty prompt row")
@@ -981,7 +1189,8 @@ class ContinuousBatcher:
                 f"pages than the engine's pool holds "
                 f"({self.num_pages - 1} x {self.page_size} tokens)"
             )
-        ticket = _Ticket()
+
+    def _enqueue(self, payload) -> None:
         with self._close_lock:
             if self._closed:
                 raise RuntimeError("continuous batcher closed")
@@ -990,8 +1199,30 @@ class ContinuousBatcher:
                 # its final queue drain — a put here either precedes the
                 # drain (and gets failed by it) or raises
                 raise RuntimeError("continuous batcher is broken") from self._broken
-            self._q.put((list(ids), int(max_new_tokens), dict(samp), ticket))
+            self._q.put(payload)
+
+    def submit(self, ids: list[int], max_new_tokens: int, samp: dict) -> _Ticket:
+        """Enqueue one prompt row; the returned ticket carries the output
+        queue and a ``cancel()`` the transport calls when its client goes
+        away (the engine then frees the slot at the next chunk boundary)."""
+        self._validate(ids, max_new_tokens)
+        ticket = _Ticket()
+        self._enqueue((list(ids), int(max_new_tokens), dict(samp), ticket))
         return ticket
+
+    def submit_many(self, rows: list[tuple[list[int], int, dict]]) -> list[_Ticket]:
+        """Enqueue several rows as ONE burst: the engine admits them at the
+        same chunk boundary, so same-bucket rows share an admit program
+        deterministically (a loop of ``submit`` calls races the engine
+        thread for that grouping). Used by multi-row ``generate``."""
+        for ids, n, _samp in rows:
+            self._validate(ids, n)
+        tickets = [_Ticket() for _ in rows]
+        self._enqueue([
+            (list(ids), int(n), dict(samp), t)
+            for (ids, n, samp), t in zip(rows, tickets)
+        ])
+        return tickets
 
     def submit_row(self, ids: list[int], max_new_tokens: int, samp: dict) -> "queue.Queue":
         return self.submit(ids, max_new_tokens, samp).out
@@ -1019,14 +1250,13 @@ class ContinuousBatcher:
         tokens = np.asarray(tokens, np.int32)
         b, s = tokens.shape
         stops = list(stop_token_ids or ())
-        outs = [
-            self.submit_row(
-                tokens[i].tolist(), max_new_tokens,
-                {"temperature": temperature, "top_k": top_k, "top_p": top_p,
-                 "seed": (seed + i) % (2**31), "stop_token_ids": stops},
-            )
+        tickets = self.submit_many([
+            (tokens[i].tolist(), max_new_tokens,
+             {"temperature": temperature, "top_k": top_k, "top_p": top_p,
+              "seed": (seed + i) % (2**31), "stop_token_ids": stops})
             for i in range(b)
-        ]
+        ])
+        outs = [t.out for t in tickets]
         rows = []
         emitted = 0
         for out in outs:

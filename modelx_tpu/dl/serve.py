@@ -867,7 +867,8 @@ class ServerSet:
                  max_batch: int = 32, batch_window_ms: float = 3.0,
                  stream_chunk_size: int = 8, kv_page_size: int = 0,
                  kv_live_tokens: int = 0,
-                 kv_attention: str = "gather") -> None:
+                 kv_attention: str = "gather",
+                 pipeline_depth: int = 2) -> None:
         if not servers:
             raise ValueError("no models")
         self.max_new_tokens_limit = max_new_tokens_limit
@@ -888,6 +889,10 @@ class ServerSet:
         # "gather" = bit-exact dense view per step; "in-place" = blockwise
         # paged attention reading pools directly (see ContinuousBatcher)
         self.kv_attention = kv_attention
+        # chunks the continuous engine keeps in flight before syncing the
+        # oldest (hides the per-chunk fetch round-trip; value-dependent row
+        # exits lag by up to this many chunks of wasted compute)
+        self.pipeline_depth = pipeline_depth
         self.max_batch = max_batch
         self.batch_window_ms = batch_window_ms
         self.stream_chunk_size = stream_chunk_size
@@ -972,6 +977,7 @@ class ServerSet:
                     # is active (VERDICT r4: the flags must not be
                     # mutually exclusive)
                     speculative_k=server.speculative_k,
+                    pipeline_depth=self.pipeline_depth,
                 )
                 self.cbatchers[server.name] = cb
         return cb

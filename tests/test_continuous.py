@@ -391,6 +391,146 @@ class TestContinuousPrefixCache:
             cb.close()
 
 
+class TestBatchedAdmission:
+    """A burst of same-bucket arrivals admits as ONE compiled program
+    (k round-trips -> 1 on a tunneled device) — token-exactly."""
+
+    def test_burst_groups_and_matches(self, server):
+        cb = ContinuousBatcher(server, max_slots=4, chunk_size=4)
+        try:
+            import concurrent.futures
+
+            # same 16-bucket, mixed sampling: one grouped admit program
+            reqs = [
+                (np.array([[1, 2, 3]], np.int32), 6, dict()),
+                (np.array([[9, 8, 7, 6]], np.int32), 6, dict(temperature=0.7, seed=3)),
+                (np.array([[11, 12]], np.int32), 5, dict(temperature=1.1, top_p=0.8, seed=8)),
+                (np.array([[4, 4, 4, 4, 4]], np.int32), 4, dict(top_k=9, temperature=0.4, seed=2)),
+            ]
+            expected = [server.generate(t, max_new_tokens=n, **s) for t, n, s in reqs]
+            barrier = threading.Barrier(len(reqs))
+
+            def go(r):
+                barrier.wait()
+                return cb.generate(r[0], max_new_tokens=r[1], **r[2])
+
+            with concurrent.futures.ThreadPoolExecutor(len(reqs)) as pool:
+                got = list(pool.map(go, reqs))
+            for e, g in zip(expected, got):
+                np.testing.assert_array_equal(g, e)
+            assert cb.stats.get("admit_batches", 0) >= 1, (
+                "simultaneous same-bucket burst never shared an admit program"
+            )
+        finally:
+            cb.close()
+
+    def test_multirow_generate_batches_admissions(self, server):
+        """generate()'s B rows arrive together -> grouped admission, and the
+        per-row seed streams still match the ragged path."""
+        cb = ContinuousBatcher(server, max_slots=4, chunk_size=4)
+        try:
+            tokens = np.array([[5, 9, 2], [8, 1, 1], [3, 3, 3]], np.int32)
+            expected = server.generate(tokens, max_new_tokens=7,
+                                       temperature=0.9, seed=17)
+            got = cb.generate(tokens, max_new_tokens=7, temperature=0.9, seed=17)
+            np.testing.assert_array_equal(got, expected)
+            assert cb.stats.get("admit_batches", 0) >= 1
+        finally:
+            cb.close()
+
+    def test_mixed_buckets_split_groups(self, server):
+        """Arrivals in different prompt buckets can't share a program but
+        must still all admit correctly at one boundary."""
+        cb = ContinuousBatcher(server, max_slots=4, chunk_size=4)
+        try:
+            import concurrent.futures
+
+            reqs = [
+                (np.array([[1, 2]], np.int32), 4, dict()),                      # 16-bucket
+                (np.array([[i % 50 + 1 for i in range(20)]], np.int32), 4, dict()),  # 32-bucket
+                (np.array([[7, 7, 7]], np.int32), 4, dict()),                   # 16-bucket
+            ]
+            expected = [server.generate(t, max_new_tokens=n, **s) for t, n, s in reqs]
+            barrier = threading.Barrier(len(reqs))
+
+            def go(r):
+                barrier.wait()
+                return cb.generate(r[0], max_new_tokens=r[1], **r[2])
+
+            with concurrent.futures.ThreadPoolExecutor(len(reqs)) as pool:
+                got = list(pool.map(go, reqs))
+            for e, g in zip(expected, got):
+                np.testing.assert_array_equal(g, e)
+        finally:
+            cb.close()
+
+    def test_prefix_cache_keeps_single_admissions(self, server):
+        """With a prefix cache the engine admits one-by-one (the batched
+        program has no per-row scratch-KV return) — and stays exact."""
+        from modelx_tpu.models.decode import PrefixKVCache
+
+        cb = ContinuousBatcher(server, max_slots=4, chunk_size=4,
+                               prefix_cache=PrefixKVCache(4))
+        try:
+            tokens = np.array([[5, 9, 2], [8, 1, 1]], np.int32)
+            expected = server.generate(tokens, max_new_tokens=5)
+            got = cb.generate(tokens, max_new_tokens=5)
+            np.testing.assert_array_equal(got, expected)
+            assert cb.stats.get("admit_batches", 0) == 0
+        finally:
+            cb.close()
+
+
+class TestPipelineDepth:
+    """Deeper chunk pipelining (dispatch-ahead) must not change tokens —
+    plans are value-independent, so depth only moves sync points."""
+
+    @pytest.mark.parametrize("depth", [1, 3])
+    def test_depth_variants_match_plain(self, server, depth):
+        cb = ContinuousBatcher(server, max_slots=4, chunk_size=4,
+                               pipeline_depth=depth)
+        try:
+            tokens = np.array([[5, 9, 2, 7, 1]], np.int32)
+            expected = server.generate(tokens, max_new_tokens=13)
+            np.testing.assert_array_equal(
+                cb.generate(tokens, max_new_tokens=13), expected)
+            # concurrent mixed load at this depth too
+            import concurrent.futures
+
+            reqs = [
+                (np.array([[1, 2, 3]], np.int32), 9, dict()),
+                (np.array([[9, 8, 7]], np.int32), 5, dict(temperature=0.7, seed=3)),
+                (np.array([[30]], np.int32), 1, dict()),
+            ]
+            exp = [server.generate(t, max_new_tokens=n, **s) for t, n, s in reqs]
+            with concurrent.futures.ThreadPoolExecutor(len(reqs)) as pool:
+                got = list(pool.map(
+                    lambda r: cb.generate(r[0], max_new_tokens=r[1], **r[2]), reqs))
+            for e, g in zip(exp, got):
+                np.testing.assert_array_equal(g, e)
+        finally:
+            cb.close()
+
+    def test_deep_pipeline_stop_tokens_still_cut(self, server):
+        """Stop hits lag by up to depth chunks of wasted compute but the
+        DELIVERED stream must still cut at the first stop."""
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4,
+                               pipeline_depth=3)
+        try:
+            tokens = np.array([[7, 8, 9]], np.int32)
+            plain = server.generate(tokens, max_new_tokens=24)
+            gen = plain[0, 3:].tolist()
+            stop = gen[5]  # a token ~5 steps in
+            got = cb.generate(tokens, max_new_tokens=24, stop_token_ids=[stop])
+            # inclusive cut: the delivered row ENDS at the stop token — a
+            # full-budget row (stop ignored) must fail here, not pass on a
+            # matching greedy prefix
+            want = tokens[0].tolist() + gen[: gen.index(stop) + 1]
+            assert got[0].tolist() == want
+        finally:
+            cb.close()
+
+
 class TestOtherFamilies:
     def test_gpt2_engine_clamps_to_n_positions_and_matches(self, tmp_path):
         """ServerSet.continuous_for must cap the engine's max_len at gpt2's
