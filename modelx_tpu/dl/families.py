@@ -24,6 +24,7 @@ import numpy as np
 
 from modelx_tpu.dl.sharding import (
     BERT_RULES,
+    GEMMA2_RULES,
     GPT2_RULES,
     LLAMA_RULES,
     MIXTRAL_RULES,
@@ -266,6 +267,77 @@ def infer_qwen2_config(params: dict):
                                rope_theta=1_000_000.0)
 
 
+# -- gemma2 -------------------------------------------------------------------
+
+
+def infer_gemma2_config(params: dict):
+    """Gemma2 shapes are llama-like; head_dim is 256 in every released
+    checkpoint except 27b (hidden 4608, head_dim 128, query_pre_attn_scalar
+    hidden/heads = 144 instead of head_dim). Softcaps and the 4096 sliding
+    window are architecture constants shapes can't reveal."""
+    from modelx_tpu.models import gemma2
+
+    vocab, hidden = _shape(params, "model.embed_tokens.weight")
+    layers = 0
+    while f"model.layers.{layers}.self_attn.q_proj.weight" in params:
+        layers += 1
+    q = _shape(params, "model.layers.0.self_attn.q_proj.weight")[0]
+    kv = _shape(params, "model.layers.0.self_attn.k_proj.weight")[0]
+    inter = _shape(params, "model.layers.0.mlp.gate_proj.weight")[0]
+    if hidden <= 512:  # toy checkpoints
+        head_dim = 32
+        qpas = float(head_dim)
+        window = 16
+    elif hidden >= 4608:  # gemma2-27b
+        head_dim = 128
+        qpas = float(hidden // (q // head_dim))
+        window = 4096
+    else:  # 2b / 9b
+        head_dim = 256
+        qpas = 256.0
+        window = 4096
+    return gemma2.Gemma2Config(
+        vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
+        num_layers=layers, num_heads=q // head_dim,
+        num_kv_heads=kv // head_dim, head_dim=head_dim,
+        query_pre_attn_scalar=qpas, sliding_window=window,
+        dtype=_act_dtype(params, "model.embed_tokens.weight"),
+    )
+
+
+def _gemma2_forward(params, tokens, cfg, mesh=None):
+    from modelx_tpu.models import gemma2
+
+    return gemma2.forward(params, tokens, cfg, mesh=mesh)[0]
+
+
+def _gemma2_generate(params, tokens, cfg, mesh=None, max_new_tokens=16):
+    from modelx_tpu.models import gemma2
+
+    return gemma2.greedy_generate(params, tokens, cfg, max_new_tokens=max_new_tokens, mesh=mesh)
+
+
+def _gemma2_generate_ragged(params, tokens, row_lens, cfg, mesh=None,
+                            max_new_tokens=16, **sampling):
+    from modelx_tpu.models import gemma2
+
+    return gemma2.ragged_greedy_generate(
+        params, tokens, row_lens, cfg, max_new_tokens=max_new_tokens, mesh=mesh,
+        **sampling,
+    )
+
+
+def _gemma2_decode_fns(cfg, mesh=None):
+    from modelx_tpu.models import gemma2
+
+    def fwd(p, t, kv_cache, cache_offset, mesh=mesh):
+        return gemma2.forward(
+            p, t, cfg, kv_cache=kv_cache, cache_offset=cache_offset, mesh=mesh
+        )
+
+    return fwd, (lambda b, max_len: gemma2.init_kv_cache(cfg, b, max_len))
+
+
 def _gpt2_forward(params, tokens, cfg, mesh=None):
     from modelx_tpu.models import gpt2
 
@@ -350,6 +422,12 @@ FAMILIES: dict[str, Family] = {
     "qwen2": Family("qwen2", QWEN2_RULES, infer_qwen2_config, _llama_forward,
                     _llama_generate, _llama_generate_ragged, _llama_decode_fns,
                     _llama_paged_decode_fns),
+    # no paged_decode_fns: gemma2's softcapped/windowed attention isn't
+    # modeled by ops/paged_attention yet — the continuous engine uses its
+    # exact dense-gather chunk for this family
+    "gemma2": Family("gemma2", GEMMA2_RULES, infer_gemma2_config,
+                     _gemma2_forward, _gemma2_generate,
+                     _gemma2_generate_ragged, _gemma2_decode_fns, None),
     "mixtral": Family("mixtral", MIXTRAL_RULES, infer_mixtral_config, _mixtral_forward,
                       _mixtral_generate, _mixtral_generate_ragged, _mixtral_decode_fns,
                       _mixtral_paged_decode_fns),

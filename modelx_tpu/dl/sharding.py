@@ -99,6 +99,10 @@ QWEN2_RULES: Rules = [
     *LLAMA_RULES,
 ]
 
+# Gemma2 (HF names): llama's projection layout; the extra sandwich norms
+# (pre/post_feedforward_layernorm) are 1-D and replicate via the norm rule.
+GEMMA2_RULES: Rules = LLAMA_RULES
+
 # GPT-2 (HF names; Conv1D weights are [in, out] so column-parallel = dim 1).
 GPT2_RULES: Rules = [
     (r"wte\.weight$", ["tp", None]),
@@ -141,6 +145,7 @@ MIXTRAL_RULES: Rules = [
 DEFAULT_RULES: dict[str, Rules] = {
     "llama": LLAMA_RULES,
     "qwen2": QWEN2_RULES,
+    "gemma2": GEMMA2_RULES,
     "gpt2": GPT2_RULES,
     "bert": BERT_RULES,
     "mixtral": MIXTRAL_RULES,
@@ -156,6 +161,8 @@ def infer_family(tensor_names: Sequence[str]) -> str:
     joined = "\n".join(names)
     if "block_sparse_moe" in joined:
         return "mixtral"
+    if "pre_feedforward_layernorm" in joined:
+        return "gemma2"  # llama layout + sandwich norms (unique to gemma2)
     if "q_proj.bias" in joined:
         return "qwen2"  # llama layout + qkv biases
     if "q_proj" in joined or "gate_proj" in joined:

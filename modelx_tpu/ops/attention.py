@@ -35,14 +35,25 @@ NEG_INF = -1e30
 # -- reference (jnp) ----------------------------------------------------------
 
 
-def attention_reference(q, k, v, causal: bool = True, q_offset=0):
-    """Plain softmax(QK^T/sqrt(d))V. Shapes: [B, H, S, D] (kv may have fewer
+def attention_reference(q, k, v, causal: bool = True, q_offset=0,
+                        scale: float | None = None, logit_softcap: float = 0.0,
+                        window: int = 0):
+    """Plain softmax(QK^T * scale)V. Shapes: [B, H, S, D] (kv may have fewer
     heads than q — GQA — as long as H % Hkv == 0). ``q_offset`` positions the
     queries for cached decode: a scalar for uniform batches, or a [B] vector
-    for ragged ones (each row decoding from its own prompt length)."""
+    for ragged ones (each row decoding from its own prompt length).
+
+    ``scale`` defaults to 1/sqrt(head_dim); gemma2-style attention passes
+    query_pre_attn_scalar**-0.5 instead. ``logit_softcap`` > 0 applies
+    cap * tanh(logits / cap) BEFORE masking (the gemma2 convention).
+    ``window`` > 0 limits each query to its last ``window`` keys (sliding
+    window attention; needs ``causal``)."""
     q, k, v = _repeat_kv_heads(q, k, v)
-    scale = 1.0 / math.sqrt(q.shape[-1])
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if logit_softcap > 0.0:
+        logits = logit_softcap * jnp.tanh(logits / logit_softcap)
     if causal:
         qlen, klen = q.shape[2], k.shape[2]
         off = jnp.asarray(q_offset)
@@ -50,7 +61,10 @@ def attention_reference(q, k, v, causal: bool = True, q_offset=0):
             off[:, None, None, None] if off.ndim else off
         )  # [Q,K] or [B,1,Q,K]
         kpos = jnp.arange(klen)[None, :]
-        logits = jnp.where(kpos <= qpos, logits, NEG_INF)
+        visible = kpos <= qpos
+        if window > 0:  # keys qpos-window < kpos <= qpos stay visible
+            visible = visible & (kpos > qpos - window)
+        logits = jnp.where(visible, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(v.dtype), v)
 
