@@ -277,6 +277,15 @@ def measure_ttft(base: str, repo: str, workdir: str, runs: int = 5,
         "ttft_compile_join_ms": med("compile_join_ms"),
         "ttft_first_exec_ms": med("first_exec_ms"),
         "ttft_weights_ready_ms": med("weights_ready_ms"),
+        # best-of alongside the medians: the relay's program-setup tax and
+        # link state swing 5-10x BETWEEN bench invocations (measured: the
+        # same code captured first_exec 133 ms and 1688 ms an hour apart),
+        # so the best run is the capability number, the median the
+        # that-capture number, and ttft_ms_runs the full evidence
+        "ttft_ms_best": round(min(r["ttft_ms"] for r in records), 1),
+        "ttft_weights_ready_best_ms": round(
+            min(r["weights_ready_ms"] for r in records), 1
+        ),
     }
     if int8_runs > 0:
         q_records = []

@@ -44,6 +44,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import Iterator
 
 import jax
@@ -124,7 +125,8 @@ class ContinuousBatcher:
                  max_len: int = 0, prefix_cache=None, page_size: int = 0,
                  max_live_tokens: int = 0, speculative_k: int = 0,
                  max_ngram: int = 3, paged_attention: str = "gather",
-                 pipeline_depth: int = 2) -> None:
+                 pipeline_depth: int = 2,
+                 burst_window_ms: float = 1.0) -> None:
         if server.family.decode_fns is None:
             raise ValueError(f"family {server.family.name} has no cached decode")
         self.server = server
@@ -279,6 +281,10 @@ class ContinuousBatcher:
         # compute. Value-DEPENDENT row exits (stop tokens, client cancels)
         # lag by up to depth chunks of wasted compute, never wrong tokens.
         self.pipeline_depth = max(1, int(pipeline_depth))
+        # idle-burst gather window: when the first request hits an IDLE
+        # engine, wait this long for co-arrivals before admitting (burst ->
+        # one admit program + aligned decode depths). 0 disables.
+        self.burst_window_ms = float(burst_window_ms)
         self._spec_prog = jax.jit(
             self._spec_verify_paged_impl if paged else self._spec_verify_impl,
             donate_argnums=(1,),
@@ -714,6 +720,21 @@ class ContinuousBatcher:
             self._table[slot, :] = 0
             self.stats["pages_free"] = len(self._free_pages)
 
+    def _gather_prep(self, item, to_admit: list) -> None:
+        """Prepare one admissible item into ``to_admit``. If preparation
+        itself dies, every waiter gathered so far (plus this item's) is
+        failed before the engine unwinds — their preps live only in the
+        loop-local list, out of reach of the generic death failsafes."""
+        try:
+            prep = self._prepare_admit(item)
+        except BaseException as e:
+            item[3].out.put(e)
+            for p in to_admit:
+                p["ticket"].out.put(e)
+            raise
+        if prep is not None:
+            to_admit.append(prep)
+
     def _prepare_admit(self, item) -> dict | None:
         """Claim a slot (and, paged, reserve the row's pages) for one
         admissible item and resolve its prefix-cache hit. Pure host-side
@@ -834,7 +855,6 @@ class ContinuousBatcher:
             top_k[i] = int(p["samp"].get("top_k", 0))
             top_p[i] = float(p["samp"].get("top_p", 1.0))
             seeds[i] = int(p["samp"].get("seed", 0))
-        filters = bool((top_k > 0).any() or (top_p < 1.0).any())
         args = [self.server.params, jnp.asarray(prompts), self._cache,
                 self._tok, jnp.asarray(row_lens), jnp.asarray(slots)]
         if self.page_size > 0:
@@ -843,9 +863,11 @@ class ContinuousBatcher:
             for i, p in enumerate(preps):
                 page_ids[i] = p["prompt_pages"]
             args.append(jnp.asarray(page_ids))
-        args += [jnp.asarray(temp),
-                 jnp.asarray(top_k) if filters else None,
-                 jnp.asarray(top_p) if filters else None,
+        # top_k/top_p always ride as ARRAYS here (0 / 1.0 = off per row):
+        # a None variant would mean two compiles per bucket, and the admit
+        # program samples once — the chunk scan's per-step sort-skip
+        # optimization has nothing to save on a one-shot program
+        args += [jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
                  jnp.asarray(seeds)]
         self._cache, self._tok, firsts = self._admit_many_prog(*args)
         block = {"dev": firsts, "np": None}
@@ -1057,9 +1079,7 @@ class ContinuousBatcher:
                     if self._waiting:
                         if not self._admits_now(self._waiting[0]):
                             break  # still contended: decode on, retry later
-                        prep = self._prepare_admit(self._waiting.pop(0))
-                        if prep is not None:
-                            to_admit.append(prep)
+                        self._gather_prep(self._waiting.pop(0), to_admit)
                         continue
                     block = (not self._rows and not pending
                              and not self._first_pending and not to_admit)
@@ -1067,6 +1087,17 @@ class ContinuousBatcher:
                         item = self._q.get(block=block)
                     except queue.Empty:
                         break
+                    if block and self.burst_window_ms > 0 and isinstance(item, tuple):
+                        # the engine was fully idle and one request just
+                        # arrived: wait a beat for its co-arrivals so a
+                        # burst admits as ONE program and decodes in step
+                        # (independent clients racing this loop otherwise
+                        # split across admission boundaries — each straggler
+                        # group then costs whole extra chunks). A lone
+                        # request pays ~1 ms against a ~50+ ms admission
+                        # dispatch; requests landing mid-decode never wait,
+                        # and submit_many bursts arrive whole already.
+                        time.sleep(self.burst_window_ms / 1e3)
                     if isinstance(item, list):
                         # a submit_many burst: route through the FIFO backlog
                         # so the whole burst hits ONE admission boundary
@@ -1093,9 +1124,7 @@ class ContinuousBatcher:
                         # chunk frees capacity for it
                         self._waiting.append(item)
                         break
-                    prep = self._prepare_admit(item)
-                    if prep is not None:
-                        to_admit.append(prep)
+                    self._gather_prep(item, to_admit)
                 if to_admit:
                     self._admit_all(to_admit)
                 if self._spec_ok():

@@ -868,7 +868,8 @@ class ServerSet:
                  stream_chunk_size: int = 8, kv_page_size: int = 0,
                  kv_live_tokens: int = 0,
                  kv_attention: str = "gather",
-                 pipeline_depth: int = 2) -> None:
+                 pipeline_depth: int = 2,
+                 burst_window_ms: float = 1.0) -> None:
         if not servers:
             raise ValueError("no models")
         self.max_new_tokens_limit = max_new_tokens_limit
@@ -893,6 +894,9 @@ class ServerSet:
         # oldest (hides the per-chunk fetch round-trip; value-dependent row
         # exits lag by up to this many chunks of wasted compute)
         self.pipeline_depth = pipeline_depth
+        # idle-burst gather window (ms): co-arrivals at an idle engine admit
+        # as one program + decode in step; 0 disables
+        self.burst_window_ms = burst_window_ms
         self.max_batch = max_batch
         self.batch_window_ms = batch_window_ms
         self.stream_chunk_size = stream_chunk_size
@@ -978,6 +982,7 @@ class ServerSet:
                     # mutually exclusive)
                     speculative_k=server.speculative_k,
                     pipeline_depth=self.pipeline_depth,
+                    burst_window_ms=self.burst_window_ms,
                 )
                 self.cbatchers[server.name] = cb
         return cb

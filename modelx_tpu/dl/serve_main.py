@@ -69,6 +69,10 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
                    "round-trip behind device compute (stop-token and "
                    "disconnect exits lag by up to DEPTH chunks of wasted "
                    "compute; 1 = classic lockstep)")
+@click.option("--burst-window-ms", default=1.0, type=float,
+              help="continuous batching: when a request hits an IDLE "
+                   "engine, wait this long for co-arrivals so the burst "
+                   "admits as one program and decodes in step (0 = off)")
 @click.option("--prefix-cache", default=0, type=int,
               help="keep the prefill KV of the last N single-row stream "
                    "prompts on device: multi-turn chats that re-send their "
@@ -90,7 +94,7 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
          dynamic_batch: bool, continuous_batch: bool, max_slots: int,
          kv_page_size: int, kv_live_tokens: int, kv_attention: str,
          max_batch: int, batch_window_ms: float, stream_chunk_size: int,
-         pipeline_depth: int,
+         pipeline_depth: int, burst_window_ms: float,
          prefix_cache: int, quantize: str | None, speculative_k: int,
          loras: tuple[str, ...], drain_seconds: float) -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
@@ -158,7 +162,8 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
                      max_batch=max_batch, batch_window_ms=batch_window_ms,
                      stream_chunk_size=stream_chunk_size,
                      kv_page_size=kv_page_size, kv_live_tokens=kv_live_tokens,
-                     kv_attention=kv_attention, pipeline_depth=pipeline_depth)
+                     kv_attention=kv_attention, pipeline_depth=pipeline_depth,
+                     burst_window_ms=burst_window_ms)
     httpd = serve(sset, listen=listen)  # starts serving 503s while loading
     stats = sset.load_all(concurrent=concurrent_load)
     logging.getLogger("modelx.serve").info("models loaded: %s", stats)
