@@ -562,7 +562,13 @@ def measure_continuous(params: dict, mesh, decode_tps: float | None) -> dict:
     shim.stats = {"tokens_generated": 0}
     chunk = 128
     clients, new_tokens = 8, 256
-    cb = ContinuousBatcher(shim, max_slots=8, chunk_size=chunk, max_len=1024)
+    # burst_window_ms 5: the 8 barrier-released clients contend on the GIL
+    # while submitting, so give co-arrivals a real window — admitting the
+    # whole burst as one batch keeps every row at the same decode depth
+    # (stragglers that miss a 128-step chunk boundary cost a whole extra
+    # chunk of misaligned decode)
+    cb = ContinuousBatcher(shim, max_slots=8, chunk_size=chunk, max_len=1024,
+                           burst_window_ms=5.0)
     try:
         rng = np.random.RandomState(11)
         prompts = [

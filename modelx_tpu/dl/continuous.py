@@ -1087,7 +1087,8 @@ class ContinuousBatcher:
                         item = self._q.get(block=block)
                     except queue.Empty:
                         break
-                    if block and self.burst_window_ms > 0 and isinstance(item, tuple):
+                    if (block and self.burst_window_ms > 0
+                            and item is not None and self.max_slots > 1):
                         # the engine was fully idle and one request just
                         # arrived: wait a beat for its co-arrivals so a
                         # burst admits as ONE program and decodes in step
@@ -1095,8 +1096,10 @@ class ContinuousBatcher:
                         # split across admission boundaries — each straggler
                         # group then costs whole extra chunks). A lone
                         # request pays ~1 ms against a ~50+ ms admission
-                        # dispatch; requests landing mid-decode never wait,
-                        # and submit_many bursts arrive whole already.
+                        # dispatch; requests landing mid-decode never wait.
+                        # Applies to submit_many lists too: a single-row
+                        # generate IS a 1-row list, and independent clients'
+                        # lists co-arrive exactly like tuples do.
                         time.sleep(self.burst_window_ms / 1e3)
                     if isinstance(item, list):
                         # a submit_many burst: route through the FIFO backlog

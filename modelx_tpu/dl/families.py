@@ -338,6 +338,18 @@ def _gemma2_decode_fns(cfg, mesh=None):
     return fwd, (lambda b, max_len: gemma2.init_kv_cache(cfg, b, max_len))
 
 
+def _gemma2_paged_decode_fns(cfg, mesh=None):
+    from modelx_tpu.models import gemma2
+
+    def fwd(p, t, kv_cache, cache_offset, table, mesh=mesh):
+        return gemma2.forward(
+            p, t, cfg, kv_cache=kv_cache, cache_offset=cache_offset,
+            mesh=mesh, paged_table=table,
+        )
+
+    return fwd
+
+
 def _gpt2_forward(params, tokens, cfg, mesh=None):
     from modelx_tpu.models import gpt2
 
@@ -422,12 +434,10 @@ FAMILIES: dict[str, Family] = {
     "qwen2": Family("qwen2", QWEN2_RULES, infer_qwen2_config, _llama_forward,
                     _llama_generate, _llama_generate_ragged, _llama_decode_fns,
                     _llama_paged_decode_fns),
-    # no paged_decode_fns: gemma2's softcapped/windowed attention isn't
-    # modeled by ops/paged_attention yet — the continuous engine uses its
-    # exact dense-gather chunk for this family
     "gemma2": Family("gemma2", GEMMA2_RULES, infer_gemma2_config,
                      _gemma2_forward, _gemma2_generate,
-                     _gemma2_generate_ragged, _gemma2_decode_fns, None),
+                     _gemma2_generate_ragged, _gemma2_decode_fns,
+                     _gemma2_paged_decode_fns),
     "mixtral": Family("mixtral", MIXTRAL_RULES, infer_mixtral_config, _mixtral_forward,
                       _mixtral_generate, _mixtral_generate_ragged, _mixtral_decode_fns,
                       _mixtral_paged_decode_fns),

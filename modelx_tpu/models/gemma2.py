@@ -18,11 +18,13 @@ architectural deltas implemented to match HF `Gemma2ForCausalLM` exactly:
   checkpoints), odd layers attend globally;
 - embeddings are always tied (no lm_head.weight in checkpoints).
 
-The softcap/window combination routes attention through the reference
-(jnp) implementation — XLA fuses the tanh into the score matmul's
-epilogue, so prefill still rides the MXU; the pallas flash kernel and the
-in-place paged path don't model softcapping yet (the continuous engine's
-paged mode falls back to its exact dense-gather chunk for this family).
+Both hot attention paths carry the gemma2 semantics natively: prefill on
+TPU rides the pallas flash kernel (scale/softcap/window live inside the
+online-softmax loop, with window-aware k-block skipping — long-context
+prefill does O(S * window) work on the sliding layers instead of O(S^2)),
+and the continuous engine's ``--kv-attention in-place`` paged decode
+reads the page pools directly (ops/paged_attention carries the same
+kwargs). Cached dense decode and CPU tests use the reference path.
 
 No reference counterpart (kubegems/modelx stores checkpoints without
 executing them); family surface mirrors `pkg/client` model-agnosticism.
@@ -154,15 +156,28 @@ def _rms_norm(x, weight, eps: float):
     return (out * (1.0 + weight.astype(jnp.float32))).astype(x.dtype)
 
 
-def _attend(q, k, v, cfg: Gemma2Config, q_offset, window: int):
-    """[B,S,H,D] in/out; reference attention with gemma2's scale + softcap
-    (+ sliding window on even layers)."""
-    out = attn_ops.attention_reference(
-        q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3),
-        causal=True, q_offset=q_offset,
-        scale=cfg.query_pre_attn_scalar ** -0.5,
-        logit_softcap=cfg.attn_logit_softcap, window=window,
-    )
+def _attend(q, k, v, cfg: Gemma2Config, q_offset, window: int,
+            prefill: bool = False, mesh: "Mesh | None" = None):
+    """[B,S,H,D] in/out; gemma2's scale + softcap (+ sliding window on even
+    layers). Prefill on TPU rides the pallas flash kernel (it carries the
+    same scale/softcap/window semantics, with window-aware block skipping);
+    cached decode uses the reference path (per-row q_offset vectors), and
+    so do sequence-parallel meshes — the pallas kernel doesn't model sp
+    partitioning (ring attention doesn't model softcap/window yet), while
+    XLA partitions the reference einsums under the sp constraints."""
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    kwargs = dict(scale=cfg.query_pre_attn_scalar ** -0.5,
+                  logit_softcap=cfg.attn_logit_softcap, window=window)
+    sp_active = (mesh is not None and "sp" in mesh.axis_names
+                 and mesh.shape["sp"] > 1)
+    if prefill and not sp_active and jax.default_backend() == "tpu":
+        out = attn_ops.flash_attention(qt, kt, vt, causal=True, **kwargs)
+    else:
+        out = attn_ops.attention_reference(
+            qt, kt, vt, causal=True, q_offset=q_offset, **kwargs
+        )
     return out.transpose(0, 2, 1, 3)
 
 
@@ -175,9 +190,12 @@ def decoder_layer(
     layer_idx: int,
     cache: tuple[jax.Array, jax.Array] | None = None,
     cache_offset: int | jax.Array = 0,
+    paged_table: jax.Array | None = None,
 ) -> tuple[jax.Array, tuple[jax.Array, jax.Array] | None]:
     """One gemma2 block: sandwich norms around both halves; even layers
-    slide their attention window."""
+    slide their attention window. ``paged_table`` switches the cached path
+    to PAGED layout (page pools + block table, single-token steps), like
+    llama's decoder_layer."""
     b, s = x.shape[:2]
     window = cfg.sliding_window if layer_idx % 2 == 0 else 0
     h = _rms_norm(x, lp["input_layernorm.weight"], cfg.rms_eps)
@@ -191,7 +209,24 @@ def decoder_layer(
     k = ctx.constrain(_rope(k, positions, cfg.rope_theta), "dp", "sp", "tp", None)
 
     new_cache: tuple[jax.Array, jax.Array] | None = None
-    if cache is not None:
+    if cache is not None and paged_table is not None:
+        from modelx_tpu.ops.paged_attention import paged_attention, write_token_kv
+
+        if s != 1:  # static shape: fails clearly at trace time
+            raise ValueError(
+                f"paged decode is single-token only (got seq len {s}); "
+                "multi-token blocks (spec verify) take the dense path"
+            )
+        ck, cv = cache  # pools [P, ps, Hkv, D]
+        ck = write_token_kv(ck, k, paged_table, cache_offset)
+        cv = write_token_kv(cv, v, paged_table, cache_offset)
+        new_cache = (ck, cv)
+        attn_out = paged_attention(
+            q[:, 0], ck, cv, paged_table, cache_offset + 1,
+            scale=cfg.query_pre_attn_scalar ** -0.5,
+            logit_softcap=cfg.attn_logit_softcap, window=window,
+        )[:, None]  # [B, 1, Hq, D]
+    elif cache is not None:
         ck, cv = cache
         if jnp.ndim(cache_offset) == 0:
             ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_offset, 0, 0))
@@ -205,7 +240,8 @@ def decoder_layer(
         new_cache = (ck, cv)
         attn_out = _attend(q, ck, cv, cfg, q_offset=cache_offset, window=window)
     else:
-        attn_out = _attend(q, k, v, cfg, q_offset=0, window=window)
+        attn_out = _attend(q, k, v, cfg, q_offset=0, window=window,
+                           prefill=True, mesh=ctx.mesh)
 
     attn_out = attn_out.reshape(b, s, cfg.num_heads * cfg.head_dim)
     attn_out = _linear(attn_out, lp["self_attn.o_proj.weight"])
@@ -229,9 +265,11 @@ def forward(
     kv_cache: dict | None = None,
     cache_offset: int | jax.Array = 0,
     mesh: Mesh | None = None,
+    paged_table: jax.Array | None = None,
 ) -> tuple[jax.Array, dict | None]:
     """Returns (logits [B,S,V], updated kv_cache). Prefill: kv_cache=None;
-    decode: pass the cache and offset with tokens [B, 1]."""
+    decode: pass the cache and offset with tokens [B, 1]. With
+    ``paged_table``, kv_cache holds PAGE POOLS read in place."""
     ctx = ShardingCtx(mesh)
     b, s = tokens.shape
     if positions is None:
@@ -252,6 +290,7 @@ def forward(
         cache = (kv_cache[f"k{i}"], kv_cache[f"v{i}"]) if kv_cache is not None else None
         x, updated = decoder_layer(
             lp, x, positions, cfg, ctx, i, cache=cache, cache_offset=cache_offset,
+            paged_table=paged_table,
         )
         if updated is not None:
             new_cache[f"k{i}"], new_cache[f"v{i}"] = updated

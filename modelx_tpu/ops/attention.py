@@ -80,10 +80,14 @@ def _repeat_kv_heads(q, k, v):
 # -- pallas flash kernel ------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sm_scale: float):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  sm_scale: float, logit_softcap: float = 0.0, window: int = 0):
     """One (batch*head, q-block) program: online softmax over k/v blocks.
 
     q_ref: [block_q, d], k_ref/v_ref: [seq_k, d], o_ref: [block_q, d].
+    ``logit_softcap`` > 0 tanh-caps the scaled scores before masking and
+    ``window`` > 0 limits each query to its last ``window`` keys (gemma2);
+    both default off, preserving the plain flash semantics.
     """
     block_q, d = q_ref.shape
     seq_k = k_ref.shape[0]
@@ -97,14 +101,23 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sm_
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )  # [block_q, block_k]
+        if logit_softcap > 0.0:
+            s = logit_softcap * jnp.tanh(s / logit_softcap)
         if causal:
             qpos = q_idx * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             kpos = start_k * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(kpos <= qpos, s, NEG_INF)
+            visible = kpos <= qpos
+            if window > 0:
+                visible = visible & (kpos > qpos - window)
+            s = jnp.where(visible, s, NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
+        # multiply by the visibility mask after exp when a block can be
+        # fully masked (window mode): exp(NEG_INF - NEG_INF) = 1 otherwise
         p = jnp.exp(s - m_new[:, None])
+        if causal and window > 0:
+            p = jnp.where(visible, p, 0.0)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1)
         acc = acc * alpha[:, None] + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
@@ -112,25 +125,37 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sm_
         return acc, m_new, l_new
 
     num_k = seq_k // block_k
+    lo = 0
     if causal:
         # skip fully-masked k blocks beyond this q block: exact ceiling of
         # the last visible key over block_k. (The previous floor-based form
         # computed ZERO blocks for early q blocks whenever block_k >
         # block_q, silently zeroing those output rows.)
         num_k = jnp.minimum(num_k, ((q_idx + 1) * block_q + block_k - 1) // block_k)
+        if window > 0:
+            # ...and the fully-below-window blocks before it: the earliest
+            # key any query in this block can see is q_idx*bq - window + 1
+            lo = jnp.maximum(0, (q_idx * block_q - window + 1) // block_k)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
-    acc, _m, l = jax.lax.fori_loop(0, num_k, body, (acc0, m0, l0))
+    acc, _m, l = jax.lax.fori_loop(lo, num_k, body, (acc0, m0, l0))
     o_ref[:] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret"))
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 128, block_k: int = 128, interpret: bool | None = None):
+@functools.partial(jax.jit, static_argnames=(
+    "causal", "block_q", "block_k", "interpret", "scale", "logit_softcap",
+    "window"))
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None,
+                    scale: float | None = None, logit_softcap: float = 0.0,
+                    window: int = 0):
     """Flash attention via pallas. q/k/v: [B, H, S, D] (GQA allowed).
 
     Falls back to interpret mode automatically off-TPU so the same call site
     works in CPU tests (pallas_guide.md: interpret=True for debugging).
+    ``scale``/``logit_softcap``/``window`` mirror attention_reference — the
+    gemma2 prefill rides the MXU kernel with its own semantics.
     """
     q, k, v = _repeat_kv_heads(q, k, v)
     b, h, sq, d = q.shape
@@ -139,15 +164,18 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 128, block_k: i
         interpret = jax.default_backend() != "tpu"
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k:
-        return attention_reference(q, k, v, causal=causal)  # ragged fallback
-    sm_scale = 1.0 / math.sqrt(d)
+    if sq % block_q or sk % block_k:  # ragged fallback
+        return attention_reference(q, k, v, causal=causal, scale=scale,
+                                   logit_softcap=logit_softcap, window=window)
+    sm_scale = scale if scale is not None else 1.0 / math.sqrt(d)
 
     qr = q.reshape(b * h, sq, d)
     kr = k.reshape(b * h, sk, d)
     vr = v.reshape(b * h, sk, d)
     out = pl.pallas_call(
-        functools.partial(_flash_kernel, block_k=block_k, causal=causal, sm_scale=sm_scale),
+        functools.partial(_flash_kernel, block_k=block_k, causal=causal,
+                          sm_scale=sm_scale, logit_softcap=logit_softcap,
+                          window=window),
         grid=(b * h, sq // block_q),
         in_specs=[
             pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),

@@ -21,6 +21,8 @@ property as the prefill flash kernel. fp32 accumulation throughout.
 
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -53,14 +55,24 @@ def paged_attention(
     pool_v: jax.Array,  # [P, ps, Hkv, D]
     table: jax.Array,   # [S, pages_per_slot] int32 (0 = trash page)
     lengths: jax.Array,  # [S] valid positions per slot (= offset + 1)
+    scale: float | None = None,
+    logit_softcap: float = 0.0,
+    window: int = 0,
 ) -> jax.Array:
     """Returns [S, Hq, D]. Positions >= lengths[s] (junk pages, partial
     tails) contribute exactly zero weight; every slot has >= 1 valid
-    position (idle slots attend to their trash-page write at 0)."""
+    position (idle slots attend to their trash-page write at 0).
+
+    ``scale`` defaults to 1/sqrt(head_dim). ``logit_softcap`` > 0 applies
+    cap * tanh(scores / cap) before masking, ``window`` > 0 keeps only
+    each row's last ``window`` positions visible — gemma2's decode
+    semantics, matching attention_reference's kwargs of the same names."""
     s, hq, d = q.shape
     _p, ps, hkv, _d = pool_k.shape
     rep = hq // hkv
-    qg = (q.astype(jnp.float32) / jnp.sqrt(jnp.float32(d))).reshape(s, hkv, rep, d)
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    qg = (q.astype(jnp.float32) * jnp.float32(scale)).reshape(s, hkv, rep, d)
 
     def body(carry, j):
         m, l, acc = carry
@@ -68,8 +80,12 @@ def paged_attention(
         kb = pool_k[pids].astype(jnp.float32)    # [S, ps, Hkv, D]
         vb = pool_v[pids].astype(jnp.float32)
         scores = jnp.einsum("skrd,spkd->skrp", qg, kb)  # [S, Hkv, rep, ps]
+        if logit_softcap > 0.0:
+            scores = logit_softcap * jnp.tanh(scores / logit_softcap)
         pos = j * ps + jnp.arange(ps)
         mask = pos[None, :] < lengths[:, None]   # [S, ps]
+        if window > 0:  # each row's query sits at lengths-1
+            mask = mask & (pos[None, :] > lengths[:, None] - 1 - window)
         scores = jnp.where(mask[:, None, None, :], scores, NEG_INF)
         m_new = jnp.maximum(m, scores.max(axis=-1))
         corr = jnp.exp(m - m_new)

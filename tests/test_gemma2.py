@@ -177,6 +177,69 @@ class TestDecode:
         np.testing.assert_array_equal(np.asarray(got), np.asarray(toks))
 
 
+class TestFlashGemma2Semantics:
+    @pytest.mark.parametrize("window", [0, 12, 48])
+    def test_flash_softcap_window_matches_reference(self, window):
+        """The pallas kernel (interpret mode on CPU) with scale/softcap/
+        window must match attention_reference — the contract gemma2's TPU
+        prefill rides. Blocked shapes (block 16 over seq 64) exercise the
+        window-aware lower block skip and the all-masked-block exp fix."""
+        from modelx_tpu.ops.attention import attention_reference, flash_attention
+
+        rng = np.random.RandomState(1)
+        B, H, S, D = 2, 4, 64, 16
+        q = jnp.asarray(rng.randn(B, H, S, D), jnp.float32)
+        k = jnp.asarray(rng.randn(B, H // 2, S, D), jnp.float32)  # GQA
+        v = jnp.asarray(rng.randn(B, H // 2, S, D), jnp.float32)
+        kw = dict(scale=32.0 ** -0.5, logit_softcap=50.0, window=window)
+        ref = attention_reference(q, k, v, causal=True, **kw)
+        got = flash_attention(q, k, v, causal=True, block_q=16, block_k=16,
+                              interpret=True, **kw)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
+class TestPagedAttentionGemma2Semantics:
+    def test_softcap_window_matches_reference(self):
+        """paged_attention with scale/softcap/window must match
+        attention_reference given the SAME kwargs over the equivalent
+        dense cache — the contract the gemma2 in-place decode rides."""
+        from modelx_tpu.ops.attention import attention_reference
+        from modelx_tpu.ops.paged_attention import paged_attention
+
+        rng = np.random.RandomState(0)
+        S, Hq, Hkv, D, ps, pps = 3, 4, 2, 16, 8, 6
+        max_len = ps * pps
+        P = 1 + S * pps
+        lengths = np.array([5, 17, 44], np.int32)
+        dense_k = rng.randn(S, max_len, Hkv, D).astype(np.float32)
+        dense_v = rng.randn(S, max_len, Hkv, D).astype(np.float32)
+        pool_k = np.zeros((P, ps, Hkv, D), np.float32)
+        pool_v = np.zeros((P, ps, Hkv, D), np.float32)
+        table = np.zeros((S, pps), np.int32)
+        pid = 1
+        for s in range(S):
+            for j in range(pps):
+                table[s, j] = pid
+                pool_k[pid] = dense_k[s, j * ps:(j + 1) * ps]
+                pool_v[pid] = dense_v[s, j * ps:(j + 1) * ps]
+                pid += 1
+        q = rng.randn(S, Hq, D).astype(np.float32)
+        kw = dict(scale=32.0 ** -0.5, logit_softcap=50.0, window=12)
+        ref = attention_reference(
+            jnp.asarray(q)[:, :, None, :],
+            jnp.asarray(dense_k).transpose(0, 2, 1, 3),
+            jnp.asarray(dense_v).transpose(0, 2, 1, 3),
+            causal=True, q_offset=jnp.asarray(lengths - 1), **kw,
+        )[:, :, 0, :]
+        got = paged_attention(
+            jnp.asarray(q), jnp.asarray(pool_k), jnp.asarray(pool_v),
+            jnp.asarray(table), jnp.asarray(lengths), **kw,
+        )
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+
 class TestServing:
     def test_serves_end_to_end_with_continuous_engine(self, tmp_path):
         from modelx_tpu.dl import safetensors as st
@@ -209,5 +272,41 @@ class TestServing:
         try:
             np.testing.assert_array_equal(
                 cb.generate(prompt, max_new_tokens=6), got)
+        finally:
+            cb.close()
+
+    def test_paged_in_place_engine_exact(self, tmp_path):
+        """--kv-attention in-place wires gemma2's pool-reading forward
+        (softcap + sliding window in the paged op) and must stay
+        token-exact on the f32 fixture, past a page boundary AND past the
+        tiny config's sliding window."""
+        from modelx_tpu.dl import safetensors as st
+        from modelx_tpu.dl.continuous import ContinuousBatcher
+        from modelx_tpu.dl.serve import ModelServer
+        from modelx_tpu.models import gemma2
+
+        cfg = dataclasses.replace(gemma2.Gemma2Config.tiny(vocab_size=64),
+                                  dtype=jnp.float32)
+        params = gemma2.init_params(cfg, jax.random.PRNGKey(4))
+        d = tmp_path / "g2p"
+        d.mkdir()
+        st.write_safetensors(
+            str(d / "model.safetensors"), {k: np.asarray(v) for k, v in params.items()}
+        )
+        server = ModelServer(str(d), mesh_spec="dp=1", dtype="float32",
+                             max_seq_len=96, name="g2p")
+        server.load()
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4, page_size=16,
+                               paged_attention="in-place")
+        try:
+            assert cb._fwd_paged is not None  # gemma2 wires the paged fwd
+            t = np.array([[5, 9, 2]], np.int32)
+            # 28 new tokens: crosses page boundaries (ps 16) and decodes
+            # past sliding_window 16, so the windowed layer's paged mask
+            # does real work
+            np.testing.assert_array_equal(
+                cb.generate(t, max_new_tokens=28),
+                server.generate(t, max_new_tokens=28),
+            )
         finally:
             cb.close()
