@@ -33,7 +33,7 @@ def checkpoints(tmp_path_factory):
     root = tmp_path_factory.mktemp("families")
     out = {}
 
-    from modelx_tpu.models import bert, gpt2, llama, mixtral
+    from modelx_tpu.models import bert, gemma2, gpt2, llama, mixtral
 
     cfg = llama.LlamaConfig.tiny(vocab_size=64)
     import dataclasses
@@ -49,6 +49,9 @@ def checkpoints(tmp_path_factory):
 
     m = dataclasses.replace(mixtral.MixtralConfig.tiny(vocab_size=64), dtype=jnp.float32)
     out["mixtral"] = _write_checkpoint(root / "mixtral", mixtral.init_params(m, jax.random.PRNGKey(3)))
+
+    g2 = dataclasses.replace(gemma2.Gemma2Config.tiny(vocab_size=64), dtype=jnp.float32)
+    out["gemma2"] = _write_checkpoint(root / "gemma2", gemma2.init_params(g2, jax.random.PRNGKey(4)))
     return out
 
 
@@ -64,7 +67,7 @@ class TestFamilyDetection:
 
 
 class TestFamilyServing:
-    @pytest.mark.parametrize("family", ["llama", "gpt2", "mixtral", "bert"])
+    @pytest.mark.parametrize("family", ["llama", "gpt2", "mixtral", "bert", "gemma2"])
     def test_load_and_forward(self, checkpoints, family):
         server = ModelServer(checkpoints[family], mesh_spec="dp=1", dtype="float32", name=family)
         stats = server.load()
