@@ -7,10 +7,13 @@ at a modelx-tpu sidecar unchanged (``base_url=http://sidecar:8000/v1``).
 
 Scope (documented, deliberate):
 - ``prompt``: str or list of str (each row generated independently);
-  ``messages``: the standard role/content list, rendered with the simple
-  template ``<|role|>\\n{content}\\n`` ... ``<|assistant|>\\n`` — chat
-  *templating* is model-specific and belongs to the model card, not the
-  server, so the rendering is fixed and documented rather than guessed.
+  ``messages``: the standard role/content list. When the model ships a
+  ``chat_template`` in its tokenizer_config.json (stored in the registry
+  like any blob), messages render through IT — sandboxed jinja with the
+  HF conventions (add_generation_prompt=True, bos/eos tokens, encode with
+  add_special_tokens=False), so llama-3-instruct/qwen-chat/gemma-it get
+  their real turn formatting. Without one, the simple documented fallback
+  ``<|role|>\\n{content}\\n`` ... ``<|assistant|>\\n``.
 - ``max_tokens``, ``temperature``, ``top_p``, ``seed``, ``stop`` (up to 4
   strings), ``stream`` (SSE). ``top_k`` accepted as an extension.
 - ``n``: each prompt decodes n samples (per-row seed streams — the same
@@ -79,17 +82,45 @@ def tokenizer_for(server):
     return tok
 
 
-def render_messages(messages) -> str:
+def render_messages(messages, spec: dict | None = None) -> str:
+    """Messages -> prompt text. With ``spec`` (the model's own
+    ``chat_template`` from tokenizer_config.json, see
+    ModelServer.chat_template) the template renders in a SANDBOXED jinja
+    environment with the HF conventions (``messages``,
+    ``add_generation_prompt=True``, ``bos_token``/``eos_token``,
+    ``raise_exception``) — llama-3-instruct/qwen-chat/gemma-it get their
+    real formatting. Without one, the simple generic role template."""
     if not isinstance(messages, list) or not messages:
         raise APIError(400, "messages must be a non-empty list")
-    parts = []
     for i, m in enumerate(messages):
         if not isinstance(m, dict) or not isinstance(m.get("content"), str):
             raise APIError(400, f"messages[{i}] must be {{role, content}} with string content")
         role = m.get("role", "user")
-        if role not in ("system", "user", "assistant"):
+        if not isinstance(role, str) or (
+            spec is None and role not in ("system", "user", "assistant")
+        ):
+            # the generic template only knows the three core roles; a model
+            # template validates roles itself (raise_exception)
             raise APIError(400, f"messages[{i}].role must be system|user|assistant")
-        parts.append(f"<|{role}|>\n{m['content']}\n")
+    if spec is not None:
+        from modelx_tpu.dl.serve import ChatTemplateRejected
+
+        try:
+            # compiled ONCE per model (ModelServer.chat_template); only the
+            # render runs per request
+            return spec["compiled"].render(
+                messages=messages,
+                add_generation_prompt=True,
+                bos_token=spec.get("bos_token", ""),
+                eos_token=spec.get("eos_token", ""),
+            )
+        except ChatTemplateRejected as e:
+            raise APIError(400, f"chat template rejected the messages: {e}")
+        except Exception as e:
+            raise APIError(400, f"chat template failed to render: {e}")
+    parts = [
+        f"<|{m.get('role', 'user')}|>\n{m['content']}\n" for m in messages
+    ]
     parts.append("<|assistant|>\n")
     return "".join(parts)
 
@@ -97,9 +128,10 @@ def render_messages(messages) -> str:
 MAX_PROMPTS = 32  # one request must stay one bounded unit of device work
 
 
-def parse_prompts(req: dict, chat: bool) -> list[str]:
+def parse_prompts(req: dict, chat: bool, server=None) -> list[str]:
     if chat:
-        return [render_messages(req.get("messages"))]
+        spec = server.chat_template() if server is not None else None
+        return [render_messages(req.get("messages"), spec)]
     prompt = req.get("prompt")
     if isinstance(prompt, str) and prompt:
         return [prompt]
@@ -290,8 +322,9 @@ def apply_stop(text: str, stops: list[str]) -> tuple[str, str]:
     return text, "length"
 
 
-def encode_prompt(tok, server, text: str, n_tokens: int = 0) -> list[int]:
-    ids = tok.encode(text)
+def encode_prompt(tok, server, text: str, n_tokens: int = 0,
+                  add_special_tokens: bool = True) -> list[int]:
+    ids = tok.encode(text, add_special_tokens=add_special_tokens)
     if not ids:
         raise APIError(400, "prompt tokenized to zero tokens")
     vocab = getattr(server.cfg, "vocab_size", 0) or 0
@@ -335,7 +368,10 @@ def run_completion(sset, req: dict, chat: bool) -> dict:
     """Non-streaming completions/chat: returns the OpenAI response body."""
     server = resolve_model(sset, req)
     tok = tokenizer_for(server)
-    prompts = parse_prompts(req, chat)
+    prompts = parse_prompts(req, chat, server)
+    # a model chat template carries its own special tokens (bos, turn
+    # markers): encode raw, the HF apply_chat_template convention
+    raw_encode = chat and server.chat_template() is not None
     n_tokens, samp = parse_sampling(req, sset.max_new_tokens_limit)
     n_samples = parse_n(req, len(prompts))
     top_lp = parse_logprobs(req, chat)
@@ -350,7 +386,11 @@ def run_completion(sset, req: dict, chat: bool) -> dict:
     # routing policy lives in ONE place: continuous > speculation > batcher
     engine = sset.engine_for(server, len(prompts) * n_samples, samp["temperature"])
     server.stats["requests"] += 1
-    id_rows = [encode_prompt(tok, server, text, n_tokens) for text in prompts]
+    id_rows = [
+        encode_prompt(tok, server, text, n_tokens,
+                      add_special_tokens=not raw_encode)
+        for text in prompts
+    ]
     # the continuous engine can retire a row's slot AT its EOS; other
     # engines decode the full budget and the EOS trim happens below
     stops_kw = (
@@ -447,7 +487,8 @@ def stream_completion(sset, req: dict, chat: bool) -> Iterator[dict]:
     committing a 200 so bad requests still fail with their real status."""
     server = resolve_model(sset, req)
     tok = tokenizer_for(server)
-    prompts = parse_prompts(req, chat)
+    prompts = parse_prompts(req, chat, server)
+    raw_encode = chat and server.chat_template() is not None
     if len(prompts) != 1:
         raise APIError(400, "stream supports a single prompt")
     if parse_n(req, 1) != 1:
@@ -460,7 +501,8 @@ def stream_completion(sset, req: dict, chat: bool) -> Iterator[dict]:
                             "use stream: false")
     n_tokens, samp = parse_sampling(req, sset.max_new_tokens_limit)
     stops = parse_stop(req)
-    ids = encode_prompt(tok, server, prompts[0], n_tokens)
+    ids = encode_prompt(tok, server, prompts[0], n_tokens,
+                        add_special_tokens=not raw_encode)
     if server.family.decode_fns is None:
         # fail before any SSE bytes hit the wire, not mid-stream
         raise APIError(400, f"model family {server.family.name!r} does not support streaming")

@@ -62,6 +62,11 @@ MAX_PROFILE_SECONDS = 60
 _UNSET = object()  # tokenizer not probed yet (absent is cached as None)
 
 
+class ChatTemplateRejected(Exception):
+    """A model chat template called raise_exception(msg) on the request's
+    messages — a CLIENT error (the OpenAI layer maps it to 400)."""
+
+
 _EOS_CANDIDATES = (
     # the end-of-sequence spellings of the supported families' tokenizers:
     # llama2/mistral, gpt2/gpt-j, llama3, chatml/qwen2, llama3 base, gemma
@@ -129,8 +134,10 @@ class _Tokenizer:
         self._tok = tok
         self._eos: tuple[int, ...] | None = eos_override
 
-    def encode(self, text: str) -> list[int]:
-        return self._tok.encode(text).ids
+    def encode(self, text: str, add_special_tokens: bool = True) -> list[int]:
+        # chat-template renders carry their own special tokens (bos etc.),
+        # so that path encodes raw — the HF apply_chat_template convention
+        return self._tok.encode(text, add_special_tokens=add_special_tokens).ids
 
     def decode(self, ids) -> str:
         # keep special tokens: clients watch for e.g. "</s>" in the text,
@@ -229,6 +236,7 @@ class ModelServer:
         # creation (unrelated caches)
         self._tokenizer_lock = threading.Lock()
         self._tokenizer: object = _UNSET
+        self._chat_template: object = _UNSET
 
     # the shape the dynamic batcher pads a lone first request to (seq to a
     # multiple of 16, batch to a power of two): precompiling it during load
@@ -514,6 +522,96 @@ class ModelServer:
                                 f"tokenizer.json exists but failed to load: {e}"
                             ) from e
         return self._tokenizer
+
+    def chat_template(self) -> dict | None:
+        """The model's own chat template from ``tokenizer_config.json``
+        (pulled alongside the weights like any blob), or None. Returns
+        ``{"template": str, "compiled": jinja Template, "bos_token": str,
+        "eos_token": str}``. Handles the string form and the named-list
+        form (a "default" entry ONLY — silently serving an arbitrary named
+        template like "tool_use" would format every chat wrong); special
+        tokens may be strings or HF AddedToken dicts. The template is
+        compiled ONCE here in a sandboxed environment with the HF
+        apply_chat_template conveniences (loop controls, strftime_now).
+        Cached under double-checked locking (publishing a half-built state
+        would race the first concurrent chat requests into inconsistent
+        render-vs-encode decisions); any problem — including a missing
+        jinja2 — degrades to None (generic role template) with one
+        warning, never a 500 per request."""
+        if self._chat_template is _UNSET:
+            with self._tokenizer_lock:
+                if self._chat_template is _UNSET:
+                    self._chat_template = self._load_chat_template()
+        return self._chat_template
+
+    def _load_chat_template(self) -> dict | None:
+        path = os.path.join(self.model_dir, "tokenizer_config.json")
+        if not os.path.isfile(path):
+            return None
+        try:
+            with open(path, encoding="utf-8") as f:
+                cfg = json.load(f)
+            tpl = cfg.get("chat_template")
+            if isinstance(tpl, list):  # [{name, template}, ...]
+                by_name = {
+                    t.get("name"): t.get("template")
+                    for t in tpl if isinstance(t, dict)
+                }
+                tpl = by_name.get("default")
+                if tpl is None and by_name:
+                    logger.warning(
+                        "tokenizer_config.json has named chat templates %s "
+                        "but no 'default'; using the generic role template",
+                        sorted(k for k in by_name if k),
+                    )
+                    return None
+            if not (isinstance(tpl, str) and tpl.strip()):
+                return None
+            try:
+                from jinja2.sandbox import ImmutableSandboxedEnvironment
+            except ImportError:
+                logger.warning(
+                    "model ships a chat_template but jinja2 is not "
+                    "installed (pip install 'modelx-tpu[text]'); using the "
+                    "generic role template"
+                )
+                return None
+            env = ImmutableSandboxedEnvironment(
+                trim_blocks=True, lstrip_blocks=True,
+                extensions=["jinja2.ext.loopcontrols"],
+            )
+            # the conveniences HF's apply_chat_template provides and real
+            # shipped templates use (llama-3.1 calls strftime_now for its
+            # date line); raise_exception surfaces as ChatTemplateRejected
+            # so the API layer can map it to a clean 400
+            import datetime as _dt
+
+            env.globals["strftime_now"] = (
+                lambda fmt: _dt.datetime.now().strftime(fmt)
+            )
+
+            def _raise(msg):
+                raise ChatTemplateRejected(str(msg))
+
+            env.globals["raise_exception"] = _raise
+
+            def token_str(v) -> str:
+                if isinstance(v, dict):  # AddedToken form
+                    return str(v.get("content", ""))
+                return v if isinstance(v, str) else ""
+
+            return {
+                "template": tpl,
+                "compiled": env.from_string(tpl),
+                "bos_token": token_str(cfg.get("bos_token")),
+                "eos_token": token_str(cfg.get("eos_token")),
+            }
+        except Exception as e:
+            logger.warning(
+                "tokenizer_config.json unusable for chat templating (%s); "
+                "falling back to the generic role template", e,
+            )
+            return None
 
     def generate_stream(
         self,

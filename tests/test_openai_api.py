@@ -184,6 +184,178 @@ class TestChat:
         assert text == "<|system|>\ns\n<|user|>\nu\n<|assistant|>\n"
 
 
+@pytest.fixture(scope="module")
+def templated_front(tmp_path_factory):
+    """Like ``front`` but the model SHIPS a chat_template (the HF
+    tokenizer_config.json convention): 'hello <contents...> world', with
+    bos_token in AddedToken form — rendered prompts have exactly known
+    token ids, so the tests can prove the template (not the generic
+    fallback) produced the prompt."""
+    import json as _json
+
+    tokenizers = pytest.importorskip("tokenizers")
+    from modelx_tpu.dl import safetensors as st
+    from modelx_tpu.models import llama
+
+    d = tmp_path_factory.mktemp("oai-tpl")
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64), dtype=jnp.float32)
+    st.write_safetensors(
+        str(d / "model.safetensors"),
+        {k: np.asarray(v) for k, v in llama.init_params(cfg, jax.random.PRNGKey(0)).items()},
+    )
+    vocab = {"<unk>": 0, "hello": 1, "world": 2, "tpu": 3}
+    vocab.update({f"w{i}": i for i in range(4, 64)})
+    tok = tokenizers.Tokenizer(tokenizers.models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = tokenizers.pre_tokenizers.Whitespace()
+    tok.save(str(d / "tokenizer.json"))
+    (d / "tokenizer_config.json").write_text(_json.dumps({
+        "bos_token": {"content": "hello"},  # AddedToken form
+        "chat_template": (
+            "{{ bos_token }} "
+            "{% for m in messages %}"
+            "{% if m['role'] not in ['system', 'user', 'assistant'] %}"
+            "{{ raise_exception('unknown role ' + m['role']) }}"
+            "{% endif %}"
+            "{{ m['content'] }} "
+            "{% endfor %}"
+            "{% if add_generation_prompt %}world{% endif %}"
+        ),
+    }))
+    server = ModelServer(str(d), mesh_spec="dp=1", dtype="float32", name="t")
+    sset = ServerSet({"t": server})
+    base = f"http://127.0.0.1:{free_port()}"
+    httpd = serve(sset, listen=base.rsplit("//", 1)[1])
+    sset.load_all()
+    yield base, server
+    httpd.shutdown()
+
+
+class TestChatTemplate:
+    def test_model_template_drives_the_prompt(self, templated_front):
+        """messages {content: tpu} must render 'hello tpu world' = ids
+        [1, 3, 2] — prompt_tokens 3 proves the model template ran (the
+        generic fallback renders role markers that tokenize differently)
+        and that encoding skipped add_special_tokens (HF convention)."""
+        base, server = templated_front
+        r = requests.post(base + "/v1/chat/completions",
+                          json={"messages": [{"role": "user", "content": "tpu"}],
+                                "max_tokens": 2, "temperature": 0})
+        assert r.status_code == 200, r.text
+        body = r.json()
+        assert body["usage"]["prompt_tokens"] == 3
+        # and the completion equals decoding the exact-token generate
+        out = server.generate(np.asarray([[1, 3, 2]], np.int32), max_new_tokens=2)
+        want = server.tokenizer().decode(out[0, 3:].tolist())
+        assert body["choices"][0]["message"]["content"] == want
+
+    def test_template_raise_exception_is_400(self, templated_front):
+        base, _ = templated_front
+        r = requests.post(base + "/v1/chat/completions",
+                          json={"messages": [{"role": "tool", "content": "x"}],
+                                "max_tokens": 2})
+        assert r.status_code == 400
+        assert "unknown role tool" in r.json()["error"]["message"]
+
+    def test_streaming_uses_the_template_too(self, templated_front):
+        base, server = templated_front
+        r = requests.post(base + "/v1/chat/completions",
+                          json={"messages": [{"role": "user", "content": "tpu"}],
+                                "max_tokens": 3, "temperature": 0,
+                                "stream": True,
+                                "stream_options": {"include_usage": True}},
+                          stream=True)
+        assert r.status_code == 200, r.text
+        usage = None
+        for line in r.iter_lines():
+            if not line or not line.startswith(b"data: "):
+                continue
+            payload = line[len(b"data: "):]
+            if payload == b"[DONE]":
+                break
+            evt = json.loads(payload)
+            if evt.get("usage"):
+                usage = evt["usage"]
+        assert usage and usage["prompt_tokens"] == 3
+
+    def test_completions_route_ignores_chat_template(self, templated_front):
+        """Plain /v1/completions must NOT run the chat template."""
+        base, _ = templated_front
+        r = requests.post(base + "/v1/completions",
+                          json={"prompt": "tpu", "max_tokens": 1,
+                                "temperature": 0})
+        assert r.status_code == 200, r.text
+        assert r.json()["usage"]["prompt_tokens"] == 1
+
+    def test_chat_template_parsing_forms(self, tmp_path):
+        """ModelServer.chat_template: string form, named-list form,
+        AddedToken vs string specials, broken JSON -> None, and the
+        compiled template renders with the HF conveniences."""
+        import json as _json
+        import threading as _threading
+
+        from modelx_tpu.dl.serve import ModelServer, _UNSET
+
+        d = str(tmp_path)
+        srv = ModelServer.__new__(ModelServer)
+        srv.model_dir = d
+        srv._tokenizer_lock = _threading.Lock()
+
+        def reset():
+            srv._chat_template = _UNSET
+
+        # string form + string bos; compiled once and render-ready
+        (tmp_path / "tokenizer_config.json").write_text(_json.dumps({
+            "chat_template": "{{ bos_token }}{{ messages[0]['content'] }}",
+            "bos_token": "<s>", "eos_token": {"content": "</s>"},
+        }))
+        reset()
+        spec = srv.chat_template()
+        assert spec["bos_token"] == "<s>" and spec["eos_token"] == "</s>"
+        out = spec["compiled"].render(messages=[{"content": "x"}],
+                                      add_generation_prompt=True,
+                                      bos_token="<s>", eos_token="")
+        assert out == "<s>x"
+        # the compiled object is cached (no re-parse per request)
+        assert srv.chat_template()["compiled"] is spec["compiled"]
+        # HF conveniences: strftime_now + loop controls compile and run
+        (tmp_path / "tokenizer_config.json").write_text(_json.dumps({
+            "chat_template": (
+                "{{ strftime_now('%Y') }}"
+                "{% for m in messages %}{% if loop.index > 1 %}{% break %}"
+                "{% endif %}{{ m['content'] }}{% endfor %}"
+            ),
+        }))
+        reset()
+        out = srv.chat_template()["compiled"].render(
+            messages=[{"content": "a"}, {"content": "b"}],
+            add_generation_prompt=True, bos_token="", eos_token="")
+        assert out.endswith("a") and not out.endswith("ab")
+        assert len(out) == 5  # 4-digit year + "a"
+        # named-list form picks "default" ONLY
+        (tmp_path / "tokenizer_config.json").write_text(_json.dumps({
+            "chat_template": [
+                {"name": "tool_use", "template": "T"},
+                {"name": "default", "template": "D"},
+            ],
+        }))
+        reset()
+        assert srv.chat_template()["template"] == "D"
+        # named-list WITHOUT default -> None (never silently pick tool_use)
+        (tmp_path / "tokenizer_config.json").write_text(_json.dumps({
+            "chat_template": [{"name": "tool_use", "template": "T"}],
+        }))
+        reset()
+        assert srv.chat_template() is None
+        # broken json -> None (generic fallback), not an exception
+        (tmp_path / "tokenizer_config.json").write_text("{broken")
+        reset()
+        assert srv.chat_template() is None
+        # absent file -> None
+        (tmp_path / "tokenizer_config.json").unlink()
+        reset()
+        assert srv.chat_template() is None
+
+
 class TestStreaming:
     def _events(self, resp):
         assert resp.headers["Content-Type"] == "text/event-stream"
@@ -258,7 +430,7 @@ class TestStopStraddle:
         from types import SimpleNamespace
 
         class Tok:
-            def encode(self, text):
+            def encode(self, text, add_special_tokens=True):
                 return [1, 2]
 
             def decode(self, ids):
@@ -270,6 +442,7 @@ class TestStopStraddle:
 
         server = SimpleNamespace(
             name="f", ready=True, speculative_k=0,
+            chat_template=lambda: None,
             cfg=SimpleNamespace(vocab_size=100),
             family=SimpleNamespace(decode_fns=object(), name="fake",
                                    generate_ragged=None),
@@ -391,7 +564,7 @@ class TestContextBound:
         from modelx_tpu.dl.openai_api import encode_prompt
 
         class Tok:
-            def encode(self, text):
+            def encode(self, text, add_special_tokens=True):
                 return list(range(1, 11))  # 10 tokens
 
         server = SimpleNamespace(cfg=SimpleNamespace(vocab_size=100, n_positions=16))
@@ -471,7 +644,7 @@ class TestAutoEOS:
         server = sset.servers["f"]
 
         class DivergingTok:
-            def encode(self, text):
+            def encode(self, text, add_special_tokens=True):
                 return [1, 2]
 
             def decode(self, ids):
@@ -500,7 +673,7 @@ class TestAutoEOS:
         from modelx_tpu.dl.serve import ServerSet
 
         class Tok:
-            def encode(self, text):
+            def encode(self, text, add_special_tokens=True):
                 return [1, 2]
 
             def decode(self, ids):
@@ -524,6 +697,7 @@ class TestAutoEOS:
 
         server = SimpleNamespace(
             name="f", ready=True, speculative_k=0,
+            chat_template=lambda: None,
             cfg=SimpleNamespace(vocab_size=100),
             family=SimpleNamespace(decode_fns=object(), name="fake",
                                    generate_ragged=None),
