@@ -26,6 +26,7 @@ from modelx_tpu.dl.sharding import (
     BERT_RULES,
     GEMMA2_RULES,
     GPT2_RULES,
+    PHI3_RULES,
     LLAMA_RULES,
     MIXTRAL_RULES,
     QWEN2_RULES,
@@ -267,6 +268,83 @@ def infer_qwen2_config(params: dict):
                                rope_theta=1_000_000.0)
 
 
+# -- phi3 ---------------------------------------------------------------------
+
+
+def infer_phi3_config(params: dict):
+    """Phi-3 fused shapes: qkv rows = q + 2*kv with q == hidden in every
+    released dense variant (mini 32x96, medium 40x128). head_dim: medium's
+    GQA (kv != hidden rows) means 128; mini's MHA means hidden/32 = 96.
+    Returns a llama.LlamaConfig — the module reuses llama's decoder."""
+    from modelx_tpu.models import llama
+
+    vocab, hidden = _shape(params, "model.embed_tokens.weight")
+    layers = 0
+    while f"model.layers.{layers}.self_attn.qkv_proj.weight" in params:
+        layers += 1
+    qkv_rows = _shape(params, "model.layers.0.self_attn.qkv_proj.weight")[0]
+    inter = _shape(params, "model.layers.0.mlp.gate_up_proj.weight")[0] // 2
+    kv_rows = (qkv_rows - hidden) // 2
+    if hidden <= 512:  # toy checkpoints: 4 q heads by convention
+        head_dim = max(hidden // 4, 8)
+    elif kv_rows != hidden:  # GQA (phi-3-medium): 128 everywhere released
+        head_dim = 128
+    else:  # MHA (phi-3-mini): 32 heads of hidden/32
+        head_dim = hidden // 32
+    return llama.LlamaConfig(
+        vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
+        num_layers=layers, num_heads=hidden // head_dim,
+        num_kv_heads=kv_rows // head_dim, head_dim=head_dim,
+        rope_theta=10000.0, rms_eps=1e-5, tie_embeddings=False,
+        dtype=_act_dtype(params, "model.embed_tokens.weight"),
+    )
+
+
+def _phi3_forward(params, tokens, cfg, mesh=None):
+    from modelx_tpu.models import phi3
+
+    return phi3.forward(params, tokens, cfg, mesh=mesh)[0]
+
+
+def _phi3_generate(params, tokens, cfg, mesh=None, max_new_tokens=16):
+    from modelx_tpu.models import phi3
+
+    return phi3.greedy_generate(params, tokens, cfg, max_new_tokens=max_new_tokens, mesh=mesh)
+
+
+def _phi3_generate_ragged(params, tokens, row_lens, cfg, mesh=None,
+                          max_new_tokens=16, **sampling):
+    from modelx_tpu.models import phi3
+
+    return phi3.ragged_greedy_generate(
+        params, tokens, row_lens, cfg, max_new_tokens=max_new_tokens, mesh=mesh,
+        **sampling,
+    )
+
+
+def _phi3_decode_fns(cfg, mesh=None):
+    from modelx_tpu.models import phi3
+
+    def fwd(p, t, kv_cache, cache_offset, mesh=mesh):
+        return phi3.forward(
+            p, t, cfg, kv_cache=kv_cache, cache_offset=cache_offset, mesh=mesh
+        )
+
+    return fwd, (lambda b, max_len: phi3.init_kv_cache(cfg, b, max_len))
+
+
+def _phi3_paged_decode_fns(cfg, mesh=None):
+    from modelx_tpu.models import phi3
+
+    def fwd(p, t, kv_cache, cache_offset, table, mesh=mesh):
+        return phi3.forward(
+            p, t, cfg, kv_cache=kv_cache, cache_offset=cache_offset,
+            mesh=mesh, paged_table=table,
+        )
+
+    return fwd
+
+
 # -- gemma2 -------------------------------------------------------------------
 
 
@@ -434,6 +512,9 @@ FAMILIES: dict[str, Family] = {
     "qwen2": Family("qwen2", QWEN2_RULES, infer_qwen2_config, _llama_forward,
                     _llama_generate, _llama_generate_ragged, _llama_decode_fns,
                     _llama_paged_decode_fns),
+    "phi3": Family("phi3", PHI3_RULES, infer_phi3_config, _phi3_forward,
+                  _phi3_generate, _phi3_generate_ragged, _phi3_decode_fns,
+                  _phi3_paged_decode_fns),
     "gemma2": Family("gemma2", GEMMA2_RULES, infer_gemma2_config,
                      _gemma2_forward, _gemma2_generate,
                      _gemma2_generate_ragged, _gemma2_decode_fns,

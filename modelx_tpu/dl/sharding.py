@@ -103,6 +103,22 @@ QWEN2_RULES: Rules = [
 # (pre/post_feedforward_layernorm) are 1-D and replicate via the norm rule.
 GEMMA2_RULES: Rules = LLAMA_RULES
 
+# Phi-3 (HF names): llama with FUSED qkv_proj / gate_up_proj. The fused
+# tensors shard their output rows over tp like their unfused counterparts;
+# the forward's in-jit q/k/v (gate/up) slices cross shard boundaries when
+# the sub-block sizes don't divide by tp, and GSPMD inserts the reshard —
+# correct everywhere, optimal when tp divides each sub-block.
+PHI3_RULES: Rules = [
+    (r"embed_tokens\.weight$", ["tp", None]),
+    (r"lm_head\.weight$", ["tp", None]),
+    (r"qkv_proj\.weight$", ["tp", None]),
+    (r"o_proj\.weight$", [None, "tp"]),
+    (r"gate_up_proj\.weight$", ["tp", None]),
+    (r"down_proj\.weight$", [None, "tp"]),
+    (r"norm\.weight$", [None]),
+    (r".*", []),
+]
+
 # GPT-2 (HF names; Conv1D weights are [in, out] so column-parallel = dim 1).
 GPT2_RULES: Rules = [
     (r"wte\.weight$", ["tp", None]),
@@ -146,6 +162,7 @@ DEFAULT_RULES: dict[str, Rules] = {
     "llama": LLAMA_RULES,
     "qwen2": QWEN2_RULES,
     "gemma2": GEMMA2_RULES,
+    "phi3": PHI3_RULES,
     "gpt2": GPT2_RULES,
     "bert": BERT_RULES,
     "mixtral": MIXTRAL_RULES,
@@ -163,6 +180,8 @@ def infer_family(tensor_names: Sequence[str]) -> str:
         return "mixtral"
     if "pre_feedforward_layernorm" in joined:
         return "gemma2"  # llama layout + sandwich norms (unique to gemma2)
+    if "qkv_proj" in joined:
+        return "phi3"  # llama layout with fused qkv/gate_up projections
     if "q_proj.bias" in joined:
         return "qwen2"  # llama layout + qkv biases
     if "q_proj" in joined or "gate_proj" in joined:

@@ -20,11 +20,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-# weights worth quantizing: the big llama/mixtral attention + mlp matmuls
-# ([out, in] torch layout). Embeddings/norms/expert stacks stay full
-# precision (gathers and einsums, not nn.linear matmuls).
+# weights worth quantizing: the big attention + mlp matmuls ([out, in]
+# torch layout), including phi3's FUSED qkv_proj/gate_up_proj (per-row
+# scales slice exactly with the rows, so the un-fusing views stay correct
+# — see models/phi3._slice_rows). Anchored on the preceding dot so the
+# fused names match by intent, not by suffix accident. Embeddings/norms/
+# expert stacks stay full precision (gathers and einsums, not nn.linear
+# matmuls).
 DEFAULT_ELIGIBLE = re.compile(
-    r"((q|k|v|o)_proj|gate_proj|up_proj|down_proj|lm_head)\.weight$"
+    r"(\.(q|k|v|o|qkv)_proj|\.(gate|up|gate_up|down)_proj|(^|\.)lm_head)"
+    r"\.weight$"
 )
 
 

@@ -33,7 +33,7 @@ def checkpoints(tmp_path_factory):
     root = tmp_path_factory.mktemp("families")
     out = {}
 
-    from modelx_tpu.models import bert, gemma2, gpt2, llama, mixtral
+    from modelx_tpu.models import bert, gemma2, gpt2, llama, mixtral, phi3
 
     cfg = llama.LlamaConfig.tiny(vocab_size=64)
     import dataclasses
@@ -52,6 +52,10 @@ def checkpoints(tmp_path_factory):
 
     g2 = dataclasses.replace(gemma2.Gemma2Config.tiny(vocab_size=64), dtype=jnp.float32)
     out["gemma2"] = _write_checkpoint(root / "gemma2", gemma2.init_params(g2, jax.random.PRNGKey(4)))
+
+    p3 = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64),
+                             dtype=jnp.float32, tie_embeddings=False)
+    out["phi3"] = _write_checkpoint(root / "phi3", phi3.init_params(p3, jax.random.PRNGKey(5)))
     return out
 
 
@@ -67,7 +71,7 @@ class TestFamilyDetection:
 
 
 class TestFamilyServing:
-    @pytest.mark.parametrize("family", ["llama", "gpt2", "mixtral", "bert", "gemma2"])
+    @pytest.mark.parametrize("family", ["llama", "gpt2", "mixtral", "bert", "gemma2", "phi3"])
     def test_load_and_forward(self, checkpoints, family):
         server = ModelServer(checkpoints[family], mesh_spec="dp=1", dtype="float32", name=family)
         stats = server.load()
