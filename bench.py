@@ -238,11 +238,7 @@ def measure_ttft(base: str, repo: str, workdir: str, runs: int = 5,
     registry+loader leg this framework owns) is reported alongside the
     headline."""
     cache_dir = os.path.join(workdir, "xla-cache")
-    here = os.path.dirname(os.path.abspath(__file__))
-    existing = os.environ.get("PYTHONPATH", "")
-    env = dict(os.environ,
-               PYTHONPATH=here + (os.pathsep + existing if existing else ""))
-    env.pop("JAX_PLATFORMS", None)  # children use the real device
+    env = _device_child_env()  # children use the real device
 
     def run_once(quantize: str = "") -> dict:
         cmd = [sys.executable, "-m", "modelx_tpu.dl.ttft", base, repo, cache_dir]
@@ -669,11 +665,7 @@ def measure_continuous(params: dict, mesh, decode_tps: float | None) -> dict:
 def run_leg(kind: str, base: str, repo: str, workdir: str) -> dict:
     """One timed leg in a FRESH subprocess (fresh per-process tunnel
     throttle state — see module docstring). Returns the child's JSON."""
-    here = os.path.dirname(os.path.abspath(__file__))
-    existing = os.environ.get("PYTHONPATH", "")
-    env = dict(os.environ,
-               PYTHONPATH=here + (os.pathsep + existing if existing else ""))
-    env.pop("JAX_PLATFORMS", None)  # children use the real device
+    env = _device_child_env()  # children use the real device
     p = subprocess.run(
         [sys.executable, os.path.abspath(__file__), "--leg", kind, base, repo, workdir],
         capture_output=True, text=True, env=env, timeout=900,
@@ -726,11 +718,71 @@ def leg_main(kind: str, base: str, repo: str, workdir: str) -> int:
     return 0
 
 
+def _device_child_env() -> dict:
+    """Environment for subprocesses that must see the REAL device: this
+    repo on PYTHONPATH, and any JAX_PLATFORMS=cpu override (the parent's
+    own stay-off-the-TPU discipline) stripped."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    existing = os.environ.get("PYTHONPATH", "")
+    env = dict(os.environ,
+               PYTHONPATH=here + (os.pathsep + existing if existing else ""))
+    env.pop("JAX_PLATFORMS", None)
+    return env
+
+
+def wait_for_device(max_wait_s: float = 1800.0, probe_timeout_s: float = 120.0,
+                    retry_s: float = 30.0) -> float:
+    """Block until the ACCELERATOR answers, up to ``max_wait_s``.
+
+    The tunnel relay occasionally dies and restarts (observed live: a
+    mid-bench 'Connection refused' on its remote_compile endpoint, with
+    ``jax.devices()`` hanging afterwards). A capture that starts while
+    it's down burns every leg's full subprocess timeout and records
+    nothing — probing first in SHORT-LIVED subprocesses (a hung backend
+    init cannot be cancelled in-process) turns a transient outage into a
+    delayed capture instead of a failed one. The probe REJECTS a
+    cpu-fallback backend (outage modes where discovery fails fast would
+    otherwise pass vacuously) and the last probe's stderr rides in the
+    final error so a broken environment doesn't masquerade as a relay
+    outage. Returns seconds waited."""
+    env = _device_child_env()
+    t0 = time.monotonic()
+    last_err = ""
+    while True:
+        try:
+            p = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; assert jax.devices()[0].platform != 'cpu', "
+                 "'cpu fallback — accelerator not found'"],
+                env=env, timeout=probe_timeout_s,
+                stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+            )
+            if p.returncode == 0:
+                waited = time.monotonic() - t0
+                if waited > probe_timeout_s:
+                    print(f"# device came back after {waited:.0f}s",
+                          file=sys.stderr)
+                return waited
+            last_err = (p.stderr or "").strip()[-500:]
+        except subprocess.TimeoutExpired:
+            last_err = f"probe hung > {probe_timeout_s:.0f}s (backend init)"
+        if time.monotonic() - t0 > max_wait_s:
+            raise RuntimeError(
+                f"accelerator unreachable for {max_wait_s:.0f}s "
+                "(tunnel relay down?) — refusing to record a dead capture; "
+                f"last probe: {last_err or 'no stderr'}"
+            )
+        time.sleep(retry_s)
+
+
 def main() -> None:
     workdir = tempfile.mkdtemp(prefix="modelx-bench-")
     settle_s = float(os.environ.get("BENCH_SETTLE_S", 8.0))
     srv = None
     try:
+        wait_for_device(
+            max_wait_s=float(os.environ.get("BENCH_DEVICE_WAIT_S", 1800.0))
+        )
         ckpt = os.path.join(workdir, "model.safetensors")
         target = int(os.environ.get("BENCH_BYTES", 512 * 1024 * 1024))
         size = build_checkpoint(ckpt, target)

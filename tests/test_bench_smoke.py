@@ -10,6 +10,49 @@ import sys
 import numpy as np
 
 
+class TestWaitForDevice:
+    """wait_for_device: the relay-outage guard must return promptly when
+    the probe succeeds and raise (not hang forever) when it never does."""
+
+    def test_returns_when_probe_succeeds(self, monkeypatch):
+        import bench
+
+        calls = []
+
+        def fake_run(cmd, **kw):
+            calls.append(cmd)
+            return subprocess.CompletedProcess(cmd, 0)
+
+        monkeypatch.setattr(bench.subprocess, "run", fake_run)
+        waited = bench.wait_for_device(max_wait_s=5, probe_timeout_s=1, retry_s=0.01)
+        assert waited < 5 and len(calls) == 1
+
+    def test_raises_after_budget_when_probe_hangs(self, monkeypatch):
+        import bench
+        import pytest
+
+        def fake_run(cmd, **kw):
+            raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 0))
+
+        monkeypatch.setattr(bench.subprocess, "run", fake_run)
+        with pytest.raises(RuntimeError, match="unreachable"):
+            bench.wait_for_device(max_wait_s=0.05, probe_timeout_s=0.01,
+                                  retry_s=0.01)
+
+    def test_retries_through_transient_failure(self, monkeypatch):
+        import bench
+
+        state = {"n": 0}
+
+        def fake_run(cmd, **kw):
+            state["n"] += 1
+            return subprocess.CompletedProcess(cmd, 1 if state["n"] < 3 else 0)
+
+        monkeypatch.setattr(bench.subprocess, "run", fake_run)
+        bench.wait_for_device(max_wait_s=10, probe_timeout_s=1, retry_s=0.01)
+        assert state["n"] == 3
+
+
 class TestBenchSmoke:
     def test_checkpoint_builder_and_loader_roundtrip(self, tmp_path):
         import jax
