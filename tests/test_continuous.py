@@ -481,6 +481,41 @@ class TestBatchedAdmission:
             cb.close()
 
 
+class TestBurstWindow:
+    def test_zero_window_disables_the_idle_sleep(self, server):
+        """burst_window_ms=0 must serve correctly with no gather pause."""
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4,
+                               burst_window_ms=0.0)
+        try:
+            t = np.array([[5, 9, 2]], np.int32)
+            np.testing.assert_array_equal(
+                cb.generate(t, max_new_tokens=6),
+                server.generate(t, max_new_tokens=6))
+        finally:
+            cb.close()
+
+    def test_single_slot_engine_skips_the_window(self, server):
+        """max_slots=1 can never co-admit a burst; the window must not add
+        latency there (and the engine still serves exactly)."""
+        cb = ContinuousBatcher(server, max_slots=1, chunk_size=4,
+                               burst_window_ms=50.0)
+        try:
+            t = np.array([[7, 8]], np.int32)
+            import time as _t
+
+            t0 = _t.monotonic()
+            out = cb.generate(t, max_new_tokens=1)
+            # warm call includes compile; the SECOND call shows the per-
+            # request cost — with the 50 ms window wrongly applied, three
+            # sequential requests would pay >= 150 ms of pure sleep
+            for _ in range(3):
+                out = cb.generate(t, max_new_tokens=1)
+            np.testing.assert_array_equal(
+                out, server.generate(t, max_new_tokens=1))
+        finally:
+            cb.close()
+
+
 class TestPipelineDepth:
     """Deeper chunk pipelining (dispatch-ahead) must not change tokens —
     plans are value-independent, so depth only moves sync points."""
