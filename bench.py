@@ -158,17 +158,20 @@ def probe_link_gbps(device, nbytes: int = 16 << 20, reps: int = 3) -> float:
 
 
 def run_ours(client, repo: str, desc, mesh, size: int,
-             quantize: str | None = None) -> tuple[float, str, object]:
+             quantize: str | None = None, cache=None,
+             prefer_local: bool | None = None) -> tuple[float, str, object]:
     """The loader path through the blob-location seam. Returns (seconds,
     source-class name actually used — proves which engine ran, LoadStats
-    for the fetch/device decomposition)."""
+    for the fetch/device decomposition). ``cache`` routes the load through
+    the local blob-cache tier; ``prefer_local=False`` skips the colocated
+    file redirect so the leg models a remote pod (the cache legs' shape)."""
     from modelx_tpu.dl.initializer import _blob_source
     from modelx_tpu.dl.loader import load_safetensors
     from modelx_tpu.dl import safetensors as st
     from modelx_tpu.dl.sharding import LLAMA_RULES
 
     t0 = time.monotonic()
-    source = _blob_source(client, repo, desc)
+    source = _blob_source(client, repo, desc, cache=cache, prefer_local=prefer_local)
     tensors = data_offset = None
     from modelx_tpu.types import AnnotationTensorIndex
 
@@ -217,7 +220,8 @@ def run_baseline(base: str, repo: str, desc, workdir: str, devices) -> float:
 
 
 def measure_ttft(base: str, repo: str, workdir: str, runs: int = 5,
-                 int8_runs: int = 2, settle_s: float = 4.0) -> dict:
+                 int8_runs: int = 2, settle_s: float = 4.0,
+                 blob_cache_dir: str = "") -> dict:
     """p50 registry->first-token (BASELINE north star), subprocess-per-run.
 
     Each run is a FRESH process (``python -m modelx_tpu.dl.ttft``) with the
@@ -239,6 +243,13 @@ def measure_ttft(base: str, repo: str, workdir: str, runs: int = 5,
     headline."""
     cache_dir = os.path.join(workdir, "xla-cache")
     env = _device_child_env()  # children use the real device
+    if blob_cache_dir:
+        # blob-cache (warm-restart) variant: the children share one local
+        # blob cache and skip the colocated file redirect, so run 0 pays
+        # the network (and fills the cache) while every scored run models a
+        # warm pod restart — zero network reads for the weights
+        env = dict(env, MODELX_BLOB_CACHE_DIR=blob_cache_dir,
+                   MODELX_DL_NO_LOCAL_REDIRECT="1")
 
     def run_once(quantize: str = "") -> dict:
         cmd = [sys.executable, "-m", "modelx_tpu.dl.ttft", base, repo, cache_dir]
@@ -296,6 +307,35 @@ def measure_ttft(base: str, repo: str, workdir: str, runs: int = 5,
             statistics.median(r["weights_ready_ms"] for r in q_records), 1
         )
     return out
+
+
+def cache_split_summary(size: int, cold_rec: dict, warm_rec: dict) -> dict:
+    """The multi-tier cache's cold/warm split from two blob-cache legs
+    (leg_main kinds "cold"/"warm"). ``warm_hit`` is the zero-network-reads
+    verdict: the warm leg's source must be the cache's LocalFileSource.
+    ``cold_overlap_seconds``/``cold_staging_allocs`` surface the cold
+    pipeline's fetch-vs-device_put overlap and staging-pool reuse."""
+    cold_gbps = size / max(cold_rec["seconds"], 1e-9) / 1e9
+    warm_gbps = size / max(warm_rec["seconds"], 1e-9) / 1e9
+    return {
+        "registry_to_hbm_cold_cached_gbps": round(cold_gbps, 3),
+        "registry_to_hbm_warm_gbps": round(warm_gbps, 3),
+        "warm_seconds": round(warm_rec["seconds"], 3),
+        "warm_vs_cold": round(warm_gbps / max(cold_gbps, 1e-9), 3),
+        "warm_hit": bool(warm_rec.get("cache_state") == "warm"),
+        "cold_overlap_seconds": cold_rec.get("overlap_seconds"),
+        "cold_staging_allocs": cold_rec.get("staging_allocs"),
+        "cold_fetch_growths": cold_rec.get("fetch_growths"),
+    }
+
+
+def ttft_warm_fields(warm_ttft: dict) -> dict:
+    """Key mapping for the warm-restart TTFT variant (measure_ttft with a
+    shared blob cache): the bench JSON carries them under ttft_warm_*."""
+    return {
+        "ttft_warm_ms": warm_ttft.get("ttft_ms"),
+        "ttft_warm_weights_ready_ms": warm_ttft.get("ttft_weights_ready_ms"),
+    }
 
 
 # stdlib-only puller (no jax import: interpreter startup must not drown the
@@ -700,11 +740,26 @@ def leg_main(kind: str, base: str, repo: str, workdir: str) -> int:
     from modelx_tpu.parallel.mesh import make_mesh
 
     mesh = make_mesh(f"dp={len(devices)}")
+    cache = None
+    prefer_local: bool | None = None
+    if kind in ("cold", "warm"):
+        # blob-cache legs model a REMOTE pod: skip the colocated file
+        # redirect (the registry and the leg share this host) so the cold
+        # leg streams HTTP + tees to the cache, and the warm leg must be
+        # served by the cache alone (zero network reads)
+        from modelx_tpu.dl.blob_cache import BlobCache
+
+        cache_dir = os.path.join(workdir, "blobcache")
+        if kind == "cold":
+            shutil.rmtree(cache_dir, ignore_errors=True)
+        cache = BlobCache(cache_dir)
+        prefer_local = False
     secs, src, stats = run_ours(
         client, repo, desc, mesh, size,
         quantize="int8" if kind == "int8" else None,
+        cache=cache, prefer_local=prefer_local,
     )
-    print(json.dumps({
+    rec = {
         "seconds": round(secs, 3),
         "source": src,
         "native": native.available(),
@@ -713,8 +768,19 @@ def leg_main(kind: str, base: str, repo: str, workdir: str) -> int:
         "bytes_to_device": stats.bytes_to_device,
         "fetch_width": stats.fetch_width,
         "fetch_backoffs": stats.fetch_backoffs,
+        "fetch_growths": stats.fetch_growths,
+        "overlap_seconds": round(stats.overlap_seconds, 3),
+        "device_put_seconds": round(stats.device_put_seconds, 3),
+        "staging_allocs": stats.staging_allocs,
+        "staging_reuses": stats.staging_reuses,
         "link_gbps": round(probe_link_gbps(devices[0]), 3),
-    }))
+    }
+    if cache is not None:
+        # warm = the load came off the local cache tier (LocalFileSource
+        # over the verified entry), i.e. zero network reads
+        rec["cache_state"] = "warm" if src == "LocalFileSource" else "cold"
+        rec["blob_cache"] = dict(cache.stats)
+    print(json.dumps(rec))
     return 0
 
 
@@ -801,6 +867,14 @@ def main() -> None:
         # half the leg settle: the 48 MB TTFT children sip the burst bucket
         # where the 512 MB legs gulp it, but BENCH_SETTLE_S must scale both
         ttft = measure_ttft(base, "library/ttft", workdir, settle_s=settle_s / 2)
+        # warm-restart TTFT: the children share a blob cache, run 0 fills
+        # it, the scored runs model a pod restart that skips the network
+        warm_ttft = measure_ttft(
+            base, "library/ttft", workdir, runs=2, int8_runs=0,
+            settle_s=settle_s / 2,
+            blob_cache_dir=os.path.join(workdir, "ttft-blobcache"),
+        )
+        ttft.update(ttft_warm_fields(warm_ttft))
 
         # alternate subprocess legs with settle pauses (token-bucket tunnel;
         # see module docstring), baseline first = any leftover burst credit
@@ -860,6 +934,17 @@ def main() -> None:
             time.sleep(settle_s)
             int8_recs.append(run_leg("int8", base, "library/bench", workdir))
             legs_retried.append("int8")
+
+        # blob-cache cold/warm split: one cold leg (HTTP + tee, fresh
+        # cache), then warm legs served purely off the local cache tier —
+        # the ServerlessLLM re-deploy story, measured
+        time.sleep(settle_s)
+        cold_rec = run_leg("cold", base, "library/bench", workdir)
+        warm_recs = []
+        for _ in range(2):
+            time.sleep(settle_s)
+            warm_recs.append(run_leg("warm", base, "library/bench", workdir))
+        cache_split = cache_split_summary(size, cold_rec, best(warm_recs))
 
         ours_s = best(ours_recs)["seconds"]
         baseline_s = best(baseline_recs)["seconds"]
@@ -963,6 +1048,13 @@ def main() -> None:
             "bytes_to_device": best_rec["bytes_to_device"],
             "fetch_width": best_rec.get("fetch_width"),
             "fetch_backoffs": best_rec.get("fetch_backoffs"),
+            "fetch_growths": best_rec.get("fetch_growths"),
+            "overlap_seconds": best_rec.get("overlap_seconds"),
+            "device_put_seconds": best_rec.get("device_put_seconds"),
+            "staging_allocs": best_rec.get("staging_allocs"),
+            "staging_reuses": best_rec.get("staging_reuses"),
+            # blob-cache tier: cold tee vs warm (zero-network) restart
+            **cache_split,
             # int8 deploy leg: same source checkpoint, half the link bytes
             "int8_load_seconds": round(int8_s, 3),
             "int8_load_gbps_effective": round(size / int8_s / 1e9, 3),

@@ -428,7 +428,14 @@ def cmd_serve(
 @click.argument("dest")
 @click.option("--device-put", is_flag=True, help="after pulling, load safetensors onto the local TPU mesh and report timings")
 @click.option("--mesh", default="", help='mesh override, e.g. "dp=1,tp=8"')
-def cmd_dl(uri: str, dest: str, device_put: bool, mesh: str) -> None:
+@click.option("--blob-cache-dir", default="",
+              help="content-addressed local blob cache for the --device-put "
+                   "load: cold loads tee to disk, warm re-deploys of an "
+                   "already-served checkpoint skip the network")
+@click.option("--blob-cache-max-bytes", default=0, type=int,
+              help="blob cache size cap; LRU eviction (0 = unbounded)")
+def cmd_dl(uri: str, dest: str, device_put: bool, mesh: str,
+           blob_cache_dir: str, blob_cache_max_bytes: int) -> None:
     """Deploy-time puller (cmd/modelxdl/modelxdl.go:30-98): pull (a subset of)
     a model into DEST. With --device-put, continue into TPU HBM."""
     try:
@@ -438,7 +445,11 @@ def cmd_dl(uri: str, dest: str, device_put: bool, mesh: str) -> None:
             from modelx_tpu.parallel.distributed import initialize
 
             initialize()  # no-op single-process; wires multi-host TPU pods
-        summary = run_initializer(uri, dest, device_put=device_put, mesh_spec=mesh)
+        summary = run_initializer(
+            uri, dest, device_put=device_put, mesh_spec=mesh,
+            blob_cache_dir=blob_cache_dir,
+            blob_cache_max_bytes=blob_cache_max_bytes,
+        )
         if "load" in summary:
             summary["load"] = {k: v for k, v in summary["load"].items() if k != "arrays"}
         click.echo(json.dumps(summary))

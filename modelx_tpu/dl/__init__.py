@@ -7,4 +7,9 @@ safetensors tensor index, fetches exactly those bytes (ranged HTTP GETs or
 local preads), and materializes `jax.Array`s directly on a
 `jax.sharding.Mesh` via `jax.make_array_from_callback` — each device shard
 reads only its own bytes, so a multi-host pull moves each byte once.
+
+Loading is multi-tier (docs/loading.md): a content-addressed local blob
+cache (blob_cache.py) makes warm re-deploys network-free, and the loader
+(loader.py) pipelines governor-scaled ranged fetches through a reusable
+host staging pool into overlapped `jax.device_put`s.
 """

@@ -335,10 +335,11 @@ class ContinuousBatcher:
         single admits), and scatter the scratch rows into their slots. On
         a tunneled device each program dispatch costs a host round-trip,
         so k arrivals admitted one-by-one pay k round-trips where this
-        pays one. The program is SIZE-INVARIANT — the host pads every
-        burst to max_slots rows, pad rows carrying an out-of-bounds slot
-        index whose scatter ``mode="drop"`` discards — so it compiles
-        once per prompt bucket, never per burst size."""
+        pays one. The host pads the burst to the next POWER OF TWO of its
+        size (pad rows carry an out-of-bounds slot index whose scatter
+        ``mode="drop"`` discards), so small bursts don't pay a full
+        max_slots-row prefill and compiles stay bounded at
+        log2(max_slots) sizes per prompt bucket."""
         small = self._init_cache(prompts.shape[0], prompts.shape[1])
         logits, small = self._fwd(params, prompts, kv_cache=small, cache_offset=0)
         firsts = self._sample_first(logits, row_lens - 1, temp, top_k, top_p, seeds)
@@ -835,14 +836,25 @@ class ContinuousBatcher:
             raise
 
     def _admit_group(self, preps: list) -> None:
-        """One size-invariant program admits the whole same-bucket group:
-        always [max_slots, Sb] on the wire, rows past the real burst padded
-        with row_len 1 and an out-of-bounds slot (scatter drops them), so
-        the program compiles once per bucket, never per burst size."""
-        sb, m = preps[0]["bucket"], self.max_slots
+        """One program admits the whole same-bucket group as [m, Sb], with
+        m the burst size rounded UP to the next power of two (clamped to
+        max_slots): a 2-row burst on a max_slots=16 engine used to prefill
+        a full [16, Sb] block — up to max_slots/2 x wasted prefill FLOPs
+        on small bursts. Pow2 rounding keeps compiles bounded at
+        log2(max_slots) sizes per prompt bucket (burst size itself never
+        retraces). Rows past the real burst are padded with row_len 1 and
+        an out-of-bounds slot index (scatter ``mode="drop"`` discards)."""
+        sb = preps[0]["bucket"]
+        m = min(self.max_slots, 1 << max(len(preps) - 1, 0).bit_length())
+        self.stats["admit_pad_rows"] = (
+            self.stats.get("admit_pad_rows", 0) + m - len(preps)
+        )
         prompts = np.zeros((m, sb), np.int32)
         row_lens = np.ones(m, np.int32)  # pad rows: last_idx 0 stays valid
-        slots = np.full(m, m, np.int32)  # pad rows: OOB -> scatter drop
+        # pad rows: max_slots is ALWAYS out of bounds for the [max_slots,..]
+        # engine state -> scatter drop (m itself can be a valid slot now
+        # that m may sit below max_slots)
+        slots = np.full(m, self.max_slots, np.int32)
         temp = np.zeros(m, np.float32)
         top_k = np.zeros(m, np.int32)
         top_p = np.ones(m, np.float32)

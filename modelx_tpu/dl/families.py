@@ -17,6 +17,9 @@ dl/sharding.infer_family but over loaded params.
 from __future__ import annotations
 
 import dataclasses
+import json
+import logging
+import os
 from typing import Any, Callable
 
 import jax
@@ -275,7 +278,10 @@ def infer_phi3_config(params: dict):
     """Phi-3 fused shapes: qkv rows = q + 2*kv with q == hidden in every
     released dense variant (mini 32x96, medium 40x128). head_dim: medium's
     GQA (kv != hidden rows) means 128; mini's MHA means hidden/32 = 96.
-    Returns a llama.LlamaConfig — the module reuses llama's decoder."""
+    Returns a llama.LlamaConfig — the module reuses llama's decoder.
+    rope_theta=10000 is the 4k variants' value; the 128k variants need
+    longrope scaling shapes can't reveal — apply_sidecar_config checks the
+    pulled config.json and refuses those instead of mis-serving them."""
     from modelx_tpu.models import llama
 
     vocab, hidden = _shape(params, "model.embed_tokens.weight")
@@ -527,6 +533,78 @@ FAMILIES: dict[str, Family] = {
                    _gpt2_paged_decode_fns),
     "bert": Family("bert", BERT_RULES, infer_bert_config, _bert_forward, None),
 }
+
+
+logger = logging.getLogger("modelx.serve")
+
+
+def sidecar_config(model_dir: str) -> dict | None:
+    """The checkpoint's pulled ``config.json`` (the HF sidecar), if any.
+    Shape inference recovers the architecture but NOT the RoPE parameters —
+    rope_theta and rope_scaling leave no trace in tensor shapes."""
+    try:
+        with open(os.path.join(model_dir, "config.json")) as f:
+            raw = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return raw if isinstance(raw, dict) else None
+
+
+# rope_scaling schemes that reshape position encoding at EVERY position:
+# serving them with plain RoPE is wrong from token 0, so they refuse.
+# Other schemes (llama3 / linear / dynamic-ntk) match plain RoPE inside
+# the original context window — those warn (degraded long-context) but
+# keep previously-deployable checkpoints loadable.
+_ROPE_SCALING_REFUSED = ("longrope", "su", "yarn")
+
+
+def apply_sidecar_config(cfg, sidecar: dict, family_name: str):
+    """Reconcile a shape-inferred config with the checkpoint's config.json.
+
+    ``rope_scaling`` is not implemented by this runtime. Schemes that
+    change the encoding at every position (longrope/su/yarn — e.g. the
+    phi-3-*-128k family) would decode garbage from the first token, so
+    those checkpoints are REFUSED instead of silently mis-served
+    (infer_phi3_config assumes the unscaled rope_theta=10000 of every 4k
+    dense phi-3); window-extension schemes (llama3, linear, dynamic) warn
+    and serve, correct within the pre-scaling window. A differing
+    ``rope_theta`` is safe to honor: the sidecar's value replaces the
+    inferred default, with a warning so the override is visible in logs."""
+    scaling = sidecar.get("rope_scaling")
+    if scaling:
+        stype = (
+            scaling.get("type") or scaling.get("rope_type")
+            if isinstance(scaling, dict) else scaling
+        )
+        if not isinstance(stype, str) or stype.lower() in _ROPE_SCALING_REFUSED:
+            raise ValueError(
+                f"{family_name} checkpoint's config.json declares "
+                f"rope_scaling ({stype!r}); this runtime implements "
+                "unscaled RoPE only — refusing to mis-serve a long-context "
+                "checkpoint (e.g. phi-3-*-128k)"
+            )
+        logger.warning(
+            "%s config.json declares rope_scaling %r: not implemented — "
+            "serving is exact only within the pre-scaling context window",
+            family_name, stype,
+        )
+    theta = sidecar.get("rope_theta")
+    if theta is not None and hasattr(cfg, "rope_theta"):
+        try:
+            theta = float(theta)
+        except (TypeError, ValueError):
+            logger.warning(
+                "%s config.json rope_theta=%r is not numeric; keeping the "
+                "inferred %s", family_name, theta, cfg.rope_theta,
+            )
+            return cfg
+        if theta != float(cfg.rope_theta):
+            logger.warning(
+                "%s config.json rope_theta=%s overrides the shape-inferred %s",
+                family_name, theta, cfg.rope_theta,
+            )
+            cfg = dataclasses.replace(cfg, rope_theta=theta)
+    return cfg
 
 
 def detect(tensor_names) -> Family:

@@ -14,6 +14,7 @@ ranged reads, and the function reports GB/s into HBM.
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 from modelx_tpu.client.model_config import ModelConfig
@@ -59,9 +60,25 @@ def run_initializer(
     device_put: bool = False,
     mesh_spec: str = "",
     quiet: bool = False,
+    blob_cache_dir: str = "",
+    blob_cache_max_bytes: int = 0,
 ) -> dict:
-    """modelxdl.go:50-98 Run. Returns a summary dict (timings, GB/s)."""
+    """modelxdl.go:50-98 Run. Returns a summary dict (timings, GB/s).
+
+    ``blob_cache_dir`` enables the content-addressed local blob cache
+    (dl/blob_cache.py) for the ``device_put`` load path: cold loads tee
+    their fetched ranges to disk, warm re-deploys of a blob the node has
+    already served skip the network entirely."""
     from modelx_tpu.utils import trace
+
+    cache = None
+    if device_put:
+        from modelx_tpu.dl import blob_cache as bc
+
+        cache = (
+            bc.BlobCache(blob_cache_dir, max_bytes=blob_cache_max_bytes)
+            if blob_cache_dir else bc.default_cache()
+        )
 
     t0 = time.monotonic()
     ref = parse_reference(uri)
@@ -90,13 +107,17 @@ def run_initializer(
     }
     if device_put:
         summary["load"] = load_to_mesh(
-            client, ref.repository, selected, mesh_spec or config.serving.mesh, quiet=quiet
+            client, ref.repository, selected, mesh_spec or config.serving.mesh,
+            quiet=quiet, cache=cache,
         )
+        if cache is not None:
+            summary["blob_cache"] = dict(cache.stats)
     summary["total_seconds"] = round(time.monotonic() - t0, 3)
     return summary
 
 
-def load_to_mesh(client, repository: str, manifest: Manifest, mesh_spec: str, quiet: bool = False) -> dict:
+def load_to_mesh(client, repository: str, manifest: Manifest, mesh_spec: str,
+                 quiet: bool = False, cache=None) -> dict:
     """Stream every safetensors blob of the manifest onto the local mesh.
 
     Uses the presigned download location when the registry offers one (bytes
@@ -126,7 +147,7 @@ def load_to_mesh(client, repository: str, manifest: Manifest, mesh_spec: str, qu
         else:
             names = list(tensors) if tensors else []
             rules = rules_for_family(infer_family(names))
-        source = _blob_source(client, repository, blob)
+        source = _blob_source(client, repository, blob, cache=cache)
         try:
             loaded, stats = load_safetensors(
                 source, mesh, rules, tensors=tensors, data_offset=data_offset
@@ -145,24 +166,49 @@ def load_to_mesh(client, repository: str, manifest: Manifest, mesh_spec: str, qu
     return out
 
 
-def _blob_source(client, repository: str, blob):
-    """Best transport for a blob, via the load-separation seam: a readable
-    ``file`` location (colocated registry / shared volume) beats ranged HTTP
-    — local preads cost no server round-trips and no tunnel bytes. Presigned
-    URLs and the direct blob endpoint are the remote paths."""
+def _blob_source(client, repository: str, blob, cache=None,
+                 prefer_local: bool | None = None):
+    """Best transport for a blob, tier by tier: a readable ``file``
+    location (colocated registry / shared volume) beats everything — local
+    preads cost no server round-trips and no tunnel bytes; next the local
+    blob cache (dl/blob_cache.py) serves a digest-verified copy with zero
+    network reads; finally the remote paths (presigned URL or the direct
+    blob endpoint), teed into the cache for the next deploy.
+
+    ``prefer_local=False`` (or env MODELX_DL_NO_LOCAL_REDIRECT=1) skips the
+    colocated-file redirect — the bench/test knob that models a remote pod
+    against a colocated registry."""
     from modelx_tpu.client.extension import LocationUnreachable, usable_file_path
     from modelx_tpu.dl.loader import HTTPSource, LocalFileSource
 
+    if prefer_local is None:
+        prefer_local = os.environ.get("MODELX_DL_NO_LOCAL_REDIRECT", "") not in ("1", "true")
     location = client.remote.get_blob_location(repository, blob, BlobLocationPurposeDownload)
-    if location is not None and location.provider == "file":
+    if prefer_local and location is not None and location.provider == "file":
         try:
             return LocalFileSource(usable_file_path(location, blob.size or -1))
         except LocationUnreachable:
             pass  # advertised for a colocated client; we're not one
+    if cache is not None and blob.digest:
+        hit = cache.lookup(blob.digest, expected_size=blob.size or -1)
+        if hit is not None:
+            try:
+                src = LocalFileSource(hit)
+            except OSError:
+                # a concurrent admit's LRU eviction can unlink the entry
+                # between lookup and open — fall through to the network
+                pass
+            else:
+                src.cache_state = "warm"
+                return src
     if location is not None and location.properties.get("url"):
-        return HTTPSource(location.properties["url"], total=blob.size)
-    headers = {}
-    if client.remote.authorization:
-        headers["Authorization"] = client.remote.authorization
-    url = f"{client.remote.registry}/{repository}/blobs/{blob.digest}"
-    return HTTPSource(url, headers=headers, total=blob.size)
+        src = HTTPSource(location.properties["url"], total=blob.size)
+    else:
+        headers = {}
+        if client.remote.authorization:
+            headers["Authorization"] = client.remote.authorization
+        url = f"{client.remote.registry}/{repository}/blobs/{blob.digest}"
+        src = HTTPSource(url, headers=headers, total=blob.size)
+    if cache is not None and blob.digest:
+        src = cache.wrap(src, blob.digest, blob.size or 0)
+    return src

@@ -20,10 +20,18 @@ Fetch planning:
 Reference parity: this replaces cmd/modelxdl's "download files into a pod
 volume, let a GPU container mmap them" with "bytes land in HBM, laid out for
 GSPMD" (BASELINE.json north_star).
+
+Tiering (docs/loading.md): fetched bytes stage through a reusable host
+buffer pool (_StagingPool) whose bounded occupancy double-buffers the
+fetch of shard k+1 against the device_put of shard k (_OverlapClock
+reports the achieved overlap), and a content-addressed local blob cache
+(dl/blob_cache.py, wired at the dl/initializer._blob_source seam) makes a
+warm re-deploy of an already-served blob entirely network-free.
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
 import re
@@ -52,6 +60,138 @@ DEFAULT_PACK_THRESHOLD = 1 << 20
 PACK_CHUNK = 64 << 20  # bytes of small tensors batched per device_put call
 # host bytes allowed to sit in the fetch->transfer queue (see _ByteBudget)
 DEFAULT_TRANSFER_BUDGET = 1 << 30
+# reads at least this big stage into the reusable host buffer pool
+# (_StagingPool) instead of allocating fresh — below it the allocator is
+# cheaper than the bookkeeping, and the packed-transfer path (which parks
+# its arrays until load end) stays out of the pool by construction
+DEFAULT_STAGING_MIN = 1 << 20
+# remote ranged reads above this split into governor-gated subranges on
+# parallel connections (HTTPSource keeps one connection per thread), so a
+# lone multi-GB tensor can use the whole fetch width instead of one stream
+DEFAULT_SPLIT_READ = 64 << 20
+
+
+class _StagingPool:
+    """Reusable host staging buffers for fetched shard bytes (the
+    ServerlessLLM pinned-pool idea, arxiv 2401.14351): every shard read
+    used to allocate a fresh numpy buffer, so a multi-hundred-shard load
+    churned the allocator at GB/s. Buffers live in power-of-two size
+    classes, and at most ``max_outstanding`` are out at once — an acquire
+    past the cap BLOCKS until a transfer returns one, which is the
+    double-buffering gate: fetch k+1 proceeds exactly while the puts of
+    earlier shards drain, and allocation count tracks CONCURRENCY (fetch
+    width + transfer width), not shard count. Freelists are bounded —
+    overflow buffers fall to the GC rather than pinning peak-burst
+    memory. Every acquired buffer MUST be released on every path, or the
+    cap starves the remaining fetch workers."""
+
+    MAX_FREE_PER_CLASS = 8
+
+    def __init__(self, max_outstanding: int = 0) -> None:
+        self._cv = threading.Condition()
+        self._free: dict[int, list[np.ndarray]] = {}
+        self._out = 0
+        self.max_outstanding = int(max_outstanding)
+        self.allocs = 0
+        self.reuses = 0
+
+    def acquire(self, nbytes: int) -> np.ndarray:
+        cls = 1 << max(nbytes - 1, 0).bit_length()
+        with self._cv:
+            while True:
+                free = self._free.get(cls)
+                if free:
+                    base = free.pop()
+                    self.reuses += 1
+                    break
+                if not self.max_outstanding or self._out < self.max_outstanding:
+                    base = None
+                    self.allocs += 1
+                    break
+                self._cv.wait()
+            self._out += 1
+        if base is None:
+            base = np.empty(cls, np.uint8)
+        return base[:nbytes]
+
+    def release(self, view: np.ndarray) -> None:
+        base = view.base if view.base is not None else view
+        if not isinstance(base, np.ndarray) or base.dtype != np.uint8:
+            return
+        cls = base.nbytes
+        if cls & (cls - 1):  # not a pool buffer
+            return
+        with self._cv:
+            self._out -= 1
+            free = self._free.setdefault(cls, [])
+            if len(free) < self.MAX_FREE_PER_CLASS:
+                free.append(base)
+            self._cv.notify_all()
+
+    def forfeit(self, view: np.ndarray) -> None:
+        """Give up a buffer WITHOUT recycling it: the device array aliases
+        it (PJRT CPU zero-copies 64-byte-aligned host buffers), so its
+        memory now belongs to the loaded weights. Frees the outstanding
+        slot so the pipeline keeps moving; the buffer itself lives as long
+        as the arrays that share it."""
+        with self._cv:
+            self._out -= 1
+            self._cv.notify_all()
+
+
+def _aliases_buffer(dev_arrays, host: np.ndarray) -> bool:
+    """True when any device shard's buffer lives inside ``host``'s
+    allocation — the zero-copy case where recycling the host buffer would
+    rewrite the 'device' bytes. Unprovable (no buffer pointer API on this
+    backend) counts as aliased: correctness over reuse."""
+    base = host.base if host.base is not None else host
+    h0 = base.__array_interface__["data"][0]
+    h1 = h0 + base.nbytes
+    for arr in dev_arrays:
+        try:
+            for shard in arr.addressable_shards:
+                if h0 <= shard.data.unsafe_buffer_pointer() < h1:
+                    return True
+        except Exception:
+            return True
+    return False
+
+
+class _OverlapClock:
+    """Wall-clock accounting of the fetch / device_put pipeline: how long
+    each phase had work in flight, and for how long BOTH did (the overlap
+    the two-pool design exists to create). Entirely host-side counters —
+    a load whose overlap_s ~ 0 on a big checkpoint is running its stages
+    serially and has lost the pipeline."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._n = {"fetch": 0, "put": 0}
+        self._last = time.monotonic()
+        self.busy = {"fetch": 0.0, "put": 0.0}
+        self.overlap_s = 0.0
+
+    def _tick(self) -> None:
+        now = time.monotonic()
+        dt = now - self._last
+        self._last = now
+        if dt <= 0:
+            return
+        for kind, n in self._n.items():
+            if n > 0:
+                self.busy[kind] += dt
+        if self._n["fetch"] > 0 and self._n["put"] > 0:
+            self.overlap_s += dt
+
+    def enter(self, kind: str) -> None:
+        with self._lock:
+            self._tick()
+            self._n[kind] += 1
+
+    def exit(self, kind: str) -> None:
+        with self._lock:
+            self._tick()
+            self._n[kind] -= 1
 
 
 class _ByteBudget:
@@ -121,20 +261,30 @@ def auto_fetch_concurrency(source) -> int:
 class _FetchGovernor:
     """Admission gate for fetch reads that HALVES its width when measured
     per-thread throughput collapses (the r4 failure signature: local reads
-    at ~1.5 MB/s per thread while the same file streams at 1+ GB/s). Width
-    only shrinks — a governor that grows again would oscillate against the
-    scheduler conditions that caused the collapse. Gating happens per READ,
-    so shrinking takes effect mid-load without tearing down pool threads."""
+    at ~1.5 MB/s per thread while the same file streams at 1+ GB/s) and —
+    new for the cache-tier loader — GROWS it while per-thread throughput
+    shows headroom (``growth_bps``), up to ``max_width``. The r5 capture
+    sat at width 2 with the link 56% idle; growth is what lets the width
+    recover above the collapse floor. Oscillation guard: after 3 backoffs
+    growth disables permanently — a link that keeps punishing added width
+    gets no more probes. Gating happens per READ, so width changes take
+    effect mid-load without tearing down pool threads."""
 
-    def __init__(self, width: int, floor_bps: float, min_width: int = 2) -> None:
+    MAX_GROWTH_BACKOFFS = 3
+
+    def __init__(self, width: int, floor_bps: float, min_width: int = 2,
+                 max_width: int = 0, growth_bps: float = 0.0) -> None:
         self.width = max(1, int(width))
         self.floor_bps = float(floor_bps)
         self.min_width = min(min_width, self.width)
+        self.max_width = max(self.width, int(max_width))
+        self.growth_bps = float(growth_bps)
         self._cv = threading.Condition()
         self._active = 0
         self._bytes = 0
         self._busy_s = 0.0
-        self.backoffs = 0  # observability: how often the governor fired
+        self.backoffs = 0  # observability: how often the governor shrank
+        self.growths = 0  # ... and how often it grew
 
     def acquire(self) -> None:
         with self._cv:
@@ -147,15 +297,25 @@ class _FetchGovernor:
             self._active -= 1
             self._bytes += nbytes
             self._busy_s += seconds
-            if self.floor_bps and self._busy_s >= 0.25:
+            if (self.floor_bps or self.growth_bps) and self._busy_s >= 0.25:
                 # per-busy-thread-second rate: busy seconds sum across
                 # threads, so this is throughput per active thread
+                rate = self._bytes / self._busy_s
                 if (
-                    self._bytes / self._busy_s < self.floor_bps
+                    self.floor_bps
+                    and rate < self.floor_bps
                     and self.width > self.min_width
                 ):
                     self.width = max(self.min_width, self.width // 2)
                     self.backoffs += 1
+                elif (
+                    self.growth_bps
+                    and rate >= self.growth_bps
+                    and self.width < self.max_width
+                    and self.backoffs < self.MAX_GROWTH_BACKOFFS
+                ):
+                    self.width = min(self.max_width, self.width * 2)
+                    self.growths += 1
                 # decay: recent reads dominate the next verdict
                 self._bytes //= 2
                 self._busy_s /= 2
@@ -375,6 +535,16 @@ class LoadStats:
     total_seconds: float = 0.0
     fetch_width: int = 0  # governor's final width (== initial when healthy)
     fetch_backoffs: int = 0  # times the governor halved the width
+    fetch_growths: int = 0  # times the governor doubled it (headroom)
+    # pipeline accounting (_OverlapClock): wall time ranged fetches were in
+    # flight vs device_put dispatches, and the window where both were —
+    # overlap ~ 0 on a big load means the fetch->HBM pipeline collapsed
+    device_put_seconds: float = 0.0
+    overlap_seconds: float = 0.0
+    # staging pool: fresh buffer allocations vs pooled reuses; allocs track
+    # concurrency, not shard count (tests assert this stays bounded)
+    staging_allocs: int = 0
+    staging_reuses: int = 0
 
     @property
     def gbps(self) -> float:
@@ -487,6 +657,8 @@ def load_safetensors(
     quantize: str | None = None,
     pack_threshold: int = DEFAULT_PACK_THRESHOLD,
     transfer_budget_bytes: int = DEFAULT_TRANSFER_BUDGET,
+    staging_min_bytes: int = DEFAULT_STAGING_MIN,
+    split_read_bytes: int = DEFAULT_SPLIT_READ,
 ) -> tuple[dict[str, jax.Array], LoadStats]:
     """Load every tensor of a safetensors blob onto ``mesh`` per ``rules``.
 
@@ -516,6 +688,15 @@ def load_safetensors(
     small tensors, no on-device program) — per-tensor dispatch latency
     (~5-40 ms on a tunneled device) would otherwise dominate checkpoints
     with many small tensors. 0 disables (every shard dispatches alone).
+    ``staging_min_bytes``: reads at least this big land in pooled, reusable
+    host staging buffers (_StagingPool) instead of fresh allocations; the
+    pool plus the fetch/transfer thread pair is the double-buffering that
+    overlaps the fetch of shard k+1 with the device_put of shard k
+    (LoadStats carries the overlap accounting). 0 disables the pool.
+    ``split_read_bytes``: remote ranged reads above this split into
+    parallel governor-gated subrange reads (one connection per thread), so
+    a single huge tensor doesn't serialize the link. 0 disables splitting;
+    local files never split (pread has no per-stream ceiling to beat).
     """
     t0 = time.monotonic()
     if tensors is None or data_offset is None:
@@ -533,11 +714,26 @@ def load_safetensors(
     # the threads are fighting the scheduler, not the disk (healthy is
     # 300+ MB/s; the r4 collapse was 1.5 MB/s). HTTP sources skip the
     # governor's floor — a genuinely slow remote link must not trigger a
-    # width collapse that makes it slower still.
+    # width collapse that makes it slower still. Growth: remote sources may
+    # double width up to 2x the auto width while per-thread throughput holds
+    # above 24 MB/s (the r5 capture left 56% of the link idle at width 2);
+    # local sources may regrow only back to the auto width, and only while
+    # per-thread reads run at healthy page-cache rates (4x the floor).
+    is_local = isinstance(source, LocalFileSource)
     governor = _FetchGovernor(
         concurrency,
-        floor_bps=32e6 if isinstance(source, LocalFileSource) else 0.0,
+        floor_bps=32e6 if is_local else 0.0,
+        max_width=concurrency if is_local else 2 * concurrency,
+        growth_bps=128e6 if is_local else 24e6,
     )
+    n_transfer = transfer_concurrency
+    if n_transfer <= 0:
+        n_transfer = max(8, min(16, 2 * len(mesh.local_devices)))
+    clock = _OverlapClock()
+    # the outstanding-buffer cap is what makes the pool a PIPELINE gate:
+    # one buffer per fetch thread, one per transfer thread, plus slack so a
+    # fetch never waits on an about-to-finish put
+    staging_pool = _StagingPool(max_outstanding=concurrency + n_transfer + 2)
 
     def _gated_read(offset: int, length: int, out=None):
         """Ranged read under the governor's gate; the retry policy stays
@@ -546,6 +742,7 @@ def load_safetensors(
         retry story, not a width story, and must not read as a collapse
         that permanently sheds fetch parallelism."""
         governor.acquire()
+        clock.enter("fetch")
         sample = [0, 0.0]
 
         def timer(n: int, secs: float) -> None:
@@ -554,7 +751,36 @@ def load_safetensors(
         try:
             return _read_with_retry(source, offset, length, out, timer=timer)
         finally:
+            clock.exit("fetch")
             governor.release(sample[0], sample[1])
+
+    # per-blob multi-connection fetch: huge reads split into subranges run
+    # on a DEDICATED executor (split tasks never submit further work, so the
+    # fetch pool can block on them without starving itself); the governor
+    # still gates every subrange, so total width stays under its control
+    split_pool = None
+    if split_read_bytes and not is_local:
+        split_pool = ThreadPoolExecutor(max_workers=min(8, max(2, concurrency)))
+
+    def _fetch_bytes(offset: int, length: int, out=None):
+        if split_pool is None or length <= split_read_bytes:
+            return _gated_read(offset, length, out)
+        if out is None:
+            buf = np.empty(length, np.uint8)
+            view = memoryview(buf)
+        else:
+            buf = out
+            view = out if isinstance(out, memoryview) else memoryview(out)
+        futs = [
+            split_pool.submit(
+                _gated_read, offset + o, min(split_read_bytes, length - o),
+                view[o : o + min(split_read_bytes, length - o)],
+            )
+            for o in range(0, length, split_read_bytes)
+        ]
+        for f in futs:
+            f.result()
+        return buf
 
     stats = LoadStats()
     lock = threading.Lock()
@@ -590,24 +816,54 @@ def load_safetensors(
     # whole-tensor fetches are deduped across shard-groups of the same tensor
     _full_cache: dict[str, bytes] = {}
     _full_lock = threading.Lock()
+    # single-flight events: the get-then-fetch window would otherwise let
+    # two groups of the same inner-sharded tensor BOTH miss and BOTH pull
+    # the whole tensor (the exactly-once byte accounting the fetch plan
+    # promises — TestByteAccounting2DMesh — raced away under load)
+    _full_events: dict[str, threading.Event] = {}
     # global per-channel scales for quantized tensors on the full-fetch path
     _scale_cache: dict[str, np.ndarray] = {}
 
     def _cached_full_tensor(info: st.TensorInfo) -> bytes:
-        with _full_lock:
-            cached = _full_cache.get(info.name)
-        if cached is not None:
-            return cached
-        raw = _gated_read(data_offset + info.start, info.nbytes)
-        with _full_lock:
-            _full_cache[info.name] = raw
-        return raw
+        while True:
+            with _full_lock:
+                cached = _full_cache.get(info.name)
+                if cached is not None:
+                    return cached
+                ev = _full_events.get(info.name)
+                if ev is None:
+                    ev = _full_events[info.name] = threading.Event()
+                    fetching = True
+                else:
+                    fetching = False
+            if not fetching:
+                ev.wait()  # the owner fills the cache (or fails; then retry)
+                continue
+            try:
+                raw = _fetch_bytes(data_offset + info.start, info.nbytes)
+                with _full_lock:
+                    _full_cache[info.name] = raw
+                return raw
+            finally:
+                # event removed BEFORE set: a waiter that finds no cache
+                # entry and no event becomes the next owner (owner failed)
+                with _full_lock:
+                    _full_events.pop(info.name, None)
+                ev.set()
 
-    def _fetch_slice(info: st.TensorInfo, full_spec: tuple) -> tuple[np.ndarray, int]:
+    def _fetch_slice(
+        info: st.TensorInfo, full_spec: tuple, pool_ok: bool = True
+    ) -> tuple[np.ndarray, int, np.ndarray | None]:
         """Fetch one tensor's slice. Contiguous row blocks (inner dims full)
         are fetched with one exact ranged read; byte-strided inner-axis
         slices fetch the whole tensor once (cached) and slice in memory.
-        Returns (array, bytes_read)."""
+        Returns (array, bytes_read, staging): ``staging`` is the pooled host
+        buffer backing the array when one was used — the caller must release
+        it to the pool once the bytes are on device (or copied).
+        ``pool_ok=False`` skips the pool: a caller that accumulates SEVERAL
+        slices before releasing any (stacked-expert assembly) would
+        hold-and-wait against the pool's bounded occupancy — the classic
+        deadlock shape — so it allocates fresh instead."""
         np_dtype = info.np_dtype()
         inner_full = all(
             s.start == 0 and s.stop == dim
@@ -616,12 +872,29 @@ def load_safetensors(
         if info.shape and inner_full:
             lead = full_spec[0]
             b0, b1 = st.row_range(info, lead.start, lead.stop)
-            raw = _gated_read(data_offset + b0, b1 - b0)
-            return _as_np(raw, np_dtype, (lead.stop - lead.start, *info.shape[1:])), b1 - b0
+            length = b1 - b0
+            staging = None
+            out = None
+            if pool_ok and staging_min_bytes and length >= staging_min_bytes:
+                staging = staging_pool.acquire(length)
+                out = memoryview(staging)
+            try:
+                raw = _fetch_bytes(data_offset + b0, length, out)
+            except BaseException:
+                # a leaked buffer starves the pool's outstanding cap — the
+                # sibling fetch workers would deadlock behind a dead load
+                if staging is not None:
+                    staging_pool.release(staging)
+                raise
+            arr = _as_np(
+                staging if staging is not None else raw,
+                np_dtype, (lead.stop - lead.start, *info.shape[1:]),
+            )
+            return arr, length, staging
         raw = _cached_full_tensor(info)
         arr = _as_np(raw, np_dtype, info.shape)
         sliced = np.ascontiguousarray(arr[full_spec]) if info.shape else arr.reshape(())
-        return sliced, len(raw)
+        return sliced, len(raw), None
 
     def fetch_group(info: st.TensorInfo, group: list):
         """Fetch one shard-group's bytes; hand the host array to the transfer
@@ -669,20 +942,26 @@ def load_safetensors(
                 cached = info.name in _full_cache
             cost = slice_bytes if cached else max(slice_bytes, info.nbytes)
         cost = inflight.acquire(cost)  # clamped: release exactly this much
+        staging = None
         try:
             tf0 = time.monotonic()
             if info.members is not None:
                 # virtual stacked tensor: assemble the shard from the member
-                # tensors (per-expert ranges) this group owns
+                # tensors (per-expert ranges) this group owns. pool_ok=False:
+                # holding E pooled buffers at once while siblings do the
+                # same would hold-and-wait against the pool's bounded
+                # occupancy (np.stack copies anyway)
                 lead = full_spec[0]
                 parts, nread = [], 0
                 for e in range(lead.start, lead.stop):
-                    part, nb = _fetch_slice(info.members[e], full_spec[1:])
+                    part, nb, _stg = _fetch_slice(
+                        info.members[e], full_spec[1:], pool_ok=False
+                    )
                     parts.append(part)
                     nread += nb
                 arr = np.stack(parts)
             else:
-                arr, nread = _fetch_slice(info, full_spec)
+                arr, nread, staging = _fetch_slice(info, full_spec)
             with lock:
                 stats.bytes_fetched += nread
                 stats.fetch_seconds += time.monotonic() - tf0
@@ -710,6 +989,12 @@ def load_safetensors(
                     arr = qt.quantize_rows(arr, scale)
             elif dtype is not None and arr.dtype != np.dtype(dtype):
                 arr = arr.astype(dtype)
+            if staging is not None and not np.may_share_memory(arr, staging):
+                # a host-side cast/quantize copied the bytes out: the pooled
+                # buffer is free for the next fetch right now, not after the
+                # transfer
+                staging_pool.release(staging)
+                staging = None
             if progress:
                 progress(arr.nbytes * len(group))
             if arr.nbytes < cost:
@@ -731,23 +1016,51 @@ def load_safetensors(
                 # park until every fetch settles, and the packable tail is
                 # bounded by pack_threshold x tensor count, not the budget
                 inflight.release(cost)
+                if staging is not None:
+                    # packs park until load end — copy out so the pooled
+                    # buffer doesn't sit hostage under a small tensor
+                    arr = arr.copy()
+                    staging_pool.release(staging)
                 return ("pack", arr, group)
         except BaseException:
             inflight.release(cost)
+            if staging is not None:
+                staging_pool.release(staging)
             raise
 
         def xfer():
+            pooled = staging
             try:
-                return [
-                    (
-                        dev,
-                        jax.device_put(arr, dev),
-                        jax.device_put(scale, dev) if scale is not None else None,
-                    )
-                    for dev, _ in group
-                ]
+                clock.enter("put")
+                try:
+                    out = [
+                        (
+                            dev,
+                            jax.device_put(arr, dev),
+                            jax.device_put(scale, dev) if scale is not None else None,
+                        )
+                        for dev, _ in group
+                    ]
+                    if pooled is not None:
+                        # the transfer may still be reading the pooled host
+                        # buffer asynchronously: wait before recycling it —
+                        # and if the backend zero-copied (the device array
+                        # ALIASES the buffer, PJRT CPU with 64-byte-aligned
+                        # hosts), hand the memory over instead of recycling
+                        devs = [t[1] for t in out]
+                        jax.block_until_ready(devs)
+                        if _aliases_buffer(devs, pooled):
+                            staging_pool.forfeit(pooled)
+                        else:
+                            staging_pool.release(pooled)
+                        pooled = None
+                finally:
+                    clock.exit("put")
+                return out
             finally:
                 inflight.release(cost)
+                if pooled is not None:  # device_put raised before handoff
+                    staging_pool.release(pooled)
 
         try:
             return transfer_pool.submit(xfer)
@@ -755,15 +1068,19 @@ def load_safetensors(
             # submit can refuse (pool shut down after a sibling error); give
             # the budget back or the remaining fetch workers deadlock
             inflight.release(cost)
+            if staging is not None:
+                staging_pool.release(staging)
             raise
 
-    n_transfer = transfer_concurrency
-    if n_transfer <= 0:
-        n_transfer = max(8, min(16, 2 * len(mesh.local_devices)))
     inflight = _ByteBudget(transfer_budget_bytes)
+    # contexts unwind LIFO, so the cleanup stack runs first on ANY exit: a
+    # failed load must not strand the split executor's idle threads in a
+    # long-lived serve process (one leak per retry against a flaky registry)
     with ThreadPoolExecutor(max_workers=concurrency) as pool, ThreadPoolExecutor(
         max_workers=n_transfer
-    ) as transfer_pool:
+    ) as transfer_pool, contextlib.ExitStack() as _cleanup:
+        if split_pool is not None:
+            _cleanup.callback(split_pool.shutdown, False)
         futures = {}
         # big tensors first: their fetch+transfer dominates the critical path
         for name, info in sorted(tensors.items(), key=lambda kv: -kv[1].nbytes):
@@ -824,6 +1141,11 @@ def load_safetensors(
     stats.total_seconds = time.monotonic() - t0
     stats.fetch_width = governor.width
     stats.fetch_backoffs = governor.backoffs
+    stats.fetch_growths = governor.growths
+    stats.device_put_seconds = clock.busy["put"]
+    stats.overlap_seconds = clock.overlap_s
+    stats.staging_allocs = staging_pool.allocs
+    stats.staging_reuses = staging_pool.reuses
     from modelx_tpu.utils import trace
 
     trace.tracer().record({
@@ -834,6 +1156,8 @@ def load_safetensors(
         "bytes_fetched": stats.bytes_fetched,
         "bytes_to_device": stats.bytes_to_device,
         "fetch_thread_s": round(stats.fetch_seconds, 3),
+        "overlap_s": round(stats.overlap_seconds, 3),
+        "staging_allocs": stats.staging_allocs,
         "gbps": round(stats.gbps, 3),
     })
     return results, stats

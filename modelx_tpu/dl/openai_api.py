@@ -34,12 +34,15 @@ from __future__ import annotations
 
 import itertools
 import json
+import logging
 import os
 import time
 import uuid
 from typing import Iterator
 
 import numpy as np
+
+logger = logging.getLogger("modelx.serve")
 
 OBJ_COMPLETION = "text_completion"
 OBJ_CHAT = "chat.completion"
@@ -102,22 +105,48 @@ def render_messages(messages, spec: dict | None = None) -> str:
             # the generic template only knows the three core roles; a model
             # template validates roles itself (raise_exception)
             raise APIError(400, f"messages[{i}].role must be system|user|assistant")
-    if spec is not None:
+    if spec is not None and not spec.get("broken"):
         from modelx_tpu.dl.serve import ChatTemplateRejected
 
+        render_kwargs = dict(
+            add_generation_prompt=True,
+            bos_token=spec.get("bos_token", ""),
+            eos_token=spec.get("eos_token", ""),
+        )
         try:
             # compiled ONCE per model (ModelServer.chat_template); only the
             # render runs per request
-            return spec["compiled"].render(
-                messages=messages,
-                add_generation_prompt=True,
-                bos_token=spec.get("bos_token", ""),
-                eos_token=spec.get("eos_token", ""),
-            )
+            return spec["compiled"].render(messages=messages, **render_kwargs)
         except ChatTemplateRejected as e:
+            # the template itself said no (raise_exception): the caller's
+            # messages violate the model's conversation contract — 400
             raise APIError(400, f"chat template rejected the messages: {e}")
         except Exception as e:
-            raise APIError(400, f"chat template failed to render: {e}")
+            # triage before blaming the client: a render that ALSO fails on
+            # a trivial probe payload is a broken template (a server-side
+            # defect in the pushed tokenizer_config.json), and a 400 would
+            # send the caller fixing messages that aren't the problem —
+            # fall back to the generic role template with a warning.
+            # Failures the probe does NOT reproduce are message-dependent:
+            # those stay 400. The verdict memoizes in the per-model spec
+            # dict so a broken template costs two failed renders + one log
+            # line ONCE, not per request.
+            probe = [{"role": "user", "content": "probe"}]
+            try:
+                spec["compiled"].render(messages=probe, **render_kwargs)
+            except ChatTemplateRejected:
+                # the template deliberately rejected the bare probe (e.g.
+                # requires a system turn): that's template logic working,
+                # not breakage — the original failure stays the caller's
+                raise APIError(400, f"chat template failed to render: {e}")
+            except Exception:
+                spec["broken"] = True
+                logger.warning(
+                    "chat template fails independent of the request (%s); "
+                    "falling back to the generic role template", e,
+                )
+            else:
+                raise APIError(400, f"chat template failed to render: {e}")
     parts = [
         f"<|{m.get('role', 'user')}|>\n{m['content']}\n" for m in messages
     ]

@@ -273,6 +273,14 @@ class ModelServer:
                 self.cfg = self.family.infer_config(
                     fam.abstract_params(infos_all)
                 )
+                # reconcile with the pulled config.json sidecar: rope_theta
+                # overrides apply; unimplemented rope_scaling (phi-3-*-128k
+                # longrope etc.) refuses BEFORE the weights stream to HBM
+                sidecar = fam.sidecar_config(self.model_dir)
+                if sidecar is not None:
+                    self.cfg = fam.apply_sidecar_config(
+                        self.cfg, sidecar, self.family.name
+                    )
             # quantized included: abstract_params mirrors the loader's int8
             # transform (QTensor pytrees of structs), so int8 deploys overlap
             # load and compile like bf16 ones

@@ -27,6 +27,12 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
 @click.option("--max-seq-len", default=2048, type=int)
 @click.option("--compile-cache/--no-compile-cache", default=True,
               help="persistent XLA compilation cache (restart TTFT)")
+@click.option("--blob-cache-dir", default="",
+              help="content-addressed local blob cache for registry-backed "
+                   "loads (dl/blob_cache.py): warm restarts of an "
+                   "already-served checkpoint skip the network")
+@click.option("--blob-cache-max-bytes", default=0, type=int,
+              help="blob cache size cap; LRU eviction (0 = unbounded)")
 @click.option("--concurrent-load", is_flag=True, help="overlap multi-model loads")
 @click.option("--trace-dir", default="", help="jax profiler output dir (/v1/profile)")
 @click.option("--dynamic-batch", is_flag=True,
@@ -90,7 +96,9 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
               help="on SIGTERM, serve 503 on /healthz for this long (so load "
                    "balancers drain) before stopping")
 def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen: str,
-         max_seq_len: int, compile_cache: bool, concurrent_load: bool, trace_dir: str,
+         max_seq_len: int, compile_cache: bool,
+         blob_cache_dir: str, blob_cache_max_bytes: int,
+         concurrent_load: bool, trace_dir: str,
          dynamic_batch: bool, continuous_batch: bool, max_slots: int,
          kv_page_size: int, kv_live_tokens: int, kv_attention: str,
          max_batch: int, batch_window_ms: float, stream_chunk_size: int,
@@ -103,6 +111,12 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
     initialize()  # no-op single-process; wires multi-host TPU pods
     if compile_cache:
         enable_compile_cache()
+    if blob_cache_dir:
+        # process-default blob cache: every registry-backed load this
+        # process performs (deploy-time pulls, re-loads) tees through it
+        from modelx_tpu.dl.blob_cache import configure_default
+
+        configure_default(blob_cache_dir, max_bytes=blob_cache_max_bytes)
     entries: dict[str, str] = {}
     if model_dir:
         entries["default"] = model_dir

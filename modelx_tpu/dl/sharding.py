@@ -14,6 +14,7 @@ pushed without annotations still loads sharded.
 from __future__ import annotations
 
 import json
+import logging
 import re
 from typing import Sequence
 
@@ -173,13 +174,30 @@ def rules_for_family(family: str) -> Rules:
     return DEFAULT_RULES.get(family, [(r".*", [])])
 
 
+logger = logging.getLogger("modelx.dl")
+
+
 def infer_family(tensor_names: Sequence[str]) -> str:
     names = list(tensor_names)
     joined = "\n".join(names)
     if "block_sparse_moe" in joined:
         return "mixtral"
     if "pre_feedforward_layernorm" in joined:
-        return "gemma2"  # llama layout + sandwich norms (unique to gemma2)
+        # llama layout + sandwich norms: gemma2 — but gemma3 ALSO carries
+        # them, adding per-head q_norm/k_norm attention norms (and a
+        # different rope/window schedule) that gemma2's math doesn't have;
+        # running gemma3 through the gemma2 branch would decode garbage
+        # while the extra norm tensors load silently replicated. Fail
+        # loudly instead of matching (families.detect raises on "").
+        if "q_norm" in joined or "k_norm" in joined:
+            logger.warning(
+                "checkpoint has gemma2-style sandwich norms AND q_norm/"
+                "k_norm attention-norm tensors (gemma3?): refusing the "
+                "gemma2 family match — these layer tensors are not part "
+                "of any supported architecture"
+            )
+            return ""
+        return "gemma2"
     if "qkv_proj" in joined:
         return "phi3"  # llama layout with fused qkv/gate_up projections
     if "q_proj.bias" in joined:

@@ -35,11 +35,13 @@ import time
 
 
 def measure_once(base: str, repo: str, cache_dir: str = "",
-                 version: str = "v1", quantize: str | None = None) -> dict:
+                 version: str = "v1", quantize: str | None = None,
+                 blob_cache_dir: str = "") -> dict:
     import jax
     import numpy as np
 
     from modelx_tpu.client.client import Client
+    from modelx_tpu.dl import blob_cache as bc
     from modelx_tpu.dl import families as fam
     from modelx_tpu.dl import safetensors as st
     from modelx_tpu.dl.initializer import _blob_source
@@ -50,6 +52,12 @@ def measure_once(base: str, repo: str, cache_dir: str = "",
 
     if cache_dir:
         enable_compile_cache(cache_dir)
+    # local blob-cache tier (dl/blob_cache.py): warm restarts of a blob the
+    # node already served load via preads, zero network reads — the
+    # ttft_warm_weights_ready_ms path of the bench. Explicit dir wins;
+    # otherwise the process default (MODELX_BLOB_CACHE_DIR in subprocess
+    # harnesses) applies.
+    blob_cache = bc.BlobCache(blob_cache_dir) if blob_cache_dir else bc.default_cache()
     # pre-clock: pod runtime boot — backend init + device handshake + mesh,
     # and the serving imports a real sidecar performs at process start
     # (measured ~1.1 s of the plan leg on a 1-core host when paid lazily)
@@ -75,7 +83,7 @@ def measure_once(base: str, repo: str, cache_dir: str = "",
             # reads, like initializer.load_to_mesh does
             import struct
 
-            source = _blob_source(client, repo, blob)
+            source = _blob_source(client, repo, blob, cache=blob_cache)
             try:
                 (hlen,) = struct.unpack("<Q", bytes(source.read_range(0, 8)))
                 parsed = st.parse_header(bytes(source.read_range(8, hlen)))
@@ -108,8 +116,11 @@ def measure_once(base: str, repo: str, cache_dir: str = "",
     th.start()
     params: dict = {}
     bytes_to_device = 0
+    warm_blobs = 0
     for blob, parsed, off in blobs:
-        source = _blob_source(client, repo, blob)
+        source = _blob_source(client, repo, blob, cache=blob_cache)
+        if getattr(source, "cache_state", "") == "warm":
+            warm_blobs += 1
         try:
             arrays, stats = load_safetensors(
                 source, mesh, family.rules, tensors=parsed, data_offset=off,
@@ -138,18 +149,22 @@ def measure_once(base: str, repo: str, cache_dir: str = "",
         "compile_thread_ms": round(compiled["secs"] * 1e3, 1),
         "weights_ready_ms": round((t_load - t0) * 1e3, 1),
         "bytes_to_device": bytes_to_device,
+        # how many safetensors blobs the local blob cache served (zero
+        # network reads); == len(blobs) on a fully warm restart
+        "warm_blobs": warm_blobs,
     }
 
 
 def main(argv: list[str]) -> int:
     if len(argv) < 3:
         print("usage: python -m modelx_tpu.dl.ttft <registry> <repo> "
-              "[cache_dir] [quantize]", file=sys.stderr)
+              "[cache_dir] [quantize] [blob_cache_dir]", file=sys.stderr)
         return 2
     out = measure_once(
         argv[1], argv[2],
         cache_dir=argv[3] if len(argv) > 3 else "",
         quantize=(argv[4] or None) if len(argv) > 4 else None,
+        blob_cache_dir=argv[5] if len(argv) > 5 else "",
     )
     print(json.dumps(out))
     return 0

@@ -1,0 +1,17 @@
+"""Version shims for jax APIs this codebase uses by their CURRENT names.
+
+The container pins an older jax than the code targets; each shim maps the
+modern spelling onto what's installed so call sites stay written against
+the current API (and the shim deletes cleanly when the pin catches up).
+"""
+
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.6: experimental namespace, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map_old
+
+    def shard_map(f, **kw):
+        kw["check_rep"] = kw.pop("check_vma", True)
+        return _shard_map_old(f, **kw)
+
+__all__ = ["shard_map"]

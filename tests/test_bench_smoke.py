@@ -8,6 +8,7 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 
 class TestWaitForDevice:
@@ -139,7 +140,10 @@ class TestBenchSmoke:
     def test_leg_subprocess_roundtrip(self, tmp_path):
         """The timed legs run as `bench.py --leg <kind>` children on the
         driver's rig; each must load against a live registry and print one
-        JSON line with the fields the parent consumes (CPU backend here)."""
+        JSON line with the fields the parent consumes (CPU backend here).
+        Legs run in order: the warm blob-cache leg consumes the cache the
+        cold leg admitted, and must report a warm hit (zero network reads
+        — its source is the cache's LocalFileSource)."""
         from bench import build_checkpoint, push_checkpoint, start_registry
 
         import shutil
@@ -152,10 +156,15 @@ class TestBenchSmoke:
             push_checkpoint(base, "library/smoke", ckpt)
             here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
             env = dict(os.environ, PYTHONPATH=here, JAX_PLATFORMS="cpu")
+            recs = {}
             for kind, fields in (
-                ("ours", ("seconds", "source", "fetch_width", "bytes_to_device", "link_gbps")),
+                ("ours", ("seconds", "source", "fetch_width", "bytes_to_device",
+                          "link_gbps", "overlap_seconds", "staging_allocs")),
                 ("baseline", ("seconds", "link_gbps")),
                 ("int8", ("seconds", "bytes_to_device")),
+                ("cold", ("seconds", "cache_state", "blob_cache",
+                          "overlap_seconds", "staging_allocs")),
+                ("warm", ("seconds", "cache_state", "blob_cache")),
             ):
                 p = subprocess.run(
                     [sys.executable, os.path.join(here, "bench.py"),
@@ -167,6 +176,33 @@ class TestBenchSmoke:
                 for f in fields:
                     assert f in rec, (kind, f, rec)
                 assert rec["seconds"] > 0
+                recs[kind] = rec
+            # the cold leg streamed the network source and admitted the blob
+            assert recs["cold"]["cache_state"] == "cold"
+            assert recs["cold"]["blob_cache"]["admitted"] == 1
+            # the warm leg was served entirely by the cache: LocalFileSource,
+            # zero network reads
+            assert recs["warm"]["cache_state"] == "warm"
+            assert recs["warm"]["source"] == "LocalFileSource"
+            assert recs["warm"]["blob_cache"]["hits"] == 1
         finally:
             srv.terminate()
             shutil.rmtree(os.path.join(workdir, "registry"), ignore_errors=True)
+
+    def test_cache_split_summary_schema(self):
+        """The cold/warm bench fields the driver consumes (ISSUE 1): key
+        names are load-bearing — schema-check them in tier-1."""
+        from bench import cache_split_summary, ttft_warm_fields
+
+        cold = {"seconds": 2.0, "overlap_seconds": 0.5, "staging_allocs": 12,
+                "fetch_growths": 1, "cache_state": "cold"}
+        warm = {"seconds": 0.5, "cache_state": "warm"}
+        out = cache_split_summary(1 << 30, cold, warm)
+        for key in ("registry_to_hbm_warm_gbps", "registry_to_hbm_cold_cached_gbps",
+                    "warm_vs_cold", "warm_hit", "warm_seconds",
+                    "cold_overlap_seconds", "cold_staging_allocs"):
+            assert key in out, key
+        assert out["warm_hit"] is True
+        assert out["warm_vs_cold"] == pytest.approx(4.0)
+        ttft = ttft_warm_fields({"ttft_ms": 120.0, "ttft_weights_ready_ms": 80.0})
+        assert ttft == {"ttft_warm_ms": 120.0, "ttft_warm_weights_ready_ms": 80.0}

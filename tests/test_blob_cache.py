@@ -1,0 +1,244 @@
+"""The local blob-cache tier (dl/blob_cache.py): cold-miss tee + admission,
+warm-hit network bypass (zero ByteSource reads), size-capped LRU eviction,
+and digest rejection of corrupted entries (falling back to the network)."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from modelx_tpu.dl import safetensors as st
+from modelx_tpu.dl.blob_cache import BlobCache, CachingByteSource
+from modelx_tpu.dl.loader import LocalFileSource, load_safetensors
+from modelx_tpu.dl.sharding import LLAMA_RULES
+from modelx_tpu.parallel.mesh import make_mesh
+from modelx_tpu.types import Digest
+
+
+class SpySource(LocalFileSource):
+    """A 'network' stand-in that counts every ranged read."""
+
+    def __init__(self, path):
+        super().__init__(path)
+        self.reads = 0
+        self._spy_lock = threading.Lock()
+
+    def read_range(self, offset, length, out=None):
+        with self._spy_lock:
+            self.reads += 1
+        return super().read_range(offset, length, out)
+
+
+@pytest.fixture
+def checkpoint(tmp_path):
+    rng = np.random.RandomState(3)
+    tensors = {
+        "model.layers.0.self_attn.q_proj.weight": rng.rand(32, 16).astype(np.float32),
+        "model.layers.0.self_attn.o_proj.weight": rng.rand(16, 32).astype(np.float32),
+        "model.norm.weight": rng.rand(16).astype(np.float32),
+    }
+    path = str(tmp_path / "ckpt.safetensors")
+    st.write_safetensors(path, tensors)
+    with open(path, "rb") as f:
+        digest = str(Digest.from_bytes(f.read()))
+    return path, tensors, digest, os.path.getsize(path)
+
+
+class TestColdTee:
+    def test_cold_load_admits_verified_blob(self, checkpoint, tmp_path):
+        path, tensors, digest, size = checkpoint
+        cache = BlobCache(str(tmp_path / "cache"))
+        src = cache.wrap(SpySource(path), digest, size)
+        assert isinstance(src, CachingByteSource)
+        mesh = make_mesh("dp=2,tp=4")
+        arrays, _ = load_safetensors(src, mesh, LLAMA_RULES)
+        for name, expected in tensors.items():
+            np.testing.assert_array_equal(np.asarray(arrays[name]), expected)
+        src.close()  # finalize: backfill header gap, verify, admit
+        assert cache.stats["admitted"] == 1
+        hit = cache.lookup(digest, expected_size=size)
+        assert hit is not None
+        with open(hit, "rb") as f, open(path, "rb") as g:
+            assert f.read() == g.read()
+
+    def test_partial_spool_is_discarded(self, checkpoint, tmp_path):
+        """A source that only probed a fraction of the blob (header reads,
+        a multi-host shard subset, a died load) must not admit — and must
+        not turn its close() into a full synchronous download either (the
+        backfill budget only covers a LOAD's header/padding leftovers)."""
+        path, _tensors, digest, size = checkpoint
+        cache = BlobCache(str(tmp_path / "cache"))
+        inner = SpySource(path)
+        src = cache.wrap(inner, digest, size)
+        src.read_range(0, 8)  # header length only — minority coverage
+        src.close()
+        assert inner.reads == 1  # close() did NOT download the rest
+        assert cache.stats["admitted"] == 0
+        assert cache.lookup(digest) is None
+        assert [n for n in os.listdir(cache.root) if ".tmp" in n] == []
+
+    def test_tee_write_failure_keeps_load_uncached(self, checkpoint, tmp_path, monkeypatch):
+        """The cache is an optimization, never load-bearing: a full cache
+        volume (pwrite ENOSPC) must not fail the deploy — the tee goes
+        dead, bytes still flow, nothing is admitted."""
+        path, tensors, digest, size = checkpoint
+        cache = BlobCache(str(tmp_path / "cache"))
+        src = cache.wrap(SpySource(path), digest, size)
+
+        def broken_pwrite(_fd, _data, _offset):
+            raise OSError(28, "No space left on device")
+
+        monkeypatch.setattr(os, "pwrite", broken_pwrite)
+        arrays, _ = load_safetensors(src, make_mesh("dp=1"), LLAMA_RULES)
+        src.close()
+        monkeypatch.undo()
+        for name, expected in tensors.items():
+            np.testing.assert_array_equal(np.asarray(arrays[name]), expected)
+        assert cache.stats["admitted"] == 0
+        assert cache.lookup(digest) is None
+
+    def test_wrap_refuses_uncacheable(self, checkpoint, tmp_path):
+        path, _t, _d, size = checkpoint
+        cache = BlobCache(str(tmp_path / "cache"))
+        inner = SpySource(path)
+        assert cache.wrap(inner, "weird:abc", size) is inner  # unknown algo
+        assert cache.wrap(inner, "sha256:00", 0) is inner  # unknown size
+
+
+class TestWarmHit:
+    def _fill(self, cache, path, digest, size):
+        src = cache.wrap(SpySource(path), digest, size)
+        mesh = make_mesh("dp=1")
+        load_safetensors(src, mesh, LLAMA_RULES)
+        src.close()
+        assert cache.stats["admitted"] == 1
+
+    def test_warm_load_zero_network_reads(self, checkpoint, tmp_path):
+        """THE warm-restart claim: a cached blob loads without a single
+        ByteSource read against the network."""
+        path, tensors, digest, size = checkpoint
+        cache = BlobCache(str(tmp_path / "cache"))
+        self._fill(cache, path, digest, size)
+
+        network = SpySource(path)
+        hit = cache.lookup(digest, expected_size=size)
+        assert hit is not None
+        arrays, _ = load_safetensors(
+            LocalFileSource(hit), make_mesh("dp=2,tp=4"), LLAMA_RULES
+        )
+        for name, expected in tensors.items():
+            np.testing.assert_array_equal(np.asarray(arrays[name]), expected)
+        assert network.reads == 0  # the network source was never touched
+
+    def test_corrupted_entry_rejected_falls_back_to_network(self, checkpoint, tmp_path):
+        path, tensors, digest, size = checkpoint
+        cache = BlobCache(str(tmp_path / "cache"))
+        self._fill(cache, path, digest, size)
+        entry = cache.entry_path(digest)
+        with open(entry, "r+b") as f:  # same-size corruption: only the
+            f.seek(size - 4)  # digest check can catch it
+            f.write(b"\xde\xad\xbe\xef")
+        assert cache.lookup(digest, expected_size=size) is None
+        assert cache.stats["corrupt_rejected"] == 1
+        assert not os.path.exists(entry)  # evicted, not served
+        # the fallback path repairs the cache from the network
+        self_heal = cache.wrap(SpySource(path), digest, size)
+        load_safetensors(self_heal, make_mesh("dp=1"), LLAMA_RULES)
+        self_heal.close()
+        assert self_heal.network_reads > 0
+        assert cache.lookup(digest, expected_size=size) is not None
+
+
+class TestLRUEviction:
+    def _admit_blob(self, cache, tmp_path, name, nbytes):
+        data = np.full(nbytes, ord(name[0]), np.uint8).tobytes()
+        digest = str(Digest.from_bytes(data))
+        tmp = str(tmp_path / f"{name}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(data)
+        assert cache.admit_file(digest, tmp) is not None
+        return digest
+
+    def test_lru_eviction_at_byte_cap(self, tmp_path):
+        import time
+
+        cache = BlobCache(str(tmp_path / "cache"), max_bytes=2500)
+        d_a = self._admit_blob(cache, tmp_path, "a", 1000)
+        time.sleep(0.02)
+        d_b = self._admit_blob(cache, tmp_path, "b", 1000)
+        time.sleep(0.02)
+        # touch a so b becomes the LRU entry
+        assert cache.lookup(d_a) is not None
+        time.sleep(0.02)
+        d_c = self._admit_blob(cache, tmp_path, "c", 1000)
+        assert cache.total_bytes() <= 2500
+        assert cache.stats["evicted"] == 1
+        assert cache.lookup(d_b) is None  # the LRU entry went
+        assert cache.lookup(d_a) is not None
+        assert cache.lookup(d_c) is not None
+
+    def test_unbounded_cache_never_evicts(self, tmp_path):
+        cache = BlobCache(str(tmp_path / "cache"), max_bytes=0)
+        for name in "abcde":
+            self._admit_blob(cache, tmp_path, name, 1000)
+        assert cache.stats["evicted"] == 0
+        assert cache.total_bytes() == 5000
+
+    def test_blob_larger_than_cap_refused(self, tmp_path):
+        """Evicting everything to install an over-cap blob would leave the
+        cache permanently over budget — refuse it and keep the residents."""
+        cache = BlobCache(str(tmp_path / "cache"), max_bytes=1500)
+        d_a = self._admit_blob(cache, tmp_path, "a", 1000)
+        data = np.full(2000, ord("x"), np.uint8).tobytes()
+        digest = str(Digest.from_bytes(data))
+        tmp = str(tmp_path / "big.tmp")
+        with open(tmp, "wb") as f:
+            f.write(data)
+        assert cache.admit_file(digest, tmp) is None
+        assert cache.stats["admit_rejected"] == 1
+        assert cache.lookup(d_a) is not None  # resident survived
+        assert cache.stats["evicted"] == 0
+
+    def test_stale_dead_pid_spool_swept_on_init(self, tmp_path):
+        root = tmp_path / "cache"
+        root.mkdir()
+        stale = root / "sha256-aa.blob.tmp-999999-0"  # dead pid
+        stale.write_bytes(b"x" * 64)
+        mine = root / f"sha256-bb.blob.tmp-{os.getpid()}-0"  # live pid (us)
+        mine.write_bytes(b"y" * 64)
+        BlobCache(str(root))
+        assert not stale.exists()
+        assert mine.exists()
+
+    def test_admit_rejects_digest_mismatch(self, tmp_path):
+        cache = BlobCache(str(tmp_path / "cache"))
+        tmp = str(tmp_path / "x.tmp")
+        with open(tmp, "wb") as f:
+            f.write(b"not the advertised bytes")
+        digest = str(Digest.from_bytes(b"different bytes"))
+        assert cache.admit_file(digest, tmp) is None
+        assert cache.stats["admit_rejected"] == 1
+        assert not os.path.exists(tmp)
+        assert cache.lookup(digest) is None
+
+
+class TestDefaultCache:
+    def test_env_configured_default(self, tmp_path, monkeypatch):
+        import modelx_tpu.dl.blob_cache as bc
+
+        monkeypatch.setattr(bc, "_default", None)
+        monkeypatch.setattr(bc, "_default_set", False)
+        monkeypatch.setenv("MODELX_BLOB_CACHE_DIR", str(tmp_path / "envcache"))
+        monkeypatch.setenv("MODELX_BLOB_CACHE_MAX_BYTES", "12345")
+        cache = bc.default_cache()
+        assert cache is not None and cache.max_bytes == 12345
+        assert bc.default_cache() is cache  # memoized
+
+    def test_unset_env_means_no_cache(self, monkeypatch):
+        import modelx_tpu.dl.blob_cache as bc
+
+        monkeypatch.setattr(bc, "_default", None)
+        monkeypatch.setattr(bc, "_default_set", False)
+        monkeypatch.delenv("MODELX_BLOB_CACHE_DIR", raising=False)
+        assert bc.default_cache() is None

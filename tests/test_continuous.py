@@ -438,6 +438,37 @@ class TestBatchedAdmission:
         finally:
             cb.close()
 
+    def test_small_burst_pads_to_pow2_not_max_slots(self, server):
+        """A 2-row burst on a max_slots=8 engine must prefill a [2, Sb]
+        block, not [8, Sb] — the batched-admit program pads to the next
+        power of two of the burst size (up to max_slots/2 x wasted prefill
+        FLOPs otherwise), and the tokens stay exact."""
+        cb = ContinuousBatcher(server, max_slots=8, chunk_size=4)
+        try:
+            admit_rows = []
+            orig = cb._admit_many_prog
+
+            def spy(params, prompts, *args):
+                admit_rows.append(int(prompts.shape[0]))
+                return orig(params, prompts, *args)
+
+            cb._admit_many_prog = spy
+            tokens = np.array([[5, 9, 2], [8, 1, 1]], np.int32)
+            expected = server.generate(tokens, max_new_tokens=6)
+            got = cb.generate(tokens, max_new_tokens=6)
+            np.testing.assert_array_equal(got, expected)
+            assert admit_rows == [2], admit_rows
+            assert cb.stats.get("admit_pad_rows", 0) == 0
+            # a 3-row burst rounds up to 4 (one pad row), never to 8
+            tokens3 = np.array([[5, 9, 2], [8, 1, 1], [3, 3, 3]], np.int32)
+            expected3 = server.generate(tokens3, max_new_tokens=5)
+            got3 = cb.generate(tokens3, max_new_tokens=5)
+            np.testing.assert_array_equal(got3, expected3)
+            assert admit_rows == [2, 4], admit_rows
+            assert cb.stats.get("admit_pad_rows", 0) == 1
+        finally:
+            cb.close()
+
     def test_mixed_buckets_split_groups(self, server):
         """Arrivals in different prompt buckets can't share a program but
         must still all admit correctly at one boundary."""

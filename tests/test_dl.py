@@ -145,6 +145,92 @@ class TestServeSidecar:
         assert inferred.vocab_size == cfg.vocab_size
 
 
+class TestSidecarConfig:
+    """config.json reconciliation (dl/families.py): shape inference can't
+    see RoPE parameters — the sidecar's rope_theta overrides the inferred
+    default, and unimplemented rope_scaling (phi-3-*-128k longrope) refuses
+    loudly instead of silently mis-serving."""
+
+    def _model_dir(self, tmp_path, sidecar=None):
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from modelx_tpu.dl import safetensors as st_mod
+
+        cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64), dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        d = tmp_path / "model"
+        d.mkdir()
+        st_mod.write_safetensors(
+            str(d / "model.safetensors"), {k: np.asarray(v) for k, v in params.items()}
+        )
+        if sidecar is not None:
+            (d / "config.json").write_text(json.dumps(sidecar))
+        return str(d)
+
+    def test_rope_scaling_refused(self):
+        from modelx_tpu.dl import families as fam
+
+        cfg = llama.LlamaConfig.tiny(vocab_size=64)
+        sidecar = {
+            "rope_theta": 10000.0,
+            "rope_scaling": {"type": "longrope",
+                             "long_factor": [1.0], "short_factor": [1.0]},
+        }
+        with pytest.raises(ValueError, match="rope_scaling"):
+            fam.apply_sidecar_config(cfg, sidecar, "phi3")
+
+    def test_rope_theta_override_applied(self):
+        from modelx_tpu.dl import families as fam
+
+        cfg = llama.LlamaConfig.tiny(vocab_size=64)
+        out = fam.apply_sidecar_config(cfg, {"rope_theta": 1_000_000.0}, "llama")
+        assert out.rope_theta == 1_000_000.0
+
+    def test_window_extension_scaling_warns_but_serves(self):
+        """llama3/linear-style scaling matches plain RoPE inside the
+        original window — previously-deployable checkpoints must keep
+        loading (warn, don't refuse)."""
+        from modelx_tpu.dl import families as fam
+
+        cfg = llama.LlamaConfig.tiny(vocab_size=64)
+        for scaling in ({"rope_type": "llama3", "factor": 8.0},
+                        {"type": "linear", "factor": 2.0}):
+            out = fam.apply_sidecar_config(cfg, {"rope_scaling": scaling}, "llama")
+            assert out.rope_theta == cfg.rope_theta
+
+    def test_malformed_rope_theta_ignored(self):
+        from modelx_tpu.dl import families as fam
+
+        cfg = llama.LlamaConfig.tiny(vocab_size=64)
+        out = fam.apply_sidecar_config(cfg, {"rope_theta": "not-a-number"}, "llama")
+        assert out.rope_theta == cfg.rope_theta
+
+    def test_missing_or_malformed_sidecar_is_none(self, tmp_path):
+        from modelx_tpu.dl import families as fam
+
+        assert fam.sidecar_config(str(tmp_path)) is None
+        (tmp_path / "config.json").write_text("{not json")
+        assert fam.sidecar_config(str(tmp_path)) is None
+
+    def test_serve_load_refuses_longrope_checkpoint(self, tmp_path):
+        """The wiring: ModelServer.load must refuse BEFORE streaming a
+        128k-style checkpoint's weights behind a wrong RoPE."""
+        model_dir = self._model_dir(
+            tmp_path, sidecar={"rope_scaling": {"type": "longrope"}}
+        )
+        server = ModelServer(model_dir, mesh_spec="dp=1", dtype="float32")
+        with pytest.raises(ValueError, match="rope_scaling"):
+            server.load()
+
+    def test_serve_load_applies_sidecar_rope_theta(self, tmp_path):
+        model_dir = self._model_dir(tmp_path, sidecar={"rope_theta": 500000.0})
+        server = ModelServer(model_dir, mesh_spec="dp=1", dtype="float32")
+        server.load()
+        assert server.cfg.rope_theta == 500000.0
+
+
 class TestPodSpec:
     def test_no_gpu_invariant(self):
         mc = ModelConfig()

@@ -119,6 +119,26 @@ class TestShardingRules:
         assert infer_family(["bert.embeddings.word_embeddings.weight"]) == "bert"
         assert infer_family(["mystery"]) == ""
 
+    def test_gemma3_not_matched_as_gemma2(self):
+        """Gemma3 carries gemma2's sandwich norms PLUS q_norm/k_norm
+        attention norms gemma2's math doesn't have — it must fail loudly
+        (families.detect raises), not silently serve through the gemma2
+        branch."""
+        gemma2_names = [
+            "model.layers.0.self_attn.q_proj.weight",
+            "model.layers.0.pre_feedforward_layernorm.weight",
+        ]
+        assert infer_family(gemma2_names) == "gemma2"
+        gemma3_names = gemma2_names + [
+            "model.layers.0.self_attn.q_norm.weight",
+            "model.layers.0.self_attn.k_norm.weight",
+        ]
+        assert infer_family(gemma3_names) == ""
+        from modelx_tpu.dl import families as fam
+
+        with pytest.raises(ValueError, match="family"):
+            fam.detect(gemma3_names)
+
 
 class TestLoader:
     @pytest.fixture
@@ -433,6 +453,36 @@ class TestAdaptiveFetchWidth:
             gov.release(nbytes=1024, seconds=1.0)  # 1 KB/s would trip any floor
         assert gov.width == 16
 
+    def test_governor_grows_with_headroom(self):
+        """Per-thread throughput above growth_bps means the link has
+        headroom: width doubles up to max_width (the r5 capture sat at
+        width 2 with the link 56% idle)."""
+        from modelx_tpu.dl.loader import _FetchGovernor
+
+        gov = _FetchGovernor(2, floor_bps=0.0, max_width=16, growth_bps=24e6)
+        for _ in range(32):  # 400 MB/s per thread: plenty of headroom
+            gov.acquire()
+            gov.release(nbytes=100 << 20, seconds=0.25)
+        assert gov.width == 16  # capped at max_width, never beyond
+        assert gov.growths >= 3
+
+    def test_governor_growth_disabled_after_repeated_collapse(self):
+        """Three backoffs = the link punishes added width; growth must not
+        oscillate against it."""
+        from modelx_tpu.dl.loader import _FetchGovernor
+
+        gov = _FetchGovernor(16, floor_bps=32e6, min_width=2,
+                             max_width=32, growth_bps=128e6)
+        for _ in range(64):  # collapse to the floor
+            gov.acquire()
+            gov.release(nbytes=1 << 20, seconds=1.0)
+        assert gov.width == 2 and gov.backoffs >= 3
+        for _ in range(32):  # throughput recovers — but trust is spent
+            gov.acquire()
+            gov.release(nbytes=200 << 20, seconds=0.25)
+        assert gov.width == 2
+        assert gov.growths == 0
+
     def test_load_reports_governor_stats(self, tmp_path):
         """End-to-end: a local load records the width it ran at."""
         import jax
@@ -454,6 +504,130 @@ class TestAdaptiveFetchWidth:
             src.close()
         assert stats.fetch_width >= 2
         assert stats.fetch_backoffs == 0
+
+
+class TestStagingPool:
+    """The reusable host staging pool (ISSUE 1 tentpole): shard reads must
+    recycle buffers, so allocation count tracks CONCURRENCY, not shard
+    count, and the load reports fetch-vs-device_put overlap accounting."""
+
+    def _many_shard_checkpoint(self, tmp_path, layers: int):
+        rng = np.random.RandomState(9)
+        tensors = {
+            f"model.layers.{i}.mlp.gate_proj.weight": rng.rand(64, 32).astype(np.float32)
+            for i in range(layers)
+        }
+        path = str(tmp_path / f"many{layers}.safetensors")
+        st.write_safetensors(path, tensors)
+        return path, tensors
+
+    def test_pool_reused_across_shards(self, tmp_path):
+        # host-side bf16 cast: the shard bytes are COPIED out of the pooled
+        # buffer before device_put, so the buffer recycles deterministically
+        # on every backend (without a cast, a zero-copy backend like PJRT
+        # CPU may alias some buffers, which forfeit instead of recycling —
+        # covered by test_zero_copy_backend_stays_correct)
+        import ml_dtypes
+
+        path, tensors = self._many_shard_checkpoint(tmp_path, 48)
+        src = LocalFileSource(path)
+        try:
+            arrays, stats = load_safetensors(
+                src, make_mesh("dp=1"), LLAMA_RULES,
+                concurrency=2, transfer_concurrency=2,
+                dtype=ml_dtypes.bfloat16,
+                pack_threshold=0,  # every shard through the transfer path
+                staging_min_bytes=1024,  # the 8 KB test shards qualify
+            )
+        finally:
+            src.close()
+        for name, expected in tensors.items():
+            np.testing.assert_array_equal(
+                np.asarray(arrays[name]), expected.astype(ml_dtypes.bfloat16)
+            )
+        # every qualifying read staged, and the pool turned over: fresh
+        # allocations bounded by in-flight concurrency (2 fetch + 2
+        # transfer + slack), NOT by the 48 shards
+        assert stats.staging_allocs + stats.staging_reuses >= 48
+        assert stats.staging_allocs <= 8, stats
+        assert stats.staging_reuses >= 40, stats
+
+    def test_alloc_count_independent_of_shard_count(self, tmp_path):
+        """2x the shards must not mean 2x the allocations — the pool's
+        whole point (ISSUE 1 acceptance criterion)."""
+        import ml_dtypes
+
+        allocs = {}
+        for layers in (24, 48):
+            path, _ = self._many_shard_checkpoint(tmp_path, layers)
+            src = LocalFileSource(path)
+            try:
+                _arrays, stats = load_safetensors(
+                    src, make_mesh("dp=1"), LLAMA_RULES,
+                    concurrency=2, transfer_concurrency=2,
+                    dtype=ml_dtypes.bfloat16,
+                    pack_threshold=0, staging_min_bytes=1024,
+                )
+            finally:
+                src.close()
+            allocs[layers] = stats.staging_allocs
+        assert allocs[48] <= allocs[24] + 2, allocs
+
+    def test_zero_copy_backend_stays_correct(self, tmp_path):
+        """No cast: device_put may zero-copy the pooled buffer (PJRT CPU,
+        64-byte-aligned hosts). Those buffers must be FORFEITED, never
+        recycled — every loaded tensor must still hold its own bytes."""
+        path, tensors = self._many_shard_checkpoint(tmp_path, 48)
+        src = LocalFileSource(path)
+        try:
+            arrays, stats = load_safetensors(
+                src, make_mesh("dp=1"), LLAMA_RULES,
+                concurrency=2, transfer_concurrency=2,
+                pack_threshold=0, staging_min_bytes=1024,
+            )
+        finally:
+            src.close()
+        for name, expected in tensors.items():
+            np.testing.assert_array_equal(
+                np.asarray(arrays[name]), expected, err_msg=name
+            )
+        assert stats.staging_allocs + stats.staging_reuses >= 48
+
+    def test_overlap_accounting_reported(self, tmp_path):
+        path, tensors = self._many_shard_checkpoint(tmp_path, 16)
+        src = LocalFileSource(path)
+        try:
+            _arrays, stats = load_safetensors(
+                src, make_mesh("dp=1"), LLAMA_RULES,
+                pack_threshold=0, staging_min_bytes=1024,
+            )
+        finally:
+            src.close()
+        assert stats.device_put_seconds > 0
+        assert stats.overlap_seconds >= 0
+        assert stats.overlap_seconds <= stats.total_seconds
+        assert stats.fetch_growths >= 0
+
+    def test_cast_and_pack_paths_stay_correct_with_staging(self, tmp_path):
+        """Host-side dtype casts copy out of the pooled buffer; packed
+        small tensors copy too — bytes on device must match either way."""
+        import ml_dtypes
+
+        path, tensors = self._many_shard_checkpoint(tmp_path, 12)
+        src = LocalFileSource(path)
+        try:
+            arrays, stats = load_safetensors(
+                src, make_mesh("dp=2,tp=4"), LLAMA_RULES,
+                dtype=ml_dtypes.bfloat16,
+                pack_threshold=1 << 20, staging_min_bytes=1024,
+            )
+        finally:
+            src.close()
+        for name, expected in tensors.items():
+            np.testing.assert_array_equal(
+                np.asarray(arrays[name]),
+                expected.astype(ml_dtypes.bfloat16),
+            )
 
 
 class TestByteAccounting2DMesh:

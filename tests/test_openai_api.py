@@ -184,6 +184,77 @@ class TestChat:
         assert text == "<|system|>\ns\n<|user|>\nu\n<|assistant|>\n"
 
 
+class TestTemplateFailureTriage:
+    """Template render failures split by blame: message-dependent renders
+    stay 400 (the caller's payload), while a template that ALSO fails on a
+    trivial probe is a server-side defect — the request falls back to the
+    generic role template instead of bouncing with a misleading 400."""
+
+    def _spec(self, render_fn):
+        class _Fake:
+            def render(self, messages, **_kw):
+                return render_fn(messages)
+
+        return {"compiled": _Fake(), "bos_token": "", "eos_token": ""}
+
+    def test_message_dependent_failure_is_400(self):
+        def render(messages):
+            if any("boom" in m["content"] for m in messages):
+                raise RuntimeError("cannot format this content")
+            return "rendered"
+
+        spec = self._spec(render)
+        with pytest.raises(APIError) as ei:
+            render_messages([{"role": "user", "content": "boom"}], spec)
+        assert ei.value.status == 400
+        # the same template still serves well-formed payloads
+        assert render_messages([{"role": "user", "content": "ok"}], spec) == "rendered"
+
+    def test_broken_template_falls_back_to_generic(self):
+        calls = []
+
+        def render(_messages):
+            calls.append(1)
+            raise RuntimeError("no filter named 'tojson'")  # payload-independent
+
+        spec = self._spec(render)
+        text = render_messages([{"role": "user", "content": "u"}], spec)
+        assert text == "<|user|>\nu\n<|assistant|>\n"
+        assert len(calls) == 2  # the real render + the probe
+        # the broken verdict memoizes per model: later requests go straight
+        # to the generic template, no re-render / re-probe / re-warn
+        text2 = render_messages([{"role": "user", "content": "v"}], spec)
+        assert text2 == "<|user|>\nv\n<|assistant|>\n"
+        assert len(calls) == 2
+
+    def test_probe_rejection_does_not_mark_template_broken(self):
+        """A template whose raise_exception fires on the bare probe (e.g.
+        it requires a system turn) is template logic working, not breakage:
+        the original failure stays a 400 and later well-formed requests
+        still get the real template."""
+        from modelx_tpu.dl.serve import ChatTemplateRejected
+
+        def render(messages):
+            if not any(m["role"] == "system" for m in messages):
+                raise ChatTemplateRejected("needs a system turn")
+            if "boom" in messages[-1]["content"]:
+                raise RuntimeError("message-dependent failure")
+            return "rendered"
+
+        spec = self._spec(render)
+        with pytest.raises(APIError) as ei:
+            render_messages([
+                {"role": "system", "content": "s"},
+                {"role": "user", "content": "boom"},
+            ], spec)
+        assert ei.value.status == 400
+        assert not spec.get("broken")
+        assert render_messages([
+            {"role": "system", "content": "s"},
+            {"role": "user", "content": "ok"},
+        ], spec) == "rendered"
+
+
 @pytest.fixture(scope="module")
 def templated_front(tmp_path_factory):
     """Like ``front`` but the model SHIPS a chat_template (the HF
