@@ -11,6 +11,9 @@ Reference parity — all three binaries in one entrypoint:
 - ``modelx dl`` = modelxdl (cmd/modelxdl/modelxdl.go:30-98), the Seldon-style
   storage initializer: ``modelx dl <uri> <dest>`` — extended with
   ``--device-put`` to load straight into TPU HBM (the north-star path).
+- ``modelx serve-model`` = the TPU serving sidecar (``modelx-serve``,
+  dl/serve_main.py), passed through lazily so registry commands never pay
+  the jax import.
 
 Run as ``python -m modelx_tpu.cli`` or via the ``modelx`` console script.
 """
@@ -418,6 +421,27 @@ def cmd_serve(
         gc_interval_s=gc_interval,
     )
     RegistryServer(opts).serve_forever()
+
+
+# -- serve-model (the TPU serving sidecar, modelx-serve) ----------------------
+
+
+@main.command(
+    "serve-model",
+    context_settings={"ignore_unknown_options": True, "help_option_names": []},
+)
+@click.argument("args", nargs=-1, type=click.UNPROCESSED)
+def cmd_serve_model(args: tuple[str, ...]) -> None:
+    """Run the model-serving sidecar (same as the ``modelx-serve``
+    console script): loads checkpoints onto the mesh and serves
+    /v1/generate + OpenAI-compatible endpoints, with the full serving
+    flag surface (--continuous-batch, --prefill-chunk/--prefill-budget
+    chunked prefill, --kv-page-size paged KV, ...). Args pass through
+    verbatim; the import is deferred so plain registry commands never
+    pay the jax startup."""
+    from modelx_tpu.dl.serve_main import main as serve_model_main
+
+    serve_model_main.main(args=list(args), prog_name="modelx serve-model")
 
 
 # -- dl (modelxdl, deploy-time puller) ----------------------------------------

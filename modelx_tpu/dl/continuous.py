@@ -23,6 +23,27 @@ scheduling (the vLLM/Orca idea), built the TPU way:
   dynamic_update_slice of that cache into the slot's rows.** The running
   batch never re-prefills, and the prefill cost is one [S]-length row copy
   per layer on top of the forward itself.
+- **Chunked prefill (``prefill_chunk`` > 0, the Sarathi-Serve idea):** a
+  long prompt no longer admits as ONE monolithic prefill that stalls
+  every active decode row for its whole length. Instead the prompt splits
+  into fixed-size pieces (``prefill_chunk`` tokens, 16-bucketed) and the
+  scheduler interleaves them with decode chunks at boundaries under a
+  per-boundary token budget (``prefill_budget``): decode rows spend
+  their ``chunk_size`` tokens first, then prefill pieces pack into the
+  remainder (the head piece always lands so fills can't starve). A
+  filling row occupies its slot but emits nothing; each piece runs
+  against the slot's own cache rows at the row's running offset and the
+  LAST piece samples the row's first token from its final-position
+  logits (step 0 of the row's (seed, step) stream — token-exact vs the
+  single-program admission). Short prompts (<= one piece) keep the
+  single-program fast path; prefix-cache hits seed the filling row's
+  offset so only the suffix is chunk-prefilled; in paged mode a filling
+  row reserves its pages INCREMENTALLY per piece (not the whole span up
+  front), so long prompts stop serializing behind the pool-full FIFO —
+  a fill that cannot get its next piece's pages simply waits a boundary,
+  and if every fill is page-blocked with no decode rows left to retire,
+  the youngest fill is preempted back to the arrival queue (it has
+  emitted nothing, so the restart is exact).
 - **Idle slots decode garbage harmlessly** (same trick as the ragged
   batcher's pad rows): attention per row sees only that row's cache, so an
   idle row's tokens are discarded on the host and its cache rows are
@@ -51,7 +72,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from modelx_tpu.models.decode import pad_seq_len
+from modelx_tpu.models.decode import SEQ_BUCKET, pad_seq_len
 from modelx_tpu.utils import trace
 
 _DONE = object()  # end-of-stream sentinel on per-request output queues
@@ -111,6 +132,24 @@ class _Row:
         return self.ticket.out
 
 
+class _Fill:
+    """A slot mid-chunked-prefill: the prompt lands piece by piece at
+    boundaries; the row emits nothing until the last piece flips it to a
+    decoding _Row. ``filled`` is the count of REAL prompt tokens whose KV
+    is resident (a prefix-cache hit starts it at the stored prefix len)."""
+
+    __slots__ = ("slot", "ids", "n", "samp", "ticket", "filled")
+
+    def __init__(self, slot: int, ids: list, n: int, samp: dict,
+                 ticket: _Ticket, filled: int = 0) -> None:
+        self.slot = slot
+        self.ids = ids
+        self.n = n
+        self.samp = samp
+        self.ticket = ticket
+        self.filled = filled
+
+
 class ContinuousBatcher:
     """Iteration-level scheduler over a fixed slot array.
 
@@ -126,13 +165,26 @@ class ContinuousBatcher:
                  max_live_tokens: int = 0, speculative_k: int = 0,
                  max_ngram: int = 3, paged_attention: str = "gather",
                  pipeline_depth: int = 2,
-                 burst_window_ms: float = 1.0) -> None:
+                 burst_window_ms: float = 1.0,
+                 prefill_chunk: int = 0,
+                 prefill_budget: int = 0) -> None:
         if server.family.decode_fns is None:
             raise ValueError(f"family {server.family.name} has no cached decode")
         self.server = server
         self.max_slots = int(max_slots)
         self.chunk_size = int(chunk_size)
         self.max_len = int(max_len) or int(server.max_seq_len)
+        # chunked prefill: prompts longer than one piece land piece by
+        # piece at boundaries instead of as one monolithic admission
+        # prefill (0 = off, today's single-program admission for every
+        # prompt). Pieces are 16-bucketed like every compiled prompt shape.
+        self.prefill_chunk = pad_seq_len(int(prefill_chunk)) if prefill_chunk else 0
+        # per-boundary token budget: decode rows spend chunk_size each
+        # first, prefill pieces pack into the remainder (0 = uncapped —
+        # every filling row lands one piece per boundary). The HEAD piece
+        # always lands regardless, so fills can't starve under a budget
+        # smaller than the decode spend.
+        self.prefill_budget = int(prefill_budget)
         # prompt-lookup speculation INSIDE the engine (speculative_k > 0):
         # whenever exactly one greedy row is active, the loop swaps the
         # chunk program for a [max_slots, k+1] verify step — propose k
@@ -238,6 +290,13 @@ class ContinuousBatcher:
         self._rows: dict[int, _Row] = {}  # slot -> active row
         self._free = list(range(self.max_slots))
         self._first_pending: list = []  # (row, async first-token array, done)
+        self._filling: dict[int, _Fill] = {}  # slot -> chunk-prefilling row
+        self._fill_order: list[int] = []  # fill slots, arrival order (FIFO)
+        # fills preempted for pages: parked (not re-queued) until a fill
+        # flips or dies, else their restart would re-grab the very pages
+        # the older fill is blocked on (admit/preempt livelock)
+        self._preempted: list = []
+        self._last_chunk_t: float | None = None  # stall_ms_max tracking
 
         # admission is ONE program (prefill + first token + insert-at-slot):
         # on a tunneled device every call costs a host round-trip, so the
@@ -275,6 +334,32 @@ class ContinuousBatcher:
             self._chunk_paged_impl if paged else self._chunk_impl,
             donate_argnums=(1, 2),
         )
+        # chunked-prefill piece programs: a mid piece only advances the
+        # slot's KV (no logits output -> XLA drops the lm_head matmul);
+        # the flip (last) piece also samples the row's first token.
+        # Compiled once per piece bucket, like every other prompt shape.
+        self._piece_prog = jax.jit(
+            self._piece_paged_impl if paged else self._piece_impl,
+            donate_argnums=(2,),
+        )
+        self._piece_flip_prog = jax.jit(
+            self._piece_flip_paged_impl if paged else self._piece_flip_impl,
+            donate_argnums=(2, 3),
+        )
+        # prefix-hit fill seeding: copy a stored prefix KV into the slot's
+        # rows/pages so only the suffix chunk-prefills (stored entry never
+        # donated — it outlives the admission)
+        self._seed_prog = jax.jit(
+            self._seed_paged_impl if paged else self._seed_impl,
+            static_argnums=(3,) if paged else (),
+            donate_argnums=(0,),
+        )
+        # flip-time prefix store: slice the freshly filled prompt KV back
+        # out of the slot (a copy — the live row decodes on)
+        self._snap_prog = jax.jit(
+            self._snap_paged_impl if paged else self._snap_impl,
+            static_argnums=(2,),
+        )
         # chunks the loop keeps in flight before syncing the oldest: plans
         # are value-independent (budgets only), so depth-D dispatch is
         # exact; it hides the per-chunk fetch round-trip behind device
@@ -299,7 +384,12 @@ class ContinuousBatcher:
         self._closed = False
         self._broken: BaseException | None = None
         self._close_lock = threading.Lock()
-        self.stats = {"chunks": 0, "admitted": 0, "active_peak": 0}
+        self.stats = {"chunks": 0, "admitted": 0, "active_peak": 0,
+                      "prefill_pieces": 0, "stall_ms_max": 0.0}
+        if self.prefill_chunk > 0:
+            self.stats["prefill_chunk"] = self.prefill_chunk
+            self.stats["fill_waits"] = 0  # page-blocked boundaries
+            self.stats["fill_preempts"] = 0  # fills restarted for pages
         if self.page_size > 0:
             self.stats["page_size"] = self.page_size
             self.stats["pages_total"] = self.num_pages - 1  # excl. trash
@@ -485,6 +575,148 @@ class ContinuousBatcher:
         return self._finish_admit(small, logits, cache, tok, suffix_len - 1, slot,
                                   temp, top_k, top_p, seed)
 
+    # -- chunked prefill piece programs ---------------------------------------
+
+    def _gather_row(self, cache, slot):
+        """The slot's own [1, max_len] cache rows, sliced out of the
+        engine state — a mid-prompt piece needs the row's earlier KV as
+        attention context, unlike admission's fresh offset-0 scratch."""
+        return jax.tree_util.tree_map(
+            lambda big: jax.lax.dynamic_slice(
+                big, (slot,) + (0,) * (big.ndim - 1), (1,) + big.shape[1:]
+            ),
+            cache,
+        )
+
+    def _scatter_row(self, cache, row, slot):
+        return jax.tree_util.tree_map(
+            lambda big, little: jax.lax.dynamic_update_slice(
+                big, little, (slot,) + (0,) * (big.ndim - 1)
+            ),
+            cache, row,
+        )
+
+    def _gather_pages(self, pool, table_row):
+        """One slot's pages as a dense [1, max_len] view (``table_row`` is
+        the slot's block-table row; unreserved entries point at trash)."""
+        return jax.tree_util.tree_map(
+            lambda p: p[table_row].reshape(1, self.max_len, *p.shape[2:]),
+            pool,
+        )
+
+    def _piece_impl(self, params, piece, cache, filled, slot):
+        """One mid-prompt prefill piece: gather the slot's row, run the
+        [1, Sb] block at offset ``filled`` (positions/causality follow the
+        decode contract, so the landed KV is byte-identical to the same
+        span of a monolithic prefill), write the row back. Logits are not
+        an output — XLA drops the lm_head matmul for mid pieces."""
+        row = self._gather_row(cache, slot)
+        _logits, row = self._fwd(params, piece, kv_cache=row, cache_offset=filled)
+        return self._scatter_row(cache, row, slot)
+
+    def _piece_flip_impl(self, params, piece, cache, tok, filled, slot,
+                         last_idx, temp, top_k, top_p, seed):
+        """The LAST piece: land its KV and sample the row's first token
+        from the piece's final real position — step 0 of the row's
+        (seed, step) stream, byte-identical to single-program admission."""
+        row = self._gather_row(cache, slot)
+        logits, row = self._fwd(params, piece, kv_cache=row, cache_offset=filled)
+        cache = self._scatter_row(cache, row, slot)
+        first = self._sample_first(logits, last_idx, temp, top_k, top_p, seed)
+        tok = jax.lax.dynamic_update_slice(tok, first[:, None], (slot, 0))
+        return cache, tok, first
+
+    def _scatter_piece_pages(self, pool, dense, write_page_ids, page_start):
+        """Write back ONLY the pages a piece touched: the forward modifies
+        [filled, filled + Sb), i.e. at most Sb/page_size + 1 pages —
+        scattering the slot's whole max_len span per piece would pay
+        ~max_len/Sb x the useful copy traffic on exactly the long-context
+        shapes chunked prefill targets. ``write_page_ids`` is the touched
+        table entries (STATIC count — compiles per piece bucket x two
+        alignments), ``page_start`` the first touched page's token offset."""
+        ps = self.page_size
+        n_touch = write_page_ids.shape[0]
+
+        def put_back(p, d):
+            out = p
+            for j in range(n_touch):
+                blk = jax.lax.dynamic_slice_in_dim(
+                    d, page_start + j * ps, ps, axis=1
+                )
+                out = jax.lax.dynamic_update_slice(
+                    out, blk, (write_page_ids[j],) + (0,) * (out.ndim - 1)
+                )
+            return out
+
+        return jax.tree_util.tree_map(put_back, pool, dense)
+
+    def _piece_paged_impl(self, params, piece, pool, table_row, filled,
+                          write_page_ids, page_start):
+        dense = self._gather_pages(pool, table_row)
+        _logits, dense = self._fwd(params, piece, kv_cache=dense, cache_offset=filled)
+        return self._scatter_piece_pages(pool, dense, write_page_ids, page_start)
+
+    def _piece_flip_paged_impl(self, params, piece, pool, tok, table_row,
+                               filled, slot, last_idx, temp, top_k, top_p,
+                               seed, write_page_ids, page_start):
+        dense = self._gather_pages(pool, table_row)
+        logits, dense = self._fwd(params, piece, kv_cache=dense, cache_offset=filled)
+        pool = self._scatter_piece_pages(pool, dense, write_page_ids, page_start)
+        first = self._sample_first(logits, last_idx, temp, top_k, top_p, seed)
+        tok = jax.lax.dynamic_update_slice(tok, first[:, None], (slot, 0))
+        return pool, tok, first
+
+    def _seed_impl(self, cache, stored, slot):
+        """Prefix-hit fill seeding: the stored [1, plen-bucket] prefix KV
+        lands at the slot's offset 0. Bucket junk past the real prefix is
+        overwritten by the first suffix piece (each layer writes its k/v
+        before attending, and piece >= 16 > bucket - plen)."""
+        return jax.tree_util.tree_map(
+            lambda big, s: jax.lax.dynamic_update_slice(
+                big, s, (slot,) + (0,) * (big.ndim - 1)
+            ),
+            cache, stored,
+        )
+
+    def _seed_paged_impl(self, pool, stored, page_ids, span: int):
+        """Paged fill seeding: the stored prefix writes into the slot's
+        first reserved pages (``span`` static = the prefix's bucket)."""
+        ps = self.page_size
+
+        def write(pool_leaf, s):
+            out = pool_leaf
+            for j in range(0, span, ps):
+                blk = jax.lax.slice_in_dim(s, j, min(j + ps, span), axis=1)
+                out = jax.lax.dynamic_update_slice(
+                    out, blk, (page_ids[j // ps],) + (0,) * (out.ndim - 1)
+                )
+            return out
+
+        return jax.tree_util.tree_map(write, pool, stored)
+
+    def _snap_impl(self, cache, slot, bucket: int):
+        """Copy the slot's freshly filled prompt KV back out (prefix-cache
+        store at flip time; the live row decodes on, so this is a copy)."""
+        return jax.tree_util.tree_map(
+            lambda big: jax.lax.dynamic_slice(
+                big, (slot,) + (0,) * (big.ndim - 1),
+                (1, bucket) + big.shape[2:],
+            ),
+            cache,
+        )
+
+    def _snap_paged_impl(self, pool, table_row, bucket: int):
+        # gather only the prompt span's pages (``bucket`` is static, so
+        # the page count is too) — densifying the whole max_len row here
+        # would pay ~max_len/bucket x the needed copy at flip time
+        n_pg = -(-bucket // self.page_size)
+        return jax.tree_util.tree_map(
+            lambda p: p[table_row[:n_pg]].reshape(
+                1, n_pg * self.page_size, *p.shape[2:]
+            )[:, :bucket],
+            pool,
+        )
+
     def _chunk_impl(self, params, cache, tok, offsets, steps, temp, top_k, top_p, seeds):
         """``chunk_size`` decode steps over ALL slots; offsets/steps are
         per-row (slots joined at different times sit at different depths).
@@ -599,8 +831,10 @@ class ContinuousBatcher:
     def _spec_ok(self) -> bool:
         """Speculate iff exactly one greedy row is active and nothing is
         waiting for a slot (admissions beat speculation — cross-row
-        batching uses each weight read better than lookahead does)."""
-        if self.speculative_k <= 0 or len(self._rows) != 1 or self._waiting:
+        batching uses each weight read better than lookahead does). A
+        filling row also disqualifies: its pieces need boundaries."""
+        if (self.speculative_k <= 0 or len(self._rows) != 1
+                or self._waiting or self._filling):
             return False
         row = next(iter(self._rows.values()))
         return (row.greedy and not row.closed and not row.ticket.cancelled
@@ -699,13 +933,22 @@ class ContinuousBatcher:
         return -(-need // self.page_size)
 
     def _admits_now(self, item) -> bool:
-        """A free slot — and, in paged mode, enough free pages for the
-        item's whole span (reserved up front so a mid-decode pool
-        exhaustion cannot strand a half-decoded row)."""
+        """A free slot — and, in paged mode, enough free pages. A prompt
+        that will single-program-admit needs its whole span up front (a
+        mid-decode pool exhaustion must not strand a half-decoded row); a
+        prompt that will CHUNK-fill needs only its first piece's pages —
+        the rest reserve incrementally as decode rows retire, so a long
+        prompt's admission no longer serializes behind the pool-full FIFO
+        for its full span."""
         if not self._free:
             return False
         if self.page_size > 0 and not item[3].cancelled:
-            if self._need_pages(item[0], item[1]) > len(self._free_pages):
+            ids, n = item[0], item[1]
+            if self.prefill_chunk > 0 and pad_seq_len(len(ids)) > self.prefill_chunk:
+                need = -(-self.prefill_chunk // self.page_size)
+            else:
+                need = self._need_pages(ids, n)
+            if need > len(self._free_pages):
                 return False
         return True
 
@@ -740,13 +983,39 @@ class ContinuousBatcher:
         """Claim a slot (and, paged, reserve the row's pages) for one
         admissible item and resolve its prefix-cache hit. Pure host-side
         bookkeeping — the device dispatch happens in ``_admit_one`` /
-        ``_admit_group`` so a burst of preparations can share a program."""
+        ``_admit_group`` so a burst of preparations can share a program.
+
+        With chunked prefill on, a prompt whose to-prefill span exceeds
+        one piece becomes a FILL preparation instead: the slot is
+        claimed but nothing dispatches now — pieces land at boundaries
+        (prefix hits seed the fill's offset so only the suffix chunks)."""
         ids, n, samp, ticket = item
         if ticket.cancelled:  # consumer left while the request queued
             ticket.out.put(_DONE)
             return None
         slot = self._free.pop()
         s = len(ids)
+        hit = None
+        if self.prefix_cache is not None:
+            # fit-aware lookup: entries whose bucket + suffix bucket exceed
+            # the slot cache are skipped (shorter fitting prefixes still win)
+            hit = self.prefix_cache.lookup(ids, max_total=self.max_len)
+        if self.prefill_chunk > 0:
+            to_fill = s - (hit[0] if hit is not None else 0)
+            use_fill = pad_seq_len(to_fill) > self.prefill_chunk
+            if (not use_fill and self.page_size > 0
+                    and self._need_pages(ids, n) > len(self._free_pages)):
+                # the single-program span's pages aren't free (a hit can
+                # shrink a long prompt under one piece after _admits_now
+                # gated on the first-piece estimate): fill incrementally
+                use_fill = True
+            if use_fill:
+                if self.page_size > 0:
+                    self._row_pages[slot] = []
+                    self._table[slot, :] = 0
+                return {"ids": ids, "n": n, "samp": samp, "ticket": ticket,
+                        "slot": slot, "s": s, "hit": hit, "fill": True,
+                        "finished": False}
         prompt_pages = None
         if self.page_size > 0:
             # reserve the row's WHOLE span now; the admit program only
@@ -759,14 +1028,10 @@ class ContinuousBatcher:
             self.stats["pages_free"] = len(self._free_pages)
             n_prompt = -(-pad_seq_len(s) // self.page_size)
             prompt_pages = np.asarray(pages[:n_prompt], np.int32)
-        hit = None
-        if self.prefix_cache is not None:
-            # fit-aware lookup: entries whose bucket + suffix bucket exceed
-            # the slot cache are skipped (shorter fitting prefixes still win)
-            hit = self.prefix_cache.lookup(ids, max_total=self.max_len)
         return {"ids": ids, "n": n, "samp": samp, "ticket": ticket,
                 "slot": slot, "s": s, "prompt_pages": prompt_pages,
-                "hit": hit, "bucket": pad_seq_len(s), "finished": False}
+                "hit": hit, "bucket": pad_seq_len(s), "fill": False,
+                "finished": False}
 
     def _finish_admit_host(self, prep: dict, first_ref) -> None:
         """Shared post-dispatch bookkeeping: per-slot vectors, the row
@@ -812,7 +1077,11 @@ class ContinuousBatcher:
             singles: list = []
             groups: dict[int, list] = {}
             for p in preps:
-                if self.prefix_cache is not None:
+                if p["fill"]:
+                    # chunked prefill: no admit program — the fill's
+                    # pieces land at boundaries from the engine loop
+                    self._start_fill(p)
+                elif self.prefix_cache is not None:
                     # single path stores each row's scratch KV (hit or miss)
                     singles.append(p)
                 else:
@@ -955,6 +1224,251 @@ class ContinuousBatcher:
             prep, lambda first=first: np.asarray(first).reshape(1, 1)
         )
 
+    # -- chunked prefill scheduling -------------------------------------------
+
+    def _reserve_upto(self, slot: int, tokens: int) -> bool:
+        """Grow a filling slot's page reservation to cover ``tokens``
+        positions (incremental per-piece reservation). False = pool
+        short: the caller waits a boundary (retirements free pages) or,
+        if every fill is wedged, preempts the youngest."""
+        need = -(-tokens // self.page_size)
+        pages = self._row_pages.setdefault(slot, [])
+        if need <= len(pages):
+            return True
+        if need - len(pages) > len(self._free_pages):
+            return False
+        for j in range(len(pages), need):
+            pg = self._free_pages.pop()
+            pages.append(pg)
+            self._table[slot, j] = pg
+        self.stats["pages_free"] = len(self._free_pages)
+        return True
+
+    def _start_fill(self, prep: dict) -> None:
+        """Begin a chunked prefill on a claimed slot. A prefix hit seeds
+        the slot with the stored KV (one insert program) so only the
+        suffix lands piece by piece; everything else is host bookkeeping
+        — the pieces themselves dispatch from the boundary scheduler."""
+        slot, ids = prep["slot"], prep["ids"]
+        plen = 0
+        if prep["hit"] is not None:
+            plen_real, stored = prep["hit"]
+            # the fill frontier starts at the stored prefix ROUNDED DOWN
+            # to the bucket quantum: every piece then lands 16-aligned,
+            # so no piece's bucket can spill past pad16(s) (an unaligned
+            # last piece near max_len would make its dynamic_update_slice
+            # clamp the write window back over live KV). The <= 15 tokens
+            # between the aligned frontier and the real prefix simply
+            # re-prefill as part of the first suffix piece, overwriting
+            # the seeded bucket's junk span on the way.
+            plen = plen_real // SEQ_BUCKET * SEQ_BUCKET
+            bucket = pad_seq_len(plen_real)
+            if plen == 0:
+                pass  # sub-bucket prefix: seeding buys nothing
+            elif self.page_size > 0 and not self._reserve_upto(slot, bucket):
+                # a concurrent preparation raced the seed's pages away:
+                # fall back to filling the whole prompt incrementally
+                plen = 0
+            elif self.page_size > 0:
+                n_pg = -(-bucket // self.page_size)
+                page_ids = jnp.asarray(
+                    np.asarray(self._row_pages[slot][:n_pg], np.int32)
+                )
+                with trace.span("continuous.fill_seed", prefix=plen):
+                    self._cache = self._seed_prog(
+                        self._cache, stored, page_ids, bucket
+                    )
+            else:
+                with trace.span("continuous.fill_seed", prefix=plen):
+                    self._cache = self._seed_prog(
+                        self._cache, stored, jnp.int32(slot)
+                    )
+        fill = _Fill(slot, list(ids), prep["n"], dict(prep["samp"]),
+                     prep["ticket"], filled=plen)
+        # the fill's offset is its KV frontier: decode chunks run over
+        # every slot, so this keeps the slot's garbage writes beyond the
+        # real prefix (the next piece overwrites them)
+        self._offsets[slot] = plen
+        self._steps[slot] = 0
+        self._filling[slot] = fill
+        self._fill_order.append(slot)
+        prep["finished"] = True
+
+    def _fill_piece(self, rem: int) -> tuple[int, int, bool]:
+        """(bucketed piece length, real tokens taken, is-last) for a fill
+        with ``rem`` prompt tokens outstanding."""
+        if rem <= self.prefill_chunk:
+            return pad_seq_len(rem), rem, True
+        return self.prefill_chunk, self.prefill_chunk, False
+
+    def _dispatch_pieces(self, decode_spend: int) -> bool:
+        """Land this boundary's prefill pieces: FIFO over filling rows,
+        one piece each, packed into the boundary budget after the decode
+        rows' spend. The head piece is exempt from the budget — a budget
+        smaller than the decode spend must bound prefill work per
+        boundary, not starve fills outright. Returns True when at least
+        one piece landed (False = every fill is page-blocked)."""
+        spent = decode_spend
+        landed = 0
+        for slot in list(self._fill_order):
+            fill = self._filling.get(slot)
+            if fill is None:
+                continue
+            if fill.ticket.cancelled:
+                # retire NOW, not at the next sweep: a cancelled lone
+                # fill skipped here would read as "every fill is
+                # page-blocked" and trip the preempt wedge check
+                self._drop_fill(slot)
+                continue
+            rem = len(fill.ids) - fill.filled
+            piece_len, take, last = self._fill_piece(rem)
+            if (landed and self.prefill_budget > 0
+                    and spent + piece_len > self.prefill_budget):
+                break  # budget spent: later fills wait for the next boundary
+            if self.page_size > 0:
+                # the last piece also reserves the decode span — the flip
+                # must never strand a row that cannot decode
+                upto = (
+                    pad_seq_len(len(fill.ids)) + fill.n + self._overrun
+                    if last else fill.filled + piece_len
+                )
+                if not self._reserve_upto(slot, upto):
+                    self.stats["fill_waits"] += 1
+                    continue
+            self._land_piece(fill, piece_len, take, last)
+            spent += piece_len
+            landed += 1
+        return landed > 0
+
+    def _land_piece(self, fill: _Fill, piece_len: int, take: int,
+                    last: bool) -> None:
+        """Dispatch one prefill piece (async). The last piece samples the
+        row's first token and flips the slot from filling to decoding."""
+        slot = fill.slot
+        block = np.zeros((1, piece_len), np.int32)
+        block[0, :take] = fill.ids[fill.filled: fill.filled + take]
+        piece = jnp.asarray(block)
+        offset = jnp.int32(fill.filled)
+        table_row = write_page_ids = page_start = None
+        if self.page_size > 0:
+            table_row = jnp.asarray(self._table[slot].copy())
+            # pages the piece's writes touch — [filled, filled+Sb) spans
+            # at most Sb/ps + 1 of them (all reserved by _reserve_upto);
+            # the touched count is static per (bucket, alignment) pair
+            ps = self.page_size
+            start_pg = fill.filled // ps
+            end_pg = (fill.filled + piece_len - 1) // ps
+            write_page_ids = jnp.asarray(
+                self._table[slot, start_pg: end_pg + 1].copy()
+            )
+            page_start = jnp.int32(start_pg * ps)
+        self.stats["prefill_pieces"] += 1
+        if not last:
+            with trace.span("continuous.prefill_piece", tokens=take):
+                if self.page_size > 0:
+                    self._cache = self._piece_prog(
+                        self.server.params, piece, self._cache,
+                        table_row, offset, write_page_ids, page_start,
+                    )
+                else:
+                    self._cache = self._piece_prog(
+                        self.server.params, piece, self._cache,
+                        offset, jnp.int32(slot),
+                    )
+            fill.filled += take
+            self._offsets[slot] = fill.filled
+            return
+        samp = fill.samp
+        # filters ride as arrays (0 / 1.0 = off): a one-shot program has
+        # no per-step sort to save, same rationale as the batched admit
+        temp = np.asarray([samp.get("temperature", 0.0)], np.float32)
+        top_k = np.asarray([samp.get("top_k", 0)], np.int32)
+        top_p = np.asarray([samp.get("top_p", 1.0)], np.float32)
+        seed = np.asarray([samp.get("seed", 0)], np.int32)
+        last_idx = jnp.asarray([take - 1], jnp.int32)
+        with trace.span("continuous.prefill_flip", tokens=take):
+            if self.page_size > 0:
+                self._cache, self._tok, first = self._piece_flip_prog(
+                    self.server.params, piece, self._cache, self._tok,
+                    table_row, offset, jnp.int32(slot), last_idx,
+                    temp, top_k, top_p, seed, write_page_ids, page_start,
+                )
+            else:
+                self._cache, self._tok, first = self._piece_flip_prog(
+                    self.server.params, piece, self._cache, self._tok,
+                    offset, jnp.int32(slot), last_idx,
+                    temp, top_k, top_p, seed,
+                )
+        del self._filling[slot]
+        self._fill_order.remove(slot)
+        if self.prefix_cache is not None:
+            # store the freshly landed prompt KV so the conversation's
+            # next turn prefills only its new suffix — parity with the
+            # single-program admission paths
+            bucket = pad_seq_len(len(fill.ids))
+            if self.page_size > 0:
+                snap = self._snap_prog(self._cache, table_row, bucket)
+            else:
+                snap = self._snap_prog(self._cache, jnp.int32(slot), bucket)
+            self.prefix_cache.put(fill.ids, snap)
+        prep = {"slot": slot, "s": len(fill.ids), "samp": fill.samp,
+                "n": fill.n, "ticket": fill.ticket, "ids": fill.ids,
+                "finished": False}
+        self._finish_admit_host(
+            prep, lambda first=first: np.asarray(first).reshape(1, 1)
+        )
+        self._requeue_preempted()
+
+    def _requeue_preempted(self) -> None:
+        """A fill flipped or died: parked preempted fills may now restart
+        (FIFO, ahead of newer arrivals)."""
+        if self._preempted:
+            self._waiting[:0] = self._preempted
+            self._preempted.clear()
+
+    def _drop_fill(self, slot: int) -> None:
+        """Retire a filling row whose consumer is gone: end its stream
+        (_DONE) and free the slot and pages; nothing was emitted, so
+        nothing else unwinds. The single cancelled-fill retirement path —
+        the sweep, the piece scheduler, and the preempt guard all route
+        here so the semantics can't diverge."""
+        fill = self._filling.pop(slot, None)
+        if fill is not None:
+            fill.ticket.out.put(_DONE)
+        if slot in self._fill_order:
+            self._fill_order.remove(slot)
+        self._release_slot(slot)
+        self._requeue_preempted()
+
+    def _preempt_fill(self) -> None:
+        """Every fill is page-blocked and no decode row is left to free
+        pages by retiring: restart the YOUNGEST fill (it has emitted
+        nothing, so a restart is exact) — its pages unblock the older
+        fills. Parked, not re-queued: an immediate re-admission would
+        re-grab the very pages the head fill needs (livelock)."""
+        dropped = False
+        for slot, fill in list(self._filling.items()):
+            if fill.ticket.cancelled:
+                # a disconnect racing this boundary (cancel() runs on the
+                # consumer's thread) is a retirement, not pool pressure
+                self._drop_fill(slot)
+                dropped = True
+        if dropped or not self._filling:
+            return  # freed slots/pages; the next boundary progresses
+        if len(self._filling) < 2:
+            # cannot happen: the pool holds any single validated row's
+            # whole span, so a lone fill always has its remaining pages
+            raise RuntimeError(
+                "page pool wedged: a lone filling row cannot reserve its "
+                "next piece (pool smaller than a validated request?)"
+            )
+        slot = self._fill_order[-1]
+        fill = self._filling.pop(slot)
+        self._fill_order.remove(slot)
+        self._release_slot(slot)
+        self.stats["fill_preempts"] += 1
+        self._preempted.append((fill.ids, fill.n, fill.samp, fill.ticket))
+
     def _dispatch_chunk(self) -> tuple:
         """Dispatch one chunk (async) and PLAN its emissions now. Take
         counts and retirements are value-independent (budgets only), so
@@ -985,8 +1499,24 @@ class ContinuousBatcher:
                 self.server.params, self._cache, self._tok, *args
             )
         self.stats["chunks"] += 1
+        now = time.monotonic()
+        if self._last_chunk_t is not None:
+            # decode-boundary cadence: the max gap between consecutive
+            # chunk dispatches while rows were active IS the admission
+            # stall a decoding client can observe (monolithic prefills
+            # used to sit here for the whole prompt)
+            gap_ms = (now - self._last_chunk_t) * 1e3
+            if gap_ms > self.stats["stall_ms_max"]:
+                self.stats["stall_ms_max"] = round(gap_ms, 3)
+        self._last_chunk_t = now
         self._offsets += self.chunk_size
         self._steps += self.chunk_size
+        for slot, fill in self._filling.items():
+            # filling slots don't decode: their offsets stay pinned at the
+            # fill frontier (the chunk's garbage writes land beyond it and
+            # the next piece overwrites them)
+            self._offsets[slot] = fill.filled
+            self._steps[slot] = 0
         plan = []
         for slot, row in list(self._rows.items()):
             # the chunk's final carry is this row's next (undelivered)
@@ -1068,6 +1598,10 @@ class ContinuousBatcher:
             if row.closed:
                 del self._rows[slot]
                 self._release_slot(slot)
+        for slot, fill in list(self._filling.items()):
+            if fill.ticket.cancelled:  # consumer gone mid-fill: nothing
+                # was emitted, so the slot and pages just free
+                self._drop_fill(slot)
 
     def _loop(self) -> None:
         from collections import deque
@@ -1076,6 +1610,10 @@ class ContinuousBatcher:
         try:
             while True:
                 self._sweep_closed()
+                if not self._rows:
+                    # idle (or fill-only) gaps between chunks aren't
+                    # decode stalls — don't let them pollute stall_ms_max
+                    self._last_chunk_t = None
                 # gather everything admissible (up to free slots), FIFO: the
                 # backlog of earlier arrivals that found no slot goes first.
                 # Preparation claims the slot/pages immediately so the
@@ -1093,7 +1631,8 @@ class ContinuousBatcher:
                             break  # still contended: decode on, retry later
                         self._gather_prep(self._waiting.pop(0), to_admit)
                         continue
-                    block = (not self._rows and not pending
+                    block = (not self._rows and not self._filling
+                             and not pending
                              and not self._first_pending and not to_admit)
                     try:
                         item = self._q.get(block=block)
@@ -1156,17 +1695,30 @@ class ContinuousBatcher:
                     if self._spec_ok():
                         self._spec_step()
                     continue
+                n_decode = len(self._rows)
                 if self._rows:
                     # keep up to pipeline_depth chunks in flight: plans are
                     # value-independent, so deeper dispatch is exact, and the
                     # oldest chunk's fetch below overlaps the younger chunks'
                     # device time. Go deep only when nothing is waiting for
-                    # a slot and nothing new sits in the queue — both want
-                    # the next chunk boundary as soon as possible.
+                    # a slot, nothing new sits in the queue, and no fill
+                    # wants its piece interleaved at every boundary.
                     pending.append(self._dispatch_chunk())
                     while (len(pending) < self.pipeline_depth and self._rows
+                           and not self._filling
                            and not self._waiting and self._q.empty()):
                         pending.append(self._dispatch_chunk())
+                if self._filling:
+                    # prefill pieces ride the boundary AFTER the decode
+                    # chunk: decode rows spend first, pieces pack into the
+                    # budget's remainder — a long admission can no longer
+                    # freeze the running batch for its whole prompt
+                    landed = self._dispatch_pieces(n_decode * self.chunk_size)
+                    if (not landed and self._filling and not self._rows
+                            and not pending and not self._first_pending):
+                        # every fill is page-blocked and nothing is left
+                        # to retire: restart the youngest to break the tie
+                        self._preempt_fill()
                 # deliveries overlap the chunks just dispatched.
                 # Deliver-then-pop: a chunk whose fetch raises must stay in
                 # the deque so _deliver_failsafe fails its plan rows (plan
@@ -1199,6 +1751,13 @@ class ContinuousBatcher:
         for row in self._rows.values():
             row.out.put(err)
         self._rows.clear()
+        for fill in self._filling.values():  # mid-fill rows have waiters
+            fill.ticket.out.put(err)
+        self._filling.clear()
+        self._fill_order.clear()
+        for item in self._preempted:  # parked fills too
+            item[3].out.put(err)
+        self._preempted.clear()
         for item in self._waiting:  # FIFO backlog items have waiters too
             item[3].out.put(err)
         self._waiting.clear()
@@ -1213,6 +1772,18 @@ class ContinuousBatcher:
                 row_item[3].out.put(err)
 
     # -- public API -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Counters + live gauges for the metrics endpoint and bench:
+        cumulative stats (chunks/admitted/active_peak, prefill_pieces,
+        stall_ms_max, spec_* when speculating, pages_* when paged) plus
+        the instantaneous active/filling/waiting row counts — operators
+        and the bench read THIS, not engine internals."""
+        snap = dict(self.stats)
+        snap["active"] = len(self._rows)
+        snap["filling"] = len(self._filling)
+        snap["waiting"] = len(self._waiting) + len(self._preempted)
+        return snap
 
     def _validate(self, ids: list[int], max_new_tokens: int) -> None:
         s = len(ids)

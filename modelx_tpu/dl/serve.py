@@ -200,6 +200,7 @@ class ModelServer:
         speculative_k: int = 0,
         lora_dir: str = "",
         prefix_cache_size: int = 0,
+        prefix_cache_max_bytes: int = 0,
     ) -> None:
         self.name = name
         self.model_dir = model_dir
@@ -207,12 +208,16 @@ class ModelServer:
         self.lora_dir = lora_dir
         # > 0 keeps the prefill KV of the last N single-row stream prompts
         # on device (models/decode.PrefixKVCache): multi-turn chats that
-        # re-send their history prefill only the new suffix
+        # re-send their history prefill only the new suffix.
+        # prefix_cache_max_bytes additionally caps the entries' actual KV
+        # bytes — an entry count alone over-commits HBM for long prefixes
         self._prefix_cache = None
         if int(prefix_cache_size) > 0:
             from modelx_tpu.models.decode import PrefixKVCache
 
-            self._prefix_cache = PrefixKVCache(int(prefix_cache_size))
+            self._prefix_cache = PrefixKVCache(
+                int(prefix_cache_size), max_bytes=int(prefix_cache_max_bytes)
+            )
         # > 0 turns on prompt-lookup speculative decoding for single-row
         # greedy requests (models/speculative.py): token-exact, fewer
         # device steps on self-repeating continuations
@@ -975,7 +980,9 @@ class ServerSet:
                  kv_live_tokens: int = 0,
                  kv_attention: str = "gather",
                  pipeline_depth: int = 2,
-                 burst_window_ms: float = 1.0) -> None:
+                 burst_window_ms: float = 1.0,
+                 prefill_chunk: int = 0,
+                 prefill_budget: int = 0) -> None:
         if not servers:
             raise ValueError("no models")
         self.max_new_tokens_limit = max_new_tokens_limit
@@ -1003,6 +1010,12 @@ class ServerSet:
         # idle-burst gather window (ms): co-arrivals at an idle engine admit
         # as one program + decode in step; 0 disables
         self.burst_window_ms = burst_window_ms
+        # chunked prefill (Sarathi-style): prompts longer than one piece
+        # land piece by piece between decode chunks instead of as one
+        # monolithic admission prefill (0 = off); prefill_budget bounds
+        # the per-boundary prefill tokens once decode rows have spent
+        self.prefill_chunk = prefill_chunk
+        self.prefill_budget = prefill_budget
         self.max_batch = max_batch
         self.batch_window_ms = batch_window_ms
         self.stream_chunk_size = stream_chunk_size
@@ -1089,6 +1102,8 @@ class ServerSet:
                     speculative_k=server.speculative_k,
                     pipeline_depth=self.pipeline_depth,
                     burst_window_ms=self.burst_window_ms,
+                    prefill_chunk=self.prefill_chunk,
+                    prefill_budget=self.prefill_budget,
                 )
                 self.cbatchers[server.name] = cb
         return cb
@@ -1297,7 +1312,11 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                     d = dict(s.stats)
                     cb = sset.cbatchers.get(n)
                     if cb is not None:
-                        d["continuous"] = dict(cb.stats)
+                        # counters + live gauges (chunks/admitted/
+                        # active_peak, prefill_pieces, stall_ms_max,
+                        # spec accept stats, pages) — the operator/bench
+                        # surface for the engine, no internals poking
+                        d["continuous"] = cb.snapshot()
                     if s._prefix_cache is not None:
                         d["prefix_cache"] = s._prefix_cache.stats()
                     payload[n] = d

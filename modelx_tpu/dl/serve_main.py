@@ -79,10 +79,26 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
               help="continuous batching: when a request hits an IDLE "
                    "engine, wait this long for co-arrivals so the burst "
                    "admits as one program and decodes in step (0 = off)")
+@click.option("--prefill-chunk", default=0, type=int,
+              help="continuous batching: chunked prefill — prompts longer "
+                   "than this many tokens (16-bucketed) land piece by "
+                   "piece between decode chunks instead of as one "
+                   "monolithic admission prefill, bounding the inter-token "
+                   "latency jitter a long admission inflicts on the "
+                   "running batch (0 = off)")
+@click.option("--prefill-budget", default=0, type=int,
+              help="chunked prefill: per-boundary token budget — decode "
+                   "rows spend chunk_size each first, prefill pieces pack "
+                   "into the remainder (the head piece always lands; "
+                   "0 = one piece per filling row per boundary)")
 @click.option("--prefix-cache", default=0, type=int,
               help="keep the prefill KV of the last N single-row stream "
                    "prompts on device: multi-turn chats that re-send their "
                    "history prefill only the new suffix (0 = off)")
+@click.option("--prefix-cache-max-bytes", default=0, type=int,
+              help="additional BYTE cap on the prefix cache's stored KV "
+                   "(entry count alone over-commits HBM for long "
+                   "prefixes; 0 = entry cap only)")
 @click.option("--quantize", type=click.Choice(["int8"]), default=None,
               help="weight-only int8: half the HBM/transfer bytes for the big matmuls")
 @click.option("--speculative-k", default=0, type=int,
@@ -103,7 +119,9 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
          kv_page_size: int, kv_live_tokens: int, kv_attention: str,
          max_batch: int, batch_window_ms: float, stream_chunk_size: int,
          pipeline_depth: int, burst_window_ms: float,
-         prefix_cache: int, quantize: str | None, speculative_k: int,
+         prefill_chunk: int, prefill_budget: int,
+         prefix_cache: int, prefix_cache_max_bytes: int,
+         quantize: str | None, speculative_k: int,
          loras: tuple[str, ...], drain_seconds: float) -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     from modelx_tpu.parallel.distributed import initialize
@@ -154,13 +172,19 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
                           name=name, mesh=shared_mesh, quantize=quantize,
                           speculative_k=speculative_k,
                           lora_dir=lora_dirs.get(name, ""),
-                          prefix_cache_size=prefix_cache)
+                          prefix_cache_size=prefix_cache,
+                          prefix_cache_max_bytes=prefix_cache_max_bytes)
         for name, path in entries.items()
     }
     if continuous_batch and speculative_k:
         logging.getLogger("modelx.serve").info(
             "--continuous-batch + --speculative-k: the engine speculates "
             "whenever a single greedy row has the device to itself"
+        )
+    if prefill_chunk and not continuous_batch:
+        logging.getLogger("modelx.serve").warning(
+            "--prefill-chunk is inert without --continuous-batch "
+            "(chunked prefill is the continuous engine's admission policy)"
         )
     if prefix_cache and speculative_k and not continuous_batch:
         # the speculative decoder owns single-row streams before the
@@ -177,7 +201,9 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
                      stream_chunk_size=stream_chunk_size,
                      kv_page_size=kv_page_size, kv_live_tokens=kv_live_tokens,
                      kv_attention=kv_attention, pipeline_depth=pipeline_depth,
-                     burst_window_ms=burst_window_ms)
+                     burst_window_ms=burst_window_ms,
+                     prefill_chunk=prefill_chunk,
+                     prefill_budget=prefill_budget)
     httpd = serve(sset, listen=listen)  # starts serving 503s while loading
     stats = sset.load_all(concurrent=concurrent_load)
     logging.getLogger("modelx.serve").info("models loaded: %s", stats)

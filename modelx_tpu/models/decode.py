@@ -151,14 +151,26 @@ class PrefixKVCache:
     Capacity is small and LRU-evicted: one entry costs
     ``bucket_len × layers × 2 × kv_heads × head_dim × dtype`` HBM (a few
     hundred KB/token-hundred for 8B-class models). VERDICT r3 item 10.
+
+    ``max_bytes`` > 0 caps the cache by the entries' actual KV bytes
+    (summed leaf nbytes, computed once at ``put``): an entry-count cap
+    silently over-commits HBM when conversations carry long prefixes —
+    four 2k-token entries cost 16x four 128-token ones. Both caps apply;
+    the newest entry always survives even when it alone exceeds the
+    byte cap (evicting it would make every long conversation miss).
     """
 
-    def __init__(self, capacity: int = 4) -> None:
+    def __init__(self, capacity: int = 4, max_bytes: int = 0) -> None:
         import collections
         import threading
 
         self.capacity = max(1, int(capacity))
+        self.max_bytes = max(0, int(max_bytes))
         self._od: "collections.OrderedDict[tuple, object]" = collections.OrderedDict()
+        # per-key (nbytes, stored_len), computed ONCE at put: lookup must
+        # not traverse the entry pytree under the lock on every scan
+        self._meta: dict[tuple, tuple[int, int | None]] = {}
+        self._bytes = 0
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
@@ -171,17 +183,19 @@ class PrefixKVCache:
         engine's slot cache). Returns (prefix_len, cache pytree) or None.
         hits/misses count USABLE lookups only — an entry discarded for
         size is not a hit, and shorter fitting prefixes still win."""
-        import jax as _jax
-
         ids = tuple(int(t) for t in ids)
         best_key = None
         with self._lock:
-            for key, cache in self._od.items():
+            for key in self._od:
                 if len(key) >= len(ids) or ids[: len(key)] != key:
                     continue
                 if max_total is not None:
-                    stored_len = int(_jax.tree_util.tree_leaves(cache)[0].shape[1])
-                    if stored_len + pad_seq_len(len(ids) - len(key)) > max_total:
+                    # stored_len was computed at put time — no per-scan
+                    # tree traversal under the lock
+                    stored_len = self._meta[key][1]
+                    if (stored_len is not None
+                            and stored_len + pad_seq_len(len(ids) - len(key))
+                            > max_total):
                         continue
                 if best_key is None or len(key) > len(best_key):
                     best_key = key
@@ -192,18 +206,46 @@ class PrefixKVCache:
             self.hits += 1
             return len(best_key), self._od[best_key]
 
+    @staticmethod
+    def _entry_meta(cache) -> tuple[int, int | None]:
+        """(nbytes, stored seq length) of an entry pytree. Non-array
+        leaves (unit-test stand-ins) count 0 bytes / unknown length."""
+        import jax as _jax
+
+        leaves = _jax.tree_util.tree_leaves(cache)
+        nbytes = sum(int(getattr(leaf, "nbytes", 0)) for leaf in leaves)
+        try:
+            stored_len = int(leaves[0].shape[1])
+        except (AttributeError, IndexError, TypeError):
+            stored_len = None
+        return nbytes, stored_len
+
+    def _pop_lru(self) -> None:
+        key, _ = self._od.popitem(last=False)
+        self._bytes -= self._meta.pop(key)[0]
+
     def put(self, ids, cache) -> None:
         key = tuple(int(t) for t in ids)
+        meta = self._entry_meta(cache)
         with self._lock:
+            if key in self._od:
+                self._bytes -= self._meta[key][0]
             self._od[key] = cache
+            self._meta[key] = meta
+            self._bytes += meta[0]
             self._od.move_to_end(key)
             while len(self._od) > self.capacity:
-                self._od.popitem(last=False)
+                self._pop_lru()
+            # byte cap: evict LRU-first, but never the entry just added
+            # (a lone oversized conversation should still hit next turn)
+            while (self.max_bytes and self._bytes > self.max_bytes
+                   and len(self._od) > 1):
+                self._pop_lru()
 
     def stats(self) -> dict:
         with self._lock:
             return {"hits": self.hits, "misses": self.misses,
-                    "entries": len(self._od)}
+                    "entries": len(self._od), "bytes": self._bytes}
 
 
 class ChunkedDecoder:

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Run the mixed prefill/decode bench leg standalone (no registry, no
+checkpoint push): synthesizes a llama-shaped model in memory and drives
+bench.measure_mixed_prefill against it, printing one JSON line.
+
+    python scripts/bench_mixed.py                 # rig-sized defaults
+    python scripts/bench_mixed.py --tiny          # seconds-fast CPU smoke
+    JAX_PLATFORMS=cpu python scripts/bench_mixed.py --tiny
+
+The full bench (python bench.py) runs this leg too; this entrypoint
+exists so the chunked-prefill jitter numbers can be re-captured in
+isolation after a scheduler change without paying the load legs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--tiny", action="store_true",
+                    help="tiny model + short traffic (CPU smoke, seconds)")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--chunk", type=int, default=32)
+    ap.add_argument("--prefill-chunk", type=int, default=128)
+    ap.add_argument("--long-prompt", type=int, default=704)
+    args = ap.parse_args()
+
+    import jax
+    import numpy as np
+
+    from bench import measure_mixed_prefill
+    from modelx_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(f"dp={len(jax.devices())}")
+    if args.tiny:
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from modelx_tpu.models import llama
+
+        cfg = dataclasses.replace(
+            llama.LlamaConfig.tiny(vocab_size=128), dtype=jnp.float32
+        )
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        out = measure_mixed_prefill(
+            params, mesh, slots=4, chunk=4, prefill_chunk=16,
+            decode_prompt=16, decode_new=48, long_prompt=48, long_new=8,
+            max_len=160,
+        )
+    else:
+        import tempfile
+
+        from bench import build_checkpoint
+        from modelx_tpu.dl import safetensors as st
+
+        with tempfile.TemporaryDirectory(prefix="modelx-mixed-") as d:
+            ckpt = os.path.join(d, "model.safetensors")
+            build_checkpoint(ckpt, int(os.environ.get("BENCH_BYTES", 256 << 20)))
+            with open(ckpt, "rb") as f:
+                infos, off = st.read_header(f)
+                params = {}
+                for name, info in infos.items():
+                    f.seek(off + info.start)
+                    # device-resident: host arrays would re-transfer per
+                    # dispatch and bill the link to the ITL numbers
+                    params[name] = jax.device_put(np.frombuffer(
+                        f.read(info.nbytes), info.np_dtype()
+                    ).reshape(info.shape))
+        out = measure_mixed_prefill(
+            params, mesh, slots=args.slots, chunk=args.chunk,
+            prefill_chunk=args.prefill_chunk, long_prompt=args.long_prompt,
+        )
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

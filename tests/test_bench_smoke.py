@@ -102,6 +102,36 @@ class TestBenchSmoke:
         assert out["continuous_spec_device_steps"] > 0
         assert out["continuous_spec_steps_per_token"] < 1.0, out
 
+    @pytest.mark.slow
+    def test_measure_mixed_prefill_schema(self):
+        """The mixed prefill/decode leg (chunked-prefill acceptance):
+        tiny traffic, but the full two-scenario harness — schema-checks
+        the load-bearing JSON keys and that the chunked scenario actually
+        chunked. Long: two engines decode a saturated batch each."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        import bench
+        from modelx_tpu.models import llama
+        from modelx_tpu.parallel.mesh import make_mesh
+
+        cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64),
+                                  dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        out = bench.measure_mixed_prefill(
+            params, make_mesh("dp=1"), slots=4, chunk=4, prefill_chunk=16,
+            decode_prompt=16, decode_new=48, long_prompt=48, long_new=8,
+            max_len=160,
+        )
+        for key in ("itl_p99_ms_mixed", "itl_p99_ms_mixed_baseline",
+                    "itl_p99_ms_idle", "admission_stall_ms_max",
+                    "admission_stall_ms_max_baseline", "mixed_prefill_pieces"):
+            assert key in out, key
+        assert out["mixed_prefill_pieces"] >= 3  # the long prompt chunked
+        assert out["itl_p99_ms_mixed"] is None or out["itl_p99_ms_mixed"] > 0
+
     def test_pull_snippets_run(self, tmp_path):
         """The stdlib-only multitenant pullers must keep working against a
         live registry (they run as bare -S subprocesses in the bench)."""

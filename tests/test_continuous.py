@@ -40,7 +40,10 @@ def server(tmp_path_factory):
     return srv
 
 
-@pytest.fixture()
+# module-scoped: one compiled engine serves every test that doesn't need
+# a special configuration — each fresh engine re-jits its whole program
+# set, and tier-1 wall time pays for every one of them
+@pytest.fixture(scope="module")
 def engine(server):
     cb = ContinuousBatcher(server, max_slots=4, chunk_size=4)
     yield cb
@@ -116,13 +119,14 @@ class TestScheduling:
             long_done["out"] = engine.generate(long_tokens, max_new_tokens=64)
             long_done["t"] = time.monotonic()
 
+        chunks0 = engine.stats["chunks"]  # module-scoped engine: delta
         t_long = threading.Thread(target=long_req)
         t_long.start()
         # wait until the long decode is genuinely mid-flight
         deadline = time.monotonic() + 10
-        while engine.stats["chunks"] < 2 and time.monotonic() < deadline:
+        while engine.stats["chunks"] - chunks0 < 2 and time.monotonic() < deadline:
             time.sleep(0.005)
-        assert engine.stats["chunks"] >= 2, "long decode never started"
+        assert engine.stats["chunks"] - chunks0 >= 2, "long decode never started"
 
         short = engine.generate(short_tokens, max_new_tokens=4)
         short_done["t"] = time.monotonic()
@@ -279,9 +283,29 @@ class TestServingIntegration:
             requests.post(base + "/v1/generate",
                           json={"tokens": [[1, 2]], "max_new_tokens": 2})
             m = requests.get(base + "/metrics").json()
-            assert m["m"]["continuous"]["admitted"] >= 1
+            cont = m["m"]["continuous"]
+            assert cont["admitted"] >= 1
+            # the operator/bench surface: engine counters + live gauges
+            # ride the endpoint (no internals poking needed)
+            for key in ("chunks", "active_peak", "prefill_pieces",
+                        "stall_ms_max", "active", "filling", "waiting"):
+                assert key in cont, key
         finally:
             httpd.shutdown()
+
+    def test_serverset_wires_prefill_knobs_to_engine(self, server):
+        s = ServerSet({"m": server}, continuous_batch=True, max_slots=2,
+                      stream_chunk_size=4, prefill_chunk=16, prefill_budget=32)
+        try:
+            cb = s.continuous_for(server)
+            # wiring only — chunked-decode exactness is covered by
+            # TestChunkedPrefill (skipping generate skips a full re-jit)
+            assert cb.prefill_chunk == 16
+            assert cb.prefill_budget == 32
+            assert cb.stats["prefill_chunk"] == 16
+        finally:
+            for cb in s.cbatchers.values():
+                cb.close()
 
 
 class TestLoneShortRequests:
@@ -595,6 +619,247 @@ class TestPipelineDepth:
             assert got[0].tolist() == want
         finally:
             cb.close()
+
+
+class TestChunkedPrefill:
+    """Chunked prefill (prefill_chunk > 0): long prompts land piece by
+    piece between decode chunks. The oracle is unchanged — byte-identical
+    tokens to the plain paths (ragged decode via server.generate) — plus
+    the scheduling property the feature exists for: decode boundaries
+    keep firing while a prompt fills."""
+
+    # class-scoped: one compiled engine serves every exactness test here
+    # (tier-1 wall time — a per-test engine re-jits the whole program set)
+    @pytest.fixture(scope="class")
+    def engine(self, server):
+        cb = ContinuousBatcher(server, max_slots=4, chunk_size=4,
+                               prefill_chunk=16)
+        yield cb
+        cb.close()
+
+    def test_long_greedy_matches_plain(self, server, engine):
+        before = engine.stats["prefill_pieces"]
+        rng = np.random.RandomState(5)
+        tokens = rng.randint(1, 64, (1, 40)).astype(np.int32)  # 3 pieces
+        expected = server.generate(tokens, max_new_tokens=11)
+        got = engine.generate(tokens, max_new_tokens=11)
+        np.testing.assert_array_equal(got, expected)
+        assert engine.stats["prefill_pieces"] - before == 3
+
+    def test_long_sampled_matches_ragged(self, server, engine):
+        """Same (seed, step) streams: the flip piece's first token is
+        step 0 of the row's stream, like single-program admission."""
+        rng = np.random.RandomState(6)
+        tokens = rng.randint(1, 64, (1, 37)).astype(np.int32)
+        expected = server.generate(
+            tokens, max_new_tokens=9, temperature=0.8, top_k=12, top_p=0.9,
+            seed=41,
+        )
+        got = engine.generate(
+            tokens, max_new_tokens=9, temperature=0.8, top_k=12, top_p=0.9,
+            seed=41,
+        )
+        np.testing.assert_array_equal(got, expected)
+
+    def test_short_prompt_keeps_single_program_fast_path(self, server, engine):
+        before = engine.stats["prefill_pieces"]
+        tokens = np.array([[5, 9, 2]], np.int32)  # <= one piece
+        np.testing.assert_array_equal(
+            engine.generate(tokens, max_new_tokens=5),
+            server.generate(tokens, max_new_tokens=5),
+        )
+        assert engine.stats["prefill_pieces"] == before
+
+    def test_stream_through_chunked_admission(self, server, engine):
+        rng = np.random.RandomState(7)
+        tokens = rng.randint(1, 64, (1, 33)).astype(np.int32)
+        pieces = list(engine.stream(tokens, max_new_tokens=10))
+        got = np.concatenate(pieces, axis=1)
+        expected = server.generate(tokens, max_new_tokens=10)[:, 33:]
+        np.testing.assert_array_equal(got, expected)
+        assert pieces[0].shape == (1, 1)  # TTFT is still one token
+
+    def test_multirow_long_prompts_match(self, server, engine):
+        rng = np.random.RandomState(8)
+        tokens = rng.randint(1, 64, (2, 35)).astype(np.int32)
+        expected = server.generate(tokens, max_new_tokens=6)
+        got = engine.generate(tokens, max_new_tokens=6)
+        np.testing.assert_array_equal(got, expected)
+
+    def test_no_decode_boundary_skipped_while_filling(self, server, engine):
+        """THE jitter regression: while a long prompt fills into a batch
+        with active decode rows, every prefill piece rides a boundary
+        that also dispatched a decode chunk — a monolithic admission (or
+        back-to-back pieces) would stall the decoding client for the
+        whole prompt. Spies ride the SHARED engine and are restored."""
+        cb = engine
+        order: list[str] = []
+        orig_chunk, orig_piece, orig_flip = (
+            cb._chunk, cb._piece_prog, cb._piece_flip_prog
+        )
+        chunks0 = cb.stats["chunks"]
+        try:
+            cb._chunk = lambda *a: (order.append("C"), orig_chunk(*a))[1]
+            cb._piece_prog = lambda *a: (order.append("P"), orig_piece(*a))[1]
+            cb._piece_flip_prog = (
+                lambda *a: (order.append("P"), orig_flip(*a))[1]
+            )
+            rng = np.random.RandomState(9)
+            dec_tokens = rng.randint(1, 64, (1, 5)).astype(np.int32)
+            long_tokens = rng.randint(1, 64, (1, 48)).astype(np.int32)
+            res = {}
+            t = threading.Thread(
+                target=lambda: res.update(
+                    dec=cb.generate(dec_tokens, max_new_tokens=40))
+            )
+            t.start()
+            deadline = time.monotonic() + 30
+            while cb.stats["chunks"] - chunks0 < 2 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert cb.stats["chunks"] - chunks0 >= 2, "decode row never started"
+            res["long"] = cb.generate(long_tokens, max_new_tokens=4)
+            t.join()
+        finally:
+            cb._chunk, cb._piece_prog, cb._piece_flip_prog = (
+                orig_chunk, orig_piece, orig_flip
+            )
+        np.testing.assert_array_equal(
+            res["dec"], server.generate(dec_tokens, max_new_tokens=40))
+        np.testing.assert_array_equal(
+            res["long"], server.generate(long_tokens, max_new_tokens=4))
+        seq = "".join(order)
+        assert seq.count("P") == 3, seq  # 48 tokens -> 3 pieces
+        assert "PP" not in seq, (
+            f"decode boundary skipped while filling: {seq}"
+        )
+
+    @pytest.mark.slow
+    def test_budget_caps_extra_pieces_per_boundary(self, server):
+        """Two concurrent long fills under a tight budget: only the head
+        piece may land per boundary (the budget exempts it so fills can't
+        starve), and both streams stay exact."""
+        cb = ContinuousBatcher(server, max_slots=4, chunk_size=4,
+                               prefill_chunk=16, prefill_budget=16)
+        try:
+            rng = np.random.RandomState(10)
+            a = rng.randint(1, 64, (1, 40)).astype(np.int32)
+            b = rng.randint(1, 64, (1, 40)).astype(np.int32)
+            tickets = cb.submit_many([
+                (a[0].tolist(), 5, {}), (b[0].tolist(), 5, {}),
+            ])
+            rows = []
+            for tk in tickets:
+                parts = []
+                while True:
+                    item = tk.out.get(timeout=60)
+                    if not isinstance(item, np.ndarray):
+                        assert item is None or not isinstance(item, BaseException)
+                        break
+                    parts.append(item)
+                rows.append(np.concatenate(parts, axis=1))
+            np.testing.assert_array_equal(
+                np.concatenate([a, rows[0]], axis=1),
+                server.generate(a, max_new_tokens=5))
+            np.testing.assert_array_equal(
+                np.concatenate([b, rows[1]], axis=1),
+                server.generate(b, max_new_tokens=5))
+            # both prompts chunked (3 pieces each); the 16-token budget
+            # admits only the (exempt) head piece per boundary, so the
+            # fills complete sequentially — and still exactly
+            assert cb.stats["prefill_pieces"] == 6
+        finally:
+            cb.close()
+
+    @pytest.mark.slow
+    def test_cancel_mid_fill_frees_slot(self, server):
+        """A consumer that disappears while its prompt is still filling:
+        the fill retires at the next boundary (nothing was emitted) and
+        the slot serves the next request exactly."""
+        cb = ContinuousBatcher(server, max_slots=1, chunk_size=4,
+                               prefill_chunk=16)
+        try:
+            rng = np.random.RandomState(11)
+            long_ids = rng.randint(1, 64, 64).astype(np.int32).tolist()
+            ticket = cb.submit(long_ids, 16, {})
+            deadline = time.monotonic() + 30
+            while not cb.stats["prefill_pieces"] and time.monotonic() < deadline:
+                time.sleep(0.002)
+            ticket.cancel()
+            deadline = time.monotonic() + 20
+            while (cb._filling or cb._rows) and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert not cb._filling and not cb._rows
+            t = np.array([[9, 1]], np.int32)
+            np.testing.assert_array_equal(
+                cb.generate(t, max_new_tokens=4),
+                server.generate(t, max_new_tokens=4))
+        finally:
+            cb.close()
+
+    def test_metrics_snapshot_carries_engine_counters(self, server, engine):
+        rng = np.random.RandomState(12)
+        tokens = rng.randint(1, 64, (1, 40)).astype(np.int32)
+        engine.generate(tokens, max_new_tokens=4)
+        snap = engine.snapshot()
+        for key in ("chunks", "admitted", "active_peak", "prefill_pieces",
+                    "stall_ms_max", "active", "filling", "waiting"):
+            assert key in snap, key
+        assert snap["prefill_pieces"] >= 3
+
+
+class TestChunkedPrefillPrefixCache:
+    """Prefix-cache hits seed the filling row's offset: only the suffix
+    chunk-prefills, and flipped rows store their prompt KV like the
+    single-program paths do."""
+
+    @pytest.fixture(scope="class")
+    def cached_engine(self, server):
+        from modelx_tpu.models.decode import PrefixKVCache
+
+        cb = ContinuousBatcher(server, max_slots=4, chunk_size=4,
+                               prefill_chunk=16, prefix_cache=PrefixKVCache(4))
+        yield cb
+        cb.close()
+
+    def test_hit_chunk_fills_only_the_suffix(self, server, cached_engine):
+        cb = cached_engine
+        pieces0, hits0 = cb.stats["prefill_pieces"], cb.prefix_cache.hits
+        rng = np.random.RandomState(13)
+        turn1 = rng.randint(1, 64, (1, 20)).astype(np.int32)
+        out1 = cb.generate(turn1, max_new_tokens=5)
+        np.testing.assert_array_equal(
+            out1, server.generate(turn1, max_new_tokens=5))
+        pieces_turn1 = cb.stats["prefill_pieces"]
+        assert pieces_turn1 - pieces0 == 2  # 20 tokens, cold
+        turn2 = np.concatenate(
+            [out1, rng.randint(1, 64, (1, 20)).astype(np.int32)], axis=1
+        )  # 45 tokens, 20 stored -> 25-token suffix = 2 pieces (not 3)
+        out2 = cb.generate(turn2, max_new_tokens=5)
+        np.testing.assert_array_equal(
+            out2, server.generate(turn2, max_new_tokens=5))
+        assert cb.prefix_cache.hits - hits0 == 1
+        assert cb.stats["prefill_pieces"] - pieces_turn1 == 2
+        # sampled third turn over the stored (flip-snapped) prefix
+        out3 = cb.generate(turn2, max_new_tokens=5, temperature=0.8, seed=13)
+        np.testing.assert_array_equal(
+            out3, server.generate(turn2, max_new_tokens=5, temperature=0.8,
+                                  seed=13))
+        assert cb.prefix_cache.hits - hits0 == 2
+
+    def test_flip_stores_prompt_bucketed_entry(self, server, cached_engine):
+        import jax as _jax
+
+        from modelx_tpu.models.decode import pad_seq_len
+
+        cb = cached_engine
+        rng = np.random.RandomState(14)
+        tokens = rng.randint(1, 64, (1, 40)).astype(np.int32)
+        cb.generate(tokens, max_new_tokens=4)
+        key = tuple(int(t) for t in tokens[0])
+        with cb.prefix_cache._lock:
+            entry = cb.prefix_cache._od[key]
+            stored_len = int(_jax.tree_util.tree_leaves(entry)[0].shape[1])
+        assert stored_len == pad_seq_len(40)
 
 
 class TestOtherFamilies:

@@ -142,3 +142,23 @@ class TestSpecEfficiency:
             )
         finally:
             cb.close()
+
+
+class TestSpecWithChunkedPrefill:
+    def test_long_prompt_fills_then_speculates_exactly(self, server):
+        """--prefill-chunk composes with in-engine speculation: a long
+        prompt chunk-fills (pieces need boundaries, so the engine must
+        NOT enter spec mode mid-fill), then the lone greedy row
+        speculates — and the stream stays byte-exact throughout."""
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4,
+                               speculative_k=4, prefill_chunk=16)
+        try:
+            rng = np.random.RandomState(21)
+            tokens = rng.randint(1, 64, (1, 40)).astype(np.int32)
+            expected = server.generate(tokens, max_new_tokens=24)
+            got = cb.generate(tokens, max_new_tokens=24)
+            np.testing.assert_array_equal(got, expected)
+            assert cb.stats["prefill_pieces"] == 3  # the prompt chunked
+            assert cb.stats.get("spec_steps", 0) >= 1  # and then speculated
+        finally:
+            cb.close()

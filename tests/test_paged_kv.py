@@ -63,13 +63,38 @@ def gpt2_server(tmp_path_factory):
 class TestPagedExactness:
     # both chunk-attention modes must be token-exact on the f32 CPU
     # fixtures ("gather" is bit-exact by construction; "in-place" is
-    # blockwise-softmax and the operator's long-context opt-in)
-    @pytest.fixture(params=["gather", "in-place"])
+    # blockwise-softmax and the operator's long-context opt-in).
+    # Class-scoped: one compiled engine per mode serves every test here
+    # (a per-test engine re-jits the whole program set — tier-1 wall
+    # time); prefill_chunk is on so the long-prompt test exercises
+    # chunked prefill while short prompts keep the fast path.
+    @pytest.fixture(params=["gather", "in-place"], scope="class")
     def engine(self, server, request):
         cb = ContinuousBatcher(server, max_slots=4, chunk_size=4, page_size=16,
-                               paged_attention=request.param)
+                               paged_attention=request.param,
+                               prefill_chunk=16)
         yield cb
         cb.close()
+
+    def test_long_prompt_chunk_prefills_and_matches(self, server, engine):
+        """Chunked prefill on the paged engine (both attention modes):
+        pieces land into the slot's pages at the running offset — pieces
+        themselves always run the dense-gather forward, in-place only
+        swaps the chunk step — and stay byte-exact, greedy and sampled."""
+        before = engine.stats["prefill_pieces"]
+        rng = np.random.RandomState(15)
+        tokens = rng.randint(1, 64, (1, 40)).astype(np.int32)
+        np.testing.assert_array_equal(
+            engine.generate(tokens, max_new_tokens=11),
+            server.generate(tokens, max_new_tokens=11),
+        )
+        assert engine.stats["prefill_pieces"] - before == 3
+        sampled = dict(temperature=0.8, top_k=12, top_p=0.9, seed=41)
+        np.testing.assert_array_equal(
+            engine.generate(tokens, max_new_tokens=7, **sampled),
+            server.generate(tokens, max_new_tokens=7, **sampled),
+        )
+        assert engine.stats["pages_free"] == engine.num_pages - 1
 
     def test_greedy_matches_plain(self, server, engine):
         tokens = np.array([[5, 9, 2, 7, 1]], np.int32)
@@ -435,5 +460,115 @@ class TestLongPagedDecode:
                 cb.generate(t, max_new_tokens=76),
                 server.generate(t, max_new_tokens=76),
             )
+        finally:
+            cb.close()
+
+
+class TestPagedChunkedPrefill:
+    """Chunked-prefill SCHEDULING on the paged engine (exactness of the
+    pieces themselves rides TestPagedExactness): pages reserve
+    INCREMENTALLY per piece (not the whole span up front), and pool
+    contention between fills resolves by preempting the youngest."""
+
+    @pytest.mark.slow
+    def test_prefix_hit_seeds_pages_and_fills_suffix(self, server):
+        from modelx_tpu.models.decode import PrefixKVCache
+
+        cb = ContinuousBatcher(server, max_slots=4, chunk_size=4, page_size=16,
+                               prefill_chunk=16, prefix_cache=PrefixKVCache(4))
+        try:
+            rng = np.random.RandomState(16)
+            turn1 = rng.randint(1, 64, (1, 20)).astype(np.int32)
+            out1 = cb.generate(turn1, max_new_tokens=5)
+            np.testing.assert_array_equal(
+                out1, server.generate(turn1, max_new_tokens=5))
+            pieces1 = cb.stats["prefill_pieces"]
+            turn2 = np.concatenate(
+                [out1, rng.randint(1, 64, (1, 20)).astype(np.int32)], axis=1)
+            out2 = cb.generate(turn2, max_new_tokens=5)
+            np.testing.assert_array_equal(
+                out2, server.generate(turn2, max_new_tokens=5))
+            assert cb.prefix_cache.hits == 1
+            # 45-token prompt, 20 stored: only the 25-token suffix chunks
+            assert cb.stats["prefill_pieces"] - pieces1 == 2
+        finally:
+            cb.close()
+
+    @pytest.mark.slow
+    def test_incremental_reservation_admits_under_pool_pressure(self, server):
+        """A long prompt whose FULL span exceeds the free pool must still
+        start filling while a decode row holds most of the pages (the old
+        up-front reservation made it wait the whole decode out in the
+        FIFO), and complete exactly once pages recycle."""
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4, page_size=16,
+                               max_live_tokens=96, prefill_chunk=16)
+        try:
+            rng = np.random.RandomState(17)
+            dec = rng.randint(1, 64, (1, 40)).astype(np.int32)
+            long_p = rng.randint(1, 64, (1, 40)).astype(np.int32)
+            res: dict = {}
+            t = threading.Thread(
+                target=lambda: res.update(
+                    dec=cb.generate(dec, max_new_tokens=24)))
+            t.start()
+            deadline = time.monotonic() + 30
+            while cb.stats["chunks"] < 1 and time.monotonic() < deadline:
+                time.sleep(0.002)
+            # decode row holds 5 of 6 pages; the long prompt's span needs 4
+            assert cb.stats["pages_free"] == 1
+            started_mid_decode = {}
+            t2 = threading.Thread(
+                target=lambda: res.update(
+                    long=cb.generate(long_p, max_new_tokens=8)))
+            t2.start()
+            deadline = time.monotonic() + 30
+            while not cb.stats["prefill_pieces"] and time.monotonic() < deadline:
+                time.sleep(0.002)
+            started_mid_decode["ok"] = bool(cb._rows) and cb.stats["prefill_pieces"] >= 1
+            t.join()
+            t2.join()
+            np.testing.assert_array_equal(
+                res["dec"], server.generate(dec, max_new_tokens=24))
+            np.testing.assert_array_equal(
+                res["long"], server.generate(long_p, max_new_tokens=8))
+            assert started_mid_decode["ok"], (
+                "long prompt did not start filling while the decode row "
+                "held the pool"
+            )
+        finally:
+            cb.close()
+
+    @pytest.mark.slow
+    def test_fill_contention_preempts_youngest_and_stays_exact(self, server):
+        """Two fills racing a pool that holds only one full span: the
+        youngest preempts (it emitted nothing, so its restart is exact),
+        the oldest flips, pages recycle, everyone finishes byte-exact."""
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4, page_size=16,
+                               max_live_tokens=80, prefill_chunk=16)
+        try:
+            rng = np.random.RandomState(18)
+            a = rng.randint(1, 64, (1, 40)).astype(np.int32)
+            b = rng.randint(1, 64, (1, 40)).astype(np.int32)
+            tickets = cb.submit_many([
+                (a[0].tolist(), 8, {}), (b[0].tolist(), 8, {}),
+            ])
+            rows = []
+            for tk in tickets:
+                parts = []
+                while True:
+                    item = tk.out.get(timeout=60)
+                    if not isinstance(item, np.ndarray):
+                        assert not isinstance(item, BaseException), item
+                        break
+                    parts.append(item)
+                rows.append(np.concatenate(parts, axis=1))
+            np.testing.assert_array_equal(
+                np.concatenate([a, rows[0]], axis=1),
+                server.generate(a, max_new_tokens=8))
+            np.testing.assert_array_equal(
+                np.concatenate([b, rows[1]], axis=1),
+                server.generate(b, max_new_tokens=8))
+            assert cb.stats["fill_preempts"] >= 1
+            assert cb.stats["pages_free"] == cb.num_pages - 1
         finally:
             cb.close()

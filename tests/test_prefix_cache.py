@@ -66,6 +66,48 @@ class TestPrefixKVCache:
         assert pc.lookup([1, 9]) is not None
         assert pc.lookup([3, 9]) is not None
 
+    def test_byte_cap_evicts_by_actual_kv_bytes(self):
+        """max_bytes caps by the entries' summed leaf nbytes (computed at
+        put) — the entry-count cap alone over-commits HBM for long
+        prefixes. LRU order, newest always survives."""
+        def entry(tokens: int):
+            return {"k": np.zeros((1, tokens, 2, 4), np.float32)}  # 32 B/tok
+
+        pc = PrefixKVCache(capacity=8, max_bytes=3000)
+        pc.put([1], entry(32))   # 1024 B
+        pc.put([2], entry(32))   # 2048 B total
+        assert pc.stats()["bytes"] == 2048
+        pc.lookup([1, 9])        # refresh [1]
+        pc.put([3], entry(48))   # 1536 B -> 3584 > cap: evict LRU [2]
+        assert pc.lookup([2, 9]) is None
+        assert pc.lookup([1, 9]) is not None
+        assert pc.lookup([3, 9]) is not None
+        assert pc.stats()["bytes"] == 1024 + 1536
+        # an entry bigger than the whole cap still lands (everything else
+        # evicts): a lone oversized conversation must hit next turn
+        pc.put([4], entry(128))  # 4096 B > cap
+        assert pc.lookup([4, 9]) is not None
+        assert pc.stats()["entries"] == 1
+        assert pc.stats()["bytes"] == 4096
+
+    def test_overwrite_same_key_does_not_leak_bytes(self):
+        pc = PrefixKVCache(capacity=4, max_bytes=10**9)
+        arr = {"k": np.zeros((1, 16, 2, 4), np.float32)}
+        pc.put([1, 2], arr)
+        pc.put([1, 2], arr)
+        assert pc.stats()["bytes"] == arr["k"].nbytes
+        assert pc.stats()["entries"] == 1
+
+    def test_lookup_max_total_uses_stored_len_without_traversal(self):
+        """The fit check reads the put-time stored_len (no tree_leaves
+        scan under the lock) and still filters oversized entries."""
+        pc = PrefixKVCache(capacity=4)
+        pc.put(list(range(32)), {"k": np.zeros((1, 32, 2, 4), np.float32)})
+        ids = list(range(32)) + [99] * 17  # suffix bucket 32
+        assert pc.lookup(ids, max_total=48) is None  # 32 + 32 > 48
+        assert pc.lookup(ids, max_total=64) is not None
+        assert pc._meta[tuple(range(32))][1] == 32
+
 
 class TestSuffixPrefill:
     def test_second_turn_matches_uncached_exactly(self, model):
