@@ -52,6 +52,7 @@ import statistics
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 import numpy as np
@@ -862,6 +863,116 @@ def measure_mixed_prefill(params, mesh, *, slots: int = 8, chunk: int = 32,
     return out
 
 
+def measure_overload(params, mesh, *, slots: int = 2, chunk: int = 8,
+                     queue_depth: int = 4, clients: int = 16,
+                     prompt: int = 16, new_tokens: int = 32,
+                     max_len: int = 256) -> dict:
+    """Overload + self-healing leg (ISSUE 3 acceptance): saturate a
+    bounded-admission engine and count the sheds, expire a queued request
+    past its deadline, then crash the engine's dispatch with a
+    deterministic FaultPlan and time the supervisor's recovery.
+
+    Reported: ``shed_429_count`` (submits rejected at --max-queue-depth),
+    ``deadline_504_count`` (requests expired at a chunk boundary),
+    ``recovery_ms`` (injected crash -> first successful generate on the
+    restarted engine), and ``overload_engine_restarts``."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from modelx_tpu.dl import families as fam
+    from modelx_tpu.dl.continuous import ContinuousBatcher
+    from modelx_tpu.dl.serving_errors import (
+        DeadlineExceededError, EngineBrokenError, QueueFullError,
+    )
+    from modelx_tpu.testing import faults
+
+    family = fam.detect(list(params))
+    cfg = family.infer_config(params)
+
+    class _Shim:
+        pass
+
+    shim = _Shim()
+    shim.family, shim.cfg, shim.mesh = family, cfg, mesh
+    shim.max_seq_len, shim.params = max_len, params
+    shim.stats = {"tokens_generated": 0}
+    rng = np.random.RandomState(31)
+    prompts = [
+        rng.randint(1, cfg.vocab_size, (1, prompt)).astype(np.int32)
+        for _ in range(clients)
+    ]
+    cb = ContinuousBatcher(shim, max_slots=slots, chunk_size=chunk,
+                           max_len=max_len, max_queue_depth=queue_depth,
+                           restart_backoff_s=0.05)
+    try:
+        cb.generate(prompts[0], max_new_tokens=8)  # warm the compiled set
+
+        # -- shed leg: saturating concurrent traffic against the bound ----
+        shed = ok = 0
+        lock = threading.Lock()
+
+        def client(i: int) -> None:
+            nonlocal shed, ok
+            try:
+                cb.generate(prompts[i], max_new_tokens=new_tokens)
+                with lock:
+                    ok += 1
+            except QueueFullError:
+                with lock:
+                    shed += 1
+
+        with ThreadPoolExecutor(clients) as pool:
+            list(pool.map(client, range(clients)))
+
+        # -- deadline leg: a queued request expired at the boundary -------
+        deadline_504 = 0
+        blocker = cb.submit(prompts[0][0].tolist(), 64, {})
+        blocker.out.get(timeout=60)  # admitted: the slot array is busy
+        fillers = [
+            cb.submit(prompts[1 + i % (clients - 1)][0].tolist(), 64, {})
+            for i in range(slots - 1)
+        ]
+        waiter = cb.submit(prompts[2][0].tolist(), 8, {})
+        waiter.deadline = 0.0  # already past: expires at the next boundary
+        item = waiter.out.get(timeout=60)
+        if isinstance(item, DeadlineExceededError):
+            deadline_504 += 1
+        blocker.cancel()
+        for f in fillers:
+            f.cancel()
+
+        # -- crash/recovery leg: injected dispatch fault ------------------
+        plan = faults.FaultPlan(seed=7)
+        plan.add("engine.dispatch", errors_at=[0],
+                 error=RuntimeError("bench-injected crash"))
+        cb._chunk = faults.wrap_dispatch(cb._chunk, plan)
+        t0 = time.monotonic()
+        try:
+            cb.generate(prompts[3], max_new_tokens=8)
+        except EngineBrokenError:
+            pass
+        recovery_ms = None
+        give_up = time.monotonic() + 60
+        while time.monotonic() < give_up:
+            try:
+                cb.generate(prompts[3], max_new_tokens=8)
+                recovery_ms = round((time.monotonic() - t0) * 1e3, 1)
+                break
+            except EngineBrokenError:
+                time.sleep(0.01)
+        snap = cb.snapshot()
+        return {
+            "overload_clients": clients,
+            "overload_queue_depth": queue_depth,
+            "shed_429_count": shed,
+            "overload_served": ok,
+            "deadline_504_count": deadline_504,
+            "recovery_ms": recovery_ms,
+            "overload_engine_restarts": snap["engine_restarts"],
+        }
+    finally:
+        cb.close()
+
+
 def run_leg(kind: str, base: str, repo: str, workdir: str) -> dict:
     """One timed leg in a FRESH subprocess (fresh per-process tunnel
     throttle state — see module docstring). Returns the child's JSON."""
@@ -1157,6 +1268,10 @@ def main() -> None:
         # decode batch; chunked prefill must bound the ITL jitter the
         # monolithic-admission baseline inflicts (ISSUE 2 acceptance)
         serving.update(measure_mixed_prefill(loaded, mesh))
+        # overload/self-healing leg: bounded admission sheds, deadline
+        # expiry, and supervised recovery after an injected engine crash
+        # (ISSUE 3 acceptance)
+        serving.update(measure_overload(loaded, mesh))
         del loaded
 
         # int8 weight-only serving: per-step weight reads halve, so decode

@@ -436,9 +436,10 @@ def cmd_serve_model(args: tuple[str, ...]) -> None:
     console script): loads checkpoints onto the mesh and serves
     /v1/generate + OpenAI-compatible endpoints, with the full serving
     flag surface (--continuous-batch, --prefill-chunk/--prefill-budget
-    chunked prefill, --kv-page-size paged KV, ...). Args pass through
-    verbatim; the import is deferred so plain registry commands never
-    pay the jax startup."""
+    chunked prefill, --kv-page-size paged KV, --max-queue-depth /
+    --request-timeout bounded admission with deadlines, ...). Args pass
+    through verbatim; the import is deferred so plain registry commands
+    never pay the jax startup."""
     from modelx_tpu.dl.serve_main import main as serve_model_main
 
     serve_model_main.main(args=list(args), prog_name="modelx serve-model")

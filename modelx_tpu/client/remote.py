@@ -9,6 +9,8 @@ media-type/annotations (registry.go:92-107).
 from __future__ import annotations
 
 import io
+import random
+import time
 from typing import Any, BinaryIO, Iterator
 
 import requests
@@ -44,9 +46,18 @@ class RegistryClient:
     # (connect, read) defaults: generous read for blob streams, bounded
     # connect so unreachable hosts fail instead of hanging
     DEFAULT_TIMEOUT = (10, 300)
+    # retry policy for IDEMPOTENT requests (GET/HEAD): the S3/GCS data-plane
+    # extensions have retried x3 since the seed (extension_s3.go parity) but
+    # the control-plane client had none — one connection blip failed a whole
+    # pull. Exponential backoff with jitter (decorrelate a fleet of sidecars
+    # all retrying the same registry); a server Retry-After wins when longer,
+    # capped so a hostile/buggy header can't park the client for minutes.
+    RETRIES = 3
+    RETRY_BACKOFF_S = 0.2
+    RETRY_AFTER_CAP_S = 5.0
 
     def __init__(self, registry: str, authorization: str = "", timeout=None,
-                 insecure: bool | None = None) -> None:
+                 insecure: bool | None = None, retries: int | None = None) -> None:
         self.registry = registry.rstrip("/")
         self.authorization = authorization
         self.timeout = timeout or self.DEFAULT_TIMEOUT
@@ -55,6 +66,7 @@ class RegistryClient:
         # must be passed PER REQUEST: a session-level verify=False loses to
         # a REQUESTS_CA_BUNDLE env var in requests' settings merge.
         self._insecure = insecure
+        self.retries = self.RETRIES if retries is None else max(1, int(retries))
 
     # -- plumbing -------------------------------------------------------------
 
@@ -66,6 +78,16 @@ class RegistryClient:
             h.update(extra)
         return h
 
+    def _retry_sleep(self, attempt: int, retry_after: str | None) -> None:
+        delay = self.RETRY_BACKOFF_S * (2 ** attempt)
+        delay += random.uniform(0.0, delay / 2)  # jitter
+        if retry_after:
+            try:
+                delay = max(delay, min(float(retry_after), self.RETRY_AFTER_CAP_S))
+            except ValueError:
+                pass  # HTTP-date form (or garbage): keep the backoff
+        time.sleep(delay)
+
     def _request(
         self,
         method: str,
@@ -75,34 +97,52 @@ class RegistryClient:
         headers: dict[str, str] | None = None,
         stream: bool = False,
     ) -> requests.Response:
-        """registry.go:146-191 — raise typed ErrorInfo from error bodies."""
+        """registry.go:146-191 — raise typed ErrorInfo from error bodies.
+
+        GET/HEAD retry transparently on connection errors and 5xx/429
+        (idempotent by contract, so a replay is always safe); writes never
+        retry here — their callers own replay semantics (e.g. http_upload's
+        rewind-and-retry)."""
         url = self.registry + path
         kwargs = {}
         if self._insecure if self._insecure is not None else _INSECURE:
             kwargs["verify"] = False
-        try:
-            resp = self.session.request(
-                method, url, params=params, data=data, headers=self._headers(headers),
-                stream=stream, timeout=self.timeout, **kwargs,
-            )
-        except requests.RequestException as e:
-            raise errors.ErrorInfo(502, errors.ErrCodeUnknown, f"request failed: {e}") from e
-        if resp.status_code >= 400:
-            if resp.content:
-                err = errors.ErrorInfo.decode(resp.content, resp.status_code)
-            else:
-                # HEAD responses carry no body — synthesize from status
-                code = {
-                    401: errors.ErrCodeUnauthorized,
-                    403: errors.ErrCodeDenied,
-                    404: errors.ErrCodeUnknown,
-                    405: errors.ErrCodeUnsupported,
-                    429: errors.ErrCodeTooManyRequests,
-                }.get(resp.status_code, errors.ErrCodeUnknown)
-                err = errors.ErrorInfo(resp.status_code, code, f"{method} {path}: HTTP {resp.status_code}")
-            resp.close()
-            raise err
-        return resp
+        attempts = self.retries if method in ("GET", "HEAD") else 1
+        for attempt in range(attempts):
+            last = attempt == attempts - 1
+            try:
+                resp = self.session.request(
+                    method, url, params=params, data=data, headers=self._headers(headers),
+                    stream=stream, timeout=self.timeout, **kwargs,
+                )
+            except requests.RequestException as e:
+                if not last:
+                    self._retry_sleep(attempt, None)
+                    continue
+                raise errors.ErrorInfo(502, errors.ErrCodeUnknown, f"request failed: {e}") from e
+            if resp.status_code >= 400:
+                if resp.content:
+                    err = errors.ErrorInfo.decode(resp.content, resp.status_code)
+                else:
+                    # HEAD responses carry no body — synthesize from status
+                    code = {
+                        401: errors.ErrCodeUnauthorized,
+                        403: errors.ErrCodeDenied,
+                        404: errors.ErrCodeUnknown,
+                        405: errors.ErrCodeUnsupported,
+                        429: errors.ErrCodeTooManyRequests,
+                    }.get(resp.status_code, errors.ErrCodeUnknown)
+                    err = errors.ErrorInfo(resp.status_code, code, f"{method} {path}: HTTP {resp.status_code}")
+                retry_after = resp.headers.get("Retry-After")
+                resp.close()
+                if not last and (resp.status_code >= 500 or resp.status_code == 429):
+                    # transient server trouble; 4xx below 429 is
+                    # deterministic (auth/not-found) and never retried
+                    self._retry_sleep(attempt, retry_after)
+                    continue
+                raise err
+            return resp
+        raise AssertionError("unreachable")  # every path above returns/raises
 
     # -- index ----------------------------------------------------------------
 

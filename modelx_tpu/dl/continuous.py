@@ -72,23 +72,44 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from modelx_tpu.dl.serving_errors import (
+    DeadlineExceededError,
+    EngineBrokenError,
+    PoisonedRequestError,
+    QueueFullError,
+    ServingError,
+)
 from modelx_tpu.models.decode import SEQ_BUCKET, pad_seq_len
+from modelx_tpu.testing import faults as _faults
 from modelx_tpu.utils import trace
 
 _DONE = object()  # end-of-stream sentinel on per-request output queues
+
+
+def _fingerprint(ids, n: int) -> tuple:
+    """Identity of one request for poison quarantine: cheap, deterministic,
+    and content-addressed (two submissions of the same prompt+budget hash
+    alike whatever objects carried them)."""
+    import zlib
+
+    return (int(zlib.crc32(np.asarray(ids, np.int32).tobytes())), len(ids), int(n))
 
 
 class _Ticket:
     """One submitted request: its output queue + a cancellation flag.
     ``cancel()`` (idempotent, any thread) tells the engine the consumer is
     gone — the row's slot frees at the next chunk boundary instead of
-    decoding to its full budget into a queue nobody drains (ADVICE r4)."""
+    decoding to its full budget into a queue nobody drains (ADVICE r4).
+    ``deadline`` (monotonic seconds, None = none) is set at submit from the
+    engine's --request-timeout: the loop expires the request at the next
+    chunk boundary once passed, whatever state it is in."""
 
-    __slots__ = ("out", "cancelled")
+    __slots__ = ("out", "cancelled", "deadline")
 
     def __init__(self) -> None:
         self.out: "queue.Queue" = queue.Queue()
         self.cancelled = False
+        self.deadline: float | None = None
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -138,16 +159,21 @@ class _Fill:
     decoding _Row. ``filled`` is the count of REAL prompt tokens whose KV
     is resident (a prefix-cache hit starts it at the stored prefix len)."""
 
-    __slots__ = ("slot", "ids", "n", "samp", "ticket", "filled")
+    __slots__ = ("slot", "ids", "n", "samp", "ticket", "filled", "fp")
 
     def __init__(self, slot: int, ids: list, n: int, samp: dict,
-                 ticket: _Ticket, filled: int = 0) -> None:
+                 ticket: _Ticket, filled: int = 0,
+                 fp: tuple | None = None) -> None:
         self.slot = slot
         self.ids = ids
         self.n = n
         self.samp = samp
         self.ticket = ticket
         self.filled = filled
+        # the request's poison-quarantine fingerprint, computed once at
+        # preparation (pieces dispatch per boundary; re-hashing the whole
+        # prompt per piece would be O(prompt) work on the loop's hot path)
+        self.fp = fp
 
 
 class ContinuousBatcher:
@@ -167,7 +193,13 @@ class ContinuousBatcher:
                  pipeline_depth: int = 2,
                  burst_window_ms: float = 1.0,
                  prefill_chunk: int = 0,
-                 prefill_budget: int = 0) -> None:
+                 prefill_budget: int = 0,
+                 max_queue_depth: int = 0,
+                 request_timeout_s: float = 0.0,
+                 supervise: bool = True,
+                 restart_backoff_s: float = 0.25,
+                 max_crashes: int = 5,
+                 crash_window_s: float = 60.0) -> None:
         if server.family.decode_fns is None:
             raise ValueError(f"family {server.family.name} has no cached decode")
         self.server = server
@@ -384,8 +416,46 @@ class ContinuousBatcher:
         self._closed = False
         self._broken: BaseException | None = None
         self._close_lock = threading.Lock()
+        # -- bounded admission + deadlines ----------------------------------
+        # max_queue_depth > 0: submits past this many not-yet-admitted rows
+        # shed with QueueFullError (429 + Retry-After on the wire) instead
+        # of queueing into unbounded latency. _backlog counts rows in _q +
+        # _waiting + _preempted, maintained under _close_lock.
+        self.max_queue_depth = int(max_queue_depth)
+        # request_timeout_s > 0: every submit gets a deadline; the loop
+        # expires past-deadline rows at chunk boundaries (waiting, filling,
+        # or decoding) with DeadlineExceededError (504 on the wire)
+        self.request_timeout_s = float(request_timeout_s)
+        self._backlog = 0
+        # -- supervision ----------------------------------------------------
+        # a crashed loop no longer bricks the engine: after the death path
+        # drains every waiter, the supervisor (_run's outer loop) rebuilds
+        # the device state and restarts, with exponential crash-loop
+        # backoff; more than max_crashes crashes inside crash_window_s
+        # opens the circuit (stay broken — something is systematically
+        # wrong and restart livelock would just burn the device)
+        self.supervise = bool(supervise)
+        self.restart_backoff_s = float(restart_backoff_s)
+        self.max_crashes = int(max_crashes)
+        self.crash_window_s = float(crash_window_s)
+        self._crash_times: list[float] = []
+        self._restarts = 0
+        self._state = "running"  # running | restarting | broken | stopped
+        self._closed_ev = threading.Event()  # interrupts the backoff sleep
+        # poison quarantine: fingerprint -> count of loop crashes that
+        # happened while dispatching THAT request's admission/fill work; at
+        # POISON_CRASHES the request is rejected at submit with 400 instead
+        # of being re-admitted into another crash
+        self._poison: dict[tuple, int] = {}
+        self._suspect_fp: tuple | None = None
         self.stats = {"chunks": 0, "admitted": 0, "active_peak": 0,
-                      "prefill_pieces": 0, "stall_ms_max": 0.0}
+                      "prefill_pieces": 0, "stall_ms_max": 0.0,
+                      "engine_restarts": 0, "shed": 0, "expired": 0}
+        # env-gated chaos drills (default off): MODELX_FAULT_PLAN schedules
+        # deterministic dispatch faults against the running engine
+        env_plan = _faults.from_env()
+        if env_plan is not None and env_plan.has("engine.dispatch"):
+            self._chunk = _faults.wrap_dispatch(self._chunk, env_plan)
         if self.prefill_chunk > 0:
             self.stats["prefill_chunk"] = self.prefill_chunk
             self.stats["fill_waits"] = 0  # page-blocked boundaries
@@ -397,8 +467,12 @@ class ContinuousBatcher:
             self.stats["paged_attention"] = (
                 "in-place" if self._fwd_paged is not None else "gather"
             )
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+
+    # a request is quarantined once this many loop crashes are attributed
+    # to dispatching its admission/fill work
+    POISON_CRASHES = 2
 
     # -- compiled programs ----------------------------------------------------
 
@@ -969,6 +1043,9 @@ class ContinuousBatcher:
         itself dies, every waiter gathered so far (plus this item's) is
         failed before the engine unwinds — their preps live only in the
         loop-local list, out of reach of the generic death failsafes."""
+        self._backlog_sub(1)  # leaving the not-yet-admitted set, whatever happens
+        fp = _fingerprint(item[0], item[1])  # computed once per request
+        self._suspect_fp = fp
         try:
             prep = self._prepare_admit(item)
         except BaseException as e:
@@ -976,7 +1053,9 @@ class ContinuousBatcher:
             for p in to_admit:
                 p["ticket"].out.put(e)
             raise
+        self._suspect_fp = None
         if prep is not None:
+            prep["fp"] = fp  # reused by the admit/fill dispatch attribution
             to_admit.append(prep)
 
     def _prepare_admit(self, item) -> dict | None:
@@ -992,6 +1071,13 @@ class ContinuousBatcher:
         ids, n, samp, ticket = item
         if ticket.cancelled:  # consumer left while the request queued
             ticket.out.put(_DONE)
+            return None
+        if ticket.deadline is not None and time.monotonic() > ticket.deadline:
+            # expired while queued: 504 BEFORE occupying a slot
+            self.stats["expired"] += 1
+            ticket.out.put(
+                DeadlineExceededError("waiting for a slot", self.request_timeout_s)
+            )
             return None
         slot = self._free.pop()
         s = len(ids)
@@ -1163,6 +1249,9 @@ class ContinuousBatcher:
 
     def _admit_one(self, prep: dict) -> None:
         ids, samp, slot, s = prep["ids"], prep["samp"], prep["slot"], prep["s"]
+        # this dispatch is attributable to ONE request: a loop death here
+        # counts against its poison-quarantine budget
+        self._suspect_fp = prep["fp"]
         hit = prep["hit"]
         prompt_pages = (
             jnp.asarray(prep["prompt_pages"])
@@ -1223,6 +1312,7 @@ class ContinuousBatcher:
         self._finish_admit_host(
             prep, lambda first=first: np.asarray(first).reshape(1, 1)
         )
+        self._suspect_fp = None
 
     # -- chunked prefill scheduling -------------------------------------------
 
@@ -1284,7 +1374,7 @@ class ContinuousBatcher:
                         self._cache, stored, jnp.int32(slot)
                     )
         fill = _Fill(slot, list(ids), prep["n"], dict(prep["samp"]),
-                     prep["ticket"], filled=plen)
+                     prep["ticket"], filled=plen, fp=prep.get("fp"))
         # the fill's offset is its KV frontier: decode chunks run over
         # every slot, so this keeps the slot's garbage writes beyond the
         # real prefix (the next piece overwrites them)
@@ -1345,6 +1435,10 @@ class ContinuousBatcher:
         """Dispatch one prefill piece (async). The last piece samples the
         row's first token and flips the slot from filling to decoding."""
         slot = fill.slot
+        # piece dispatches are attributable to the filling request (poison
+        # quarantine): a prompt that crashes the loop mid-fill must not be
+        # re-admitted forever
+        self._suspect_fp = fill.fp
         block = np.zeros((1, piece_len), np.int32)
         block[0, :take] = fill.ids[fill.filled: fill.filled + take]
         piece = jnp.asarray(block)
@@ -1377,6 +1471,7 @@ class ContinuousBatcher:
                     )
             fill.filled += take
             self._offsets[slot] = fill.filled
+            self._suspect_fp = None
             return
         samp = fill.samp
         # filters ride as arrays (0 / 1.0 = off): a one-shot program has
@@ -1417,6 +1512,7 @@ class ContinuousBatcher:
         self._finish_admit_host(
             prep, lambda first=first: np.asarray(first).reshape(1, 1)
         )
+        self._suspect_fp = None
         self._requeue_preempted()
 
     def _requeue_preempted(self) -> None:
@@ -1426,15 +1522,16 @@ class ContinuousBatcher:
             self._waiting[:0] = self._preempted
             self._preempted.clear()
 
-    def _drop_fill(self, slot: int) -> None:
-        """Retire a filling row whose consumer is gone: end its stream
-        (_DONE) and free the slot and pages; nothing was emitted, so
-        nothing else unwinds. The single cancelled-fill retirement path —
-        the sweep, the piece scheduler, and the preempt guard all route
-        here so the semantics can't diverge."""
+    def _drop_fill(self, slot: int, err: BaseException | None = None) -> None:
+        """Retire a filling row early: end its stream (_DONE for a gone
+        consumer, ``err`` for a deadline expiry) and free the slot and
+        pages; nothing was emitted, so nothing else unwinds. The single
+        early-fill-retirement path — the sweep, the piece scheduler, the
+        preempt guard, and deadline expiry all route here so the
+        semantics can't diverge."""
         fill = self._filling.pop(slot, None)
         if fill is not None:
-            fill.ticket.out.put(_DONE)
+            fill.ticket.out.put(_DONE if err is None else err)
         if slot in self._fill_order:
             self._fill_order.remove(slot)
         self._release_slot(slot)
@@ -1468,6 +1565,7 @@ class ContinuousBatcher:
         self._release_slot(slot)
         self.stats["fill_preempts"] += 1
         self._preempted.append((fill.ids, fill.n, fill.samp, fill.ticket))
+        self._backlog_add(1)  # back in the not-yet-admitted set
 
     def _dispatch_chunk(self) -> tuple:
         """Dispatch one chunk (async) and PLAN its emissions now. Take
@@ -1586,11 +1684,64 @@ class ContinuousBatcher:
             if done:
                 row.out.put(_DONE)
 
+    @staticmethod
+    def _deadline_passed(ticket: _Ticket, now: float) -> bool:
+        return (not ticket.cancelled and ticket.deadline is not None
+                and now > ticket.deadline)
+
+    def _sweep_backlog(self) -> None:
+        """Purge dead backlog entries at EVERY boundary, deadline knob or
+        not: cancelled rows (the client is gone — their corpses must not
+        occupy --max-queue-depth budget and shed live traffic with 429s)
+        end with _DONE, past-deadline rows with the 504 error — both
+        without ever taking a slot."""
+        now = time.monotonic()
+        for lst, state in ((self._waiting, "waiting for a slot"),
+                           (self._preempted, "waiting for pages")):
+            keep = []
+            for item in lst:
+                ticket = item[3]
+                if ticket.cancelled:
+                    self._backlog_sub(1)
+                    ticket.out.put(_DONE)
+                elif self._deadline_passed(ticket, now):
+                    self.stats["expired"] += 1
+                    self._backlog_sub(1)
+                    ticket.out.put(
+                        DeadlineExceededError(state, self.request_timeout_s)
+                    )
+                else:
+                    keep.append(item)
+            lst[:] = keep
+
+    def _expire_deadlines(self) -> None:
+        """Expire past-deadline ADMITTED requests at the chunk boundary:
+        filling rows release their slot and pages (nothing was emitted),
+        decoding rows fail mid-stream and their slot frees at this sweep.
+        Overload turns into fast, observable 504s instead of requests that
+        finish long after their caller gave up."""
+        now = time.monotonic()
+        for slot, fill in list(self._filling.items()):
+            if self._deadline_passed(fill.ticket, now):
+                self.stats["expired"] += 1
+                self._drop_fill(
+                    slot, DeadlineExceededError("prefilling", self.request_timeout_s)
+                )
+        for row in self._rows.values():
+            if not row.closed and self._deadline_passed(row.ticket, now):
+                self.stats["expired"] += 1
+                row.out.put(
+                    DeadlineExceededError("decoding", self.request_timeout_s)
+                )
+                row.closed = True  # the sweep below frees the slot
+
     def _sweep_closed(self) -> None:
         """Free the slots of rows a stop token ended at delivery time or a
         client abandoned (ticket.cancelled) — BEFORE admission and the next
         dispatch, so a waiting request takes the slot immediately and no
         dead-row chunk is dispatched."""
+        self._sweep_backlog()
+        self._expire_deadlines()
         for slot, row in list(self._rows.items()):
             if row.ticket.cancelled and not row.closed:
                 row.out.put(_DONE)  # unblock any racing drain
@@ -1603,7 +1754,80 @@ class ContinuousBatcher:
                 # was emitted, so the slot and pages just free
                 self._drop_fill(slot)
 
-    def _loop(self) -> None:
+    def _run(self) -> None:
+        """The engine thread: run the loop, and — supervision — restart it
+        after a crash. ``_loop`` itself drains every waiter on death (no
+        request ever hangs); this outer loop decides whether the engine
+        comes back: exponential crash-loop backoff between restarts, and a
+        circuit breaker (``max_crashes`` within ``crash_window_s``) that
+        leaves the engine broken when restarting clearly isn't helping."""
+        while True:
+            verdict = self._loop()
+            if verdict != "crashed":
+                return
+            # backoff grows with the number of recent crashes: one isolated
+            # crash restarts almost immediately, a crash loop slows down
+            delay = self.restart_backoff_s * (2 ** max(0, len(self._crash_times) - 1))
+            self._closed_ev.wait(delay)
+            with self._close_lock:
+                bail = self._closed
+                if bail and self._broken is None:
+                    self._broken = EngineBrokenError("closed during restart")
+            if bail:
+                # requests enqueued during the backoff must not hang
+                self._drain_queue(EngineBrokenError("continuous batcher closed"))
+                self._state = "stopped"
+                return
+            self._rebuild()
+            with self._close_lock:
+                self._restarts += 1
+                self.stats["engine_restarts"] = self._restarts
+                self._state = "running"
+            logging.getLogger("modelx.serve").warning(
+                "continuous engine restarted (restart #%d)", self._restarts
+            )
+
+    def _rebuild(self) -> None:
+        """Fresh engine state after a crash: new KV cache (or page pool),
+        zeroed host vectors, every slot free. The compiled programs are
+        pure functions of their inputs and are REUSED — restart cost is one
+        cache allocation, not a recompile. The prefix cache is preserved:
+        its entries are keyed by token prefix and independent of slot
+        state, so multi-turn conversations keep their fast path across a
+        restart."""
+        if self.page_size > 0:
+            self._free_pages = list(range(1, self.num_pages))
+            self._table = np.zeros(
+                (self.max_slots, self._pages_per_slot), np.int32
+            )
+            self._row_pages = {}
+            self._cache = jax.tree_util.tree_map(
+                lambda leaf: jnp.zeros(
+                    (self.num_pages, self.page_size) + leaf.shape[2:], leaf.dtype
+                ),
+                self._init_cache(1, self.page_size),
+            )
+            self.stats["pages_free"] = len(self._free_pages)
+        else:
+            self._cache = self._init_cache(self.max_slots, self.max_len)
+        self._tok = jnp.zeros((self.max_slots, 1), jnp.int32)
+        self._offsets[:] = 0
+        self._steps[:] = 0
+        self._temp[:] = 0.0
+        self._top_k[:] = 0
+        self._top_p[:] = 1.0
+        self._seeds[:] = 0
+        self._use_filters[:] = False
+        self._rows = {}
+        self._free = list(range(self.max_slots))
+        self._first_pending = []
+        self._filling = {}
+        self._fill_order = []
+        self._preempted = []
+        self._suspect_fp = None
+        self._last_chunk_t = None
+
+    def _loop(self) -> str:
         from collections import deque
 
         pending: "deque[tuple]" = deque()  # in-flight chunks, oldest first
@@ -1671,7 +1895,8 @@ class ContinuousBatcher:
                             self._deliver(pending[0])
                             pending.popleft()
                         self._fail_active(err)
-                        return
+                        self._state = "stopped"
+                        return "closed"
                     if not self._admits_now(item):
                         # no slot (or, paged, not enough free pages): hold in
                         # the FIFO backlog and decode on — a retire this
@@ -1728,13 +1953,47 @@ class ContinuousBatcher:
                     self._deliver(pending[0])
                     pending.popleft()
         except BaseException as e:  # engine death must not hang waiters
+            logging.getLogger("modelx.serve").exception(
+                "continuous engine loop died"
+            )
+            now = time.monotonic()
+            err = (
+                e if isinstance(e, ServingError)
+                else EngineBrokenError(f"engine loop died: {e!r}")
+            )
+            if err is not e:
+                err.__cause__ = e
             with self._close_lock:
-                # under the lock: submit_row checks _broken inside the same
-                # lock before enqueueing, so no request can slip into the
-                # queue after the drain below and hang forever
-                self._broken = e
-            self._deliver_failsafe(pending, e)
-            self._fail_active(e)
+                # circuit breaker: crashes inside the window beyond the
+                # budget mean restarting isn't helping — stay broken so
+                # /healthz flips and the orchestrator replaces the pod.
+                # Decided (and _broken published) under the SAME lock
+                # submit checks, so no request can slip into the queue
+                # after the broken drain below and hang forever.
+                self._crash_times = [
+                    t for t in self._crash_times if now - t < self.crash_window_s
+                ]
+                self._crash_times.append(now)
+                broken = (
+                    not self.supervise
+                    or self._closed
+                    or len(self._crash_times) > self.max_crashes
+                )
+                if broken:
+                    self._broken = err
+                    self._state = "broken"
+                else:
+                    self._state = "restarting"
+            if self._suspect_fp is not None:
+                # the death happened while dispatching ONE request's
+                # admission/fill work: charge its quarantine budget
+                self._poison[self._suspect_fp] = (
+                    self._poison.get(self._suspect_fp, 0) + 1
+                )
+                self._suspect_fp = None
+            self._deliver_failsafe(pending, err)
+            self._fail_active(err, drain_queue=broken)
+            return "broken" if broken else "crashed"
 
     def _deliver_failsafe(self, pending, err: BaseException) -> None:
         """On engine death, rows in an undelivered plan (or with undelivered
@@ -1747,7 +2006,30 @@ class ContinuousBatcher:
             for _slot, row, _skip, _take, _done in plan:
                 row.out.put(err)
 
-    def _fail_active(self, err: BaseException) -> None:
+    def _backlog_add(self, n: int) -> None:
+        with self._close_lock:
+            self._backlog += n
+
+    def _backlog_sub(self, n: int) -> None:
+        with self._close_lock:
+            self._backlog = max(0, self._backlog - n)
+
+    def _drain_queue(self, err: BaseException) -> None:
+        """Fail every row still sitting in the submit queue (crash, close,
+        or closed-during-restart paths)."""
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return
+            if item is None:
+                continue
+            rows = item if isinstance(item, list) else [item]
+            self._backlog_sub(len(rows))
+            for row_item in rows:
+                row_item[3].out.put(err)
+
+    def _fail_active(self, err: BaseException, drain_queue: bool = True) -> None:
         for row in self._rows.values():
             row.out.put(err)
         self._rows.clear()
@@ -1757,19 +2039,18 @@ class ContinuousBatcher:
         self._fill_order.clear()
         for item in self._preempted:  # parked fills too
             item[3].out.put(err)
+        self._backlog_sub(len(self._preempted))
         self._preempted.clear()
         for item in self._waiting:  # FIFO backlog items have waiters too
             item[3].out.put(err)
+        self._backlog_sub(len(self._waiting))
         self._waiting.clear()
-        while True:
-            try:
-                item = self._q.get_nowait()
-            except queue.Empty:
-                return
-            if item is None:
-                continue
-            for row_item in item if isinstance(item, list) else [item]:
-                row_item[3].out.put(err)
+        if drain_queue:
+            # broken/close: nothing will ever serve the queue — fail it.
+            # A supervised restart SKIPS this: queued rows were never
+            # touched by the engine, so they survive intact and admit
+            # normally once the rebuilt loop comes back up.
+            self._drain_queue(err)
 
     # -- public API -----------------------------------------------------------
 
@@ -1783,7 +2064,23 @@ class ContinuousBatcher:
         snap["active"] = len(self._rows)
         snap["filling"] = len(self._filling)
         snap["waiting"] = len(self._waiting) + len(self._preempted)
+        # supervision + bounded-admission surface: the operator's view of
+        # the self-healing layer (engine_restarts rides in from stats)
+        snap["engine_state"] = self._state
+        snap["quarantined"] = sum(
+            1 for c in self._poison.values() if c >= self.POISON_CRASHES
+        )
+        snap["queue_depth"] = self._backlog
+        if self.max_queue_depth > 0:
+            snap["max_queue_depth"] = self.max_queue_depth
+        if self.request_timeout_s > 0:
+            snap["request_timeout_s"] = self.request_timeout_s
         return snap
+
+    @property
+    def engine_state(self) -> str:
+        """running | restarting | broken | stopped — what /healthz reads."""
+        return self._state
 
     def _validate(self, ids: list[int], max_new_tokens: int) -> None:
         s = len(ids)
@@ -1805,7 +2102,14 @@ class ContinuousBatcher:
                 f"({self.num_pages - 1} x {self.page_size} tokens)"
             )
 
-    def _enqueue(self, payload) -> None:
+    def _check_quarantine(self, ids, n: int) -> None:
+        if not self._poison:
+            return  # the universal case: no crash ever attributed — free
+        crashes = self._poison.get(_fingerprint(ids, n), 0)
+        if crashes >= self.POISON_CRASHES:
+            raise PoisonedRequestError(crashes)
+
+    def _enqueue(self, payload, rows: int) -> None:
         with self._close_lock:
             if self._closed:
                 raise RuntimeError("continuous batcher closed")
@@ -1813,16 +2117,34 @@ class ContinuousBatcher:
                 # checked under the SAME lock the dying engine takes before
                 # its final queue drain — a put here either precedes the
                 # drain (and gets failed by it) or raises
-                raise RuntimeError("continuous batcher is broken") from self._broken
+                raise EngineBrokenError(
+                    f"continuous batcher is broken: {self._broken}"
+                ) from self._broken
+            if (self.max_queue_depth > 0
+                    and self._backlog + rows > self.max_queue_depth):
+                # bounded admission: shed NOW (429 + Retry-After) — the
+                # backlog must never grow without bound under overload
+                self.stats["shed"] += rows
+                raise QueueFullError(
+                    self._backlog, self.max_queue_depth,
+                    retry_after=1 + self._backlog // max(1, self.max_slots),
+                )
+            self._backlog += rows
             self._q.put(payload)
+
+    def _stamp_deadline(self, ticket: _Ticket) -> None:
+        if self.request_timeout_s > 0:
+            ticket.deadline = time.monotonic() + self.request_timeout_s
 
     def submit(self, ids: list[int], max_new_tokens: int, samp: dict) -> _Ticket:
         """Enqueue one prompt row; the returned ticket carries the output
         queue and a ``cancel()`` the transport calls when its client goes
         away (the engine then frees the slot at the next chunk boundary)."""
         self._validate(ids, max_new_tokens)
+        self._check_quarantine(ids, max_new_tokens)
         ticket = _Ticket()
-        self._enqueue((list(ids), int(max_new_tokens), dict(samp), ticket))
+        self._stamp_deadline(ticket)
+        self._enqueue((list(ids), int(max_new_tokens), dict(samp), ticket), 1)
         return ticket
 
     def submit_many(self, rows: list[tuple[list[int], int, dict]]) -> list[_Ticket]:
@@ -1832,11 +2154,14 @@ class ContinuousBatcher:
         thread for that grouping). Used by multi-row ``generate``."""
         for ids, n, _samp in rows:
             self._validate(ids, n)
+            self._check_quarantine(ids, n)
         tickets = [_Ticket() for _ in rows]
+        for t in tickets:
+            self._stamp_deadline(t)
         self._enqueue([
             (list(ids), int(n), dict(samp), t)
             for (ids, n, samp), t in zip(rows, tickets)
-        ])
+        ], len(rows))
         return tickets
 
     def submit_row(self, ids: list[int], max_new_tokens: int, samp: dict) -> "queue.Queue":
@@ -1847,8 +2172,15 @@ class ContinuousBatcher:
             item = out.get()
             if item is _DONE:
                 return
+            if isinstance(item, ServingError):
+                # typed failures (engine death, deadline, shed) surface
+                # as-is: one exception class = one HTTP mapping, identical
+                # between the streaming and non-streaming paths
+                raise item
             if isinstance(item, BaseException):
-                raise RuntimeError("continuous decode failed") from item
+                raise EngineBrokenError(
+                    f"continuous decode failed: {item}"
+                ) from item
             yield item
 
     def generate(self, tokens: np.ndarray, max_new_tokens: int = 16,
@@ -1923,4 +2255,5 @@ class ContinuousBatcher:
                 return
             self._closed = True
             self._q.put(None)
+        self._closed_ev.set()  # interrupt any restart-backoff sleep
         self._thread.join(timeout=30)

@@ -253,7 +253,7 @@ def auto_fetch_concurrency(source) -> int:
     HTTP: threads block on sockets (native path holds no GIL), so width
     buys round-trip overlap — 4/core in [8, 16]."""
     cpu = os.cpu_count() or 1
-    if isinstance(source, LocalFileSource):
+    if isinstance(getattr(source, "_source", source), LocalFileSource):
         return max(2, min(8, 2 * cpu))
     return max(8, min(16, 4 * cpu))
 
@@ -699,6 +699,14 @@ def load_safetensors(
     local files never split (pread has no per-stream ceiling to beat).
     """
     t0 = time.monotonic()
+    # env-gated chaos drills (default off): MODELX_FAULT_PLAN with a
+    # "loader.read" schedule wraps the source so operators can rehearse the
+    # retry/governor behavior against a real deployment on demand
+    from modelx_tpu.testing import faults as _faults
+
+    _env_plan = _faults.from_env()
+    if _env_plan is not None and _env_plan.has("loader.read"):
+        source = _faults.FaultyByteSource(source, _env_plan)
     if tensors is None or data_offset is None:
         head = bytes(_read_with_retry(source, 0, 8))
         import struct
@@ -719,7 +727,11 @@ def load_safetensors(
     # above 24 MB/s (the r5 capture left 56% of the link idle at width 2);
     # local sources may regrow only back to the auto width, and only while
     # per-thread reads run at healthy page-cache rates (4x the floor).
-    is_local = isinstance(source, LocalFileSource)
+    # unwrap a fault-injection wrapper for the policy check: injected
+    # faults must not silently flip the governor to the remote profile
+    is_local = isinstance(
+        getattr(source, "_source", source), LocalFileSource
+    )
     governor = _FetchGovernor(
         concurrency,
         floor_bps=32e6 if is_local else 0.0,

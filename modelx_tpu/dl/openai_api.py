@@ -62,6 +62,13 @@ class APIError(Exception):
         }
 
 
+def api_error_for(e) -> APIError:
+    """ONE OpenAI payload per typed serving failure (dl/serving_errors.py):
+    the exception's canonical status + api_type, identical between the
+    streaming and non-streaming paths."""
+    return APIError(e.http_status, str(e), e.api_type)
+
+
 def resolve_model(sset, req: dict):
     """The ``model`` field picks the sidecar tenant; absent = default."""
     name = req.get("model") or sset.default

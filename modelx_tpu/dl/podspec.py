@@ -69,6 +69,18 @@ def generate_pod_spec(
                         "httpGet": {"path": "/healthz", "port": 8000},
                         "initialDelaySeconds": 5,
                     },
+                    # liveness is a SEPARATE, stricter probe: /livez fails
+                    # only when the serving engine is circuit-broken
+                    # (unrecoverable — restart the pod); /healthz 503s for
+                    # recoverable states too (loading, draining, supervised
+                    # engine restart), which must drain traffic, not kill
+                    # the container
+                    "livenessProbe": {
+                        "httpGet": {"path": "/livez", "port": 8000},
+                        "initialDelaySeconds": 30,
+                        "periodSeconds": 10,
+                        "failureThreshold": 3,
+                    },
                 }
             ],
             "volumes": [{"name": "model", "emptyDir": {"sizeLimit": volume_size}}],

@@ -5,7 +5,10 @@ north_star). Loads one or more checkpoints (multi-tenant: BASELINE config #5
 is concurrent pull+serve of 4 models) onto a mesh, compiles the
 forward/decode functions, and serves:
 
-    GET  /healthz               readiness (200 once every model is compiled)
+    GET  /healthz               readiness (200 once every model is compiled;
+                                503 while loading/draining/engine-restarting)
+    GET  /livez                 liveness (503 only when the serving engine is
+                                circuit-broken -> k8s restarts the pod)
     GET  /metrics               load + inference counters (all models)
     GET  /v1/models             model inventory + per-model stats
     GET  /v1/trace              span summary (utils/trace.py)
@@ -47,6 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from modelx_tpu.dl import families as fam
+from modelx_tpu.dl.serving_errors import ServingError
 from modelx_tpu.parallel.mesh import make_mesh
 from modelx_tpu.utils import trace
 
@@ -982,7 +986,9 @@ class ServerSet:
                  pipeline_depth: int = 2,
                  burst_window_ms: float = 1.0,
                  prefill_chunk: int = 0,
-                 prefill_budget: int = 0) -> None:
+                 prefill_budget: int = 0,
+                 max_queue_depth: int = 0,
+                 request_timeout_s: float = 0.0) -> None:
         if not servers:
             raise ValueError("no models")
         self.max_new_tokens_limit = max_new_tokens_limit
@@ -1016,6 +1022,11 @@ class ServerSet:
         # the per-boundary prefill tokens once decode rows have spent
         self.prefill_chunk = prefill_chunk
         self.prefill_budget = prefill_budget
+        # bounded admission + deadlines for the continuous engine: submits
+        # past max_queue_depth shed with 429 + Retry-After; requests older
+        # than request_timeout_s expire with 504 at chunk boundaries
+        self.max_queue_depth = max_queue_depth
+        self.request_timeout_s = request_timeout_s
         self.max_batch = max_batch
         self.batch_window_ms = batch_window_ms
         self.stream_chunk_size = stream_chunk_size
@@ -1104,9 +1115,25 @@ class ServerSet:
                     burst_window_ms=self.burst_window_ms,
                     prefill_chunk=self.prefill_chunk,
                     prefill_budget=self.prefill_budget,
+                    max_queue_depth=self.max_queue_depth,
+                    request_timeout_s=self.request_timeout_s,
                 )
                 self.cbatchers[server.name] = cb
         return cb
+
+    def engine_health(self) -> str | None:
+        """Worst continuous-engine state across tenants, or None when every
+        engine is healthy: "engine-broken" (circuit open — the pod needs a
+        restart) beats "engine-restarting" (the supervisor is mid-backoff;
+        load balancers should drain until it comes back)."""
+        worst = None
+        for cb in list(self.cbatchers.values()):
+            state = getattr(cb, "engine_state", "running")
+            if state == "broken":
+                return "engine-broken"
+            if state == "restarting":
+                worst = "engine-restarting"
+        return worst
 
     def engine_for(self, server: ModelServer, n_rows: int, temperature: float):
         """THE generate-routing policy, in one place: continuous batching
@@ -1197,11 +1224,13 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
         def log_message(self, *a):
             pass
 
-        def _json(self, status: int, obj) -> None:
+        def _json(self, status: int, obj, headers: dict | None = None) -> None:
             body = json.dumps(obj).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():  # e.g. Retry-After on 429
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -1248,6 +1277,12 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                 first = next(gen, None)
             except ValueError as e:
                 return self._json(400, {"error": str(e)})
+            except ServingError as e:
+                # typed serving failures (queue full / deadline / engine
+                # broken) carry their canonical status + headers — a shed
+                # stream request still gets its 429 + Retry-After
+                return self._json(e.http_status, {"error": str(e)},
+                                  headers=e.headers())
 
             def payloads():
                 if first is not None:
@@ -1287,8 +1322,14 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
 
                     return self._stream_chunks(
                         "text/event-stream", payloads(),
+                        # mid-stream failures: typed serving errors keep
+                        # their one canonical payload even after the 200
+                        # is on the wire (a deadline expiry mid-SSE reads
+                        # the same as a pre-stream 504 body)
                         lambda e: oai.sse_encode(
-                            {"error": {"message": str(e), "type": "server_error"}}
+                            oai.api_error_for(e).payload
+                            if isinstance(e, ServingError)
+                            else {"error": {"message": str(e), "type": "server_error"}}
                         ),
                     )
                 return self._json(200, oai.run_completion(sset, req, chat))
@@ -1296,16 +1337,39 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                 return self._json(e.status, e.payload)
             except ValueError as e:
                 return self._json(400, oai.APIError(400, str(e)).payload)
+            except ServingError as e:
+                # one OpenAI-shaped payload per typed failure class: 429
+                # sheds carry Retry-After, deadlines 504, engine death 503
+                api = oai.api_error_for(e)
+                return self._json(api.status, api.payload, headers=e.headers())
             except Exception as e:
                 logger.exception("openai api error")
                 return self._json(500, oai.APIError(500, str(e), "server_error").payload)
 
         def do_GET(self):
             if self.path == "/healthz":
-                if sset.ready:
+                engine = sset.engine_health()
+                if engine is not None:
+                    # a crash-looping or circuit-broken engine must flip
+                    # readiness so load balancers drain instead of routing
+                    # every request into a dead engine
+                    self._json(503, {"status": engine})
+                elif sset.ready:
                     self._json(200, {"status": "ok"})
                 else:
                     self._json(503, {"status": "draining" if sset.draining else "loading"})
+            elif self.path == "/livez":
+                # liveness, distinct from readiness: fails ONLY on the
+                # unrecoverable engine-broken state (circuit open), so the
+                # podspec livenessProbe restarts the pod — the blob cache +
+                # compile cache make that restart cheap. Loading, draining,
+                # and supervised restarting are all ALIVE (killing a pod
+                # mid-load/drain/backoff would turn recoverable states into
+                # restart loops).
+                if sset.engine_health() == "engine-broken":
+                    self._json(503, {"status": "engine-broken"})
+                else:
+                    self._json(200, {"status": "ok"})
             elif self.path == "/metrics":
                 payload = {}
                 for n, s in sset.servers.items():
@@ -1535,6 +1599,11 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                     self._json(200, resp)
             except ValueError as e:  # e.g. generate on a non-generative family
                 self._json(400, {"error": str(e)})
+            except ServingError as e:
+                # typed serving failures carry their canonical status:
+                # 429 (queue full, + Retry-After), 504 (deadline),
+                # 503 (engine broken/restarting), 400 (quarantined)
+                self._json(e.http_status, {"error": str(e)}, headers=e.headers())
             except Exception as e:  # surface inference errors as 500 JSON
                 logger.exception("inference error")
                 self._json(500, {"error": str(e)})

@@ -91,6 +91,16 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
                    "rows spend chunk_size each first, prefill pieces pack "
                    "into the remainder (the head piece always lands; "
                    "0 = one piece per filling row per boundary)")
+@click.option("--max-queue-depth", default=0, type=int,
+              help="continuous batching: bound the admission backlog — a "
+                   "submit past this many not-yet-admitted rows is shed "
+                   "with 429 + Retry-After instead of queueing into "
+                   "unbounded latency (0 = unbounded)")
+@click.option("--request-timeout", default=0.0, type=float,
+              help="continuous batching: per-request deadline in seconds — "
+                   "a request older than this expires with 504 at the next "
+                   "chunk boundary, whether it is still queued, prefilling, "
+                   "or decoding (0 = no deadline)")
 @click.option("--prefix-cache", default=0, type=int,
               help="keep the prefill KV of the last N single-row stream "
                    "prompts on device: multi-turn chats that re-send their "
@@ -120,6 +130,7 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
          max_batch: int, batch_window_ms: float, stream_chunk_size: int,
          pipeline_depth: int, burst_window_ms: float,
          prefill_chunk: int, prefill_budget: int,
+         max_queue_depth: int, request_timeout: float,
          prefix_cache: int, prefix_cache_max_bytes: int,
          quantize: str | None, speculative_k: int,
          loras: tuple[str, ...], drain_seconds: float) -> None:
@@ -186,6 +197,12 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
             "--prefill-chunk is inert without --continuous-batch "
             "(chunked prefill is the continuous engine's admission policy)"
         )
+    if (max_queue_depth or request_timeout) and not continuous_batch:
+        logging.getLogger("modelx.serve").warning(
+            "--max-queue-depth/--request-timeout are inert without "
+            "--continuous-batch (bounded admission is the continuous "
+            "engine's submit policy)"
+        )
     if prefix_cache and speculative_k and not continuous_batch:
         # the speculative decoder owns single-row streams before the
         # ChunkedDecoder (the prefix cache's stream seam) is consulted;
@@ -203,7 +220,9 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
                      kv_attention=kv_attention, pipeline_depth=pipeline_depth,
                      burst_window_ms=burst_window_ms,
                      prefill_chunk=prefill_chunk,
-                     prefill_budget=prefill_budget)
+                     prefill_budget=prefill_budget,
+                     max_queue_depth=max_queue_depth,
+                     request_timeout_s=request_timeout)
     httpd = serve(sset, listen=listen)  # starts serving 503s while loading
     stats = sset.load_all(concurrent=concurrent_load)
     logging.getLogger("modelx.serve").info("models loaded: %s", stats)

@@ -1,0 +1,89 @@
+"""Typed serving-path errors with one HTTP mapping.
+
+The engine, the native /v1 handlers, and the OpenAI veneer all need to
+agree on what an overloaded queue, an expired deadline, or a dead engine
+looks like on the wire. Before this module, raw ``BaseException`` objects
+flowed through ``row.out.put(err)`` and surfaced differently between the
+streaming and non-streaming paths; now every failure class is one typed
+exception carrying its canonical status:
+
+- ``QueueFullError``  -> 429 + ``Retry-After`` (bounded admission shed)
+- ``DeadlineExceededError`` -> 504 (request expired before/while decoding)
+- ``PoisonedRequestError``  -> 400 (quarantined: this request crashed the
+  engine loop repeatedly; re-admitting it would crash-loop the server)
+- ``EngineBrokenError``     -> 503 (the engine died mid-flight; the
+  supervisor may be restarting it — retryable, unlike a 500)
+
+Kept dependency-free (no jax, no requests) so the transport layer can
+import it at module top without cost.
+"""
+
+from __future__ import annotations
+
+
+class ServingError(RuntimeError):
+    """Base for typed serving failures; ``http_status`` is the canonical
+    mapping every transport (native JSON + OpenAI SSE) uses."""
+
+    http_status = 500
+    api_type = "server_error"  # OpenAI error.type
+
+    def headers(self) -> dict[str, str]:
+        return {}
+
+
+class QueueFullError(ServingError):
+    """Admission backlog is at --max-queue-depth: shed NOW with 429 so the
+    client backs off, instead of queueing into unbounded latency."""
+
+    http_status = 429
+    api_type = "rate_limit_error"
+
+    def __init__(self, depth: int, limit: int, retry_after: float = 1.0) -> None:
+        super().__init__(
+            f"admission queue full ({depth} waiting, limit {limit}); retry later"
+        )
+        self.retry_after = max(1, int(retry_after))
+
+    def headers(self) -> dict[str, str]:
+        return {"Retry-After": str(self.retry_after)}
+
+
+class DeadlineExceededError(ServingError):
+    """The request sat past --request-timeout (queued, filling, or
+    decoding); it was expired at a chunk boundary instead of occupying a
+    slot the backlog needs."""
+
+    http_status = 504
+
+    def __init__(self, state: str, timeout_s: float) -> None:
+        super().__init__(
+            f"request deadline exceeded while {state} "
+            f"(--request-timeout {timeout_s:g}s)"
+        )
+        self.state = state
+
+
+class PoisonedRequestError(ServingError):
+    """This exact request crashed the engine loop repeatedly; it is
+    quarantined and rejected up front — re-admitting it would turn one bad
+    request into a restart livelock."""
+
+    http_status = 400
+    api_type = "invalid_request_error"
+
+    def __init__(self, crashes: int) -> None:
+        super().__init__(
+            f"request quarantined: it crashed the engine {crashes} times"
+        )
+
+
+class EngineBrokenError(ServingError):
+    """The engine loop died while this request was in flight (or the
+    circuit breaker opened). 503: the supervisor restarts the engine, so
+    a retry against this pod (or another) is the right client move."""
+
+    http_status = 503
+
+    def __init__(self, message: str = "serving engine failed") -> None:
+        super().__init__(message)

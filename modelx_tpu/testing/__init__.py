@@ -1,0 +1,3 @@
+"""Deterministic test harnesses shipped with the package (fault injection
+lives here so env-gated production chaos drills and the test suite share
+one implementation)."""

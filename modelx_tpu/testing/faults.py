@@ -1,0 +1,228 @@
+"""Deterministic fault injection for the storage and serving paths.
+
+The codebase already treats transient faults as EXPECTED on the storage
+path (loader retry x3, governor backoff, extension retries) and — with
+engine supervision — on the serving path too. This module makes those
+faults reproducible on demand: a seeded ``FaultPlan`` holds per-operation
+schedules (error / latency / truncation / short-read), and thin wrappers
+apply them to the three seams the framework exposes:
+
+- ``FaultyByteSource`` wraps any loader ``ByteSource`` (dl/loader.py);
+- ``wrap_dispatch`` wraps a compiled engine program (the continuous
+  engine's chunk/admit dispatches) so a crash lands at an exact call index;
+- ``tests/fake_s3.py`` / ``tests/fake_gcs.py`` accept a plan directly
+  (server-side 500s and mid-body truncation for blob-store traffic).
+
+Determinism: schedules are either explicit call indices (``errors_at``)
+or drawn once per op from ``random.Random(seed ^ crc(op))`` at rule-add
+time (``error_rate``) — the Nth call to an op always sees the same
+verdict, independent of wall clock or thread interleaving (a lock orders
+the counter).
+
+Production use is ENV-GATED and default OFF: ``MODELX_FAULT_PLAN`` holds
+inline JSON (or ``@/path/to/plan.json``) and ``from_env()`` returns None
+unless it is set — the engine and loader consult it at construction, so
+an unset env costs one getenv. Example:
+
+    MODELX_FAULT_PLAN='{"seed": 7, "rules": [
+        {"op": "engine.dispatch", "errors_at": [100], "error": "chaos"}]}'
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+import zlib
+
+ENV_VAR = "MODELX_FAULT_PLAN"
+
+
+class Action:
+    """What one call to ``fire(op)`` must do: sleep ``latency_s``, then
+    raise ``error`` (if set); ``keep_bytes`` (when >= 0) tells byte-moving
+    wrappers to truncate / short-read the payload instead."""
+
+    __slots__ = ("error", "latency_s", "keep_bytes")
+
+    def __init__(self) -> None:
+        self.error: BaseException | None = None
+        self.latency_s = 0.0
+        self.keep_bytes = -1
+
+    @property
+    def clean(self) -> bool:
+        return self.error is None and not self.latency_s and self.keep_bytes < 0
+
+
+class _Rule:
+    __slots__ = ("errors_at", "error", "latency_at", "latency_s",
+                 "truncate_at", "keep_bytes")
+
+    def __init__(self, errors_at, error, latency_at, latency_s,
+                 truncate_at, keep_bytes) -> None:
+        self.errors_at = frozenset(errors_at)
+        self.error = error
+        self.latency_at = frozenset(latency_at)
+        self.latency_s = latency_s
+        self.truncate_at = frozenset(truncate_at)
+        self.keep_bytes = keep_bytes
+
+
+def _freshen(err: BaseException) -> BaseException:
+    """A fresh exception per raise: re-raising one instance accumulates
+    tracebacks and couples unrelated call sites."""
+    try:
+        return type(err)(*err.args)
+    except Exception:
+        return RuntimeError(f"injected fault: {err}")
+
+
+class FaultPlan:
+    """Seeded, deterministic per-operation fault schedules. Thread-safe:
+    ops are counted under a lock, so the Nth call to an op sees the same
+    verdict whatever the thread interleaving."""
+
+    def __init__(self, seed: int = 0) -> None:
+        self.seed = int(seed)
+        self._rules: dict[str, list[_Rule]] = {}
+        self._counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -- schedule construction ------------------------------------------------
+
+    def add(self, op: str, *, errors_at=(), error: BaseException | None = None,
+            error_rate: float = 0.0, horizon: int = 256,
+            latency_at=(), latency_s: float = 0.0,
+            truncate_at=(), keep_bytes: int = 0) -> "FaultPlan":
+        """Add one rule for ``op``. ``errors_at``/``latency_at``/
+        ``truncate_at`` are 0-based call indices; ``error_rate`` draws a
+        deterministic error schedule over the first ``horizon`` calls from
+        the plan's seed (the same (seed, op, rate) always yields the same
+        indices). Returns self for chaining."""
+        errors_at = set(errors_at)
+        if error_rate > 0.0:
+            rng = random.Random(self.seed ^ zlib.crc32(op.encode()))
+            errors_at |= {i for i in range(horizon) if rng.random() < error_rate}
+        rule = _Rule(errors_at, error or OSError(f"injected fault on {op}"),
+                     latency_at, float(latency_s), truncate_at, int(keep_bytes))
+        with self._lock:
+            self._rules.setdefault(op, []).append(rule)
+        return self
+
+    def has(self, op: str) -> bool:
+        return op in self._rules
+
+    def count(self, op: str) -> int:
+        """Calls to ``op`` so far (observability for tests/drills)."""
+        with self._lock:
+            return self._counts.get(op, 0)
+
+    # -- firing ---------------------------------------------------------------
+
+    def fire(self, op: str) -> Action:
+        """Count one call to ``op`` and return its scheduled action."""
+        with self._lock:
+            i = self._counts.get(op, 0)
+            self._counts[op] = i + 1
+            act = Action()
+            for rule in self._rules.get(op, ()):
+                if i in rule.latency_at:
+                    act.latency_s = max(act.latency_s, rule.latency_s)
+                if i in rule.truncate_at:
+                    act.keep_bytes = rule.keep_bytes
+                if i in rule.errors_at and act.error is None:
+                    act.error = _freshen(rule.error)
+            return act
+
+    def maybe_fail(self, op: str) -> None:
+        """Apply ``op``'s scheduled latency + error (the wrapper shape for
+        call-through seams like engine dispatch)."""
+        act = self.fire(op)
+        if act.latency_s:
+            time.sleep(act.latency_s)
+        if act.error is not None:
+            raise act.error
+
+
+# -- seam wrappers -------------------------------------------------------------
+
+
+def wrap_dispatch(fn, plan: FaultPlan, op: str = "engine.dispatch"):
+    """Wrap a compiled dispatch callable (e.g. the continuous engine's
+    chunk program): scheduled latency/errors fire BEFORE the real call, so
+    a crash at call index N never half-applies device state."""
+
+    def wrapped(*args, **kwargs):
+        plan.maybe_fail(op)
+        return fn(*args, **kwargs)
+
+    wrapped.__wrapped__ = fn
+    return wrapped
+
+
+class FaultyByteSource:
+    """A loader ``ByteSource`` with scheduled faults. Errors surface as
+    OSError (what the loader's ``_read_with_retry`` treats as transient);
+    a truncation schedule performs a SHORT READ — the head of the range
+    lands in the caller's buffer, then the read fails like a dropped
+    connection, exercising partial-spool recovery paths."""
+
+    def __init__(self, source, plan: FaultPlan, op: str = "loader.read") -> None:
+        self._source = source
+        self.plan = plan
+        self.op = op
+
+    def read_range(self, offset: int, length: int, out=None):
+        act = self.plan.fire(self.op)
+        if act.latency_s:
+            time.sleep(act.latency_s)
+        if act.error is not None:
+            raise act.error
+        if 0 <= act.keep_bytes < length:
+            if act.keep_bytes and out is not None:
+                self._source.read_range(offset, act.keep_bytes,
+                                        memoryview(out)[: act.keep_bytes])
+            raise OSError(
+                f"injected short read: {act.keep_bytes}/{length} bytes at {offset}"
+            )
+        return self._source.read_range(offset, length, out)
+
+    def size(self) -> int:
+        return self._source.size()
+
+    def close(self) -> None:
+        close = getattr(self._source, "close", None)
+        if close is not None:
+            close()
+
+
+# -- env gating ----------------------------------------------------------------
+
+
+def from_env(env_var: str = ENV_VAR) -> FaultPlan | None:
+    """Build a plan from ``MODELX_FAULT_PLAN`` (inline JSON or ``@path``);
+    None when unset — the default-off gate every production seam uses."""
+    spec = os.environ.get(env_var, "")
+    if not spec:
+        return None
+    if spec.startswith("@"):
+        with open(spec[1:], encoding="utf-8") as f:
+            spec = f.read()
+    d = json.loads(spec)
+    plan = FaultPlan(seed=int(d.get("seed", 0)))
+    for r in d.get("rules", ()):
+        plan.add(
+            r["op"],
+            errors_at=r.get("errors_at", ()),
+            error=OSError(r["error"]) if r.get("error") else None,
+            error_rate=float(r.get("error_rate", 0.0)),
+            horizon=int(r.get("horizon", 256)),
+            latency_at=r.get("latency_at", ()),
+            latency_s=float(r.get("latency_s", 0.0)),
+            truncate_at=r.get("truncate_at", ()),
+            keep_bytes=int(r.get("keep_bytes", 0)),
+        )
+    return plan

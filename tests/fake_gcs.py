@@ -26,8 +26,8 @@ from tests.fake_s3 import _Bucket, make_handler
 _SESSION_PREFIX = "/__resumable__/"
 
 
-def make_gcs_handler(bucket: _Bucket):
-    Base = make_handler(bucket)
+def make_gcs_handler(bucket: _Bucket, plan=None):
+    Base = make_handler(bucket, plan=plan)
 
     class Handler(Base):
         def _check_presign(self) -> bool:
@@ -85,13 +85,16 @@ def make_gcs_handler(bucket: _Bucket):
 
 
 class FakeGCS:
-    def __init__(self) -> None:
+    def __init__(self, plan=None) -> None:
         self.bucket = _Bucket()
+        self.plan = plan  # optional FaultPlan (see fake_s3.make_handler)
         self.httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
     def start(self) -> str:
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_gcs_handler(self.bucket))
+        self.httpd = ThreadingHTTPServer(
+            ("127.0.0.1", 0), make_gcs_handler(self.bucket, plan=self.plan)
+        )
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
         return f"http://127.0.0.1:{self.httpd.server_address[1]}"

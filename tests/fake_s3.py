@@ -24,12 +24,46 @@ class _Bucket:
         self.counter = 0
 
 
-def make_handler(bucket: _Bucket):
+def make_handler(bucket: _Bucket, plan=None):
+    """``plan`` (modelx_tpu.testing.faults.FaultPlan, optional) injects
+    deterministic server-side faults on object GETs — op ``"blob.get"``:
+    errors answer 500, ``keep_bytes`` truncates the body mid-transfer
+    (headers promise the full length, the connection then drops — the
+    partial-read shape real object stores produce under network faults)."""
+
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
 
         def log_message(self, *a):
             pass
+
+        def _inject_get_fault(self, data: bytes):
+            """(handled, data): apply the plan's next blob.get action."""
+            if plan is None:
+                return False, data
+            act = plan.fire("blob.get")
+            if act.latency_s:
+                time.sleep(act.latency_s)
+            if act.error is not None:
+                self._send(
+                    500,
+                    b"<Error><Code>InternalError</Code>"
+                    b"<Message>injected fault</Message></Error>",
+                )
+                return True, data
+            if 0 <= act.keep_bytes < len(data):
+                # truncated body: full Content-Length on the wire, short
+                # payload, then drop the connection
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("Accept-Ranges", "bytes")
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(data[: act.keep_bytes])
+                self.close_connection = True
+                return True, data
+            return False, data
 
         def _key(self):
             # path-style: /{bucket}/{key...}
@@ -79,6 +113,9 @@ def make_handler(bucket: _Bucket):
             if obj is None:
                 return self._send(404, b"<Error><Code>NoSuchKey</Code></Error>")
             data, ctype = obj
+            handled, data = self._inject_get_fault(data)
+            if handled:
+                return
             rng = self.headers.get("Range", "")
             if rng and rng.startswith("bytes="):
                 spec = rng[len("bytes="):]
@@ -222,9 +259,12 @@ def make_handler(bucket: _Bucket):
 
 
 class FakeS3:
-    def __init__(self) -> None:
+    def __init__(self, plan=None) -> None:
         self.bucket = _Bucket()
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_handler(self.bucket))
+        self.plan = plan  # optional FaultPlan (see make_handler)
+        self.httpd = ThreadingHTTPServer(
+            ("127.0.0.1", 0), make_handler(self.bucket, plan=plan)
+        )
         self.httpd.daemon_threads = True
         self.thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
 

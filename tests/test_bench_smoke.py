@@ -236,3 +236,36 @@ class TestBenchSmoke:
         assert out["warm_vs_cold"] == pytest.approx(4.0)
         ttft = ttft_warm_fields({"ttft_ms": 120.0, "ttft_weights_ready_ms": 80.0})
         assert ttft == {"ttft_warm_ms": 120.0, "ttft_warm_weights_ready_ms": 80.0}
+
+
+class TestOverloadLeg:
+    @pytest.mark.slow
+    @pytest.mark.chaos
+    def test_measure_overload_schema(self):
+        """The overload/self-healing leg end to end on a tiny model:
+        saturating traffic sheds at the bound, a stale queued request
+        expires with 504, and the engine recovers from the injected
+        dispatch crash — schema-checks the load-bearing JSON keys."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        import bench
+        from modelx_tpu.models import llama
+        from modelx_tpu.parallel.mesh import make_mesh
+
+        cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64),
+                                  dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        out = bench.measure_overload(
+            params, make_mesh("dp=1"), slots=2, chunk=4, queue_depth=2,
+            clients=10, prompt=8, new_tokens=16, max_len=128,
+        )
+        for key in ("shed_429_count", "deadline_504_count", "recovery_ms",
+                    "overload_engine_restarts", "overload_served"):
+            assert key in out, key
+        assert out["shed_429_count"] >= 1  # saturation actually shed
+        assert out["deadline_504_count"] == 1
+        assert out["overload_engine_restarts"] >= 1
+        assert out["recovery_ms"] is not None and out["recovery_ms"] > 0

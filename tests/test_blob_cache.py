@@ -242,3 +242,66 @@ class TestDefaultCache:
         monkeypatch.setattr(bc, "_default_set", False)
         monkeypatch.delenv("MODELX_BLOB_CACHE_DIR", raising=False)
         assert bc.default_cache() is None
+
+
+class TestFaultInjectedRecovery:
+    """Cold-tee behavior under deterministic network faults (FaultPlan):
+    retried reads must still produce a digest-verified cache entry, and a
+    truncated spool must never be admitted."""
+
+    def test_cold_load_recovers_through_transient_faults(self, checkpoint, tmp_path):
+        """Injected errors + a short read under the caching tee: the
+        loader's retries re-read the ranges, the spool finalizes to the
+        promised digest, and the NEXT load is a zero-network warm hit."""
+        from modelx_tpu.testing import faults
+
+        path, tensors, digest, size = checkpoint
+        cache = BlobCache(str(tmp_path / "cache"))
+        plan = faults.FaultPlan(seed=2)
+        plan.add("loader.read", errors_at=[1], error=OSError("reset"))
+        plan.add("loader.read", truncate_at=[2], keep_bytes=3)
+        spy = SpySource(path)
+        src = cache.wrap(faults.FaultyByteSource(spy, plan), digest, size)
+        mesh = make_mesh("dp=2,tp=4")
+        arrays, _ = load_safetensors(src, mesh, LLAMA_RULES)
+        for name, expected in tensors.items():
+            np.testing.assert_array_equal(np.asarray(arrays[name]), expected)
+        src.close()
+        assert cache.stats["admitted"] == 1  # digest verified despite faults
+
+        # warm re-load: zero reads on the faulty 'network' source
+        reads_before = spy.reads
+        hit = cache.lookup(digest, expected_size=size)
+        assert hit is not None
+        arrays2, _ = load_safetensors(LocalFileSource(hit), mesh, LLAMA_RULES)
+        for name, expected in tensors.items():
+            np.testing.assert_array_equal(np.asarray(arrays2[name]), expected)
+        assert spy.reads == reads_before
+
+    def test_hard_faults_leave_no_poisoned_entry(self, checkpoint, tmp_path):
+        """A load that dies past the retry budget must not admit a partial
+        spool: the cache stays empty and a later fault-free load still
+        caches cleanly."""
+        from modelx_tpu.dl.loader import FETCH_RETRIES
+        from modelx_tpu.testing import faults
+
+        path, tensors, digest, size = checkpoint
+        cache = BlobCache(str(tmp_path / "cache"))
+        plan = faults.FaultPlan()
+        plan.add("loader.read", errors_at=range(FETCH_RETRIES),
+                 error=OSError("hard down"))
+        src = cache.wrap(faults.FaultyByteSource(SpySource(path), plan),
+                         digest, size)
+        mesh = make_mesh("dp=2,tp=4")
+        with pytest.raises(OSError):
+            load_safetensors(src, mesh, LLAMA_RULES)
+        src.close()
+        assert cache.stats["admitted"] == 0
+        assert cache.lookup(digest, expected_size=size) is None
+
+        clean = cache.wrap(SpySource(path), digest, size)
+        arrays, _ = load_safetensors(clean, mesh, LLAMA_RULES)
+        clean.close()
+        assert cache.stats["admitted"] == 1
+        for name, expected in tensors.items():
+            np.testing.assert_array_equal(np.asarray(arrays[name]), expected)

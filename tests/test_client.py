@@ -454,3 +454,117 @@ class TestConcurrentPushes:
         # global index sees the repo too
         gidx = Client(server, quiet=True).get_global_index()
         assert any(m.name == "library/race" for m in gidx.manifests)
+
+
+class TestControlPlaneRetries:
+    """RegistryClient._request retries (exponential backoff + jitter) on
+    idempotent GET/HEAD for connection errors and 5xx/429, honoring the
+    server's Retry-After — the core client finally matches the retry
+    stance both data-plane extensions have had since the seed."""
+
+    def _flaky(self, fail_times: int, status: int = 503,
+               retry_after: str | None = None):
+        """An in-process registry answering `status` for the first
+        `fail_times` requests of each method, then success."""
+        import json as _json
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from modelx_tpu.types import Manifest
+
+        counts: dict[str, int] = {}
+        ok_body = _json.dumps(Manifest().to_json()).encode()
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _handle(self):
+                n = counts[self.command] = counts.get(self.command, 0) + 1
+                if n <= fail_times:
+                    body = b'{"code": "INTERNAL", "message": "transient"}'
+                    self.send_response(status)
+                    if retry_after is not None:
+                        self.send_header("Retry-After", retry_after)
+                else:
+                    body = ok_body
+                    self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if self.command != "HEAD":
+                    self.wfile.write(body)
+
+            do_GET = do_HEAD = do_PUT = do_POST = _handle
+
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        httpd.daemon_threads = True
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd, f"http://127.0.0.1:{httpd.server_address[1]}", counts
+
+    def _client(self, base):
+        from modelx_tpu.client.remote import RegistryClient
+
+        c = RegistryClient(base)
+        c.RETRY_BACKOFF_S = 0.01  # keep the test fast; jitter rides on this
+        return c
+
+    def test_get_retries_through_transient_5xx(self):
+        httpd, base, counts = self._flaky(fail_times=2, status=503)
+        try:
+            c = self._client(base)
+            c.get_manifest("library/m", "v1")  # succeeds on attempt 3
+            assert counts["GET"] == 3
+        finally:
+            httpd.shutdown()
+
+    def test_get_retries_on_429_and_honors_retry_after(self):
+        import time as _time
+
+        httpd, base, counts = self._flaky(
+            fail_times=1, status=429, retry_after="0.3"
+        )
+        try:
+            c = self._client(base)
+            t0 = _time.monotonic()
+            c.get_manifest("library/m", "v1")
+            assert counts["GET"] == 2
+            # the server's Retry-After (0.3s) beat the 0.01s backoff
+            assert _time.monotonic() - t0 >= 0.3
+        finally:
+            httpd.shutdown()
+
+    def test_retry_budget_exhausts_to_typed_error(self):
+        httpd, base, counts = self._flaky(fail_times=99, status=503)
+        try:
+            c = self._client(base)
+            with pytest.raises(errors.ErrorInfo) as ei:
+                c.get_manifest("library/m", "v1")
+            assert ei.value.http_status == 503
+            assert counts["GET"] == c.retries
+        finally:
+            httpd.shutdown()
+
+    def test_writes_never_retry(self):
+        from modelx_tpu.types import Manifest
+
+        httpd, base, counts = self._flaky(fail_times=99, status=503)
+        try:
+            c = self._client(base)
+            with pytest.raises(errors.ErrorInfo):
+                c.put_manifest("library/m", "v1", Manifest())
+            assert counts["PUT"] == 1  # non-idempotent: exactly one attempt
+        finally:
+            httpd.shutdown()
+
+    def test_deterministic_4xx_never_retries(self):
+        httpd, base, counts = self._flaky(fail_times=99, status=404)
+        try:
+            c = self._client(base)
+            with pytest.raises(errors.ErrorInfo):
+                c.get_manifest("library/m", "v1")
+            assert counts["GET"] == 1
+        finally:
+            httpd.shutdown()

@@ -1,0 +1,522 @@
+"""Self-healing serving (ISSUE 3): engine supervision, bounded admission
+with deadlines, and the typed error surface — all driven by deterministic
+FaultPlan schedules (modelx_tpu/testing/faults.py), never sleeps-as-logic.
+
+The oracle for recovery: output on a restarted engine is byte-identical to
+the plain path for greedy requests (fresh KV state, same compiled
+programs), and no waiter EVER hangs — every submitted request terminates
+with tokens, _DONE, or a typed ServingError.
+"""
+
+import dataclasses
+import queue
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+import jax
+import jax.numpy as jnp
+
+from modelx_tpu.dl import safetensors as st
+from modelx_tpu.dl.continuous import ContinuousBatcher
+from modelx_tpu.dl.serve import ModelServer, ServerSet, serve
+from modelx_tpu.dl.serving_errors import (
+    DeadlineExceededError,
+    EngineBrokenError,
+    PoisonedRequestError,
+    QueueFullError,
+    ServingError,
+)
+from modelx_tpu.registry.server import free_port
+from modelx_tpu.testing import faults
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    from modelx_tpu.models import llama
+
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64), dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    d = tmp_path_factory.mktemp("engine_faults")
+    st.write_safetensors(
+        str(d / "model.safetensors"), {k: np.asarray(v) for k, v in params.items()}
+    )
+    srv = ModelServer(str(d), mesh_spec="dp=1", dtype="float32", max_seq_len=96)
+    srv.load()
+    return srv
+
+
+def _wait_state(cb, state: str, timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    while cb.engine_state != state and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert cb.engine_state == state, cb.engine_state
+
+
+def _wait_restarts(cb, n: int, timeout: float = 30.0) -> None:
+    """Wait for restart #n to COMPLETE. The waiter's error can arrive
+    before the crash bookkeeping runs (per-callsite failsafes fail tickets
+    first), so `engine_state` alone is a racy synchronization point; the
+    restart counter increments strictly after all crash accounting."""
+    deadline = time.monotonic() + timeout
+    while cb.snapshot()["engine_restarts"] < n and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert cb.snapshot()["engine_restarts"] >= n
+    _wait_state(cb, "running", timeout)
+
+
+def _crash_next_chunk(cb, n: int = 1):
+    """Arm a deterministic crash on the engine's next n chunk dispatches."""
+    plan = faults.FaultPlan()
+    plan.add("engine.dispatch", errors_at=range(n), error=RuntimeError("injected"))
+    cb._chunk = faults.wrap_dispatch(cb._chunk, plan)
+    return plan
+
+
+class TestSupervision:
+    def test_crash_under_load_fails_fast_then_recovers_exactly(self, server):
+        """The acceptance scenario: an injected dispatch crash under active
+        load fails every in-flight request with the typed error (no hung
+        waiter), the supervisor restarts the engine within the backoff
+        window, engine_restarts increments, and greedy output on the
+        restarted engine is byte-identical."""
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4,
+                               restart_backoff_s=0.05)
+        try:
+            tokens = np.array([[5, 9, 2, 7, 1]], np.int32)
+            expected = server.generate(tokens, max_new_tokens=11)
+            np.testing.assert_array_equal(
+                cb.generate(tokens, max_new_tokens=11), expected
+            )
+            _crash_next_chunk(cb)
+            with pytest.raises(EngineBrokenError):
+                cb.generate(tokens, max_new_tokens=11)
+            # the restarted engine serves new requests, byte-identical
+            got = cb.generate(tokens, max_new_tokens=11)
+            np.testing.assert_array_equal(got, expected)
+            snap = cb.snapshot()
+            assert snap["engine_restarts"] == 1
+            assert snap["engine_state"] == "running"
+            assert snap["quarantined"] == 0
+        finally:
+            cb.close()
+
+    def test_restart_while_draining_backlog(self, server):
+        """A crash with waiters queued behind a saturated slot: EVERY
+        waiter (active + backlog) gets the typed error — none hang — and
+        the engine still restarts and serves."""
+        cb = ContinuousBatcher(server, max_slots=1, chunk_size=4,
+                               restart_backoff_s=0.05)
+        try:
+            long_t = cb.submit([5, 5, 5], 48, {})
+            assert isinstance(long_t.out.get(timeout=30), np.ndarray)  # admitted
+            waiters = [cb.submit([2, 2], 8, {}) for _ in range(3)]
+            _crash_next_chunk(cb)
+            # the in-flight row always fails typed; a backlog waiter either
+            # fails typed (it had been popped into the waiting list) or —
+            # still in the untouched submit queue — survives the restart
+            # and completes normally. Nothing may hang.
+            outcomes = []
+            for t in [long_t] + waiters:
+                emitted = 0
+                while True:
+                    item = t.out.get(timeout=60)
+                    if isinstance(item, BaseException):
+                        assert isinstance(item, EngineBrokenError), item
+                        outcomes.append("failed")
+                        break
+                    if not isinstance(item, np.ndarray):  # _DONE
+                        outcomes.append("served")
+                        break
+                    emitted += item.size
+                if outcomes[-1] == "served":
+                    assert emitted == 8
+            assert outcomes[0] == "failed"  # the decoding row, mid-crash
+            expected = server.generate(np.array([[3, 4]], np.int32), max_new_tokens=6)
+            np.testing.assert_array_equal(
+                cb.generate(np.array([[3, 4]], np.int32), max_new_tokens=6), expected
+            )
+        finally:
+            cb.close()
+
+    def test_cancel_races_engine_death(self, server):
+        """cancel() landing while _fail_active drains must not deadlock or
+        hang the consumer: the stream terminates promptly either way."""
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4,
+                               restart_backoff_s=0.05)
+        try:
+            t = cb.submit([1, 2, 3], 32, {})
+            assert isinstance(t.out.get(timeout=30), np.ndarray)  # decoding
+            _crash_next_chunk(cb)
+            threading.Thread(target=t.cancel, daemon=True).start()
+            done = threading.Event()
+
+            def drain():
+                while True:
+                    try:
+                        item = t.out.get(timeout=10)
+                    except queue.Empty:
+                        return  # hung: done never set, assert below fails
+                    if isinstance(item, BaseException) or not isinstance(
+                        item, np.ndarray
+                    ):
+                        done.set()
+                        return
+
+            th = threading.Thread(target=drain, daemon=True)
+            th.start()
+            th.join(timeout=15)
+            assert done.is_set(), "drain hung across cancel/death race"
+        finally:
+            cb.close()
+
+    def test_submit_after_broken_raises_typed(self, server):
+        """Circuit breaker: crashes past the budget leave the engine broken;
+        submits then fail immediately with the typed 503 error."""
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4,
+                               restart_backoff_s=0.01, max_crashes=2,
+                               crash_window_s=60.0)
+        try:
+            plan = faults.FaultPlan()
+            plan.add("engine.dispatch", errors_at=range(64),
+                     error=RuntimeError("always"))
+            cb._chunk = faults.wrap_dispatch(cb._chunk, plan)
+            tokens = np.array([[4, 5]], np.int32)
+            deadline = time.monotonic() + 60
+            while cb.engine_state != "broken" and time.monotonic() < deadline:
+                with pytest.raises(ServingError):
+                    cb.generate(tokens, max_new_tokens=4)
+                time.sleep(0.02)
+            assert cb.engine_state == "broken"
+            with pytest.raises(EngineBrokenError):
+                cb.submit([4, 5], 4, {})
+            assert cb.snapshot()["engine_state"] == "broken"
+        finally:
+            cb.close()
+
+    def test_poison_request_quarantined_after_two_crashes(self, server):
+        """A request whose admission crashes the loop twice is rejected
+        with the 400-mapped typed error instead of re-admitted; innocent
+        requests keep working on the restarted engine."""
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4,
+                               restart_backoff_s=0.02)
+        try:
+            cb.generate(np.array([[1, 2, 3]], np.int32), max_new_tokens=4)
+            plan = faults.FaultPlan()
+            plan.add("engine.admit", errors_at=[0, 1],
+                     error=RuntimeError("poison"))
+            cb._admit_prog = faults.wrap_dispatch(
+                cb._admit_prog, plan, op="engine.admit"
+            )
+            poison = np.array([[7, 7, 7, 7]], np.int32)
+            for i in range(2):
+                with pytest.raises(EngineBrokenError):
+                    cb.generate(poison, max_new_tokens=5)
+                _wait_restarts(cb, i + 1)
+            with pytest.raises(PoisonedRequestError) as ei:
+                cb.generate(poison, max_new_tokens=5)
+            assert ei.value.http_status == 400
+            assert cb.snapshot()["quarantined"] == 1
+            # the same prompt with a DIFFERENT budget is a different request
+            # (and the admit program's fault schedule is exhausted): served
+            out = cb.generate(np.array([[1, 2, 3]], np.int32), max_new_tokens=4)
+            assert out.shape == (1, 7)
+            assert cb.snapshot()["engine_restarts"] == 2
+        finally:
+            cb.close()
+
+
+class TestSupervisionPaged:
+    def test_paged_crash_rebuilds_pool_and_recovers_exactly(self, server):
+        """The paged engine's restart rebuilds the page pool + block table:
+        pages_free returns to pages_total and greedy output stays
+        byte-identical after the crash."""
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4,
+                               page_size=16, restart_backoff_s=0.05)
+        try:
+            tokens = np.array([[5, 9, 2, 7, 1]], np.int32)
+            expected = server.generate(tokens, max_new_tokens=11)
+            np.testing.assert_array_equal(
+                cb.generate(tokens, max_new_tokens=11), expected
+            )
+            _crash_next_chunk(cb)
+            with pytest.raises(EngineBrokenError):
+                cb.generate(tokens, max_new_tokens=11)
+            got = cb.generate(tokens, max_new_tokens=11)
+            np.testing.assert_array_equal(got, expected)
+            snap = cb.snapshot()
+            assert snap["engine_restarts"] == 1
+            assert snap["pages_free"] == snap["pages_total"]
+        finally:
+            cb.close()
+
+
+class TestBoundedAdmission:
+    @pytest.mark.parametrize("page_size", [0, 16], ids=["dense", "paged"])
+    def test_shed_at_max_queue_depth(self, server, page_size):
+        """With the slot saturated and the backlog at --max-queue-depth,
+        the next submit sheds with 429 + Retry-After instead of queueing."""
+        cb = ContinuousBatcher(server, max_slots=1, chunk_size=4,
+                               page_size=page_size, max_queue_depth=2)
+        try:
+            long_t = cb.submit([3, 3, 3], 40, {})
+            assert isinstance(long_t.out.get(timeout=30), np.ndarray)  # admitted
+            backlog = [cb.submit([2, 2], 8, {}) for _ in range(2)]
+            with pytest.raises(QueueFullError) as ei:
+                cb.submit([9, 9], 8, {})
+            assert ei.value.http_status == 429
+            assert int(ei.value.headers()["Retry-After"]) >= 1
+            assert cb.snapshot()["shed"] == 1
+            assert cb.snapshot()["queue_depth"] <= 2  # never unbounded
+            long_t.cancel()
+            for t in backlog:  # the backlog drains once the slot frees
+                got = []
+                while True:
+                    item = t.out.get(timeout=30)
+                    if not isinstance(item, np.ndarray):
+                        break
+                    got.append(item)
+                assert sum(p.size for p in got) == 8
+            # capacity freed: submits admit again
+            out = cb.generate(np.array([[1, 2]], np.int32), max_new_tokens=4)
+            assert out.shape == (1, 6)
+        finally:
+            cb.close()
+
+    def test_cancelled_backlog_corpses_free_queue_budget(self, server):
+        """Clients that give up while queued (transport calls cancel())
+        must stop counting toward --max-queue-depth at the next boundary —
+        dead backlog entries shedding live traffic with 429s is exactly
+        wrong under overload, when client timeouts are most common."""
+        cb = ContinuousBatcher(server, max_slots=1, chunk_size=4,
+                               max_queue_depth=2)
+        try:
+            long_t = cb.submit([3, 3, 3], 64, {})
+            assert isinstance(long_t.out.get(timeout=30), np.ndarray)
+            corpses = [cb.submit([2, 2], 8, {}) for _ in range(2)]
+            with pytest.raises(QueueFullError):
+                cb.submit([9, 9], 8, {})
+            for t in corpses:
+                t.cancel()
+            deadline = time.monotonic() + 30
+            while (cb.snapshot()["queue_depth"] > 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert cb.snapshot()["queue_depth"] == 0
+            for t in corpses:  # purged with _DONE, not an error
+                assert t.out.get(timeout=10) is not None
+            live = cb.submit([4, 4], 4, {})  # no 429: the budget freed
+            long_t.cancel()
+            while True:
+                item = live.out.get(timeout=30)
+                if not isinstance(item, np.ndarray):
+                    break
+        finally:
+            cb.close()
+
+    def test_saturating_traffic_never_grows_unbounded(self, server):
+        """Concurrent saturating submits: every request either completes or
+        sheds with 429; the backlog gauge never exceeds the bound."""
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4,
+                               max_queue_depth=3)
+        try:
+            results = {"ok": 0, "shed": 0}
+            lock = threading.Lock()
+
+            def client(i):
+                try:
+                    out = cb.generate(
+                        np.array([[1 + i % 5, 2]], np.int32), max_new_tokens=8
+                    )
+                    assert out.shape == (1, 10)
+                    with lock:
+                        results["ok"] += 1
+                except QueueFullError:
+                    with lock:
+                        results["shed"] += 1
+
+            threads = [
+                threading.Thread(target=client, args=(i,)) for i in range(16)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert results["ok"] + results["shed"] == 16
+            assert results["ok"] >= 1
+            assert cb.snapshot()["queue_depth"] <= 3
+        finally:
+            cb.close()
+
+
+class TestDeadlines:
+    @pytest.mark.parametrize("page_size", [0, 16], ids=["dense", "paged"])
+    def test_waiting_request_expires_before_taking_a_slot(self, server, page_size):
+        cb = ContinuousBatcher(server, max_slots=1, chunk_size=4,
+                               page_size=page_size, request_timeout_s=60.0)
+        try:
+            long_t = cb.submit([3, 3, 3], 48, {})
+            assert isinstance(long_t.out.get(timeout=30), np.ndarray)
+            waiter = cb.submit([2, 2], 8, {})
+            waiter.deadline = 0.0  # deterministically in the past
+            item = waiter.out.get(timeout=30)
+            assert isinstance(item, DeadlineExceededError)
+            assert item.http_status == 504
+            assert "waiting" in str(item)
+            long_t.cancel()
+            assert cb.snapshot()["expired"] >= 1
+        finally:
+            cb.close()
+
+    @pytest.mark.parametrize("page_size", [0, 16], ids=["dense", "paged"])
+    def test_filling_request_expires_mid_prefill(self, server, page_size):
+        """A chunk-prefilling row past its deadline releases its slot (and
+        pages) at the boundary — the 504 arrives while still filling."""
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4,
+                               page_size=page_size, prefill_chunk=16,
+                               request_timeout_s=60.0)
+        try:
+            started, go = threading.Event(), threading.Event()
+            orig = cb._piece_prog
+
+            def gated(*args, **kwargs):
+                started.set()
+                go.wait(30)
+                return orig(*args, **kwargs)
+
+            cb._piece_prog = gated
+            t = cb.submit(list(range(1, 61)), 8, {})  # 60 tokens = 4 pieces
+            assert started.wait(30)  # mid-fill, deterministically
+            t.deadline = 0.0
+            go.set()
+            item = t.out.get(timeout=30)
+            assert isinstance(item, DeadlineExceededError)
+            assert "prefilling" in str(item)
+            # slot + pages released: a fresh request decodes fine
+            out = cb.generate(np.array([[1, 2]], np.int32), max_new_tokens=4)
+            assert out.shape == (1, 6)
+            if page_size:
+                snap = cb.snapshot()
+                assert snap["pages_free"] == snap["pages_total"]
+        finally:
+            cb.close()
+
+    @pytest.mark.parametrize("page_size", [0, 16], ids=["dense", "paged"])
+    def test_decoding_request_expires_mid_stream(self, server, page_size):
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4,
+                               page_size=page_size, request_timeout_s=60.0)
+        try:
+            t = cb.submit([1, 2, 3], 48, {})
+            assert isinstance(t.out.get(timeout=30), np.ndarray)  # decoding
+            t.deadline = 0.0
+            while True:
+                item = t.out.get(timeout=30)
+                if not isinstance(item, np.ndarray):
+                    break
+            assert isinstance(item, DeadlineExceededError)
+            assert "decoding" in str(item)
+            # the slot freed at the boundary: engine keeps serving
+            out = cb.generate(np.array([[4, 5]], np.int32), max_new_tokens=4)
+            assert out.shape == (1, 6)
+        finally:
+            cb.close()
+
+
+class TestServingSurface:
+    """healthz / metrics / HTTP error mapping over a real ServerSet."""
+
+    @pytest.fixture(scope="class")
+    def front(self, server):
+        sset = ServerSet({"m": server}, continuous_batch=True, max_slots=2,
+                         stream_chunk_size=4)
+        port = free_port()
+        httpd = serve(sset, listen=f"127.0.0.1:{port}")
+        yield sset, f"http://127.0.0.1:{port}"
+        for cb in list(sset.cbatchers.values()):
+            cb.close()
+        httpd.shutdown()
+
+    def test_healthz_tracks_engine_lifecycle(self, front):
+        sset, base = front
+        assert requests.get(base + "/healthz").status_code == 200
+        assert requests.get(base + "/livez").status_code == 200
+        cb = sset.continuous_for(sset.servers["m"])
+        # crash with a LONG backoff: the engine sits in "restarting" and
+        # /healthz must drain traffic away while it does
+        cb.restart_backoff_s = 30.0
+        _crash_next_chunk(cb)
+        r = requests.post(base + "/v1/m/generate",
+                          json={"tokens": [[5, 9, 2]], "max_new_tokens": 8})
+        assert r.status_code == 503  # typed EngineBrokenError on the wire
+        r = requests.get(base + "/healthz")
+        assert r.status_code == 503
+        assert r.json() == {"status": "engine-restarting"}
+        # liveness stays OK: a supervised restart is recoverable — k8s
+        # must drain (readiness), not kill the container
+        assert requests.get(base + "/livez").status_code == 200
+        r = requests.get(base + "/metrics")
+        cont = r.json()["m"]["continuous"]
+        assert cont["engine_state"] == "restarting"
+        assert cont["engine_restarts"] == 0  # not yet back up
+        # cut the backoff short (close-event doubles as the interruptible
+        # sleep); the engine rebuilds and readiness returns
+        cb._closed_ev.set()
+        deadline = time.monotonic() + 30
+        while cb.engine_state != "running" and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert requests.get(base + "/healthz").status_code == 200
+        assert requests.get(base + "/metrics").json()["m"]["continuous"][
+            "engine_restarts"] == 1
+        # now break the circuit: zero crash budget, one more crash
+        cb.max_crashes = 0
+        _crash_next_chunk(cb)
+        requests.post(base + "/v1/m/generate",
+                      json={"tokens": [[5, 9, 2]], "max_new_tokens": 8})
+        deadline = time.monotonic() + 30
+        while cb.engine_state != "broken" and time.monotonic() < deadline:
+            time.sleep(0.01)
+        r = requests.get(base + "/healthz")
+        assert r.status_code == 503
+        assert r.json() == {"status": "engine-broken"}
+        # ... and ONLY now does liveness fail: the livenessProbe (podspec)
+        # restarts the pod out of the unrecoverable state
+        r = requests.get(base + "/livez")
+        assert r.status_code == 503
+        assert r.json() == {"status": "engine-broken"}
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestChaosSweep:
+    def test_seeded_dispatch_fault_sweep_always_terminates(self, server):
+        """Heavier schedule sweep: across seeds, a supervised engine under
+        a random dispatch-fault schedule either keeps serving (restarts) or
+        breaks its circuit cleanly — every request terminates with tokens
+        or a typed error, never a hang."""
+        for seed in range(3):
+            cb = ContinuousBatcher(server, max_slots=2, chunk_size=4,
+                                   restart_backoff_s=0.01, max_crashes=4,
+                                   crash_window_s=30.0)
+            plan = faults.FaultPlan(seed=seed)
+            plan.add("engine.dispatch", error_rate=0.15, horizon=128,
+                     error=RuntimeError("chaos"))
+            cb._chunk = faults.wrap_dispatch(cb._chunk, plan)
+            try:
+                outcomes = []
+                for i in range(12):
+                    tokens = np.array([[1 + (seed + i) % 9, 2, 3]], np.int32)
+                    try:
+                        out = cb.generate(tokens, max_new_tokens=6)
+                        assert out.shape == (1, 9)
+                        outcomes.append("ok")
+                    except ServingError:
+                        outcomes.append("err")
+                    if cb.engine_state == "broken":
+                        break
+                assert outcomes, "no request terminated"
+                assert cb.engine_state in ("running", "restarting", "broken")
+            finally:
+                cb.close()

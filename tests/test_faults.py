@@ -1,0 +1,152 @@
+"""The deterministic fault-injection harness (modelx_tpu/testing/faults.py).
+
+The plan itself must be boringly predictable: the Nth call to an op sees
+the same verdict for the same (seed, schedule) whatever thread got there,
+or every chaos test built on it becomes a flake generator.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from modelx_tpu.testing import faults
+
+
+class TestFaultPlan:
+    def test_explicit_indices_fire_exactly_there(self):
+        plan = faults.FaultPlan()
+        plan.add("op", errors_at=[1, 3], error=OSError("boom"))
+        outcomes = []
+        for _ in range(5):
+            act = plan.fire("op")
+            outcomes.append(act.error is not None)
+        assert outcomes == [False, True, False, True, False]
+        assert plan.count("op") == 5
+
+    def test_seeded_rate_schedule_is_reproducible(self):
+        a = faults.FaultPlan(seed=42).add("op", error_rate=0.3, horizon=64)
+        b = faults.FaultPlan(seed=42).add("op", error_rate=0.3, horizon=64)
+        sched_a = [a.fire("op").error is not None for _ in range(64)]
+        sched_b = [b.fire("op").error is not None for _ in range(64)]
+        assert sched_a == sched_b
+        assert any(sched_a) and not all(sched_a)
+        # a different seed gives a different schedule (overwhelmingly)
+        c = faults.FaultPlan(seed=43).add("op", error_rate=0.3, horizon=64)
+        sched_c = [c.fire("op").error is not None for _ in range(64)]
+        assert sched_c != sched_a
+
+    def test_ops_count_independently(self):
+        plan = faults.FaultPlan()
+        plan.add("a", errors_at=[0])
+        plan.add("b", errors_at=[1])
+        assert plan.fire("a").error is not None
+        assert plan.fire("b").error is None
+        assert plan.fire("b").error is not None
+
+    def test_fresh_exception_per_fire(self):
+        plan = faults.FaultPlan()
+        plan.add("op", errors_at=[0, 1], error=OSError("x"))
+        e1, e2 = plan.fire("op").error, plan.fire("op").error
+        assert e1 is not e2 and type(e1) is OSError
+
+    def test_thread_safety_counts_every_call(self):
+        plan = faults.FaultPlan()
+        plan.add("op", errors_at=range(0, 400, 2))
+
+        hits = []
+
+        def worker():
+            for _ in range(100):
+                hits.append(plan.fire("op").error is not None)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert plan.count("op") == 400
+        assert sum(hits) == 200  # every scheduled index fired exactly once
+
+    def test_maybe_fail_raises_scheduled_error(self):
+        plan = faults.FaultPlan()
+        plan.add("op", errors_at=[0], error=RuntimeError("scheduled"))
+        with pytest.raises(RuntimeError, match="scheduled"):
+            plan.maybe_fail("op")
+        plan.maybe_fail("op")  # index 1: clean
+
+    def test_truncation_action(self):
+        plan = faults.FaultPlan()
+        plan.add("op", truncate_at=[0], keep_bytes=7)
+        act = plan.fire("op")
+        assert act.keep_bytes == 7
+        assert plan.fire("op").keep_bytes == -1
+
+
+class TestWrappers:
+    def test_wrap_dispatch_passthrough_and_fault(self):
+        plan = faults.FaultPlan()
+        plan.add("engine.dispatch", errors_at=[1], error=RuntimeError("die"))
+        calls = []
+        wrapped = faults.wrap_dispatch(lambda x: calls.append(x) or x * 2, plan)
+        assert wrapped(3) == 6
+        with pytest.raises(RuntimeError, match="die"):
+            wrapped(4)
+        assert calls == [3]  # the faulted call never reached the real fn
+
+    def test_faulty_byte_source_error_then_success(self, tmp_path):
+        from modelx_tpu.dl.loader import LocalFileSource
+
+        p = tmp_path / "blob.bin"
+        payload = bytes(range(256)) * 4
+        p.write_bytes(payload)
+        plan = faults.FaultPlan()
+        plan.add("loader.read", errors_at=[0], error=OSError("reset"))
+        src = faults.FaultyByteSource(LocalFileSource(str(p)), plan)
+        with pytest.raises(OSError, match="reset"):
+            src.read_range(0, 16)
+        got = src.read_range(4, 16)
+        assert bytes(got) == payload[4:20]
+        assert src.size() == len(payload)
+        src.close()
+
+    def test_faulty_byte_source_short_read(self, tmp_path):
+        from modelx_tpu.dl.loader import LocalFileSource
+
+        p = tmp_path / "blob.bin"
+        p.write_bytes(b"abcdefghij" * 10)
+        plan = faults.FaultPlan()
+        plan.add("loader.read", truncate_at=[0], keep_bytes=4)
+        src = faults.FaultyByteSource(LocalFileSource(str(p)), plan)
+        out = np.zeros(10, np.uint8)
+        with pytest.raises(OSError, match="short read"):
+            src.read_range(0, 10, memoryview(out))
+        # the head landed before the 'connection' dropped
+        assert bytes(out[:4]) == b"abcd"
+        src.close()
+
+
+class TestEnvGate:
+    def test_unset_env_means_off(self, monkeypatch):
+        monkeypatch.delenv(faults.ENV_VAR, raising=False)
+        assert faults.from_env() is None
+
+    def test_inline_json(self, monkeypatch):
+        spec = {"seed": 3, "rules": [
+            {"op": "loader.read", "errors_at": [0], "error": "injected"}]}
+        monkeypatch.setenv(faults.ENV_VAR, json.dumps(spec))
+        plan = faults.from_env()
+        assert plan is not None and plan.has("loader.read")
+        act = plan.fire("loader.read")
+        assert isinstance(act.error, OSError)
+
+    def test_file_reference(self, monkeypatch, tmp_path):
+        p = tmp_path / "plan.json"
+        p.write_text(json.dumps({"rules": [
+            {"op": "blob.get", "truncate_at": [1], "keep_bytes": 5}]}))
+        monkeypatch.setenv(faults.ENV_VAR, f"@{p}")
+        plan = faults.from_env()
+        assert plan.has("blob.get")
+        assert plan.fire("blob.get").keep_bytes == -1
+        assert plan.fire("blob.get").keep_bytes == 5
