@@ -5,7 +5,7 @@ PY ?= python
 IMAGE ?= modelx-tpu
 TAG ?= $(shell git describe --tags --always 2>/dev/null || echo dev)
 
-.PHONY: all native test lint wheel image image-dl compose-up compose-down clean
+.PHONY: all native test chaos lint wheel image image-dl compose-up compose-down clean
 
 all: native test wheel
 
@@ -16,6 +16,11 @@ native:
 
 test:
 	$(PY) -m pytest tests/ -q
+
+# every deterministic fault sweep in one command: the seeded engine-crash
+# schedules (PR 3) plus the registry torn-write/scrub/GC-race drills
+chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
 
 lint:
 	@if $(PY) -m ruff --version >/dev/null 2>&1; then \

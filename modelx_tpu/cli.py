@@ -287,21 +287,62 @@ def cmd_diff(a: str, b: str) -> None:
 @main.command("verify")
 @click.argument("ref", shell_complete=_complete_ref)
 @click.option("--quiet", is_flag=True, help="suppress per-blob lines")
-def cmd_verify(ref: str, quiet: bool) -> None:
+@click.option("--remote", "remote_", is_flag=True,
+              help="verify server-side via the scrub route (no pull): the "
+                   "registry re-hashes its own blobs and quarantines "
+                   "corruption in place; repository-wide, so no @version")
+def cmd_verify(ref: str, quiet: bool, remote_: bool) -> None:
     """Registry fsck: re-hash every blob the repo's manifests reference
-    (all versions, or just one with repo@version); exit 1 on any mismatch."""
+    (all versions, or just one with repo@version); exit 1 on any mismatch.
+    With --remote the audit runs where the bytes live instead of streaming
+    them down first — note it covers the whole repository and MOVES corrupt
+    blobs to quarantine (they 404 until re-pushed)."""
     from modelx_tpu.client.ops import verify_repo
 
     try:
         r = parse_reference(ref)
         if not r.repository:
             raise ValueError("reference must include a repository")
+        if remote_:
+            if r.version:
+                raise ValueError(
+                    "--remote scrubs the whole repository; drop the @version "
+                    "(or verify that version locally without --remote)"
+                )
+            out = r.client(quiet=True).remote.scrub(r.repository)
+            click.echo(json.dumps(out))
+            if not out.get("clean", False):
+                sys.exit(1)
+            return
         out = verify_repo(
             r.client().remote, r.repository, r.version,
             log=(lambda line: None) if quiet else click.echo,
         )
         click.echo(json.dumps(out))
         if out["errors"]:
+            sys.exit(1)
+    except (errors.ErrorInfo, ValueError) as e:
+        _fail(e)
+
+
+@main.command("scrub")
+@click.argument("ref", shell_complete=_complete_ref)
+@click.option("--sample", type=int, default=0,
+              help="re-hash only N blobs, drawn deterministically from "
+                   "--seed (0 = scrub everything)")
+@click.option("--seed", type=int, default=0, help="sample seed")
+def cmd_scrub(ref: str, sample: int, seed: int) -> None:
+    """Server-side integrity scrub of a repository: the registry re-hashes
+    stored blobs, moves corrupt ones to quarantine/ (the digest 404s and
+    becomes re-pushable), reports dangling manifest references, and
+    rebuilds its indexes. Exit 1 when anything was found."""
+    try:
+        r = parse_reference(ref)
+        if not r.repository:
+            raise ValueError("reference must include a repository")
+        out = r.client(quiet=True).remote.scrub(r.repository, sample=sample, seed=seed)
+        click.echo(json.dumps(out))
+        if not out.get("clean", False):
             sys.exit(1)
     except (errors.ErrorInfo, ValueError) as e:
         _fail(e)
@@ -389,11 +430,14 @@ def cmd_gc(ref: str, grace: float | None) -> None:
 @click.option("--auth-token", multiple=True, help="accepted bearer token (repeatable)")
 @click.option("--oidc-issuer", default="", help="OIDC issuer URL for JWT bearer auth")
 @click.option("--gc-interval", default=0.0, type=float, help="seconds between GC sweeps (0=off)")
+@click.option("--reconcile-on-start/--no-reconcile-on-start", default=True,
+              help="rebuild repo + global indexes from storage at boot "
+                   "(crash recovery; index-only — deep audits via scrub)")
 def cmd_serve(
     listen, data_dir, tls_cert, tls_key, s3_url, s3_access_key, s3_secret_key,
     s3_bucket, s3_region, gcs_url, gcs_access_key, gcs_secret_key, gcs_bucket,
     enable_redirect, local_redirect, auth_token, oidc_issuer,
-    gc_interval,
+    gc_interval, reconcile_on_start,
 ) -> None:
     """Run the registry daemon (cmd/modelxd/modelxd.go:26-58)."""
     from modelx_tpu.registry.server import Options, RegistryServer
@@ -419,6 +463,7 @@ def cmd_serve(
         auth_tokens=tuple(auth_token),
         oidc_issuer=oidc_issuer,
         gc_interval_s=gc_interval,
+        reconcile_on_start=reconcile_on_start,
     )
     RegistryServer(opts).serve_forever()
 
@@ -568,7 +613,7 @@ def cmd_completion(shell: str) -> None:
 # commands whose FIRST positional argument is a model reference; later
 # positions are directories (filename completion is the shell's own job) —
 # except `copy`, whose second position is also a ref
-_REF_COMMANDS = ("push", "pull", "info", "list", "gc", "dl", "copy", "verify", "diff")
+_REF_COMMANDS = ("push", "pull", "info", "list", "gc", "dl", "copy", "verify", "diff", "scrub")
 
 
 @main.command(

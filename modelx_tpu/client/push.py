@@ -22,6 +22,7 @@ import json
 import os
 from typing import Callable
 
+from modelx_tpu import errors
 from modelx_tpu.client import helper
 from modelx_tpu.client.extension import get_extension
 from modelx_tpu.client.progress import MultiBar
@@ -99,15 +100,22 @@ class Pusher:
         self.quiet = quiet
         self.concurrency = concurrency
 
+    # rounds of commit -> parse delta -> re-push before giving up; one
+    # retry fixes the common cases (GC'd mid-push, corrupt/quarantined
+    # stored copy), a second covers a delta racing another sweep
+    COMMIT_RETRIES = 2
+
     def push(self, repository: str, version: str, directory: str) -> None:
         """push.go:29-65."""
         manifest, tgz_paths = parse_manifest_from_dir(directory)
         bar_pool = MultiBar(quiet=self.quiet, **({"concurrency": self.concurrency} if self.concurrency else {}))
 
-        def job(desc: Descriptor) -> Callable[[], None]:
+        def blob_path(desc: Descriptor) -> str:
+            return tgz_paths.get(desc.digest) or os.path.join(directory, desc.name)
+
+        def job(desc: Descriptor, force: bool = False) -> Callable[[], None]:
             def run() -> None:
-                path = tgz_paths.get(desc.digest) or os.path.join(directory, desc.name)
-                self.push_blob(repository, desc, path, bar_pool)
+                self.push_blob(repository, desc, blob_path(desc), bar_pool, force=force)
 
             return run
 
@@ -115,19 +123,39 @@ class Pusher:
         if manifest.config.digest:
             jobs.append(job(manifest.config))
         bar_pool.run(jobs)
-        # commit point (push.go:56-64)
-        self.remote.put_manifest(repository, version, manifest)
+        # commit point (push.go:56-64). The server verifies every referenced
+        # blob and a failure names the exact delta; re-push just that and
+        # retry the commit instead of failing (or re-sending) the whole model.
+        for attempt in range(self.COMMIT_RETRIES + 1):
+            try:
+                self.remote.put_manifest(repository, version, manifest)
+                return
+            except errors.ErrorInfo as e:
+                delta = commit_delta_digests(e)
+                if not delta or attempt == self.COMMIT_RETRIES:
+                    raise
+                retriable = [d for d in manifest.all_descriptors() if d.digest in delta]
+                if not retriable:
+                    raise  # server wants digests this manifest doesn't carry
+                retry_pool = MultiBar(quiet=self.quiet)
+                retry_pool.run([job(d, force=True) for d in retriable])
 
-    def push_blob(self, repository: str, desc: Descriptor, path: str, bars: MultiBar) -> None:
+    def push_blob(
+        self, repository: str, desc: Descriptor, path: str, bars: MultiBar, force: bool = False
+    ) -> None:
         """push.go:163-207."""
         from modelx_tpu.utils import trace
 
         with trace.span("push.blob", blob=desc.name, bytes=desc.size):
-            self._push_blob(repository, desc, path, bars)
+            self._push_blob(repository, desc, path, bars, force=force)
 
-    def _push_blob(self, repository: str, desc: Descriptor, path: str, bars: MultiBar) -> None:
+    def _push_blob(
+        self, repository: str, desc: Descriptor, path: str, bars: MultiBar, force: bool = False
+    ) -> None:
         bar = bars.bar(desc.name, desc.size)
-        if self.remote.head_blob(repository, desc.digest):
+        # ``force`` skips the dedup probe: the server just told us this
+        # digest is missing or mismatched, so "exists" is a lie here
+        if not force and self.remote.head_blob(repository, desc.digest):
             bar.done("exists")  # dedup skip (push.go:169-177)
             return
         location = self.remote.get_blob_location(repository, desc, BlobLocationPurposeUpload)
@@ -141,6 +169,19 @@ class Pusher:
         with open(path, "rb") as f:
             self.remote.upload_blob_content(repository, desc, _ProgressReader(f, bar.update))
         bar.done()
+
+
+def commit_delta_digests(e: errors.ErrorInfo) -> set[str]:
+    """Digests the server's commit-verification 400 wants re-pushed:
+    ``detail`` carries ``{"missing": [...], "sizeMismatch": [{"digest":
+    ...}]}`` (docs/api.md). Empty set = not a delta-shaped error."""
+    if e.http_status != 400 or not isinstance(e.detail, dict):
+        return set()
+    out = {d for d in e.detail.get("missing", ()) if isinstance(d, str)}
+    for m in e.detail.get("sizeMismatch", ()):
+        if isinstance(m, dict) and isinstance(m.get("digest"), str):
+            out.add(m["digest"])
+    return out
 
 
 class _ProgressReader:

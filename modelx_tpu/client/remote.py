@@ -258,6 +258,18 @@ class RegistryClient:
             path += f"?grace={grace_s}"
         return self._request("POST", path).json()
 
+    def scrub(self, repository: str, sample: int = 0, seed: int = 0) -> dict:
+        """Server-side integrity scrub: re-hash stored blobs (all, or a
+        seeded sample), quarantine corruption, report dangling references.
+        Backs ``modelx scrub`` and ``modelx verify --remote`` — the audit
+        happens where the bytes live, no pull required."""
+        params: dict[str, str] = {}
+        if sample:
+            params["sample"] = str(sample)
+        if seed:
+            params["seed"] = str(seed)
+        return self._request("POST", f"/{repository}/scrub", params=params or None).json()
+
 
 def _sized_iter(f: BinaryIO, size: int, chunk: int = 1024 * 1024) -> Iterator[bytes]:
     remaining = size

@@ -33,12 +33,16 @@ ErrCodeUnknown = "UNKNOWN"
 
 @dataclasses.dataclass
 class ErrorInfo(Exception):
-    """errors.go:35-44 — carries HTTP status + machine code + message."""
+    """errors.go:35-44 — carries HTTP status + machine code + message.
+
+    ``detail`` is either a human string or a JSON-serializable structure:
+    commit-verification failures carry ``{"missing": [...], "sizeMismatch":
+    [...]}`` so clients can re-push exactly the delta (docs/api.md)."""
 
     http_status: int = 500
     code: str = ErrCodeUnknown
     message: str = ""
-    detail: str = ""
+    detail: Any = ""
 
     def __post_init__(self) -> None:
         super().__init__(self.message or self.code)
@@ -61,7 +65,7 @@ class ErrorInfo(Exception):
             http_status=http_status,
             code=d.get("code", ErrCodeUnknown),
             message=d.get("message", ""),
-            detail=str(d.get("detail", "")),
+            detail=d.get("detail", ""),
         )
 
     def __str__(self) -> str:
@@ -117,6 +121,23 @@ def index_unknown(name: str) -> ErrorInfo:
 
 def size_invalid(detail: str = "") -> ErrorInfo:
     return ErrorInfo(400, ErrCodeSizeInvalid, "size invalid", detail)
+
+
+def commit_invalid(missing: list[str], mismatched: list[dict]) -> ErrorInfo:
+    """Manifest-PUT commit verification failed: the manifest references
+    blobs that are absent or whose stored size disagrees with the
+    descriptor. A structured 400 — ``detail`` carries the exact delta so
+    the client re-pushes only those digests instead of the whole model.
+    The code stays SIZE_INVALID when every problem is a size mismatch
+    (the pre-existing S3 commit contract); any missing blob makes it
+    MANIFEST_BLOB_UNKNOWN."""
+    code = ErrCodeManifestBlobUnknown if missing else ErrCodeSizeInvalid
+    return ErrorInfo(
+        400,
+        code,
+        "manifest commit verification failed",
+        {"missing": list(missing), "sizeMismatch": list(mismatched)},
+    )
 
 
 def unauthorized(detail: str = "") -> ErrorInfo:

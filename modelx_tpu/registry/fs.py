@@ -157,8 +157,9 @@ class LocalFSProvider:
 
     META_SUFFIX = ".meta"
 
-    def __init__(self, basepath: str) -> None:
+    def __init__(self, basepath: str, fsync: bool = True) -> None:
         self.basepath = os.path.abspath(basepath)
+        self.fsync = fsync
         os.makedirs(self.basepath, exist_ok=True)
 
     def _abs(self, path: str) -> str:
@@ -176,10 +177,23 @@ class LocalFSProvider:
             with os.fdopen(fd, "wb") as f:
                 shutil.copyfileobj(content, f, 4 * 1024 * 1024)
                 written = f.tell()
-            if size >= 0 and written != size:
-                raise ValueError(f"size mismatch: declared {size}, got {written}")
+                if size >= 0 and written != size:
+                    raise ValueError(f"size mismatch: declared {size}, got {written}")
+                if self.fsync:
+                    # fsync-before-rename: without it a host crash can leave
+                    # the rename durable but the DATA torn — a committed,
+                    # visible blob with garbage bytes. The rename's own
+                    # durability comes from the directory fsync below.
+                    f.flush()
+                    os.fsync(f.fileno())
             os.chmod(tmp, 0o644)
             os.replace(tmp, abspath)
+            if self.fsync:
+                dfd = os.open(os.path.dirname(abspath), os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
         except BaseException:
             try:
                 os.unlink(tmp)
@@ -260,7 +274,10 @@ class LocalFSProvider:
                     if fn.endswith(self.META_SUFFIX) or fn.startswith(".tmp-"):
                         continue
                     full = os.path.join(root, fn)
-                    st = os.stat(full)
+                    try:
+                        st = os.stat(full)
+                    except FileNotFoundError:
+                        continue  # removed between walk and stat
                     out.append(
                         FSMeta(
                             name=os.path.relpath(full, base).replace(os.sep, "/"),
@@ -272,7 +289,10 @@ class LocalFSProvider:
             for entry in sorted(os.scandir(base), key=lambda e: e.name):
                 if entry.name.endswith(self.META_SUFFIX) or entry.name.startswith(".tmp-"):
                     continue
-                st = entry.stat()
+                try:
+                    st = entry.stat()
+                except FileNotFoundError:
+                    continue  # removed between scandir and stat
                 out.append(
                     FSMeta(
                         name=entry.name,

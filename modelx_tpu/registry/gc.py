@@ -24,6 +24,10 @@ class GCResult:
     checked: int = 0
     deleted: int = 0
     deleted_digests: list[str] = dataclasses.field(default_factory=list)
+    # unreferenced but protected: live upload marker / inside the grace
+    # window / age unknowable — the next sweep reconsiders them
+    skipped_in_flight: int = 0
+    skipped_young: int = 0
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -39,6 +43,22 @@ def gc_blobs(store: RegistryStore, repository: str, grace_s: float = DEFAULT_GRA
     commits the manifest last, so a sweep landing inside that window would
     otherwise delete the new version's blobs out from under it.
     """
+    # in-flight upload markers (crash-safe GC): a marked digest is an
+    # active push whatever its blob mtime says. Snapshot markers BEFORE
+    # reading the index: the commit refreshes the index before clearing
+    # markers, so marker-gone implies index-visible and a sweep spanning a
+    # commit can never miss both. grace_s=0 is the explicit operator
+    # override ("sweep everything unreferenced, now") and ignores markers
+    # like it ignores the age heuristic.
+    in_flight: set[str] = set()
+    if grace_s > 0:
+        active = getattr(store, "active_uploads", None)
+        if active is not None:
+            try:
+                in_flight = active(repository)
+            except Exception:
+                logger.exception("gc: active_uploads failed; trusting mtimes only")
+
     in_use: set[str] = set()
     try:
         idx = store.get_index(repository)
@@ -63,9 +83,19 @@ def gc_blobs(store: RegistryStore, repository: str, grace_s: float = DEFAULT_GRA
         result.checked += 1
         if digest in in_use:
             continue
+        if digest in in_flight:
+            result.skipped_in_flight += 1
+            continue
         if grace_s > 0:
-            age = now - _blob_mtime(store, repository, digest)
-            if age < grace_s:
+            mtime = _blob_mtime(store, repository, digest)
+            if mtime is None:
+                # unknown age MUST read as young, never as ancient: a store
+                # that can't report last_modified would otherwise see
+                # age == now and delete blobs INSIDE the grace window
+                result.skipped_young += 1
+                continue
+            if now - mtime < grace_s:
+                result.skipped_young += 1
                 continue  # possibly an in-flight push; next sweep gets it
         store.delete_blob(repository, digest)
         result.deleted += 1
@@ -74,12 +104,15 @@ def gc_blobs(store: RegistryStore, repository: str, grace_s: float = DEFAULT_GRA
     return result
 
 
-def _blob_mtime(store: RegistryStore, repository: str, digest: str) -> float:
+def _blob_mtime(store: RegistryStore, repository: str, digest: str) -> float | None:
+    """The blob's last-modified time, or None when it cannot be known
+    (backend without mtimes, or the blob vanished mid-sweep)."""
     try:
         meta = store.get_blob_meta(repository, digest)
-        return getattr(meta, "last_modified", 0.0) or 0.0
     except errors.ErrorInfo:
-        return 0.0
+        return None
+    mtime = getattr(meta, "last_modified", 0.0) or 0.0
+    return mtime if mtime > 0 else None
 
 
 def gc_blobs_all(store: RegistryStore, grace_s: float = DEFAULT_GRACE_S) -> list[GCResult]:

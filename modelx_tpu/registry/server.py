@@ -15,17 +15,27 @@ the protocol spec in docs/api.md:13-30. Route table (identical paths):
     GET     /{repository}/blobs/{digest}                 (supports Range)
     PUT     /{repository}/blobs/{digest}
     POST    /{repository}/garbage-collect
+    POST    /{repository}/scrub                          (new: integrity scrub)
     GET     /{repository}/blobs/{digest}/locations/{purpose}
 
 Upgrades over the reference: HTTP Range on blob GET (feeds the TPU loader's
 per-shard ranged reads when no presign layer exists), a /metrics endpoint
 (SURVEY.md §5 observability gap), double-write bug of registry.go:172-175
 fixed, and the auth context actually propagated (helper.go:93 discards it).
+
+Integrity enforcement (none of which the reference has): blob PUT bodies
+stream through sha256 and mismatches are rejected with typed 400s before
+the blob is visible; manifest PUT verifies every referenced blob and
+answers a structured 400 listing the re-push delta; blob GET/HEAD carry
+``Docker-Content-Digest``/``ETag`` and honor ``If-None-Match`` with 304;
+``POST /{repo}/scrub`` re-hashes and quarantines; boot runs a structural
+reconciliation pass (docs/api.md "Integrity" section).
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import io
 import json
 import logging
@@ -40,6 +50,7 @@ from urllib.parse import parse_qs, unquote, urlparse
 
 from modelx_tpu import errors
 from modelx_tpu.registry import gc as gcmod
+from modelx_tpu.registry import scrub as scrubmod
 from modelx_tpu.registry.fs import LocalFSProvider
 from modelx_tpu.registry.store import BlobContent, RegistryStore
 from modelx_tpu.registry.store_fs import FSRegistryStore
@@ -91,6 +102,11 @@ class Options:
     # gc_grace_s survive a sweep so in-flight pushes aren't corrupted.
     gc_interval_s: float = 0.0
     gc_grace_s: float = 600.0
+    # startup reconciliation: rebuild repo + global indexes from storage
+    # before taking traffic (crash recovery for a manifest persisted
+    # without its index refresh). Index-only — per-blob re-hashing and
+    # dangling detection are the scrub route's job.
+    reconcile_on_start: bool = True
 
 
 class Metrics:
@@ -129,6 +145,7 @@ class Registry:
             ("GET", re.compile(r"^/metrics$"), self.get_metrics),
             ("GET", re.compile(r"^/$"), self.get_global_index),
             ("POST", re.compile(rf"^/(?P<name>{name})/garbage-collect$"), self.garbage_collect),
+            ("POST", re.compile(rf"^/(?P<name>{name})/scrub$"), self.scrub),
             ("GET", re.compile(rf"^/(?P<name>{name})/index$"), self.get_index),
             ("DELETE", re.compile(rf"^/(?P<name>{name})/index$"), self.delete_index),
             ("GET", re.compile(rf"^/(?P<name>{name})/manifests/(?P<reference>{ref})$"), self.get_manifest),
@@ -192,11 +209,23 @@ class Registry:
         meta = self.store.get_blob_meta(name, digest)
         return Response(
             200,
-            headers={"Content-Length": str(meta.content_length), "Content-Type": meta.content_type or "application/octet-stream"},
+            headers={
+                "Content-Length": str(meta.content_length),
+                "Content-Type": meta.content_type or "application/octet-stream",
+                **_blob_validators(digest),
+            },
             head_only=True,
         )
 
     def get_blob(self, req: "Request", name: str, digest: str) -> "Response":
+        # content addressing makes the digest a perfect validator: a client
+        # (puller / blob cache) holding matching bytes revalidates for free
+        inm = req.headers.get("If-None-Match", "")
+        if inm and _etag_matches(inm, digest):
+            if not self.store.exists_blob(name, digest):
+                raise errors.blob_unknown(digest)
+            self.metrics.inc("blob_get_revalidated_total")
+            return Response(304, headers=_blob_validators(digest), head_only=True)
         offset, length, is_range = 0, -1, False
         rng = req.headers.get("Range", "")
         total = None
@@ -215,6 +244,7 @@ class Registry:
         headers = {
             "Content-Type": blob.content_type or "application/octet-stream",
             "Accept-Ranges": "bytes",
+            **_blob_validators(digest),
         }
         status = 200
         if is_range:
@@ -225,12 +255,23 @@ class Registry:
         return Response(status, headers=headers, body=blob.content, body_length=blob.content_length)
 
     def put_blob(self, req: "Request", name: str, digest: str) -> "Response":
+        """Verified write: the body streams through sha256 on its way into
+        the store; a digest or Content-Length mismatch aborts the write
+        BEFORE the blob becomes visible (the verifier raises on the final
+        read, so the FS temp file is discarded un-renamed and an existing
+        good blob at the same address is never replaced)."""
+        verifier = _VerifyingReader(req.body_stream(), digest, req.content_length)
         content = BlobContent(
-            content=req.body_stream(),
+            content=verifier,
             content_length=req.content_length,
             content_type=req.content_type or "application/octet-stream",
         )
-        self.store.put_blob(name, digest, content)
+        try:
+            self.store.put_blob(name, digest, content)
+        except errors.ErrorInfo:
+            self.metrics.inc("blob_put_rejected_total")
+            raise
+        verifier.ensure_verified()  # zero-read store paths still verify
         self.metrics.inc("blob_put_total")
         self.metrics.inc("blob_put_bytes", max(req.content_length, 0))
         return Response(201)
@@ -254,6 +295,22 @@ class Registry:
         self.metrics.inc("gc_blobs_deleted_total", result.deleted)
         return Response.json(200, result.to_json())
 
+    def scrub(self, req: "Request", name: str) -> "Response":
+        """Admin route (behind the same auth filter as everything else):
+        re-hash the repository's blobs — all of them, or ``?sample=N``
+        drawn from ``?seed=S`` — quarantine corruption, report dangling
+        references, rebuild indexes. ``modelx scrub`` / ``modelx verify
+        --remote`` land here."""
+        try:
+            sample = int(req.query_one("sample", "0") or 0)
+            seed = int(req.query_one("seed", "0") or 0)
+        except ValueError:
+            raise errors.ErrorInfo(400, errors.ErrCodeUnknown, "bad sample/seed value") from None
+        result = scrubmod.scrub_repository(self.store, name, sample=sample, seed=seed)
+        self.metrics.inc("scrub_total")
+        self.metrics.inc("scrub_quarantined_total", len(result.quarantined))
+        return Response.json(200, result.to_json())
+
     # -- dispatch -------------------------------------------------------------
 
     def dispatch(self, req: "Request") -> "Response":
@@ -268,6 +325,98 @@ class Registry:
         if path_matched:
             raise errors.unsupported(f"{req.method} not allowed on {req.path}")
         raise errors.ErrorInfo(404, errors.ErrCodeUnknown, f"no route: {req.method} {req.path}")
+
+
+def _blob_validators(digest: str) -> dict[str, str]:
+    """Revalidation headers for content-addressed blobs: the digest IS the
+    strong validator, in both the OCI spelling and the HTTP one."""
+    return {"Docker-Content-Digest": digest, "ETag": f'"{digest}"'}
+
+
+def _etag_matches(if_none_match: str, digest: str) -> bool:
+    if if_none_match.strip() == "*":
+        return True
+    etag = f'"{digest}"'
+    for candidate in if_none_match.split(","):
+        candidate = candidate.strip()
+        if candidate.startswith("W/"):
+            candidate = candidate[2:]
+        if candidate == etag or candidate == digest:
+            return True
+    return False
+
+
+class _VerifyingReader(io.RawIOBase):
+    """Stream a request body through its claimed hash on the way into the
+    store. The moment the declared Content-Length has been consumed (or
+    the stream ends early) the digest and size are checked and a typed
+    400 raised — BEFORE the final chunk is handed to the store, so an
+    atomic-rename backend never makes the bad object visible and a
+    read-all backend never reaches its commit."""
+
+    def __init__(self, inner: BinaryIO, digest: str, content_length: int) -> None:
+        algo, _, hexpart = digest.partition(":")
+        try:
+            self._hash = hashlib.new(algo)
+        except (ValueError, TypeError):
+            raise errors.digest_invalid(digest, f"unsupported digest algorithm: {algo}") from None
+        if len(hexpart) != self._hash.digest_size * 2:
+            raise errors.digest_invalid(
+                digest, f"{algo} digests are {self._hash.digest_size * 2} hex chars"
+            )
+        if content_length < 0:
+            raise errors.size_invalid("Content-Length required for blob upload")
+        self._inner = inner
+        self._digest = digest
+        self._want_hex = hexpart.lower()
+        self._expected = content_length
+        self._consumed = 0
+        self._verified = False
+
+    def read(self, n: int = -1) -> bytes:  # type: ignore[override]
+        if self._verified:
+            return b""
+        if n is None or n < 0:
+            # read-all semantics: loop to true EOF so a short underlying
+            # read (socket closed early) still reaches the verification
+            parts = []
+            while not self._verified:
+                chunk = self._read1(1 << 20)
+                if chunk:
+                    parts.append(chunk)
+            return b"".join(parts)
+        return self._read1(n)
+
+    def _read1(self, n: int) -> bytes:
+        data = self._inner.read(n)
+        if data:
+            self._hash.update(data)
+            self._consumed += len(data)
+        if not data or self._consumed >= self._expected:
+            self._verify()
+        return data
+
+    def readable(self) -> bool:
+        return True
+
+    def ensure_verified(self) -> None:
+        """Force the EOF check for store paths that never read (empty
+        bodies on zero-touch backends). Drains any unread remainder first
+        so verification always judges the whole declared body."""
+        while not self._verified:
+            self.read(1 << 20)
+
+    def _verify(self) -> None:
+        self._verified = True
+        if self._consumed != self._expected:
+            raise errors.size_invalid(
+                f"body was {self._consumed} bytes, Content-Length declared {self._expected}"
+            )
+        got = self._hash.hexdigest()
+        if got != self._want_hex:
+            raise errors.digest_invalid(
+                self._digest, f"body hashes to {self._digest.partition(':')[0]}:{got}"
+            )
 
 
 @dataclasses.dataclass
@@ -496,6 +645,15 @@ class RegistryServer:
         if store is None:
             store = new_store(opts)
         self.registry = Registry(store, opts)
+        if opts.reconcile_on_start:
+            # index-only pass: a crash between manifest persist and index
+            # refresh leaves indexes stale — rebuild them from storage
+            # before taking traffic (cheap even on object-store backends;
+            # the scrub route does the deep audits)
+            try:
+                scrubmod.reconcile(store, rehash=False)
+            except Exception:
+                logger.exception("startup reconciliation failed; serving anyway")
         handler = type("BoundHandler", (_Handler,), {"registry": self.registry})
         host, _, port = opts.listen.rpartition(":")
         self.httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)), handler)

@@ -92,3 +92,19 @@ def index_path(repository: str) -> str:
 def manifest_path(repository: str, reference: str) -> str:
     """store.go:67-69."""
     return posixpath.join(repository, "manifests", reference)
+
+
+def upload_marker_path(repository: str, digest: str) -> str:
+    """In-flight upload marker: touched when a blob PUT starts (or a
+    presigned upload location is issued), cleared at manifest commit. GC
+    treats marked digests as active pushes regardless of blob mtime."""
+    algo, _, hexpart = digest.partition(":")
+    return posixpath.join(repository, "uploads", algo, hexpart)
+
+
+def quarantine_path(repository: str, digest: str) -> str:
+    """Where the scrubber parks corrupt blob bytes. Outside ``blobs/`` so
+    the digest 404s (and becomes re-pushable) while the evidence stays
+    inspectable."""
+    algo, _, hexpart = digest.partition(":")
+    return posixpath.join(repository, "quarantine", algo, hexpart)

@@ -15,9 +15,11 @@ catalogued bugs fixed (SURVEY.md §7):
 from __future__ import annotations
 
 import io
+import json
 import posixpath
 import re
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterable
 
@@ -31,6 +33,8 @@ from modelx_tpu.registry.store import (
     blob_digest_path,
     index_path,
     manifest_path,
+    quarantine_path,
+    upload_marker_path,
 )
 from modelx_tpu.types import (
     BlobLocation,
@@ -48,17 +52,33 @@ _INDEX_REBUILD_CONCURRENCY = 16
 class FSRegistryStore:
     """store_fs.go:23-28."""
 
+    # Upload markers older than this are presumed abandoned pushes: GC may
+    # reclaim their blobs and active_uploads() garbage-collects the marker.
+    UPLOAD_MARKER_TTL_S = 24 * 3600.0
+
     def __init__(
-        self, fs: FSProvider, refresh_on_init: bool = True, local_redirect: bool = False
+        self,
+        fs: FSProvider,
+        refresh_on_init: bool = True,
+        local_redirect: bool = False,
+        fault_plan=None,
     ) -> None:
         self.fs = fs
         self.local_redirect = local_redirect
+        # modelx_tpu.testing.faults.FaultPlan (tests only): fires
+        # ``store.manifest_persisted`` between manifest persist and index
+        # refresh so stale-index crash recovery is deterministic.
+        self.fault_plan = fault_plan
         self._index_locks: dict[str, threading.Lock] = {}
         self._index_locks_guard = threading.Lock()
         self._global_lock = threading.Lock()
         if refresh_on_init:
             # store_fs.go:56-58 — rebuild the global index at boot.
             self.refresh_global_index()
+
+    def _fault(self, op: str) -> None:
+        if self.fault_plan is not None:
+            self.fault_plan.maybe_fail(op)
 
     # -- locks ----------------------------------------------------------------
 
@@ -210,8 +230,32 @@ class FSRegistryStore:
     def put_manifest(
         self, repository: str, reference: str, content_type: str, manifest: Manifest
     ) -> None:
-        """Manifest PUT is the commit point (store_fs.go:87-104): persist, then
-        rebuild the repo index."""
+        """Manifest PUT is the commit point (store_fs.go:87-104): mark
+        every referenced blob in-flight, verify it exists with a matching
+        size, persist, rebuild the repo index, then clear the markers.
+        Verification failure is a structured 400 whose detail lists
+        exactly the missing/mismatched digests (the delta the client must
+        re-push, docs/api.md)."""
+        self._mark_referenced(repository, manifest)
+        self._verify_commit(repository, manifest)
+        self._commit_manifest(repository, reference, content_type, manifest)
+
+    def _mark_referenced(self, repository: str, manifest: Manifest) -> None:
+        """Commit-intent markers, BEFORE verification: a blob the push
+        dedup-skipped (HEAD said it exists) never saw a blob-PUT marker,
+        so without this a sweep could reclaim it between verification and
+        the index refresh — committing a manifest whose pulls 404. Failed
+        commits leave markers behind; the TTL reclaims them."""
+        for desc in manifest.all_descriptors():
+            if desc.digest:
+                self.mark_upload(repository, desc.digest)
+
+    def _commit_manifest(
+        self, repository: str, reference: str, content_type: str, manifest: Manifest
+    ) -> None:
+        """Persist + index refresh + marker clear, in exactly that order.
+        Callers must have verified the manifest (``_verify_commit`` or a
+        backend-specific equivalent) and marked its digests first."""
         data = manifest.encode()
         self.fs.put(
             manifest_path(repository, reference),
@@ -219,7 +263,42 @@ class FSRegistryStore:
             len(data),
             content_type or MediaTypeModelManifestJson,
         )
+        # crash point for the drills: the manifest is durable but markers
+        # and indexes are stale — startup reconciliation must recover
+        self._fault("store.manifest_persisted")
         self.refresh_index(repository)
+        # markers clear ONLY after the index refresh: GC snapshots markers
+        # before it reads the index, so marker-gone implies index-visible
+        # and a sweep spanning this commit can never miss both (the
+        # GC-vs-push race drill in test_stress_registry.py)
+        for desc in manifest.all_descriptors():
+            if desc.digest:
+                self.clear_upload(repository, desc.digest)
+
+    def _verify_commit(self, repository: str, manifest: Manifest) -> None:
+        """Commit-point verification: every referenced descriptor must
+        exist with a matching size. Collects ALL problems (not first-fail)
+        so one round trip tells the client the whole re-push delta."""
+        missing: list[str] = []
+        mismatched: list[dict] = []
+        for desc in manifest.all_descriptors():
+            if not desc.digest:
+                continue
+            try:
+                meta = self.get_blob_meta(repository, desc.digest)
+            except errors.ErrorInfo:
+                missing.append(str(desc.digest))
+                continue
+            if desc.size and meta.content_length != desc.size:
+                mismatched.append(
+                    {
+                        "digest": str(desc.digest),
+                        "expected": desc.size,
+                        "stored": meta.content_length,
+                    }
+                )
+        if missing or mismatched:
+            raise errors.commit_invalid(missing, mismatched)
 
     def delete_manifest(self, repository: str, reference: str) -> None:
         try:
@@ -257,6 +336,9 @@ class FSRegistryStore:
             pass  # idempotent delete
 
     def put_blob(self, repository: str, digest: str, content: BlobContent) -> None:
+        # marker FIRST: if the write below is slow (multi-GB push) the GC
+        # must already know this digest is in flight, whatever its mtime
+        self.mark_upload(repository, digest)
         self.fs.put(
             blob_digest_path(repository, digest),
             content.content,
@@ -266,6 +348,71 @@ class FSRegistryStore:
 
     def exists_blob(self, repository: str, digest: str) -> bool:
         return self.fs.exists(blob_digest_path(repository, digest))
+
+    # -- in-flight upload markers (crash-safe GC) ------------------------------
+
+    def mark_upload(self, repository: str, digest: str) -> None:
+        """Record an in-flight push of ``digest``: touched at blob-PUT
+        start and presign issue, cleared at manifest commit. GC excludes
+        marked digests instead of trusting only the mtime grace window."""
+        payload = json.dumps({"digest": digest, "at": time.time()}).encode()
+        try:
+            self.fs.put(
+                upload_marker_path(repository, digest),
+                io.BytesIO(payload),
+                len(payload),
+                "application/json",
+            )
+        except OSError:
+            # a failed marker must not fail the push; GC degrades to the
+            # mtime grace window for this digest
+            pass
+
+    def clear_upload(self, repository: str, digest: str) -> None:
+        try:
+            self.fs.remove(upload_marker_path(repository, digest))
+        except (FSNotFound, OSError):
+            pass  # idempotent; S3-style stores 204 on missing anyway
+
+    def active_uploads(self, repository: str, ttl_s: float | None = None) -> set[str]:
+        """Digests with a live upload marker. Markers older than the TTL
+        are abandoned pushes: dropped from the result and deleted. A
+        marker whose mtime the backend can't report is treated as LIVE —
+        unknown age must never read as ancient (the `_blob_mtime` rule)."""
+        ttl = self.UPLOAD_MARKER_TTL_S if ttl_s is None else ttl_s
+        now = time.time()
+        out: set[str] = set()
+        base = posixpath.join(repository, "uploads")
+        for meta in self.fs.list(base, recursive=True):
+            digest = meta.name.replace("/", ":", 1)
+            mtime = meta.last_modified or 0.0
+            if mtime > 0 and now - mtime > ttl:
+                self.clear_upload(repository, digest)
+                continue
+            out.add(digest)
+        return out
+
+    # -- corruption quarantine -------------------------------------------------
+
+    def quarantine_blob(self, repository: str, digest: str) -> None:
+        """Move a corrupt blob out of ``blobs/`` into ``quarantine/``: the
+        content address 404s (instead of serving bad bytes) and becomes
+        re-pushable, while the evidence stays inspectable on the store."""
+        src = blob_digest_path(repository, digest)
+        dst = quarantine_path(repository, digest)
+        try:
+            content = self.fs.get(src)
+        except FSNotFound:
+            raise errors.blob_unknown(digest) from None
+        try:
+            self.fs.put(dst, content.reader, content.size, content.content_type)
+        finally:
+            content.reader.close()
+        self.fs.remove(src)
+
+    def list_quarantined(self, repository: str) -> list[str]:
+        base = posixpath.join(repository, "quarantine")
+        return [m.name.replace("/", ":", 1) for m in self.fs.list(base, recursive=True)]
 
     def get_blob_meta(self, repository: str, digest: str) -> BlobMeta:
         try:

@@ -56,6 +56,9 @@ class GCSRegistryStore(S3RegistryStore):
     ) -> BlobLocation | None:
         if purpose == BlobLocationPurposeUpload and self.enable_redirect:
             key = self._blob_key(repository, digest)
+            # resumable-session issue = upload start (crash-safe GC marker,
+            # same contract as the S3 presign path)
+            self.mark_upload(repository, digest)
             return BlobLocation(
                 provider=self.provider,
                 purpose=purpose,

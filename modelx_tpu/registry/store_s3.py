@@ -107,6 +107,9 @@ class S3RegistryStore(FSRegistryStore):
         size = int(properties.get("size", 0) or 0)
         content_type = properties.get("mediaType", "") or "application/octet-stream"
         if purpose == BlobLocationPurposeUpload:
+            # presign issue = upload start: mark so GC never reclaims a
+            # digest mid-transfer, however long the client takes
+            self.mark_upload(repository, digest)
             if size > MULTIPART_THRESHOLD:
                 return self._upload_location_multipart(key, size, content_type)
             return BlobLocation(
@@ -162,8 +165,16 @@ class S3RegistryStore(FSRegistryStore):
         verify blob sizes; a size mismatch quarantine-deletes the bad blob and
         fails. Unlike the reference, a blob already referenced by a committed
         manifest is never deleted — otherwise one bad descriptor from any
-        client with push rights could destroy blobs other versions depend on."""
+        client with push rights could destroy blobs other versions depend on.
+        Problems are COLLECTED over the whole manifest (not first-fail) and
+        raised as one structured 400, so a single round trip tells the client
+        the exact re-push delta. This loop IS the commit verification for
+        object stores — it commits via ``_commit_manifest`` directly so the
+        FS layer's ``_verify_commit`` doesn't re-HEAD every blob."""
+        self._mark_referenced(repository, manifest)
         in_use: set[str] | None = None
+        missing: list[str] = []
+        mismatched: list[dict] = []
         for desc in manifest.all_descriptors():
             if not desc.digest:
                 continue
@@ -175,17 +186,20 @@ class S3RegistryStore(FSRegistryStore):
             try:
                 head = self.client.head_object(key)
             except FSNotFound:
-                raise errors.manifest_blob_unknown(desc.digest) from None
+                missing.append(str(desc.digest))
+                continue
             actual = int(head.get("Content-Length", 0) or 0)
             if desc.size and actual != desc.size:
                 if in_use is None:
                     in_use = self._referenced_digests(repository)
                 if desc.digest not in in_use:
                     self.client.delete_object(key)  # quarantine (store_s3.go:77-89)
-                raise errors.size_invalid(
-                    f"blob {desc.digest}: expected {desc.size} bytes, stored {actual}"
+                mismatched.append(
+                    {"digest": str(desc.digest), "expected": desc.size, "stored": actual}
                 )
-        super().put_manifest(repository, reference, content_type, manifest)
+        if missing or mismatched:
+            raise errors.commit_invalid(missing, mismatched)
+        self._commit_manifest(repository, reference, content_type, manifest)
 
     def _referenced_digests(self, repository: str) -> set[str]:
         """Digests referenced by any committed manifest of the repository."""

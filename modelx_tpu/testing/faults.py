@@ -11,7 +11,14 @@ apply them to the three seams the framework exposes:
 - ``wrap_dispatch`` wraps a compiled engine program (the continuous
   engine's chunk/admit dispatches) so a crash lands at an exact call index;
 - ``tests/fake_s3.py`` / ``tests/fake_gcs.py`` accept a plan directly
-  (server-side 500s and mid-body truncation for blob-store traffic).
+  (server-side 500s and mid-body truncation for blob-store traffic);
+- ``FaultyFSProvider`` wraps any registry ``FSProvider`` with crash-point
+  injection: abort before ``fs.put`` (nothing written) or mid-put (a TORN
+  object commits, then :class:`InjectedCrash`), plus scheduled errors and
+  latency on every provider op — the registry torn-write/scrub drills;
+- ``FSRegistryStore(fault_plan=...)`` fires ``store.manifest_persisted``
+  between manifest persist and index refresh, so stale-index recovery is
+  a deterministic test.
 
 Determinism: schedules are either explicit call indices (``errors_at``)
 or drawn once per op from ``random.Random(seed ^ crc(op))`` at rule-add
@@ -150,6 +157,82 @@ class FaultPlan:
 # -- seam wrappers -------------------------------------------------------------
 
 
+class InjectedCrash(RuntimeError):
+    """A deterministic 'host died here' stand-in. Raised at a scheduled
+    point, it aborts the in-flight operation exactly where a crash would;
+    the drill then rebuilds the store over the same underlying provider to
+    model a process restart and asserts recovery (torn-write quarantine,
+    stale-index rebuild, marker-protected GC)."""
+
+
+class FaultyFSProvider:
+    """Wrap any registry ``FSProvider`` with a seeded :class:`FaultPlan`.
+
+    Ops fired (0-based call indices, per plan semantics): ``fs.put``,
+    ``fs.get``, ``fs.stat``, ``fs.remove``, ``fs.exists``, ``fs.list``.
+    Special ``fs.put`` behaviors:
+
+    - an error schedule raises BEFORE the inner put — nothing written
+      (crash before the write);
+    - a truncation schedule (``truncate_at``/``keep_bytes``) COMMITS the
+      torn prefix at the destination path and then raises
+      :class:`InjectedCrash` — the torn-write shape a non-atomic backend
+      (or a crash on a store without fsync-before-rename) produces. This
+      is what the scrub/quarantine drills feed on.
+
+    Unlike ``FaultInjectionFSProvider`` (callback-driven), schedules here
+    are seeded and index-exact, so crash drills replay byte-identically.
+    """
+
+    def __init__(self, inner, plan: FaultPlan, prefix: str = "fs") -> None:
+        self.inner = inner
+        self.plan = plan
+        self.prefix = prefix
+
+    def _op(self, name: str) -> str:
+        return f"{self.prefix}.{name}"
+
+    def put(self, path: str, content, size: int = -1, content_type: str = "") -> None:
+        act = self.plan.fire(self._op("put"))
+        if act.latency_s:
+            time.sleep(act.latency_s)
+        if act.error is not None:
+            raise act.error
+        if act.keep_bytes >= 0:
+            torn = content.read()[: act.keep_bytes]
+            import io as _io
+
+            self.inner.put(path, _io.BytesIO(torn), len(torn), content_type)
+            raise InjectedCrash(
+                f"torn write: {len(torn)} bytes committed at {path}"
+            )
+        self.inner.put(path, content, size, content_type)
+
+    def get(self, path: str, offset: int = 0, length: int = -1):
+        self.plan.maybe_fail(self._op("get"))
+        return self.inner.get(path, offset, length)
+
+    def stat(self, path: str):
+        self.plan.maybe_fail(self._op("stat"))
+        return self.inner.stat(path)
+
+    def remove(self, path: str) -> None:
+        self.plan.maybe_fail(self._op("remove"))
+        self.inner.remove(path)
+
+    def exists(self, path: str) -> bool:
+        self.plan.maybe_fail(self._op("exists"))
+        return self.inner.exists(path)
+
+    def list(self, prefix: str, recursive: bool = False):
+        self.plan.maybe_fail(self._op("list"))
+        return self.inner.list(prefix, recursive)
+
+    def __getattr__(self, name):
+        # pass through provider extras (e.g. LocalFSProvider.local_path)
+        return getattr(self.inner, name)
+
+
 def wrap_dispatch(fn, plan: FaultPlan, op: str = "engine.dispatch"):
     """Wrap a compiled dispatch callable (e.g. the continuous engine's
     chunk program): scheduled latency/errors fire BEFORE the real call, so
@@ -214,10 +297,15 @@ def from_env(env_var: str = ENV_VAR) -> FaultPlan | None:
     d = json.loads(spec)
     plan = FaultPlan(seed=int(d.get("seed", 0)))
     for r in d.get("rules", ()):
+        err: BaseException | None = None
+        if r.get("crash"):
+            err = InjectedCrash(r.get("error", "injected crash"))
+        elif r.get("error"):
+            err = OSError(r["error"])
         plan.add(
             r["op"],
             errors_at=r.get("errors_at", ()),
-            error=OSError(r["error"]) if r.get("error") else None,
+            error=err,
             error_rate=float(r.get("error_rate", 0.0)),
             horizon=int(r.get("horizon", 256)),
             latency_at=r.get("latency_at", ()),

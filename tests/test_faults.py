@@ -150,3 +150,57 @@ class TestEnvGate:
         assert plan.has("blob.get")
         assert plan.fire("blob.get").keep_bytes == -1
         assert plan.fire("blob.get").keep_bytes == 5
+
+
+class TestFaultyFSProvider:
+    """Registry crash-point seam (ISSUE 4): torn puts and exact-index
+    aborts over any FSProvider."""
+
+    def test_torn_put_commits_prefix_then_crashes(self):
+        import io
+
+        from modelx_tpu.registry.fs import MemoryFSProvider
+
+        inner = MemoryFSProvider()
+        plan = faults.FaultPlan(seed=1).add("fs.put", truncate_at=[0], keep_bytes=3)
+        fs = faults.FaultyFSProvider(inner, plan)
+        with pytest.raises(faults.InjectedCrash):
+            fs.put("a/blob", io.BytesIO(b"0123456789"), 10)
+        # the torn prefix IS visible — the non-atomic-backend shape the
+        # scrub drills recover from
+        assert inner.get("a/blob").read_all() == b"012"
+        # next put is clean and replaces the tear
+        fs.put("a/blob", io.BytesIO(b"0123456789"), 10)
+        assert inner.get("a/blob").read_all() == b"0123456789"
+
+    def test_error_before_put_writes_nothing(self):
+        import io
+
+        from modelx_tpu.registry.fs import MemoryFSProvider
+
+        inner = MemoryFSProvider()
+        plan = faults.FaultPlan().add("fs.put", errors_at=[0], error=faults.InjectedCrash("die"))
+        fs = faults.FaultyFSProvider(inner, plan)
+        with pytest.raises(faults.InjectedCrash):
+            fs.put("x", io.BytesIO(b"zz"), 2)
+        assert not inner.exists("x")
+
+    def test_passthrough_ops_fire_plan(self):
+        import io
+
+        from modelx_tpu.registry.fs import MemoryFSProvider
+
+        inner = MemoryFSProvider()
+        plan = faults.FaultPlan().add("fs.get", errors_at=[0], error=OSError("nope"))
+        fs = faults.FaultyFSProvider(inner, plan)
+        fs.put("k", io.BytesIO(b"v"), 1)
+        with pytest.raises(OSError):
+            fs.get("k")
+        assert fs.get("k").read_all() == b"v"  # index 1: clean
+
+    def test_from_env_crash_rule(self, monkeypatch):
+        spec = {"rules": [{"op": "fs.put", "errors_at": [0], "crash": True, "error": "host died"}]}
+        monkeypatch.setenv(faults.ENV_VAR, json.dumps(spec))
+        plan = faults.from_env()
+        act = plan.fire("fs.put")
+        assert isinstance(act.error, faults.InjectedCrash)

@@ -13,12 +13,17 @@ from modelx_tpu.types import Descriptor, Digest, Index, Manifest
 
 
 @pytest.fixture
-def server():
+def server_store():
     store = FSRegistryStore(MemoryFSProvider())
     srv = RegistryServer(Options(listen=f"127.0.0.1:{free_port()}"), store=store)
     base = srv.serve_background()
-    yield base
+    yield base, store
     srv.shutdown()
+
+
+@pytest.fixture
+def server(server_store):
+    return server_store[0]
 
 
 @pytest.fixture
@@ -159,6 +164,172 @@ class TestRoutes:
         assert "modelx_blob_put_total 1" in text
 
 
+class TestVerifiedWrites:
+    """Blob PUT streams through sha256: mismatches are typed 400s and the
+    bad bytes never become visible (ISSUE 4 tentpole, pillar 1)."""
+
+    def test_digest_mismatch_rejected_and_invisible(self, server):
+        data = b"these are not the bytes the digest promises"
+        wrong = str(Digest.from_bytes(b"something else entirely"))
+        r = requests.put(f"{server}/{REPO}/blobs/{wrong}", data=data)
+        assert r.status_code == 400
+        assert r.json()["code"] == "DIGEST_INVALID"
+        # no file at the blob path
+        assert requests.head(f"{server}/{REPO}/blobs/{wrong}").status_code == 404
+        assert requests.get(f"{server}/{REPO}/blobs/{wrong}").status_code == 404
+        # the same address accepts the RIGHT bytes afterwards
+        good = b"something else entirely"
+        assert requests.put(f"{server}/{REPO}/blobs/{wrong}", data=good).status_code == 201
+        assert requests.get(f"{server}/{REPO}/blobs/{wrong}").content == good
+
+    def test_unsupported_algorithm_rejected(self, server):
+        r = requests.put(f"{server}/{REPO}/blobs/nosuchalgo:" + "a" * 64, data=b"x")
+        assert r.status_code == 400
+        assert r.json()["code"] == "DIGEST_INVALID"
+
+    def test_wrong_hex_length_rejected(self, server):
+        r = requests.put(f"{server}/{REPO}/blobs/sha256:" + "a" * 40, data=b"x")
+        assert r.status_code == 400
+        assert r.json()["code"] == "DIGEST_INVALID"
+
+    def test_content_length_mismatch_rejected(self, server):
+        """A body shorter than its declared Content-Length is SIZE_INVALID
+        (raw socket: requests always sends a truthful Content-Length)."""
+        import socket as socketmod
+        from urllib.parse import urlparse
+
+        data = b"short"
+        digest = str(Digest.from_bytes(data))
+        u = urlparse(server)
+        with socketmod.create_connection((u.hostname, u.port), timeout=10) as s:
+            req = (
+                f"PUT /{REPO}/blobs/{digest} HTTP/1.1\r\n"
+                f"Host: {u.netloc}\r\nContent-Length: 64\r\n"
+                "Connection: close\r\n\r\n"
+            ).encode() + data
+            s.sendall(req)
+            s.shutdown(socketmod.SHUT_WR)  # body ends 59 bytes early
+            resp = b""
+            while chunk := s.recv(65536):
+                resp += chunk
+        status = int(resp.split(b" ", 2)[1])
+        body = json.loads(resp.split(b"\r\n\r\n", 1)[1])
+        assert status == 400 and body["code"] == "SIZE_INVALID"
+        assert requests.head(f"{server}/{REPO}/blobs/{digest}").status_code == 404
+
+    def test_manifest_commit_lists_missing_delta(self, server):
+        """Manifest PUT verifies every referenced blob and answers the
+        exact missing-digest list; pushing just that delta completes the
+        commit (ISSUE 4 tentpole, pillar 2)."""
+        a, b = b"present blob", b"absent blob"
+        da, db = str(Digest.from_bytes(a)), str(Digest.from_bytes(b))
+        assert requests.put(f"{server}/{REPO}/blobs/{da}", data=a).status_code == 201
+        manifest = Manifest(blobs=[
+            Descriptor(name="a.bin", digest=da, size=len(a)),
+            Descriptor(name="b.bin", digest=db, size=len(b)),
+        ])
+        r = requests.put(f"{server}/{REPO}/manifests/v1", data=manifest.encode())
+        assert r.status_code == 400
+        body = r.json()
+        assert body["code"] == "MANIFEST_BLOB_UNKNOWN"
+        assert body["detail"]["missing"] == [db]
+        assert body["detail"]["sizeMismatch"] == []
+        # nothing committed: the repo still has no versions
+        assert requests.get(f"{server}/{REPO}/manifests/v1").status_code == 404
+        # push exactly the delta, retry the commit
+        assert requests.put(f"{server}/{REPO}/blobs/{db}", data=b).status_code == 201
+        assert requests.put(f"{server}/{REPO}/manifests/v1", data=manifest.encode()).status_code == 201
+
+    def test_manifest_commit_flags_size_mismatch(self, server):
+        data = b"right bytes"
+        digest = str(Digest.from_bytes(data))
+        assert requests.put(f"{server}/{REPO}/blobs/{digest}", data=data).status_code == 201
+        manifest = Manifest(blobs=[Descriptor(name="w.bin", digest=digest, size=999)])
+        r = requests.put(f"{server}/{REPO}/manifests/v1", data=manifest.encode())
+        assert r.status_code == 400
+        body = r.json()
+        assert body["code"] == "SIZE_INVALID"
+        assert body["detail"]["sizeMismatch"] == [
+            {"digest": digest, "expected": 999, "stored": len(data)}
+        ]
+
+
+class TestBlobRevalidation:
+    """Content addressing makes the digest a perfect cache validator."""
+
+    def test_get_and_head_carry_validators(self, server):
+        digest, _ = push_model(server)
+        for r in (requests.get(f"{server}/{REPO}/blobs/{digest}"),
+                  requests.head(f"{server}/{REPO}/blobs/{digest}")):
+            assert r.headers["Docker-Content-Digest"] == digest
+            assert r.headers["ETag"] == f'"{digest}"'
+
+    def test_if_none_match_304(self, server):
+        digest, _ = push_model(server)
+        r = requests.get(f"{server}/{REPO}/blobs/{digest}",
+                         headers={"If-None-Match": f'"{digest}"'})
+        assert r.status_code == 304 and r.content == b""
+        assert r.headers["ETag"] == f'"{digest}"'
+        # weak validators and bare digests also match
+        for inm in (f'W/"{digest}"', digest, f'"other", "{digest}"'):
+            assert requests.get(f"{server}/{REPO}/blobs/{digest}",
+                                headers={"If-None-Match": inm}).status_code == 304
+        # a non-matching validator streams the bytes
+        r = requests.get(f"{server}/{REPO}/blobs/{digest}",
+                         headers={"If-None-Match": '"sha256:' + "0" * 64 + '"'})
+        assert r.status_code == 200 and r.content == b"some model weights"
+
+    def test_if_none_match_on_missing_blob_404s(self, server):
+        missing = "sha256:" + "0" * 64
+        r = requests.get(f"{server}/{REPO}/blobs/{missing}",
+                         headers={"If-None-Match": f'"{missing}"'})
+        assert r.status_code == 404
+
+
+class TestScrubRoute:
+    def test_scrub_clean(self, server):
+        push_model(server)
+        r = requests.post(f"{server}/{REPO}/scrub")
+        assert r.status_code == 200
+        body = r.json()
+        assert body["clean"] and body["checked"] == 1 and body["quarantined"] == []
+
+    def test_scrub_quarantines_and_repush_restores(self, server_store):
+        """HTTP acceptance round-trip: corrupt -> scrub -> 404 -> re-push."""
+        base, store = server_store
+        digest, manifest = push_model(base)
+        # disk rot underneath the store (the API refuses tampered writes)
+        import io as _io
+
+        from modelx_tpu.registry.store import blob_digest_path
+
+        store.fs.put(blob_digest_path(REPO, digest), _io.BytesIO(b"rotted bytes here!"), 18, "")
+        r = requests.post(f"{base}/{REPO}/scrub")
+        assert r.json()["quarantined"] == [digest]
+        # the digest 404s — corrupt bytes are never served
+        assert requests.get(f"{base}/{REPO}/blobs/{digest}").status_code == 404
+        # re-push the same digest restores service
+        assert requests.put(f"{base}/{REPO}/blobs/{digest}",
+                            data=b"some model weights").status_code == 201
+        assert requests.get(f"{base}/{REPO}/blobs/{digest}").content == b"some model weights"
+        assert requests.post(f"{base}/{REPO}/scrub").json()["clean"]
+
+    def test_scrub_sampled(self, server):
+        push_model(server, tag="v1", data=b"first blob bytes")
+        push_model(server, tag="v2", data=b"second blob bytes")
+        body = requests.post(f"{server}/{REPO}/scrub", params={"sample": 1, "seed": 3}).json()
+        assert body["sampled"] is True and body["checked"] == 1
+
+    def test_scrub_bad_params(self, server):
+        assert requests.post(f"{server}/{REPO}/scrub", params={"sample": "nope"}).status_code == 400
+
+    def test_scrub_requires_auth(self, auth_server):
+        assert requests.post(f"{auth_server}/{REPO}/scrub").status_code == 401
+        r = requests.post(f"{auth_server}/{REPO}/scrub",
+                          headers={"Authorization": "Bearer sekrit"})
+        assert r.status_code == 200
+
+
 class TestAuth:
     def test_rejects_anonymous(self, auth_server):
         assert requests.get(f"{auth_server}/").status_code == 401
@@ -176,6 +347,43 @@ class TestAuth:
         assert requests.get(f"{auth_server}/?token=sekrit").status_code == 200
         assert requests.get(f"{auth_server}/?access_token=sekrit").status_code == 200
         assert requests.get(f"{auth_server}/?token=wrong").status_code == 401
+
+
+class TestStartupReconcile:
+    def test_boot_recovers_index_stale_after_crash(self):
+        """A commit that crashed between manifest persist and index refresh
+        leaves a stale index; serve's startup reconciliation pass rebuilds
+        it from storage before taking traffic."""
+        import io as _io
+
+        from modelx_tpu.registry.store import BlobContent
+        from modelx_tpu.testing.faults import FaultPlan, InjectedCrash
+
+        fs = MemoryFSProvider()
+        plan = FaultPlan().add(
+            "store.manifest_persisted", errors_at=[1], error=InjectedCrash("host died")
+        )
+        store = FSRegistryStore(fs, fault_plan=plan)
+        data = b"v0 bytes"
+        d0 = str(Digest.from_bytes(data))
+        store.put_blob(REPO, d0, BlobContent(_io.BytesIO(data), len(data), ""))
+        store.put_manifest(REPO, "v0", "", Manifest(blobs=[Descriptor(name="a", digest=d0, size=len(data))]))
+        data1 = b"v1 bytes!"
+        d1 = str(Digest.from_bytes(data1))
+        store.put_blob(REPO, d1, BlobContent(_io.BytesIO(data1), len(data1), ""))
+        with pytest.raises(InjectedCrash):
+            store.put_manifest(REPO, "v1", "", Manifest(blobs=[Descriptor(name="b", digest=d1, size=len(data1))]))
+
+        # "restart" the registry process over the same storage
+        srv = RegistryServer(
+            Options(listen=f"127.0.0.1:{free_port()}"), store=FSRegistryStore(fs)
+        )
+        base = srv.serve_background()
+        try:
+            idx = Index.from_json(requests.get(f"{base}/{REPO}/index").json())
+            assert sorted(m.name for m in idx.manifests) == ["v0", "v1"]
+        finally:
+            srv.shutdown()
 
 
 class TestRangeEdgeCases:

@@ -3,6 +3,7 @@
 
 import io
 import threading
+import time
 
 import pytest
 
@@ -207,6 +208,186 @@ class TestStoreContract:
         store.refresh_index(self.REPO)
         idx = store.get_index(self.REPO)
         assert {e.name for e in idx.manifests} == {f"v{i}" for i in range(n)}
+
+
+class TestCommitVerification:
+    """Manifest PUT is the commit point: every referenced blob must exist
+    with a matching size, and the error names the exact re-push delta."""
+
+    REPO = "library/commitcheck"
+
+    def test_missing_blob_listed(self, store):
+        present = put_blob(store, self.REPO, b"here")
+        absent = "sha256:" + "c" * 64
+        m = Manifest(blobs=[present, Descriptor(name="gone.bin", digest=absent, size=4)])
+        with pytest.raises(errors.ErrorInfo) as ei:
+            store.put_manifest(self.REPO, "v1", "", m)
+        e = ei.value
+        assert (e.http_status, e.code) == (400, errors.ErrCodeManifestBlobUnknown)
+        assert e.detail["missing"] == [absent]
+        assert not store.exists_manifest(self.REPO, "v1")
+
+    def test_size_mismatch_listed(self, store):
+        desc = put_blob(store, self.REPO, b"eight by")
+        bad = Descriptor(name=desc.name, digest=desc.digest, size=desc.size + 7)
+        with pytest.raises(errors.ErrorInfo) as ei:
+            store.put_manifest(self.REPO, "v1", "", Manifest(blobs=[bad]))
+        e = ei.value
+        assert (e.http_status, e.code) == (400, errors.ErrCodeSizeInvalid)
+        assert e.detail["sizeMismatch"] == [
+            {"digest": desc.digest, "expected": desc.size + 7, "stored": desc.size}
+        ]
+
+    def test_all_problems_collected_in_one_round_trip(self, store):
+        good = put_blob(store, self.REPO, b"fine")
+        short = put_blob(store, self.REPO, b"xy")
+        missing1 = "sha256:" + "d" * 64
+        missing2 = "sha256:" + "e" * 64
+        m = Manifest(blobs=[
+            good,
+            Descriptor(name=short.name, digest=short.digest, size=99),
+            Descriptor(name="m1", digest=missing1, size=1),
+            Descriptor(name="m2", digest=missing2, size=1),
+        ])
+        with pytest.raises(errors.ErrorInfo) as ei:
+            store.put_manifest(self.REPO, "v1", "", m)
+        e = ei.value
+        assert e.code == errors.ErrCodeManifestBlobUnknown
+        assert sorted(e.detail["missing"]) == sorted([missing1, missing2])
+        assert [x["digest"] for x in e.detail["sizeMismatch"]] == [short.digest]
+
+    def test_descriptor_without_size_checks_existence_only(self, store):
+        desc = put_blob(store, self.REPO, b"sized later")
+        lax = Descriptor(name=desc.name, digest=desc.digest, size=0)
+        store.put_manifest(self.REPO, "v1", "", Manifest(blobs=[lax]))
+        assert store.exists_manifest(self.REPO, "v1")
+
+
+class TestUploadMarkers:
+    """Crash-safe GC: in-flight markers at blob-PUT start, cleared at
+    manifest commit; grace=0 stays the explicit operator override."""
+
+    REPO = "library/markers"
+
+    def test_put_blob_marks_and_commit_clears(self, store):
+        desc = put_blob(store, self.REPO, b"in flight")
+        assert desc.digest in store.active_uploads(self.REPO)
+        store.put_manifest(self.REPO, "v1", "", Manifest(blobs=[desc]))
+        assert desc.digest not in store.active_uploads(self.REPO)
+
+    def test_gc_skips_marked_blob_outside_grace(self, store):
+        """A marked blob survives GC even when its mtime has aged past the
+        grace window — the slow-push hazard the mtime heuristic misses."""
+        store.put_manifest(self.REPO, "v0", "", Manifest())  # repo must exist for GC
+        desc = put_blob(store, self.REPO, b"slow push")
+        time.sleep(0.05)
+        result = gc_blobs(store, self.REPO, grace_s=0.01)  # age > grace
+        assert result.deleted == 0 and result.skipped_in_flight == 1
+        assert store.exists_blob(self.REPO, desc.digest)
+        # marker cleared: the next aggressive sweep may collect — if the
+        # backend can date the blob; undatable blobs stay protected
+        store.clear_upload(self.REPO, desc.digest)
+        time.sleep(0.05)
+        result = gc_blobs(store, self.REPO, grace_s=0.01)
+        assert result.skipped_in_flight == 0
+        if result.deleted:
+            assert result.deleted == 1 and not store.exists_blob(self.REPO, desc.digest)
+        else:
+            # backend can't date the blob: unknown age reads as young
+            assert result.skipped_young == 1
+            assert store.get_blob_meta(self.REPO, desc.digest).last_modified == 0
+
+    def test_commit_marks_referenced_digests_before_verification(self, store):
+        """A dedup-skipped blob never saw a blob-PUT marker; the manifest
+        commit must mark every referenced digest BEFORE verifying, or a
+        sweep could reclaim it between verification and the index refresh
+        (code-review finding on the HEAD-dedup path)."""
+        from modelx_tpu.registry.store import blob_digest_path
+
+        data = b"dedup-skipped blob"
+        digest = str(Digest.from_bytes(data))
+        # blob written underneath the store: exists, but no marker
+        store.fs.put(blob_digest_path(self.REPO, digest), io.BytesIO(data), len(data), "")
+        assert digest not in store.active_uploads(self.REPO)
+        missing = "sha256:" + "f" * 64
+        m = Manifest(blobs=[
+            Descriptor(name="w.bin", digest=digest, size=len(data)),
+            Descriptor(name="gone", digest=missing, size=1),
+        ])
+        with pytest.raises(errors.ErrorInfo):
+            store.put_manifest(self.REPO, "v1", "", m)
+        # marked during the FAILED commit: protected while the client
+        # re-pushes the delta (TTL reclaims markers of abandoned commits)
+        assert digest in store.active_uploads(self.REPO)
+        # a successful commit clears them again
+        store.put_manifest(
+            self.REPO, "v1", "", Manifest(blobs=[Descriptor(name="w.bin", digest=digest, size=len(data))])
+        )
+        assert digest not in store.active_uploads(self.REPO)
+
+    def test_gc_grace_zero_overrides_markers(self, store):
+        store.put_manifest(self.REPO, "v0", "", Manifest())  # repo must exist for GC
+        desc = put_blob(store, self.REPO, b"forced out")
+        assert desc.digest in store.active_uploads(self.REPO)
+        assert gc_blobs(store, self.REPO, grace_s=0).deleted == 1
+
+    def test_stale_markers_expire(self, store):
+        desc = put_blob(store, self.REPO, b"abandoned")
+        assert desc.digest in store.active_uploads(self.REPO)
+        # a TTL in the past makes every datable marker stale
+        active = store.active_uploads(self.REPO, ttl_s=0.0)
+        meta = store.fs.list(f"{self.REPO}/uploads", recursive=True)
+        if any(m.last_modified for m in meta) or not meta:
+            assert desc.digest not in active
+            assert not store.fs.list(f"{self.REPO}/uploads", recursive=True)
+        else:
+            # backend can't date markers: unknown age must read as LIVE
+            assert desc.digest in active
+
+    def test_marker_failure_does_not_fail_push(self, store, monkeypatch):
+        """mark_upload swallows backend errors: GC degrades to the mtime
+        grace window for that digest, the push itself must land."""
+        inner_put = store.fs.put
+
+        def flaky_put(path, content, size=-1, content_type=""):
+            if "/uploads/" in path:
+                raise OSError("marker backend down")
+            return inner_put(path, content, size, content_type)
+
+        monkeypatch.setattr(store.fs, "put", flaky_put)
+        desc = put_blob(store, self.REPO, b"still lands")
+        assert store.exists_blob(self.REPO, desc.digest)
+
+
+class TestGCMtimeSemantics:
+    REPO = "library/mtimes"
+
+    def test_unknown_mtime_treated_as_young(self, store, monkeypatch):
+        """Regression (ISSUE 4 satellite): a store that can't report
+        last_modified made age == now, deleting blobs INSIDE the grace
+        window. Unknown age must mean skip, never sweep."""
+        from modelx_tpu.registry.store import BlobMeta, blob_digest_path
+
+        data = b"undatable orphan"
+        digest = str(Digest.from_bytes(data))
+        # write underneath the store: no upload marker, so only the mtime
+        # heuristic stands between this blob and the sweep
+        store.fs.put(blob_digest_path(self.REPO, digest), io.BytesIO(data), len(data), "")
+        store.put_manifest(self.REPO, "v1", "", Manifest())
+
+        real_meta = store.get_blob_meta
+
+        def undated(repo, dig):
+            m = real_meta(repo, dig)
+            return BlobMeta(content_type=m.content_type, content_length=m.content_length,
+                            last_modified=0.0)
+
+        monkeypatch.setattr(store, "get_blob_meta", undated)
+        result = gc_blobs(store, self.REPO, grace_s=3600)
+        assert result.deleted == 0 and result.skipped_young == 1
+        assert store.exists_blob(self.REPO, digest)
+        # grace=0 still collects it (no age check at all)
+        assert gc_blobs(store, self.REPO, grace_s=0).deleted == 1
 
 
 class TestGC:

@@ -21,10 +21,12 @@ import time
 
 import pytest
 
+from modelx_tpu import errors
 from modelx_tpu.registry.fs import LocalFSProvider, MemoryFSProvider
 from modelx_tpu.registry.gc import gc_blobs
 from modelx_tpu.registry.store import BlobContent
 from modelx_tpu.registry.store_fs import FSRegistryStore
+from modelx_tpu.testing.faults import FaultPlan
 from modelx_tpu.types import Descriptor, Digest, Manifest
 
 REPO = "library/stress"
@@ -111,6 +113,83 @@ class TestRegistryStorm:
         # no referenced blob was GC'd out from under its manifest
         for i, desc in pushed.items():
             assert store.exists_blob(REPO, desc.digest), f"v{i} lost its blob"
+
+    def test_gc_vs_slow_push_race_with_markers(self, store):
+        """ISSUE 4 acceptance drill: a push whose blob->manifest gap
+        outlasts the grace window races a concurrent sweeper. The upload
+        marker (touched at blob PUT, cleared at commit) must keep the blob
+        alive; no blob referenced by a manifest committed after the sweep
+        started is ever deleted."""
+        self._race(store, markers=True, grace=0.05)
+
+    def test_gc_vs_slow_push_race_without_markers(self, store):
+        """Without markers (pre-marker pushes / marker backend down) the
+        mtime grace window alone must protect the same gap."""
+        self._race(store, markers=False, grace=3600.0)
+
+    def test_gc_vs_slow_push_no_markers_tiny_grace_caught_at_commit(self, store):
+        """Negative control, and why markers exist: with neither marker
+        nor adequate grace the sweeper DOES reclaim the in-flight blob —
+        and commit-point verification refuses the dangling manifest with
+        the structured re-push delta instead of committing a corrupt
+        version. Re-pushing the delta completes the push."""
+        digest, desc, outcome = self._race(
+            store, markers=False, grace=0.05, expect_loss=True
+        )
+        assert isinstance(outcome, errors.ErrorInfo)
+        assert outcome.detail["missing"] == [digest]
+        # the client-side recovery: re-push exactly the delta, recommit
+        data = b"raced payload bytes"
+        store.put_blob(REPO, digest, BlobContent(io.BytesIO(data), len(data), ""))
+        store.put_manifest(REPO, "raced", "", Manifest(blobs=[desc]))
+        assert store.get_blob(REPO, digest).content.read() == data
+
+    def _race(self, store, markers: bool, grace: float, expect_loss: bool = False):
+        # seeded gap schedule: the push stalls 0.4s between blob PUT and
+        # manifest PUT — a slow client, far past a 0.05s grace window
+        plan = FaultPlan(seed=2024).add("push.commit_gap", latency_at=[0], latency_s=0.4)
+        if not markers:
+            store.mark_upload = lambda repo, digest: None  # pre-marker world
+        store.put_manifest(REPO, "v0", "", Manifest())  # repo must exist for GC
+        data = b"raced payload bytes"
+        digest = str(Digest.from_bytes(data))
+        desc = Descriptor(name="w.bin", digest=digest, size=len(data))
+
+        stop = threading.Event()
+        sweep_results = []
+
+        def sweeper():
+            while not stop.is_set():
+                sweep_results.append(gc_blobs(store, REPO, grace_s=grace))
+                time.sleep(0.005)
+
+        t = threading.Thread(target=sweeper)
+        t.start()
+        outcome = None
+        try:
+            store.put_blob(REPO, digest, BlobContent(io.BytesIO(data), len(data), ""))
+            plan.maybe_fail("push.commit_gap")  # the raced window
+            try:
+                store.put_manifest(REPO, "raced", "", Manifest(blobs=[desc]))
+            except errors.ErrorInfo as e:
+                outcome = e
+        finally:
+            stop.set()
+            t.join()
+
+        if expect_loss:
+            assert outcome is not None, "sweeper never caught the unprotected blob"
+            assert not store.exists_blob(REPO, digest)
+            return digest, desc, outcome
+        # committed => the blob survived the storm of sweeps
+        assert outcome is None, f"commit failed: {outcome}"
+        assert store.exists_blob(REPO, digest)
+        assert store.get_blob(REPO, digest).content.read() == data
+        if markers:
+            assert any(r.skipped_in_flight for r in sweep_results), (
+                "the drill never exercised the marker (gap too short?)"
+            )
+        return digest, desc, outcome
 
     def test_gc_grace_zero_after_quiesce_removes_only_orphans(self, store):
         """After the storm quiesces, an aggressive GC still only removes
