@@ -5,7 +5,7 @@ PY ?= python
 IMAGE ?= modelx-tpu
 TAG ?= $(shell git describe --tags --always 2>/dev/null || echo dev)
 
-.PHONY: all native test chaos lint wheel image image-dl compose-up compose-down clean
+.PHONY: all native test chaos lifecycle lint wheel image image-dl compose-up compose-down clean
 
 all: native test wheel
 
@@ -20,6 +20,15 @@ test:
 # every deterministic fault sweep in one command: the seeded engine-crash
 # schedules (PR 3) plus the registry torn-write/scrub/GC-race drills
 chaos:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
+
+# model lifecycle drills (ISSUE 5): runtime load/drain/unload/evict,
+# HBM-budget refusal, degraded multi-tenant boot, the bench swap leg —
+# plus the chaos sweep (a crashed load must leave the pool serving and
+# the slot retryable)
+lifecycle:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_lifecycle.py \
+		"tests/test_bench_smoke.py::TestSwapLeg" -q
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
 
 lint:

@@ -973,6 +973,83 @@ def measure_overload(params, mesh, *, slots: int = 2, chunk: int = 8,
         cb.close()
 
 
+def measure_model_swap(base: str, workdir: str, *, target_bytes: int = 16 << 20,
+                       hidden: int = 512, inter: int = 1408, vocab: int = 8192,
+                       prompt_len: int = 8, new_tokens: int = 4) -> dict:
+    """Model lifecycle swap leg (ISSUE 5): with live traffic to a third
+    model C, unload A and load B through the pool — cold (empty blob
+    cache, bytes come from the registry) vs blob-cache-warm (B's blobs
+    already on the node from the cold swap, zero network reads).
+
+    Reported: ``ttft_swap_cold_ms`` / ``ttft_swap_warm_ms`` (DELETE of the
+    old model -> first token out of the newly loaded one),
+    ``swap_traffic_errors`` (C requests that failed during either swap —
+    the uninterrupted-traffic contract, must be 0), and the pull path's
+    ``swap_cache_hits``."""
+    import threading as _threading
+
+    from modelx_tpu.dl.blob_cache import BlobCache
+    from modelx_tpu.dl.serve import ModelServer, ServerSet
+
+    root = os.path.join(workdir, "swap")
+    dirs: dict[str, str] = {}
+    for name in ("a", "b", "c"):
+        d = os.path.join(root, name)
+        os.makedirs(d, exist_ok=True)
+        build_checkpoint(os.path.join(d, "model.safetensors"), target_bytes,
+                         hidden=hidden, inter=inter, vocab=vocab)
+        push_checkpoint(base, f"library/swap-{name}",
+                        os.path.join(d, "model.safetensors"))
+        dirs[name] = d
+    cache = BlobCache(os.path.join(root, "blobcache"))
+    servers = {n: ModelServer(dirs[n], name=n) for n in ("a", "c")}
+    sset = ServerSet(servers, default="c", allow_admin_load=True,
+                     staging_root=os.path.join(root, "staging"))
+    sset.pool.blob_cache = cache
+    sset.load_all()
+
+    stop = _threading.Event()
+    counts = {"served": 0, "errors": 0}
+    rng = np.random.RandomState(5)
+    prompt = rng.randint(1, vocab, (1, prompt_len)).astype(np.int32)
+
+    def traffic() -> None:
+        while not stop.is_set():
+            try:
+                sset.servers["c"].generate(prompt, max_new_tokens=new_tokens)
+                counts["served"] += 1
+            except Exception:
+                counts["errors"] += 1
+
+    t = _threading.Thread(target=traffic, daemon=True)
+    t.start()
+
+    def swap(old: str, new: str) -> float:
+        t0 = time.monotonic()
+        sset.pool.request_unload(old, wait=True)
+        sset.pool.request_load(new, ref=f"{base}/library/swap-{new}@v1",
+                               wait=True)
+        state = sset.pool.states()[new]
+        if state["state"] != "READY":
+            raise RuntimeError(f"swap load of {new} landed {state}")
+        sset.servers[new].generate(prompt, max_new_tokens=1)  # first token
+        return (time.monotonic() - t0) * 1e3
+
+    try:
+        cold_ms = swap("a", "b")       # empty cache: bytes from the registry
+        warm_ms = swap("b", "b")       # B's blobs admitted by the cold pull
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    return {
+        "ttft_swap_cold_ms": round(cold_ms, 1),
+        "ttft_swap_warm_ms": round(warm_ms, 1),
+        "swap_traffic_served": counts["served"],
+        "swap_traffic_errors": counts["errors"],
+        "swap_cache_hits": cache.stats["hits"],
+    }
+
+
 def run_leg(kind: str, base: str, repo: str, workdir: str) -> dict:
     """One timed leg in a FRESH subprocess (fresh per-process tunnel
     throttle state — see module docstring). Returns the child's JSON."""
@@ -1273,6 +1350,10 @@ def main() -> None:
         # (ISSUE 3 acceptance)
         serving.update(measure_overload(loaded, mesh))
         del loaded
+
+        # model-swap leg: unload A / load B through the lifecycle pool
+        # under live traffic to C, cold vs blob-cache-warm (ISSUE 5)
+        serving.update(measure_model_swap(base, workdir))
 
         # int8 weight-only serving: per-step weight reads halve, so decode
         # (HBM-bound) speeds up — the quantize flag the serve sidecar ships

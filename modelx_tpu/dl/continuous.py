@@ -2257,3 +2257,19 @@ class ContinuousBatcher:
             self._q.put(None)
         self._closed_ev.set()  # interrupt any restart-backoff sleep
         self._thread.join(timeout=30)
+
+    def release_device_state(self) -> None:
+        """Drop the engine's device allocations — the KV cache / page pool
+        (the big one: [max_slots, max_len] or [num_pages, page_size] per
+        layer), the token vector, and every compiled-program reference.
+        Call AFTER ``close()``: the model-unload path (dl/lifecycle.py)
+        must return the HBM to the pool budget immediately, not when the
+        garbage collector eventually notices the dead engine."""
+        if not self._closed:
+            raise RuntimeError("release_device_state requires close() first")
+        self._cache = None
+        self._tok = None
+        for attr in ("_admit_prog", "_admit_cached_prog", "_admit_many_prog",
+                     "_chunk", "_piece_prog", "_piece_flip_prog",
+                     "_seed_prog", "_snap_prog", "_spec_prog"):
+            setattr(self, attr, None)

@@ -54,6 +54,28 @@ def filter_blobs(manifest: Manifest, model_files: list[str]) -> Manifest:
     )
 
 
+def _resolve_selected(uri: str, quiet: bool):
+    """Shared ref-resolution step for the boot-time initializer AND the
+    runtime pull path (both must agree on config handling and blob
+    filtering): parse the reference, fetch the manifest + modelx.yaml
+    sidecar, apply the ``modelFiles`` filter. Returns (ref, client,
+    config, selected manifest)."""
+    from modelx_tpu.utils import trace
+
+    ref = parse_reference(uri)
+    client = ref.client(quiet=quiet)
+    with trace.span("dl.manifest", uri=uri):
+        manifest = client.get_manifest(ref.repository, ref.version)
+        config = ModelConfig()
+        if manifest.config.digest:
+            raw = client.get_config_content(ref.repository, ref.version)
+            try:
+                config = ModelConfig.from_yaml(raw)
+            except ValueError:
+                logger.warning("invalid modelx.yaml in %s; pulling everything", uri)
+    return ref, client, config, filter_blobs(manifest, config.model_files)
+
+
 def run_initializer(
     uri: str,
     dest: str,
@@ -81,20 +103,7 @@ def run_initializer(
         )
 
     t0 = time.monotonic()
-    ref = parse_reference(uri)
-    client = ref.client(quiet=quiet)
-    with trace.span("dl.manifest", uri=uri):
-        manifest = client.get_manifest(ref.repository, ref.version)
-
-        config = ModelConfig()
-        if manifest.config.digest:
-            raw = client.get_config_content(ref.repository, ref.version)
-            try:
-                config = ModelConfig.from_yaml(raw)
-            except ValueError:
-                logger.warning("invalid modelx.yaml in %s; pulling everything", uri)
-
-    selected = filter_blobs(manifest, config.model_files)
+    ref, client, config, selected = _resolve_selected(uri, quiet)
     with trace.span("dl.pull", blobs=len(selected.blobs)):
         Puller(client.remote, quiet=quiet).pull_blobs(ref.repository, selected, dest)
     pull_seconds = time.monotonic() - t0
@@ -114,6 +123,82 @@ def run_initializer(
             summary["blob_cache"] = dict(cache.stats)
     summary["total_seconds"] = round(time.monotonic() - t0, 3)
     return summary
+
+
+def pull_model(uri: str, dest: str, cache=None, quiet: bool = True) -> dict:
+    """Pull a registry ref into ``dest`` THROUGH the local blob cache —
+    the runtime model-load path (dl/lifecycle.py admin loads).
+
+    Same manifest/filter flow as ``run_initializer``, but file blobs the
+    node's blob cache already holds are COPIED from it (zero network
+    reads; the Puller's hash-skip then confirms them up-to-date), and
+    freshly pulled blobs are admitted for the next swap — a model the
+    node served before reloads blob-cache-warm (``ttft_swap_warm_ms``
+    in bench.py's swap leg)."""
+    from modelx_tpu.dl import blob_cache as bc
+    from modelx_tpu.types import MediaTypeModelDirectoryTarGz
+    from modelx_tpu.utils import trace
+
+    if cache is None:
+        cache = bc.default_cache()
+    t0 = time.monotonic()
+    ref, client, _config, selected = _resolve_selected(uri, quiet)
+    os.makedirs(dest, exist_ok=True)
+    file_blobs = [
+        b for b in selected.blobs
+        if b.digest and b.media_type != MediaTypeModelDirectoryTarGz
+    ]
+    cache_hits = 0
+    if cache is not None:
+        import shutil as _shutil
+
+        for blob in file_blobs:
+            hit = cache.lookup(blob.digest, expected_size=blob.size or -1)
+            if hit is None:
+                continue
+            target = os.path.join(dest, blob.name)
+            os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
+            try:
+                _shutil.copyfile(hit, target)
+                os.chmod(target, blob.mode or 0o644)
+                cache_hits += 1
+            except OSError:
+                # a racing LRU eviction unlinked the entry: the Puller
+                # fetches it over the network like any miss
+                pass
+    with trace.span("dl.pull", blobs=len(selected.blobs)):
+        Puller(client.remote, quiet=quiet).pull_blobs(ref.repository, selected, dest)
+    admitted = 0
+    if cache is not None:
+        import shutil as _shutil
+
+        import tempfile
+
+        for blob in file_blobs:
+            target = os.path.join(dest, blob.name)
+            if not os.path.isfile(target):
+                continue
+            if os.path.isfile(cache.entry_path(blob.digest)):
+                continue  # already cached; don't churn the LRU clock
+            try:
+                # unique spool per admit: concurrent runtime loads in one
+                # process must not overwrite each other's in-flight copies
+                fd, tmp = tempfile.mkstemp(dir=cache.root, prefix=".pull-admit-")
+                os.close(fd)
+                _shutil.copyfile(target, tmp)
+            except OSError:
+                continue
+            if cache.admit_file(blob.digest, tmp) is not None:
+                admitted += 1
+    return {
+        "uri": uri,
+        "dest": dest,
+        "blobs": len(selected.blobs),
+        "bytes": sum(b.size for b in selected.blobs),
+        "cache_hits": cache_hits,
+        "cache_admitted": admitted,
+        "pull_seconds": round(time.monotonic() - t0, 3),
+    }
 
 
 def load_to_mesh(client, repository: str, manifest: Manifest, mesh_spec: str,

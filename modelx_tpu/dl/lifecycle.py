@@ -1,0 +1,644 @@
+"""Dynamic model lifecycle: runtime load / drain / unload / evict.
+
+modelx's whole point is that models are registry objects materialized at
+deploy time — yet the serving container used to fix its model set at boot:
+adding, swapping, or retiring a model meant a pod restart and a cold TTFT.
+This module is the missing scheduler (the ServerlessLLM-style runtime half;
+PR 1 built the fast-materialization half): a ``ModelPool`` owns every
+``ModelServer`` behind a ``ServerSet`` and drives each through an explicit
+state machine
+
+    PULLING -> LOADING -> READY -> DRAINING -> UNLOADED
+                  \\-> FAILED (slot retryable)
+
+exposed on the serving HTTP surface as
+
+    GET    /admin/models          every entry's state + accounting
+    POST   /admin/models          {"name", "ref"|"model_dir", "wait"?}
+                                  pull a registry ref (blob-cache-warm when
+                                  the node has served it before) and load it
+                                  while traffic to other models is live
+    DELETE /admin/models/{name}   drain in-flight requests, stop admission,
+                                  free device + host state
+
+(dl/serve.py routes them, behind the admin bearer-token filter).
+
+Request routing during transitions is typed (dl/serving_errors.py): a model
+that is PULLING/LOADING answers 503 + ``Retry-After``, DRAINING answers
+409, FAILED answers 503 with the reason, UNLOADED/unknown answers 404 —
+identically on the native and OpenAI surfaces.
+
+HBM budget: every load first ESTIMATES its device footprint (manifest
+``.safetensors`` blob sizes for a registry ref, file sizes for a local
+dir — both ≈ parameter bytes; int8 loads over-reserve, the safe direction)
+and reserves it against ``hbm_budget_bytes``. A load that cannot fit is
+refused with 507 — unless ``evict_idle`` is set, in which case READY
+models with no in-flight requests are LRU-evicted (least-recently-used
+first) until the load fits. Reservations tighten to the measured
+``load_bytes`` once a model lands READY.
+
+No reference equivalent (the reference stores models; it cannot serve
+them, let alone schedule them) — this turns the sidecar into the
+serverless-style multi-tenant node the ROADMAP's north star asks for.
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import os
+import shutil
+import threading
+import time
+
+logger = logging.getLogger("modelx.lifecycle")
+
+# -- lifecycle states ---------------------------------------------------------
+PULLING = "PULLING"      # registry blobs streaming to the staging dir
+LOADING = "LOADING"      # safetensors streaming onto the mesh + compiling
+READY = "READY"          # serving traffic
+DRAINING = "DRAINING"    # admission stopped; in-flight requests finishing
+UNLOADED = "UNLOADED"    # freed; the name 404s, the entry records history
+FAILED = "FAILED"        # load crashed; slot retryable via re-POST
+
+# states that hold (or are about to hold) device memory: their reservations
+# count against the HBM budget
+_RESERVING = (PULLING, LOADING, READY, DRAINING)
+
+
+class PoolError(Exception):
+    """An admin-surface refusal with its HTTP status (the serving layer
+    maps it 1:1 to a JSON error body)."""
+
+    def __init__(self, status: int, message: str) -> None:
+        super().__init__(message)
+        self.status = status
+
+
+class ModelEntry:
+    """One named model's lifecycle record. Lives for the pool's lifetime
+    (an UNLOADED/FAILED entry keeps its counters and is re-usable: a
+    re-POST of the same name retries into the same slot)."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.state = LOADING
+        self.state_since = time.monotonic()
+        self.server = None          # ModelServer once LOADING starts
+        self.error: str | None = None
+        self.ref = ""               # registry uri when pulled at runtime
+        self.model_dir = ""
+        self.hbm_reserved_bytes = 0
+        self.loads_total = 0
+        self.evictions_total = 0
+        self.drain_seconds: float | None = None  # last drain's duration
+        self.inflight = 0
+        self.last_used = time.monotonic()
+        self._staged = False        # model_dir is pool-owned (safe to rm)
+
+    def to(self, state: str, error: str | None = None) -> None:
+        self.state = state
+        self.state_since = time.monotonic()
+        self.error = error
+
+    def snapshot(self) -> dict:
+        """JSON-safe view for GET /admin/models and /metrics."""
+        snap = {
+            "state": self.state,
+            "state_age_s": round(time.monotonic() - self.state_since, 3),
+            "hbm_reserved_bytes": int(self.hbm_reserved_bytes),
+            "loads_total": self.loads_total,
+            "evictions_total": self.evictions_total,
+            "inflight": self.inflight,
+        }
+        if self.ref:
+            snap["ref"] = self.ref
+        if self.error:
+            snap["error"] = self.error
+        if self.drain_seconds is not None:
+            snap["drain_seconds"] = round(self.drain_seconds, 3)
+        return snap
+
+
+def estimate_dir_bytes(model_dir: str) -> int:
+    """Device-footprint estimate for a local checkpoint dir: the summed
+    ``*.safetensors`` file sizes (header overhead is noise next to the
+    tensor data, which loads byte-for-byte onto the mesh)."""
+    total = 0
+    for path in glob.glob(os.path.join(model_dir, "*.safetensors")):
+        try:
+            total += os.path.getsize(path)
+        except OSError:
+            pass
+    return total
+
+
+def estimate_ref_bytes(uri: str) -> int:
+    """Device-footprint estimate for a registry ref, read from the
+    manifest's ``.safetensors`` blob sizes — BEFORE any byte is pulled, so
+    an over-budget load is refused for free."""
+    from modelx_tpu.client.reference import parse_reference
+
+    ref = parse_reference(uri)
+    client = ref.client(quiet=True)
+    manifest = client.get_manifest(ref.repository, ref.version)
+    return sum(
+        (b.size or 0) for b in manifest.blobs
+        if b.name.endswith(".safetensors")
+    )
+
+
+class ModelPool:
+    """Owns the lifecycle of every model behind a ServerSet.
+
+    The pool is ALWAYS attached (dl/serve.ServerSet creates one): it tracks
+    states, in-flight counts, and per-model metrics for the boot-time model
+    set too. The admin load/unload surface additionally requires
+    ``allow_admin_load`` (--allow-admin-load)."""
+
+    # how long DELETE waits for in-flight requests before forcing the free
+    DEFAULT_DRAIN_TIMEOUT_S = 30.0
+
+    def __init__(self, sset, hbm_budget_bytes: int = 0, evict_idle: bool = False,
+                 allow_admin_load: bool = False, staging_root: str = "",
+                 drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S,
+                 blob_cache=None) -> None:
+        self.sset = sset
+        self.hbm_budget_bytes = int(hbm_budget_bytes)
+        self.evict_idle = bool(evict_idle)
+        self.allow_admin_load = bool(allow_admin_load)
+        self.staging_root = staging_root
+        # the local blob cache the pull path tees through (None = the
+        # process default, dl/blob_cache.configure_default / --blob-cache-dir)
+        self.blob_cache = blob_cache
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._lock = threading.RLock()
+        self._idle = threading.Condition(self._lock)  # inflight hit zero
+        self.entries: dict[str, ModelEntry] = {}
+        self.stats = {"loads_total": 0, "evictions_total": 0,
+                      "load_failures_total": 0, "unloads_total": 0}
+        for name, server in sset.servers.items():
+            e = ModelEntry(name)
+            e.server = server
+            e.model_dir = server.model_dir
+            self.entries[name] = e
+
+    # -- state transitions driven by ServerSet.load_all -----------------------
+
+    def mark_loading(self, name: str) -> None:
+        with self._lock:
+            e = self.entries.get(name)
+            if e is not None:
+                e.to(LOADING)
+
+    def mark_ready(self, name: str) -> None:
+        with self._lock:
+            e = self.entries.get(name)
+            if e is None:
+                return
+            e.to(READY)
+            e.loads_total += 1
+            self.stats["loads_total"] += 1
+            if e.server is not None:
+                e.hbm_reserved_bytes = int(
+                    e.server.stats.get("load_bytes", 0) or 0
+                ) or e.hbm_reserved_bytes
+            e.last_used = time.monotonic()
+
+    def mark_failed(self, name: str, reason: str) -> None:
+        with self._lock:
+            e = self.entries.get(name)
+            if e is None:
+                return
+            e.to(FAILED, error=reason)
+            e.hbm_reserved_bytes = 0
+            self.stats["load_failures_total"] += 1
+            server = e.server
+        if server is not None:
+            # the crashed load may have landed SOME shards on the mesh;
+            # the reservation above just went to zero, so those partial
+            # arrays must actually free or the budget undercounts and a
+            # later load can oversubscribe real HBM. (A FAILED boot
+            # tenant stays in routing for /healthz's degraded report, but
+            # check_admission 503s its requests before params are touched.)
+            try:
+                self._free_server(name, server)
+            except Exception:
+                logger.exception("freeing failed load of %s", name)
+
+    # -- routing --------------------------------------------------------------
+
+    def check_admission(self, name: str) -> None:
+        """Raise the typed lifecycle error for a model that must not take
+        new requests; no-op for READY (or pool-unknown: the legacy direct
+        paths stay untouched). The serving layer calls this after route
+        resolution and maps the exceptions to 503/409/404."""
+        from modelx_tpu.dl.serving_errors import (
+            ModelDrainingError, ModelFailedError, ModelLoadingError,
+        )
+
+        with self._lock:
+            e = self.entries.get(name)
+            if e is None:
+                return
+            state = self._effective_state(e)
+            if state == DRAINING:
+                raise ModelDrainingError(name)
+            if state in (PULLING, LOADING):
+                raise ModelLoadingError(
+                    name, state=state.lower(), retry_after=self._retry_after(e)
+                )
+            if state == FAILED:
+                raise ModelFailedError(name, e.error or "")
+        # UNLOADED falls through: the server is gone from the ServerSet, so
+        # route resolution already 404s — exactly the contract we want.
+
+    def routing_error(self, name: str):
+        """The typed error (or None) for a name that did NOT resolve to a
+        live server — PULLING/LOADING entries have no server yet, so the
+        404 path consults the pool before giving up."""
+        from modelx_tpu.dl.serving_errors import ServingError
+
+        try:
+            self.check_admission(name)
+        except ServingError as e:
+            return e
+        return None
+
+    def _retry_after(self, e: ModelEntry) -> float:
+        # a load that just started gets a longer back-off than one that has
+        # been running a while (it is presumably nearly done)
+        age = time.monotonic() - e.state_since
+        return 2.0 if age < 10.0 else 1.0
+
+    def _effective_state(self, e: ModelEntry) -> str:
+        """The entry's state, reconciled with direct-load paths that bypass
+        the pool (tests constructing ServerSet and calling server.load()
+        themselves): a LOADING entry whose server turned ready is READY."""
+        if e.state == LOADING and e.server is not None and e.server.ready:
+            e.to(READY)
+            if not e.hbm_reserved_bytes:
+                e.hbm_reserved_bytes = int(
+                    e.server.stats.get("load_bytes", 0) or 0
+                )
+        return e.state
+
+    # -- in-flight accounting (drain + LRU recency) ---------------------------
+
+    def enter(self, name: str) -> None:
+        """Register one in-flight request. Raises when the model flipped
+        to DRAINING (409) or all the way to UNLOADED (404) since the
+        admission check — taken under the SAME lock the drain waits on,
+        so a request either counts (the drain waits for it) or is
+        refused; it can never slip between the two and run against a
+        freed model."""
+        from modelx_tpu.dl.serving_errors import (
+            ModelDrainingError, ModelUnloadedError,
+        )
+
+        with self._lock:
+            e = self.entries.get(name)
+            if e is None:
+                return
+            if e.state == DRAINING:
+                raise ModelDrainingError(name)
+            if e.state == UNLOADED:
+                # a zero-in-flight drain or eviction completed in the
+                # window since check_admission: the server is freed
+                raise ModelUnloadedError(name)
+            e.inflight += 1
+            e.last_used = time.monotonic()
+
+    def exit(self, name: str) -> None:
+        with self._lock:
+            e = self.entries.get(name)
+            if e is not None:
+                e.inflight = max(0, e.inflight - 1)
+                e.last_used = time.monotonic()
+                if e.inflight == 0:
+                    self._idle.notify_all()
+
+    # -- observability --------------------------------------------------------
+
+    def states(self) -> dict:
+        """{name: snapshot} for GET /admin/models, /v1/models, /metrics."""
+        with self._lock:
+            out = {}
+            for name, e in self.entries.items():
+                self._effective_state(e)
+                out[name] = e.snapshot()
+            return out
+
+    def reserved_bytes(self) -> int:
+        with self._lock:
+            return sum(
+                e.hbm_reserved_bytes for e in self.entries.values()
+                if self._effective_state(e) in _RESERVING
+            )
+
+    def pool_snapshot(self) -> dict:
+        snap = dict(self.stats)
+        snap["hbm_reserved_bytes"] = self.reserved_bytes()
+        if self.hbm_budget_bytes:
+            snap["hbm_budget_bytes"] = self.hbm_budget_bytes
+        snap["evict_idle"] = self.evict_idle
+        return snap
+
+    def failed(self) -> dict[str, str]:
+        """{name: reason} for every FAILED entry (/healthz's degraded set)."""
+        with self._lock:
+            return {
+                name: (e.error or "load failed")
+                for name, e in self.entries.items()
+                if self._effective_state(e) == FAILED
+            }
+
+    # -- admin: load ----------------------------------------------------------
+
+    def request_load(self, name: str, ref: str = "", model_dir: str = "",
+                     wait: bool = False, wait_timeout_s: float = 600.0) -> dict:
+        """Admit a load request: validate the name, estimate + reserve the
+        HBM footprint (evicting idle models if allowed and needed), then
+        run PULLING -> LOADING -> READY on a background thread. ``wait``
+        blocks until the entry leaves the transient states (tests and
+        synchronous tooling). Returns the entry snapshot."""
+        if not self.allow_admin_load:
+            raise PoolError(403, "admin model loading is disabled "
+                                 "(start with --allow-admin-load)")
+        if not name or not all(c.isalnum() or c in "._-" for c in name):
+            raise PoolError(400, "name must be [A-Za-z0-9._-]+")
+        if bool(ref) == bool(model_dir):
+            raise PoolError(400, "send exactly one of ref or model_dir")
+
+        # estimate BEFORE mutating any state: an unreachable ref or empty
+        # dir must refuse cleanly, reserving nothing
+        try:
+            est = estimate_ref_bytes(ref) if ref else estimate_dir_bytes(model_dir)
+        except Exception as e:
+            raise PoolError(400, f"cannot estimate footprint for "
+                                 f"{ref or model_dir!r}: {e}")
+        if est <= 0:
+            raise PoolError(400, f"no safetensors found under {ref or model_dir!r}")
+
+        frees: list = []
+        try:
+            with self._lock:
+                e = self.entries.get(name)
+                if e is not None:
+                    state = self._effective_state(e)
+                    if state not in (UNLOADED, FAILED):
+                        raise PoolError(409, f"model {name!r} is {state}")
+                self._ensure_budget(est, loading=name, frees=frees)
+                if e is None:
+                    e = self.entries[name] = ModelEntry(name)
+                e.server = None
+                e.ref = ref
+                e.model_dir = model_dir
+                e.hbm_reserved_bytes = est
+                e.drain_seconds = None
+                e.to(PULLING if ref else LOADING)
+        finally:
+            # evicted victims' engines/params/staging close OUTSIDE the
+            # lock (their routing entries already flipped UNLOADED), and
+            # even when the budget STILL refused after partial eviction —
+            # those models are gone either way and must free fully
+            for art in frees:
+                self._finish_free(art)
+        t = threading.Thread(target=self._do_load, args=(e,), daemon=True,
+                             name=f"model-load-{name}")
+        t.start()
+        if wait:
+            deadline = time.monotonic() + wait_timeout_s
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if e.state in (READY, FAILED, UNLOADED):
+                        break
+                time.sleep(0.02)
+        with self._lock:
+            return {name: e.snapshot()}
+
+    def _ensure_budget(self, est: int, loading: str = "",
+                       frees: list | None = None) -> None:
+        """Caller holds the lock. Refuse (507) or LRU-evict until ``est``
+        fits under the budget; evicted victims' heavy artifacts land in
+        ``frees`` for the caller to close after releasing the lock."""
+        if not self.hbm_budget_bytes:
+            return
+        reserved = self.reserved_bytes()  # RLock: safe under the lock
+        if reserved + est <= self.hbm_budget_bytes:
+            return
+        if self.evict_idle:
+            # LRU order over READY models with nothing in flight; never the
+            # model being (re)loaded
+            victims = sorted(
+                (
+                    e for e in self.entries.values()
+                    if self._effective_state(e) == READY
+                    and e.inflight == 0 and e.name != loading
+                ),
+                key=lambda e: e.last_used,
+            )
+            for victim in victims:
+                if len(self._serving_names()) <= 1:
+                    # same stance as request_unload: never empty the node —
+                    # if the incoming load then FAILED, nothing would serve
+                    break
+                logger.info(
+                    "evicting idle model %s (%d bytes) for the HBM budget",
+                    victim.name, victim.hbm_reserved_bytes,
+                )
+                art = self._free_entry_locked(victim, evicted=True)
+                if frees is not None:
+                    frees.append(art)
+                reserved = self.reserved_bytes()
+                if reserved + est <= self.hbm_budget_bytes:
+                    return
+        raise PoolError(
+            507,
+            f"load needs ~{est} bytes but only "
+            f"{self.hbm_budget_bytes - reserved} of the "
+            f"{self.hbm_budget_bytes}-byte HBM budget is free"
+            + ("" if self.evict_idle else
+               " (and --evict-idle is off; unload a model first)"),
+        )
+
+    def _staging_dir(self, name: str) -> str:
+        root = self.staging_root or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "modelx-pool-staging"
+        )
+        # per-load generation counter: a retry after FAILED must not trip
+        # over a half-pulled previous attempt
+        gen = int(time.monotonic() * 1e3) % 1_000_000
+        return os.path.join(root, f"{name}-{gen}")
+
+    def _do_load(self, e: ModelEntry) -> None:
+        name = e.name
+        try:
+            if e.ref:
+                dest = self._staging_dir(name)
+                from modelx_tpu.dl.initializer import pull_model
+                from modelx_tpu.utils import trace
+
+                with trace.span("lifecycle.pull", model=name, ref=e.ref):
+                    pull_model(e.ref, dest, cache=self.blob_cache, quiet=True)
+                with self._lock:
+                    if e.state != PULLING:  # raced an unload/retry
+                        shutil.rmtree(dest, ignore_errors=True)
+                        return
+                    e.model_dir = dest
+                    e._staged = True
+                    e.to(LOADING)
+            from modelx_tpu.dl.serve import ModelServer
+
+            kwargs = dict(self.sset.server_defaults)
+            server = ModelServer(e.model_dir, name=name, **kwargs)
+            with self._lock:
+                e.server = server
+            server.load()
+            aborted = False
+            with self._lock:
+                if e.state != LOADING:  # raced an unload/retry mid-load
+                    aborted = True
+                else:
+                    self.sset.add_server(name, server)
+                    self.mark_ready(name)
+            if aborted:
+                self._free_server(name, server)  # outside the lock
+                return
+            logger.info("model %s loaded at runtime (%s)", name,
+                        e.ref or e.model_dir)
+        except BaseException as exc:  # FAILED is a state, not a crash
+            logger.warning("runtime load of %s failed: %s", name, exc)
+            with self._lock:
+                if e._staged and e.model_dir:
+                    shutil.rmtree(e.model_dir, ignore_errors=True)
+                    e.model_dir = ""
+                    e._staged = False
+            self.mark_failed(name, str(exc))
+
+    # -- admin: unload / evict ------------------------------------------------
+
+    def request_unload(self, name: str, wait: bool = True,
+                       drain_timeout_s: float | None = None) -> dict:
+        """DRAIN then free one model: admission stops immediately (new
+        requests 409), in-flight requests get up to ``drain_timeout_s`` to
+        finish, then device + host state frees and the entry lands
+        UNLOADED (the name 404s). FAILED/UNLOADED entries delete their
+        record outright (freeing the name for unrelated reuse)."""
+        timeout = self.drain_timeout_s if drain_timeout_s is None else drain_timeout_s
+        deleted_art = None
+        with self._lock:
+            e = self.entries.get(name)
+            if e is None:
+                raise PoolError(404, f"model {name!r} not found")
+            state = self._effective_state(e)
+            if state in (UNLOADED, FAILED):
+                # delete the record outright — INCLUDING a FAILED boot
+                # tenant's zombie server, which otherwise stays in routing
+                # answering 503 forever while /healthz reads healthy
+                server, batcher, cb = self.sset.remove_server(name, close=False)
+                staged = e.model_dir if e._staged else ""
+                del self.entries[name]
+                deleted_art = (name, server, batcher, cb, staged)
+            elif state == DRAINING:
+                raise PoolError(409, f"model {name!r} is already draining")
+            elif state in (PULLING, LOADING):
+                raise PoolError(409, f"model {name!r} is {state}; "
+                                     "wait for the load to finish")
+            elif len(self._serving_names()) <= 1:
+                raise PoolError(409, "refusing to unload the last serving "
+                                     "model (delete the pod instead)")
+            else:
+                e.to(DRAINING)
+                t0 = time.monotonic()
+        if deleted_art is not None:
+            self._finish_free(deleted_art)  # outside the lock, as always
+            return {name: {"state": "DELETED"}}
+
+        def _drain() -> None:
+            with self._lock:
+                deadline = time.monotonic() + timeout
+                while e.inflight > 0 and time.monotonic() < deadline:
+                    self._idle.wait(timeout=min(0.5, timeout))
+                if e.inflight > 0:
+                    logger.warning(
+                        "drain of %s timed out with %d in flight; freeing "
+                        "anyway", name, e.inflight,
+                    )
+                e.drain_seconds = time.monotonic() - t0
+                art = self._free_entry_locked(e, evicted=False)
+            # the heavy part — engine join, device-state release, staging
+            # rmtree — happens OUTSIDE the lock so the other tenants'
+            # admission never stalls behind this model's teardown
+            self._finish_free(art)
+
+        if wait:
+            _drain()
+        else:
+            threading.Thread(target=_drain, daemon=True,
+                             name=f"model-drain-{name}").start()
+        with self._lock:
+            snap = e.snapshot()
+        return {name: snap}
+
+    def _serving_names(self) -> list[str]:
+        return [
+            n for n, e in self.entries.items()
+            if self._effective_state(e) in (READY, DRAINING)
+        ]
+
+    def _free_entry_locked(self, e: ModelEntry, evicted: bool) -> tuple:
+        """Caller holds the lock. The BOOKKEEPING half of freeing a model:
+        pull it out of routing, flip the entry UNLOADED, release the HBM
+        reservation. Returns the heavy artifacts (server, engines, staged
+        dir) for ``_finish_free`` — run it AFTER releasing the lock."""
+        name = e.name
+        server, batcher, cb = self.sset.remove_server(name, close=False)
+        staged = e.model_dir if e._staged else ""
+        if e._staged:
+            e.model_dir = ""
+            e._staged = False
+        e.server = None
+        e.hbm_reserved_bytes = 0
+        e.to(UNLOADED)
+        if evicted:
+            e.evictions_total += 1
+            self.stats["evictions_total"] += 1
+        else:
+            self.stats["unloads_total"] += 1
+        logger.info("model %s %s", name, "evicted" if evicted else "unloaded")
+        return name, server, batcher, cb, staged
+
+    def _finish_free(self, art: tuple) -> None:
+        """The HEAVY half of freeing a model (engine thread join, device
+        state release, params drop, staging rmtree). Never called under
+        the pool lock: one tenant's teardown must not stall admission for
+        the others."""
+        name, server, batcher, cb, staged = art
+        if batcher is not None:
+            batcher.close()
+        if cb is not None:
+            cb.close()
+            cb.release_device_state()
+        if server is not None:
+            self._free_server(name, server)
+        if staged:
+            shutil.rmtree(staged, ignore_errors=True)
+
+    @staticmethod
+    def _free_server(name: str, server) -> None:
+        """Drop every device + host reference a ModelServer holds so the
+        params, AOT executables, and decoder caches become collectable
+        the moment the last in-flight array fetch completes."""
+        server.ready = False
+        server.params = None
+        server._forward_aot.clear()
+        server._decoders.clear()
+        server._score_progs.clear()
+        server._spec_decoder = None
+        server._forward = None
+        if server._prefix_cache is not None:
+            try:
+                server._prefix_cache.clear()
+            except AttributeError:
+                server._prefix_cache = None

@@ -52,11 +52,16 @@ _UNSUPPORTED = ("echo", "tools", "tool_choice", "functions")
 
 
 class APIError(Exception):
-    """OpenAI-shaped error: {"error": {"message", "type", "code"}}."""
+    """OpenAI-shaped error: {"error": {"message", "type", "code"}}.
 
-    def __init__(self, status: int, message: str, err_type: str = "invalid_request_error") -> None:
+    ``headers`` ride to the transport (dl/serve.py) — the still-loading
+    503 carries Retry-After exactly like the native surface's."""
+
+    def __init__(self, status: int, message: str, err_type: str = "invalid_request_error",
+                 headers: dict | None = None) -> None:
         super().__init__(message)
         self.status = status
+        self.headers = dict(headers or {})
         self.payload = {
             "error": {"message": message, "type": err_type, "param": None, "code": None}
         }
@@ -64,19 +69,33 @@ class APIError(Exception):
 
 def api_error_for(e) -> APIError:
     """ONE OpenAI payload per typed serving failure (dl/serving_errors.py):
-    the exception's canonical status + api_type, identical between the
-    streaming and non-streaming paths."""
-    return APIError(e.http_status, str(e), e.api_type)
+    the exception's canonical status + api_type + headers (Retry-After on
+    sheds and still-loading), identical between the streaming and
+    non-streaming paths."""
+    return APIError(e.http_status, str(e), e.api_type, headers=e.headers())
 
 
 def resolve_model(sset, req: dict):
-    """The ``model`` field picks the sidecar tenant; absent = default."""
+    """The ``model`` field picks the sidecar tenant; absent = default.
+    Lifecycle-transitioning names (dl/lifecycle.py) map like the native
+    surface: PULLING/LOADING 503 + Retry-After, DRAINING 409, FAILED 503
+    with the reason (the serve.py handler also pre-gates, but direct
+    library callers of run_completion get identical behavior here)."""
+    from modelx_tpu.dl.serving_errors import ServingError
+
     name = req.get("model") or sset.default
     server = sset.servers.get(name)
+    pool = getattr(sset, "pool", None)
+    if pool is not None:
+        try:
+            pool.check_admission(name)
+        except ServingError as e:
+            raise api_error_for(e) from e
     if server is None:
         raise APIError(404, f"model {name!r} not found", "not_found_error")
     if not server.ready:
-        raise APIError(503, f"model {name!r} is still loading", "server_error")
+        raise APIError(503, f"model {name!r} is still loading", "server_error",
+                       headers={"Retry-After": "2"})
     return server
 
 
@@ -670,14 +689,40 @@ def stream_completion(sset, req: dict, chat: bool) -> Iterator[dict]:
 
 def models_payload(sset) -> dict:
     """GET /v1/models body serving BOTH contracts: the sidecar's native
-    {default, models} keys and OpenAI's {object: "list", data: [...]}."""
+    {default, models} keys and OpenAI's {object: "list", data: [...]}.
+
+    The DYNAMIC model set (dl/lifecycle.py) is fully reflected: every
+    lifecycle entry appears with its state — a PULLING/LOADING model shows
+    up before it can serve, a FAILED one carries its failure reason, an
+    UNLOADED one records that it was here — and OpenAI ``data`` rows gain
+    a ``lifecycle_state`` extension field."""
+    pool = getattr(sset, "pool", None)
+    lifecycle = pool.states() if pool is not None else {}
+    models: dict = {}
+    for n, s in list(sset.servers.items()):
+        d = {"ready": s.ready, **s.stats}
+        if s.load_error:
+            d["error"] = s.load_error
+        if n in lifecycle:
+            d["lifecycle"] = lifecycle[n]
+        models[n] = d
+    for n, st in lifecycle.items():
+        if n not in models:  # PULLING/FAILED-at-pull/UNLOADED: no server
+            d = {"ready": False, "lifecycle": st}
+            if st.get("error"):
+                d["error"] = st["error"]
+            models[n] = d
     return {
         "default": sset.default,
-        "models": {n: {"ready": s.ready, **s.stats} for n, s in sset.servers.items()},
+        "models": models,
         "object": "list",
+        # OpenAI clients treat data rows as invokable: UNLOADED models
+        # stay visible in the native ``models`` history but not here
         "data": [
-            {"id": n, "object": "model", "created": 0, "owned_by": "modelx-tpu"}
-            for n in sset.servers
+            {"id": n, "object": "model", "created": 0, "owned_by": "modelx-tpu",
+             **({"lifecycle_state": lifecycle[n]["state"]} if n in lifecycle else {})}
+            for n in models
+            if lifecycle.get(n, {}).get("state") != "UNLOADED"
         ],
     }
 

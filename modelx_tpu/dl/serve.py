@@ -20,6 +20,11 @@ forward/decode functions, and serves:
                                 "seed": s}  (temperature 0 = greedy)
     POST /v1/{model}/forward    named model
     POST /v1/{model}/generate   named model
+    GET  /admin/models          lifecycle states + HBM accounting
+    POST /admin/models          runtime load: {"name", "ref"|"model_dir"}
+    DELETE /admin/models/{name} drain + unload (dl/lifecycle.py; the
+                                mutations need --allow-admin-load, the
+                                surface honors --admin-token bearer auth)
 
 Model family (llama / mixtral / gpt2 / bert) is detected from checkpoint
 tensor names (dl/families.py) — the checkpoint is self-describing, no
@@ -50,7 +55,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from modelx_tpu.dl import families as fam
-from modelx_tpu.dl.serving_errors import ServingError
+from modelx_tpu.dl.serving_errors import ModelLoadingError, ServingError
 from modelx_tpu.parallel.mesh import make_mesh
 from modelx_tpu.utils import trace
 
@@ -233,6 +238,11 @@ class ModelServer:
         self.dtype = jnp.bfloat16 if dtype == "bfloat16" else jnp.float32
         self.max_seq_len = max_seq_len
         self.ready = False
+        # set by ServerSet.load_all when this model's load crashed: the
+        # pool marks it FAILED, /healthz reports the degraded set, and the
+        # reason is visible in GET /v1/models — the OTHER tenants keep
+        # serving instead of the whole process dying
+        self.load_error: str | None = None
         self.stats: dict = {"requests": 0, "tokens_generated": 0}
         self.cfg = config
         self.family: fam.Family | None = None
@@ -970,6 +980,7 @@ class Batcher:
 
 
 _MODEL_ROUTE = re.compile(r"^/v1/(?P<model>[A-Za-z0-9._-]+)/(?P<verb>forward|generate)$")
+_ADMIN_MODEL_ROUTE = re.compile(r"^/admin/models/(?P<model>[A-Za-z0-9._-]+)(?:\?.*)?$")
 
 
 class ServerSet:
@@ -988,14 +999,38 @@ class ServerSet:
                  prefill_chunk: int = 0,
                  prefill_budget: int = 0,
                  max_queue_depth: int = 0,
-                 request_timeout_s: float = 0.0) -> None:
+                 request_timeout_s: float = 0.0,
+                 hbm_budget_bytes: int = 0,
+                 evict_idle: bool = False,
+                 allow_admin_load: bool = False,
+                 admin_tokens: tuple[str, ...] = (),
+                 staging_root: str = "") -> None:
         if not servers:
             raise ValueError("no models")
         self.max_new_tokens_limit = max_new_tokens_limit
         self.servers = servers
+        # the model set is MUTABLE at runtime (dl/lifecycle.py admin
+        # loads/unloads): every structural change goes through
+        # add_server/remove_server under this lock
+        self._servers_lock = threading.RLock()
         for name, s in servers.items():
             s.name = name  # route key and server identity must agree
         self.default = default or next(iter(servers))
+        # template for runtime-loaded ModelServers (the pool's admin load
+        # path): same mesh, dtype, context budget, quantization, and cache
+        # knobs the boot-time set got — serve_main overrides as needed
+        first = next(iter(servers.values()))
+        self.server_defaults: dict = {
+            "mesh": first.mesh,
+            "dtype": "bfloat16" if first.dtype == jnp.bfloat16 else "float32",
+            "max_seq_len": first.max_seq_len,
+            "quantize": first.quantize,
+            "speculative_k": first.speculative_k,
+        }
+        # bearer tokens gating the /admin surface (the registry auth
+        # model's static-token tier; empty = anonymous admin, for
+        # single-tenant dev pods and tests)
+        self.admin_tokens = tuple(admin_tokens)
         self.trace_dir = trace_dir or os.path.join(os.getcwd(), "jax-trace")
         self._profiling = threading.Lock()
         self._dynamic_batch = dynamic_batch
@@ -1037,6 +1072,44 @@ class ServerSet:
         # set on SIGTERM: /healthz flips to 503 so load balancers stop
         # routing here while in-flight requests finish (graceful drain)
         self.draining = False
+        # the lifecycle pool (dl/lifecycle.py): state machine + HBM budget
+        # + in-flight accounting for every tenant, boot-time set included
+        from modelx_tpu.dl.lifecycle import ModelPool
+
+        self.pool = ModelPool(
+            self, hbm_budget_bytes=hbm_budget_bytes, evict_idle=evict_idle,
+            allow_admin_load=allow_admin_load, staging_root=staging_root,
+        )
+
+    def add_server(self, name: str, server: ModelServer) -> None:
+        """Insert a runtime-loaded model into the routing set (the pool's
+        READY transition)."""
+        with self._servers_lock:
+            server.name = name
+            self.servers[name] = server
+
+    def remove_server(self, name: str, close: bool = True):
+        """Remove a model from routing; returns ``(server, batcher,
+        engine)``. With ``close`` the window batcher and continuous engine
+        close and the engine's device state (KV cache / page pool) is
+        released here; the pool's unload path passes ``close=False`` and
+        closes them OUTSIDE its lock, so freeing one tenant never stalls
+        admission for the others."""
+        with self._servers_lock:
+            server = self.servers.pop(name, None)
+            batcher = self.batchers.pop(name, None)
+            cb = self.cbatchers.pop(name, None)
+            self._engine_locks.pop(name, None)
+            if self.default == name and self.servers:
+                ready = [n for n, s in self.servers.items() if s.ready]
+                self.default = (ready or list(self.servers))[0]
+        if close:
+            if batcher is not None:
+                batcher.close()
+            if cb is not None:
+                cb.close()
+                cb.release_device_state()
+        return server, batcher, cb
 
     def batcher_for(self, server: ModelServer) -> "Batcher | None":
         """Lazily create a batcher once the model is loaded — only causal
@@ -1175,44 +1248,88 @@ class ServerSet:
 
     @property
     def ready(self) -> bool:
-        return not self.draining and all(s.ready for s in self.servers.values())
+        """Readiness over the HEALTHY set: models whose load crashed are
+        FAILED (degraded, reported on /healthz and /v1/models) but must
+        not hold the whole pod un-ready forever — the other tenants are
+        serving. Empty-or-all-failed is not ready."""
+        if self.draining:
+            return False
+        with self._servers_lock:
+            healthy = [s for s in self.servers.values() if s.load_error is None]
+        return bool(healthy) and all(s.ready for s in healthy)
 
     def load_all(self, concurrent: bool = False) -> dict:
         """Load every model; ``concurrent`` overlaps the fetch phases (device
-        transfers already funnel through the loader's transfer pool)."""
-        if concurrent and len(self.servers) > 1:
-            errs: dict[str, BaseException] = {}
+        transfers already funnel through the loader's transfer pool).
 
-            def _load(s: ModelServer) -> None:
-                try:
-                    s.load()
-                except BaseException as e:  # re-raised on the caller thread
-                    errs[s.name] = e
+        One model failing marks ONLY that model FAILED (load_error set,
+        pool state FAILED, reason on /v1/models) — the others keep
+        serving. Only when EVERY model fails does the process-level error
+        propagate (a single-tenant pod with a broken checkpoint should
+        still crash-loop visibly)."""
+        def _load(s: ModelServer, catch=Exception) -> None:
+            if self.pool is not None:
+                self.pool.mark_loading(s.name)
+            try:
+                s.load()
+            except catch as e:
+                s.load_error = str(e)
+                errs[s.name] = e
+                if self.pool is not None:
+                    self.pool.mark_failed(s.name, str(e))
+                logger.error("loading %s failed (tenant marked FAILED, "
+                             "others keep serving): %s", s.name, e)
+            else:
+                if self.pool is not None:
+                    self.pool.mark_ready(s.name)
 
+        errs: dict[str, BaseException] = {}
+        servers = list(self.servers.values())
+        if concurrent and len(servers) > 1:
+            # worker threads catch BaseException so a crash surfaces as a
+            # FAILED tenant rather than a silently dead thread
             threads = [
-                threading.Thread(target=_load, args=(s,), daemon=True)
-                for s in self.servers.values()
+                threading.Thread(target=_load, args=(s, BaseException),
+                                 daemon=True)
+                for s in servers
             ]
             for t in threads:
                 t.start()
             for t in threads:
                 t.join()
-            if errs:
-                name, err = next(iter(errs.items()))
-                raise RuntimeError(f"loading {name} failed: {err}") from err
         else:
-            for s in self.servers.values():
-                s.load()
-        return {name: dict(s.stats) for name, s in self.servers.items()}
+            # sequential path runs on the MAIN thread: Exception only, so
+            # an operator Ctrl-C (KeyboardInterrupt) still aborts the boot
+            # instead of marking the in-flight model FAILED
+            for s in servers:
+                _load(s)
+        if errs and len(errs) == len(servers):
+            name, err = next(iter(errs.items()))
+            raise RuntimeError(f"loading {name} failed: {err}") from err
+        return {
+            name: dict(s.stats, **({"error": s.load_error} if s.load_error else {}))
+            for name, s in self.servers.items()
+        }
 
     def resolve(self, path: str) -> tuple[ModelServer | None, str | None]:
         """(server, verb) for a POST path; (None, None) if unroutable."""
-        if path in ("/v1/forward", "/v1/generate"):
-            return self.servers[self.default], path.rsplit("/", 1)[1]
-        m = _MODEL_ROUTE.match(path)
-        if m and m.group("model") in self.servers:
-            return self.servers[m.group("model")], m.group("verb")
+        with self._servers_lock:
+            if path in ("/v1/forward", "/v1/generate"):
+                server = self.servers.get(self.default)
+                return server, (path.rsplit("/", 1)[1] if server else None)
+            m = _MODEL_ROUTE.match(path)
+            if m and m.group("model") in self.servers:
+                return self.servers[m.group("model")], m.group("verb")
         return None, None
+
+    def route_name(self, path: str) -> str | None:
+        """The model name a POST path addresses (resolved or not) — the
+        404 path asks the pool about THIS name before giving up, so a
+        PULLING/LOADING model answers 503 + Retry-After instead of 404."""
+        if path in ("/v1/forward", "/v1/generate"):
+            return self.default
+        m = _MODEL_ROUTE.match(path)
+        return m.group("model") if m else None
 
 
 def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingHTTPServer:
@@ -1301,6 +1418,17 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
             for stream=true; errors use the OpenAI {"error": {...}} shape."""
             from modelx_tpu.dl import openai_api as oai
 
+            # lifecycle gate, in the OpenAI error shape: PULLING/LOADING
+            # 503 + Retry-After, DRAINING 409, FAILED 503 + reason — the
+            # SAME typed errors the native surface maps
+            name = str(req.get("model") or sset.default)
+            if sset.pool is not None:
+                try:
+                    sset.pool.check_admission(name)
+                    sset.pool.enter(name)  # raises 409 if a drain raced in
+                except ServingError as e:
+                    api = oai.api_error_for(e)
+                    return self._json(api.status, api.payload, headers=e.headers())
             try:
                 if bool(req.get("stream", False)):
                     events = oai.stream_completion(sset, req, chat)
@@ -1334,7 +1462,11 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                     )
                 return self._json(200, oai.run_completion(sset, req, chat))
             except oai.APIError as e:
-                return self._json(e.status, e.payload)
+                # typed lifecycle 503s raised inside the API layer carry
+                # Retry-After like the native surface's (satellite:
+                # resolve_model's still-loading must back clients off)
+                return self._json(e.status, e.payload,
+                                  headers=getattr(e, "headers", None))
             except ValueError as e:
                 return self._json(400, oai.APIError(400, str(e)).payload)
             except ServingError as e:
@@ -1345,19 +1477,54 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
             except Exception as e:
                 logger.exception("openai api error")
                 return self._json(500, oai.APIError(500, str(e), "server_error").payload)
+            finally:
+                if sset.pool is not None:
+                    sset.pool.exit(name)
+
+        def _admin_auth(self) -> bool:
+            """Bearer-token filter for the /admin surface (the registry
+            auth model's static-token tier — --admin-token). Empty token
+            set = anonymous admin. Returns False after writing the 401."""
+            if not sset.admin_tokens:
+                return True
+            import hmac
+
+            authz = self.headers.get("Authorization", "")
+            presented = authz[len("Bearer "):] if authz.startswith("Bearer ") else ""
+            # constant-time per candidate: the admin surface controls model
+            # load/unload, so token comparison must not leak prefix timing
+            if any(hmac.compare_digest(presented, t) for t in sset.admin_tokens):
+                return True
+            self._json(401, {"error": "invalid or missing bearer token"})
+            return False
 
         def do_GET(self):
             if self.path == "/healthz":
                 engine = sset.engine_health()
+                failed = sset.pool.failed() if sset.pool is not None else {}
                 if engine is not None:
                     # a crash-looping or circuit-broken engine must flip
                     # readiness so load balancers drain instead of routing
                     # every request into a dead engine
                     self._json(503, {"status": engine})
                 elif sset.ready:
-                    self._json(200, {"status": "ok"})
+                    # degraded: some tenants FAILED to load, the rest are
+                    # serving — stay routable but say who is down and why
+                    if failed:
+                        self._json(200, {"status": "degraded", "failed": failed})
+                    else:
+                        self._json(200, {"status": "ok"})
                 else:
-                    self._json(503, {"status": "draining" if sset.draining else "loading"})
+                    status = "draining" if sset.draining else (
+                        "failed" if failed else "loading"
+                    )
+                    body = {"status": status}
+                    if failed:
+                        body["failed"] = failed
+                    # loading resolves on its own: tell the LB when to look
+                    # again (the same contract the 429 shed path set)
+                    headers = {} if sset.draining else {"Retry-After": "2"}
+                    self._json(503, body, headers=headers)
             elif self.path == "/livez":
                 # liveness, distinct from readiness: fails ONLY on the
                 # unrecoverable engine-broken state (circuit open), so the
@@ -1372,7 +1539,8 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                     self._json(200, {"status": "ok"})
             elif self.path == "/metrics":
                 payload = {}
-                for n, s in sset.servers.items():
+                lifecycle = sset.pool.states() if sset.pool is not None else {}
+                for n, s in list(sset.servers.items()):
                     d = dict(s.stats)
                     cb = sset.cbatchers.get(n)
                     if cb is not None:
@@ -1383,8 +1551,24 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                         d["continuous"] = cb.snapshot()
                     if s._prefix_cache is not None:
                         d["prefix_cache"] = s._prefix_cache.stats()
+                    if n in lifecycle:
+                        # per-model lifecycle gauges: state, loads_total,
+                        # evictions_total, hbm_reserved_bytes, drain_seconds
+                        d["lifecycle"] = lifecycle[n]
                     payload[n] = d
+                for n, st in lifecycle.items():
+                    if n not in payload:  # PULLING/UNLOADED: no server yet
+                        payload[n] = {"lifecycle": st}
+                if sset.pool is not None and "pool" not in payload:
+                    payload["pool"] = sset.pool.pool_snapshot()
                 self._json(200, payload)
+            elif self.path == "/admin/models":
+                if not self._admin_auth():
+                    return
+                self._json(200, {
+                    "models": sset.pool.states(),
+                    "pool": sset.pool.pool_snapshot(),
+                })
             elif self.path == "/v1/models":
                 from modelx_tpu.dl import openai_api as oai
 
@@ -1427,12 +1611,52 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                     sset._profiling.release()
                 return self._json(200, {"trace_dir": sset.trace_dir})
 
+            if self.path == "/admin/models":
+                # runtime load: pull a registry ref (or point at a local
+                # dir) and materialize it while traffic is live
+                if not self._admin_auth():
+                    return
+                from modelx_tpu.dl.lifecycle import PoolError
+
+                wait = bool(req.get("wait", False))
+                try:
+                    snap = sset.pool.request_load(
+                        str(req.get("name") or ""),
+                        ref=str(req.get("ref") or ""),
+                        model_dir=str(req.get("model_dir") or ""),
+                        wait=wait,
+                    )
+                except PoolError as e:
+                    return self._json(e.status, {"error": str(e)})
+                return self._json(200 if wait else 202, snap)
+
             if self.path in ("/v1/completions", "/v1/chat/completions"):
                 return self._openai(req, chat=self.path.endswith("chat/completions"))
 
             server, verb = sset.resolve(self.path)
             if server is None:
+                # a name the routing set doesn't know may still be a
+                # lifecycle entry: PULLING/LOADING answers 503 +
+                # Retry-After (it will be READY shortly), DRAINING 409,
+                # FAILED 503 + reason; only truly unknown names 404
+                name = sset.route_name(self.path)
+                err = (
+                    sset.pool.routing_error(name)
+                    if (sset.pool is not None and name) else None
+                )
+                if err is not None:
+                    return self._json(err.http_status, {"error": str(err)},
+                                      headers=err.headers())
                 return self._json(404, {"error": "not found"})
+            try:
+                # lifecycle gate for resolved models too: DRAINING models
+                # still sit in the routing set while in-flight requests
+                # finish, but must not admit new ones (409)
+                if sset.pool is not None:
+                    sset.pool.check_admission(server.name)
+            except ServingError as e:
+                return self._json(e.http_status, {"error": str(e)},
+                                  headers=e.headers())
             if "text" in req and "tokens" in req:
                 # generating from the tokens while silently dropping the text
                 # would answer the wrong prompt; make the caller pick one
@@ -1475,7 +1699,12 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                 # dropped connection
                 return self._json(400, {"error": f"bad request: {e}"})
             if not server.ready:
-                return self._json(503, {"error": "still loading"})
+                # 503 + Retry-After, like the 429 shed path: load
+                # balancers and the retrying RegistryClient back off and
+                # come back once the load lands READY
+                e = ModelLoadingError(server.name)
+                return self._json(e.http_status, {"error": str(e)},
+                                  headers=e.headers())
             vocab = getattr(server.cfg, "vocab_size", 0) or 0
             if vocab and (int(tokens.min()) < 0 or int(tokens.max()) >= vocab):
                 # inside jit the gather CLAMPS out-of-range ids (silent
@@ -1492,6 +1721,17 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                     f"model's {n_pos}-position context"
                 })
             server.stats["requests"] += 1
+            if sset.pool is not None:
+                # in-flight accounting: the pool's drain waits for this
+                # request to finish before freeing the model (streams
+                # complete inside this handler, so exit() fires after the
+                # last chunk is on the wire); a drain that started since
+                # the admission check above refuses here instead (409)
+                try:
+                    sset.pool.enter(server.name)
+                except ServingError as e:
+                    return self._json(e.http_status, {"error": str(e)},
+                                      headers=e.headers())
             try:
                 if verb == "forward":
                     batcher = sset.batcher_for(server)
@@ -1607,6 +1847,37 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
             except Exception as e:  # surface inference errors as 500 JSON
                 logger.exception("inference error")
                 self._json(500, {"error": str(e)})
+            finally:
+                if sset.pool is not None:
+                    sset.pool.exit(server.name)
+
+        def do_DELETE(self):
+            """DELETE /admin/models/{name}: drain in-flight requests, stop
+            admission (new requests 409 while draining, 404 once gone),
+            then free params, KV/page pools, compiled programs, and
+            pool-owned staging. ``?wait=0`` returns 202 immediately and
+            drains in the background."""
+            m = _ADMIN_MODEL_ROUTE.match(self.path)
+            if m is None:
+                return self._json(404, {"error": "not found"})
+            if not self._admin_auth():
+                return
+            from urllib.parse import parse_qs, urlparse
+
+            from modelx_tpu.dl.lifecycle import PoolError
+
+            if not sset.pool.allow_admin_load:
+                return self._json(403, {
+                    "error": "admin model unloading is disabled "
+                             "(start with --allow-admin-load)"
+                })
+            q = parse_qs(urlparse(self.path).query)
+            wait = q.get("wait", ["1"])[0] not in ("0", "false")
+            try:
+                snap = sset.pool.request_unload(m.group("model"), wait=wait)
+            except PoolError as e:
+                return self._json(e.status, {"error": str(e)})
+            return self._json(200 if wait else 202, snap)
 
     host, _, port = listen.rpartition(":")
     httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)), Handler)

@@ -118,6 +118,27 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
 @click.option("--lora", "loras", multiple=True, metavar="NAME=ADAPTER_DIR",
               help="merge a PEFT-style LoRA adapter into model NAME at load "
                    "('default' for --model-dir); repeatable")
+@click.option("--hbm-budget-bytes", default=0, type=int,
+              help="model lifecycle pool: device-memory budget — a runtime "
+                   "load whose estimated footprint (manifest/safetensors "
+                   "sizes) does not fit is refused with 507, or makes room "
+                   "by LRU-evicting idle models under --evict-idle "
+                   "(0 = unbudgeted)")
+@click.option("--evict-idle", is_flag=True,
+              help="with --hbm-budget-bytes: LRU-evict READY models that "
+                   "have no in-flight requests to make room for a new load "
+                   "instead of refusing it")
+@click.option("--allow-admin-load", is_flag=True,
+              help="enable the runtime lifecycle surface: POST "
+                   "/admin/models pulls+loads a registry ref while traffic "
+                   "is live, DELETE /admin/models/{name} drains and frees "
+                   "one (GET /admin/models always reports states)")
+@click.option("--admin-token", "admin_tokens", multiple=True,
+              help="bearer token accepted on the /admin surface "
+                   "(repeatable; none = anonymous admin — dev pods only)")
+@click.option("--staging-dir", default="",
+              help="where runtime-pulled model blobs land before loading "
+                   "(default: $TMPDIR/modelx-pool-staging)")
 @click.option("--drain-seconds", default=5.0, type=float,
               help="on SIGTERM, serve 503 on /healthz for this long (so load "
                    "balancers drain) before stopping")
@@ -133,6 +154,8 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
          max_queue_depth: int, request_timeout: float,
          prefix_cache: int, prefix_cache_max_bytes: int,
          quantize: str | None, speculative_k: int,
+         hbm_budget_bytes: int, evict_idle: bool, allow_admin_load: bool,
+         admin_tokens: tuple[str, ...], staging_dir: str,
          loras: tuple[str, ...], drain_seconds: float) -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     from modelx_tpu.parallel.distributed import initialize
@@ -222,7 +245,22 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
                      prefill_chunk=prefill_chunk,
                      prefill_budget=prefill_budget,
                      max_queue_depth=max_queue_depth,
-                     request_timeout_s=request_timeout)
+                     request_timeout_s=request_timeout,
+                     hbm_budget_bytes=hbm_budget_bytes,
+                     evict_idle=evict_idle,
+                     allow_admin_load=allow_admin_load,
+                     admin_tokens=admin_tokens,
+                     staging_root=staging_dir)
+    # runtime-loaded models get the same cache knobs the boot set got
+    sset.server_defaults.update(
+        prefix_cache_size=prefix_cache,
+        prefix_cache_max_bytes=prefix_cache_max_bytes,
+    )
+    if evict_idle and not hbm_budget_bytes:
+        logging.getLogger("modelx.serve").warning(
+            "--evict-idle is inert without --hbm-budget-bytes "
+            "(eviction only runs to fit a load under the budget)"
+        )
     httpd = serve(sset, listen=listen)  # starts serving 503s while loading
     stats = sset.load_all(concurrent=concurrent_load)
     logging.getLogger("modelx.serve").info("models loaded: %s", stats)

@@ -13,6 +13,12 @@ exception carrying its canonical status:
   engine loop repeatedly; re-admitting it would crash-loop the server)
 - ``EngineBrokenError``     -> 503 (the engine died mid-flight; the
   supervisor may be restarting it — retryable, unlike a 500)
+- ``ModelLoadingError``     -> 503 + ``Retry-After`` (the model is
+  PULLING/LOADING in the lifecycle pool; a later retry will hit it READY)
+- ``ModelDrainingError``    -> 409 (the model is being unloaded; new
+  admissions are refused while in-flight requests finish)
+- ``ModelFailedError``      -> 503 (the model's load crashed; the slot is
+  retryable via the admin API, and the reason rides in the message)
 
 Kept dependency-free (no jax, no requests) so the transport layer can
 import it at module top without cost.
@@ -87,3 +93,65 @@ class EngineBrokenError(ServingError):
 
     def __init__(self, message: str = "serving engine failed") -> None:
         super().__init__(message)
+
+
+class ModelLoadingError(ServingError):
+    """The requested model is mid-materialization (PULLING its blobs or
+    LOADING them onto the mesh — dl/lifecycle.py). 503 + ``Retry-After``
+    so load balancers and the retrying RegistryClient back off instead of
+    hammering a model that will be READY shortly."""
+
+    http_status = 503
+
+    def __init__(self, name: str, state: str = "loading",
+                 retry_after: float = 2.0) -> None:
+        super().__init__(f"model {name!r} is still {state}; retry later")
+        self.model = name
+        self.state = state
+        self.retry_after = max(1, int(retry_after))
+
+    def headers(self) -> dict[str, str]:
+        return {"Retry-After": str(self.retry_after)}
+
+
+class ModelUnloadedError(ServingError):
+    """The model was unloaded (or evicted): the name no longer serves.
+    404, matching the routing layer's treatment of unknown names — raised
+    when a request slips past the admission check just as the free
+    completes, so it can never run against a freed server."""
+
+    http_status = 404
+    api_type = "not_found_error"
+
+    def __init__(self, name: str) -> None:
+        super().__init__(f"model {name!r} is not loaded")
+        self.model = name
+
+
+class ModelDrainingError(ServingError):
+    """The requested model is DRAINING (an unload/evict is letting its
+    in-flight requests finish). 409: new admissions are refused — once the
+    drain completes the name 404s, so a retry loop should re-resolve."""
+
+    http_status = 409
+    api_type = "invalid_request_error"
+
+    def __init__(self, name: str) -> None:
+        super().__init__(f"model {name!r} is draining (being unloaded)")
+        self.model = name
+
+
+class ModelFailedError(ServingError):
+    """The model's load crashed (state FAILED in the lifecycle pool). 503:
+    the slot stays retryable — an admin re-POST of the same name retries
+    the load — and the failure reason rides in the message so clients and
+    GET /v1/models agree on what broke."""
+
+    http_status = 503
+
+    def __init__(self, name: str, reason: str = "") -> None:
+        super().__init__(
+            f"model {name!r} failed to load" + (f": {reason}" if reason else "")
+        )
+        self.model = name
+        self.reason = reason

@@ -247,6 +247,14 @@ class PrefixKVCache:
             return {"hits": self.hits, "misses": self.misses,
                     "entries": len(self._od), "bytes": self._bytes}
 
+    def clear(self) -> None:
+        """Drop every stored entry (the model-unload path: the cached KV
+        pytrees pin HBM until the last reference goes)."""
+        with self._lock:
+            self._od.clear()
+            self._meta.clear()
+            self._bytes = 0
+
 
 class ChunkedDecoder:
     """Streaming decode: tokens come back in fixed-size chunks so a server

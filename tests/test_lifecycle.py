@@ -1,0 +1,689 @@
+"""Model lifecycle pool tests (ISSUE 5): runtime load / drain / unload /
+evict with an HBM budget — the PULLING -> LOADING -> READY -> DRAINING ->
+UNLOADED | FAILED state machine, the /admin surface, the typed 503/409/404
+routing contract on both API surfaces, budget refusal + LRU eviction, the
+degraded multi-tenant boot, and the blob-cache-warm runtime pull.
+
+The multi-model drills (eviction, crashed-load retry, engine free) carry
+the ``slow`` marker — tier-1 keeps the core end-to-end swap plus the fast
+contract tests; ``make lifecycle`` runs the whole file."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+import jax
+
+from modelx_tpu.client.client import Client
+from modelx_tpu.dl import safetensors as st
+from modelx_tpu.dl.lifecycle import (
+    DRAINING,
+    FAILED,
+    LOADING,
+    PULLING,
+    READY,
+    UNLOADED,
+    ModelEntry,
+    PoolError,
+    estimate_dir_bytes,
+    estimate_ref_bytes,
+)
+from modelx_tpu.dl.serve import ModelServer, ServerSet, serve
+from modelx_tpu.models import llama
+from modelx_tpu.registry.fs import MemoryFSProvider
+from modelx_tpu.registry.server import Options, RegistryServer, free_port
+from modelx_tpu.registry.store_fs import FSRegistryStore
+
+
+def write_tiny(dirpath: str, seed: int = 0):
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+    os.makedirs(dirpath, exist_ok=True)
+    st.write_safetensors(
+        os.path.join(dirpath, "model.safetensors"),
+        {k: np.asarray(v) for k, v in params.items()},
+    )
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def registry():
+    srv = RegistryServer(
+        Options(listen=f"127.0.0.1:{free_port()}"),
+        store=FSRegistryStore(MemoryFSProvider()),
+    )
+    base = srv.serve_background()
+    yield base
+    srv.shutdown()
+
+
+@pytest.fixture(scope="module")
+def model_dirs(tmp_path_factory):
+    root = tmp_path_factory.mktemp("lifecycle-models")
+    dirs = {}
+    for name, seed in (("a", 0), ("b", 1)):
+        d = root / name
+        write_tiny(str(d), seed)
+        dirs[name] = str(d)
+    # "bad": a VALID safetensors file whose tensors match no family — the
+    # pull succeeds, the load crashes (the FAILED-state drill)
+    bad = root / "bad"
+    bad.mkdir()
+    st.write_safetensors(
+        str(bad / "model.safetensors"),
+        {"mystery.weight": np.zeros((4, 4), np.float32)},
+    )
+    dirs["bad"] = str(bad)
+    return dirs
+
+
+@pytest.fixture(scope="module")
+def pushed(registry, model_dirs):
+    client = Client(registry, quiet=True)
+    for name in ("a", "b", "bad"):
+        client.push(f"library/{name}", "v1", model_dirs[name])
+    return registry
+
+
+def make_server(model_dir: str, name: str = "a") -> ModelServer:
+    return ModelServer(model_dir, mesh_spec="dp=1", max_seq_len=64, name=name)
+
+
+def serve_sset(sset):
+    port = free_port()
+    httpd = serve(sset, listen=f"127.0.0.1:{port}")
+    return httpd, f"http://127.0.0.1:{port}"
+
+
+@pytest.fixture(scope="module")
+def served_a(model_dirs):
+    """One loaded, HTTP-served single-model set shared by the READ-ONLY
+    contract tests (model loads dominate this file's wall time)."""
+    sset = ServerSet({"a": make_server(model_dirs["a"])})
+    sset.load_all()
+    httpd, base = serve_sset(sset)
+    yield sset, base
+    httpd.shutdown()
+
+
+class TestFootprintEstimates:
+    def test_dir_estimate_is_safetensors_bytes(self, model_dirs):
+        path = os.path.join(model_dirs["a"], "model.safetensors")
+        assert estimate_dir_bytes(model_dirs["a"]) == os.path.getsize(path)
+
+    def test_empty_dir_estimates_zero(self, tmp_path):
+        assert estimate_dir_bytes(str(tmp_path)) == 0
+
+    def test_ref_estimate_matches_manifest(self, pushed, model_dirs):
+        path = os.path.join(model_dirs["a"], "model.safetensors")
+        got = estimate_ref_bytes(f"{pushed}/library/a@v1")
+        assert got == os.path.getsize(path)
+
+
+class TestEndToEndLifecycle:
+    def test_load_route_drain_unload(self, pushed, model_dirs, tmp_path):
+        """The acceptance drill: serve A; POST /admin/models pulls+loads B
+        from the registry while A streams UNINTERRUPTED (token-exact vs an
+        unloaded-server baseline); traffic routes to B; DELETE A with a
+        request in flight (in-flight completes, new requests 409 while
+        draining, then 404); the freed server holds no params."""
+        sset = ServerSet(
+            {"a": make_server(model_dirs["a"])},
+            allow_admin_load=True, staging_root=str(tmp_path / "staging"),
+        )
+        httpd, base = serve_sset(sset)
+        try:
+            sset.load_all()
+
+            # ground truth from a server with NO lifecycle churn around it
+            baseline = make_server(model_dirs["a"], name="baseline")
+            baseline.load()
+            prompt = [[1, 2, 3]]
+            expected = baseline.generate(
+                np.asarray(prompt, np.int32), max_new_tokens=12
+            )
+
+            # B is unknown before the load: a plain 404
+            r = requests.post(base + "/v1/b/generate",
+                              json={"tokens": prompt, "max_new_tokens": 2})
+            assert r.status_code == 404
+
+            # stream from A while B pulls + loads
+            stream_tokens: list = []
+            stream_err: list = []
+
+            def run_stream() -> None:
+                try:
+                    resp = requests.post(
+                        base + "/v1/generate",
+                        json={"tokens": prompt, "max_new_tokens": 12,
+                              "stream": True},
+                        stream=True, timeout=120,
+                    )
+                    assert resp.status_code == 200, resp.text
+                    for line in resp.iter_lines():
+                        obj = json.loads(line)
+                        if obj.get("done"):
+                            return
+                        stream_tokens.extend(obj["tokens"][0])
+                except Exception as e:  # surfaces on the main thread
+                    stream_err.append(e)
+
+            t = threading.Thread(target=run_stream)
+            t.start()
+            r = requests.post(
+                base + "/admin/models",
+                json={"name": "b", "ref": f"{pushed}/library/b@v1",
+                      "wait": True},
+                timeout=300,
+            )
+            assert r.status_code == 200, r.text
+            assert r.json()["b"]["state"] == READY
+            t.join(timeout=120)
+            assert not stream_err, stream_err
+            # token-exact: the concurrent pull+load changed NOTHING about
+            # A's stream
+            assert stream_tokens == expected[0, 3:].tolist()
+
+            # traffic routes to B; /v1/models reflects the dynamic set
+            r = requests.post(base + "/v1/b/generate",
+                              json={"tokens": prompt, "max_new_tokens": 4})
+            assert r.status_code == 200, r.text
+            assert len(r.json()["tokens"][0]) == 7
+            models = requests.get(base + "/v1/models").json()
+            assert models["models"]["b"]["lifecycle"]["state"] == READY
+            assert {d["id"] for d in models["data"]} >= {"a", "b"}
+
+            # GET /admin/models shows both READY + the pool accounting
+            admin = requests.get(base + "/admin/models").json()
+            assert admin["models"]["a"]["state"] == READY
+            assert admin["models"]["b"]["state"] == READY
+            assert admin["pool"]["hbm_reserved_bytes"] > 0
+
+            # DELETE A with a request in flight: drain waits, new requests
+            # 409, completion flips to 404
+            a_server = sset.servers["a"]
+            sset.pool.enter("a")  # a held in-flight request
+            result: dict = {}
+
+            def run_delete() -> None:
+                result["r"] = requests.delete(base + "/admin/models/a",
+                                              timeout=60)
+
+            dt = threading.Thread(target=run_delete)
+            dt.start()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if sset.pool.states()["a"]["state"] == DRAINING:
+                    break
+                time.sleep(0.02)
+            assert sset.pool.states()["a"]["state"] == DRAINING
+            r = requests.post(base + "/v1/a/generate",
+                              json={"tokens": prompt, "max_new_tokens": 2})
+            assert r.status_code == 409
+            assert "draining" in r.json()["error"]
+            sset.pool.exit("a")  # the in-flight request finishes
+            dt.join(timeout=60)
+            assert result["r"].status_code == 200, result["r"].text
+            assert result["r"].json()["a"]["state"] == UNLOADED
+            r = requests.post(base + "/v1/a/generate",
+                              json={"tokens": prompt, "max_new_tokens": 2})
+            assert r.status_code == 404
+            # freed for real: params dropped, routing set shrunk, default
+            # reassigned to the surviving tenant
+            assert a_server.params is None and not a_server.ready
+            assert "a" not in sset.servers
+            assert sset.default == "b"
+            models = requests.get(base + "/v1/models").json()
+            assert models["models"]["a"]["lifecycle"]["state"] == UNLOADED
+        finally:
+            httpd.shutdown()
+
+    @pytest.mark.slow
+    def test_budget_refuses_then_evicts_lru(self, pushed, model_dirs, tmp_path):
+        """Third-load acceptance: a load that exceeds --hbm-budget-bytes
+        refuses with 507; with evict-idle it LRU-evicts the idlest READY
+        model instead and lands READY."""
+        sset = ServerSet(
+            {"a": make_server(model_dirs["a"])},
+            allow_admin_load=True, staging_root=str(tmp_path / "staging"),
+        )
+        httpd, base = serve_sset(sset)
+        try:
+            sset.load_all()
+            r = requests.post(
+                base + "/admin/models",
+                json={"name": "b", "ref": f"{pushed}/library/b@v1",
+                      "wait": True},
+                timeout=300,
+            )
+            assert r.status_code == 200 and r.json()["b"]["state"] == READY
+
+            # budget: exactly what A + B hold, plus half a model of slack —
+            # a third full model cannot fit
+            est_c = estimate_ref_bytes(f"{pushed}/library/a@v1")
+            sset.pool.hbm_budget_bytes = (
+                sset.pool.reserved_bytes() + est_c // 2
+            )
+            r = requests.post(
+                base + "/admin/models",
+                json={"name": "c", "ref": f"{pushed}/library/a@v1",
+                      "wait": True},
+            )
+            assert r.status_code == 507, r.text
+            assert "budget" in r.json()["error"]
+            assert "c" not in sset.pool.states()
+
+            # stamp B as recently used so A is the LRU victim
+            requests.post(base + "/v1/b/generate",
+                          json={"tokens": [[1, 2]], "max_new_tokens": 2})
+            sset.pool.evict_idle = True
+            r = requests.post(
+                base + "/admin/models",
+                json={"name": "c", "ref": f"{pushed}/library/a@v1",
+                      "wait": True},
+                timeout=300,
+            )
+            assert r.status_code == 200, r.text
+            assert r.json()["c"]["state"] == READY
+            states = requests.get(base + "/admin/models").json()["models"]
+            assert states["a"]["state"] == UNLOADED
+            assert states["a"]["evictions_total"] == 1
+            assert states["b"]["state"] == READY
+            # the evicted model 404s; the new one serves
+            assert requests.post(
+                base + "/v1/a/generate",
+                json={"tokens": [[1]], "max_new_tokens": 1},
+            ).status_code == 404
+            assert requests.post(
+                base + "/v1/c/generate",
+                json={"tokens": [[1]], "max_new_tokens": 1},
+            ).status_code == 200
+            # pool-level metrics record the eviction
+            m = requests.get(base + "/metrics").json()
+            assert m["pool"]["evictions_total"] == 1
+        finally:
+            httpd.shutdown()
+
+    @pytest.mark.slow
+    @pytest.mark.chaos
+    def test_crashed_load_leaves_pool_serving_and_slot_retryable(
+        self, pushed, model_dirs, tmp_path
+    ):
+        """Fault drill: a ref whose pull succeeds but whose LOAD crashes
+        lands FAILED (reason visible, requests 503) while the pool keeps
+        serving — and a re-POST of the same name retries into the slot."""
+        sset = ServerSet(
+            {"a": make_server(model_dirs["a"])},
+            allow_admin_load=True, staging_root=str(tmp_path / "staging"),
+        )
+        httpd, base = serve_sset(sset)
+        try:
+            sset.load_all()
+            r = requests.post(
+                base + "/admin/models",
+                json={"name": "x", "ref": f"{pushed}/library/bad@v1",
+                      "wait": True},
+                timeout=300,
+            )
+            assert r.status_code == 200  # the REQUEST succeeded; the load...
+            assert r.json()["x"]["state"] == FAILED  # ...did not
+            assert r.json()["x"]["error"]
+
+            # the pool keeps serving A
+            r = requests.post(base + "/v1/generate",
+                              json={"tokens": [[1, 2]], "max_new_tokens": 2})
+            assert r.status_code == 200
+            # requests to the failed model: 503 with the reason
+            r = requests.post(base + "/v1/x/generate",
+                              json={"tokens": [[1]], "max_new_tokens": 1})
+            assert r.status_code == 503
+            assert "failed to load" in r.json()["error"]
+            # healthz: degraded, naming the failure
+            h = requests.get(base + "/healthz")
+            assert h.status_code == 200
+            assert h.json()["status"] == "degraded" and "x" in h.json()["failed"]
+            # /v1/models carries the reason
+            models = requests.get(base + "/v1/models").json()
+            assert models["models"]["x"]["lifecycle"]["state"] == FAILED
+            assert models["models"]["x"]["error"]
+
+            # the slot is retryable: same name, good ref
+            r = requests.post(
+                base + "/admin/models",
+                json={"name": "x", "ref": f"{pushed}/library/b@v1",
+                      "wait": True},
+                timeout=300,
+            )
+            assert r.status_code == 200 and r.json()["x"]["state"] == READY
+            assert requests.post(
+                base + "/v1/x/generate",
+                json={"tokens": [[1]], "max_new_tokens": 1},
+            ).status_code == 200
+            assert requests.get(base + "/healthz").json()["status"] == "ok"
+        finally:
+            httpd.shutdown()
+
+
+class TestRoutingStates:
+    def test_pulling_model_gets_503_retry_after_both_surfaces(self, served_a):
+        sset, base = served_a
+        # manufacture a mid-pull entry (deterministic: no thread races)
+        e = ModelEntry("warming")
+        e.to(PULLING)
+        sset.pool.entries["warming"] = e
+        try:
+            r = requests.post(base + "/v1/warming/generate",
+                              json={"tokens": [[1]], "max_new_tokens": 1})
+            assert r.status_code == 503
+            assert "Retry-After" in r.headers
+            assert "pulling" in r.json()["error"]
+            r = requests.post(base + "/v1/completions",
+                              json={"model": "warming", "prompt": "hi"})
+            assert r.status_code == 503
+            assert "Retry-After" in r.headers
+            assert r.json()["error"]["type"] == "server_error"
+        finally:
+            del sset.pool.entries["warming"]
+
+    def test_still_loading_503_carries_retry_after(self, model_dirs):
+        """Satellite: the boot-time still-loading 503s must back clients
+        off like the 429 shed path does."""
+        sset = ServerSet({"a": make_server(model_dirs["a"])})  # NOT loaded
+        httpd, base = serve_sset(sset)
+        try:
+            r = requests.post(base + "/v1/generate",
+                              json={"tokens": [[1]], "max_new_tokens": 1})
+            assert r.status_code == 503
+            assert "Retry-After" in r.headers
+            r = requests.post(base + "/v1/completions",
+                              json={"prompt": "hi"})
+            assert r.status_code == 503
+            assert "Retry-After" in r.headers
+            # readiness 503 while loading points retries forward too
+            h = requests.get(base + "/healthz")
+            assert h.status_code == 503 and "Retry-After" in h.headers
+        finally:
+            httpd.shutdown()
+
+    def test_unknown_model_still_404s(self, served_a):
+        _sset, base = served_a
+        r = requests.post(base + "/v1/nope/generate",
+                          json={"tokens": [[1]], "max_new_tokens": 1})
+        assert r.status_code == 404
+
+
+class TestPoolAPI:
+    def test_load_validation_errors(self, model_dirs, tmp_path):
+        sset = ServerSet(
+            {"a": make_server(model_dirs["a"])}, allow_admin_load=True,
+            staging_root=str(tmp_path / "staging"),
+        )
+        pool = sset.pool
+        with pytest.raises(PoolError) as ei:
+            pool.request_load("x")  # neither ref nor model_dir
+        assert ei.value.status == 400
+        with pytest.raises(PoolError) as ei:
+            pool.request_load("x", ref="r", model_dir="d")  # both
+        assert ei.value.status == 400
+        with pytest.raises(PoolError) as ei:
+            pool.request_load("bad/name", model_dir=model_dirs["a"])
+        assert ei.value.status == 400
+        with pytest.raises(PoolError) as ei:
+            pool.request_load("x", model_dir=str(tmp_path))  # no safetensors
+        assert ei.value.status == 400
+        with pytest.raises(PoolError) as ei:
+            pool.request_load("a", model_dir=model_dirs["a"])  # name taken
+        assert ei.value.status == 409
+
+    def test_load_disabled_without_flag(self, model_dirs):
+        sset = ServerSet({"a": make_server(model_dirs["a"])})
+        with pytest.raises(PoolError) as ei:
+            sset.pool.request_load("b", model_dir=model_dirs["b"])
+        assert ei.value.status == 403
+
+    def test_unload_refuses_transitional_and_last(self, model_dirs, tmp_path):
+        sset = ServerSet(
+            {"a": make_server(model_dirs["a"])}, allow_admin_load=True,
+            staging_root=str(tmp_path / "staging"),
+        )
+        sset.servers["a"].ready = True  # READY without paying a real load
+        pool = sset.pool
+        # a mid-load entry cannot be unloaded
+        e = ModelEntry("mid")
+        e.to(LOADING)
+        pool.entries["mid"] = e
+        with pytest.raises(PoolError) as ei:
+            pool.request_unload("mid")
+        assert ei.value.status == 409
+        del pool.entries["mid"]
+        # the last serving model cannot be unloaded
+        with pytest.raises(PoolError) as ei:
+            pool.request_unload("a")
+        assert ei.value.status == 409
+        # unknown name
+        with pytest.raises(PoolError) as ei:
+            pool.request_unload("ghost")
+        assert ei.value.status == 404
+        # deleting a FAILED entry removes the record outright
+        f = ModelEntry("flop")
+        f.to(FAILED, error="boom")
+        pool.entries["flop"] = f
+        out = pool.request_unload("flop")
+        assert out["flop"]["state"] == "DELETED"
+        assert "flop" not in pool.entries
+        # ...and a FAILED boot tenant's DELETE also removes the zombie
+        # server from routing (it must 404, not answer 503 forever)
+        z = make_server(model_dirs["b"], name="z")
+        sset.add_server("z", z)
+        ze = ModelEntry("z")
+        ze.server = z
+        ze.to(FAILED, error="boom")
+        pool.entries["z"] = ze
+        out = pool.request_unload("z")
+        assert out["z"]["state"] == "DELETED"
+        assert "z" not in sset.servers and "z" not in pool.entries
+
+    @pytest.mark.slow
+    def test_local_dir_load_via_pool(self, model_dirs, tmp_path):
+        """model_dir loads skip PULLING and go straight to LOADING."""
+        sset = ServerSet(
+            {"a": make_server(model_dirs["a"])}, allow_admin_load=True,
+            staging_root=str(tmp_path / "staging"),
+        )
+        sset.load_all()
+        snap = sset.pool.request_load("b", model_dir=model_dirs["b"], wait=True)
+        assert snap["b"]["state"] == READY
+        assert "b" in sset.servers and sset.servers["b"].ready
+        # runtime-loaded servers inherit the boot set's serving template
+        assert sset.servers["b"].mesh is sset.servers["a"].mesh
+        assert sset.servers["b"].max_seq_len == sset.servers["a"].max_seq_len
+
+    @pytest.mark.slow
+    def test_unload_frees_continuous_engine(self, model_dirs, tmp_path):
+        sset = ServerSet(
+            {"a": make_server(model_dirs["a"]),
+             "b": make_server(model_dirs["b"], name="b")},
+            continuous_batch=True, max_slots=2, allow_admin_load=True,
+            staging_root=str(tmp_path / "staging"),
+        )
+        sset.load_all()
+        cb = sset.continuous_for(sset.servers["a"])
+        out = cb.generate(np.asarray([[1, 2]], np.int32), max_new_tokens=2)
+        assert out.shape == (1, 4)
+        a_server = sset.servers["a"]
+        sset.pool.request_unload("a", wait=True)
+        # engine closed AND its device state released
+        assert "a" not in sset.cbatchers and "a" not in sset.servers
+        assert cb._cache is None and cb._tok is None
+        assert a_server.params is None
+        assert sset.default == "b"
+        assert sset.pool.states()["a"]["drain_seconds"] is not None
+
+
+class TestConcurrentLoad:
+    @pytest.mark.slow
+    def test_concurrent_load_all_ready(self, model_dirs):
+        """Satellite: --concurrent-load overlap path — every model lands
+        READY and the pool agrees."""
+        servers = {
+            "a": make_server(model_dirs["a"], name="a"),
+            "b": make_server(model_dirs["b"], name="b"),
+        }
+        sset = ServerSet(servers)
+        stats = sset.load_all(concurrent=True)
+        assert all(s.ready for s in servers.values())
+        assert set(stats) == {"a", "b"}
+        assert all(
+            snap["state"] == READY for snap in sset.pool.states().values()
+        )
+        assert sset.ready
+
+    def test_concurrent_load_fault_degrades_only_faulted(self, model_dirs):
+        """Satellite fix: one model failing mid---concurrent-load marks
+        ONLY that model FAILED — the others serve, /healthz reports the
+        degraded set, and the reason is visible in GET /v1/models."""
+        from modelx_tpu.testing import faults
+
+        servers = {
+            "a": make_server(model_dirs["a"], name="a"),
+            "b": make_server(model_dirs["b"], name="b"),
+        }
+        plan = faults.FaultPlan(seed=3)
+        plan.add("serve.load", errors_at=[0],
+                 error=faults.InjectedCrash("mid-load fault"))
+        servers["b"].load = faults.wrap_dispatch(
+            servers["b"].load, plan, op="serve.load"
+        )
+        sset = ServerSet(servers, default="a")
+        httpd, base = serve_sset(sset)
+        try:
+            sset.load_all(concurrent=True)  # must NOT raise
+            assert servers["a"].ready and not servers["b"].ready
+            assert "mid-load fault" in (servers["b"].load_error or "")
+            # the crashed load's partial device state is freed, so the
+            # zeroed HBM reservation matches reality
+            assert servers["b"].params is None
+            h = requests.get(base + "/healthz")
+            assert h.status_code == 200, h.text
+            assert h.json()["status"] == "degraded"
+            assert "b" in h.json()["failed"]
+            models = requests.get(base + "/v1/models").json()
+            assert "mid-load fault" in models["models"]["b"]["error"]
+            assert models["models"]["b"]["lifecycle"]["state"] == FAILED
+            # the healthy tenant serves; the failed one 503s with a reason
+            assert requests.post(
+                base + "/v1/a/generate",
+                json={"tokens": [[1, 2]], "max_new_tokens": 2},
+            ).status_code == 200
+            r = requests.post(base + "/v1/b/generate",
+                              json={"tokens": [[1]], "max_new_tokens": 1})
+            assert r.status_code == 503
+            assert "failed to load" in r.json()["error"]
+        finally:
+            httpd.shutdown()
+
+    def test_all_models_failing_still_raises(self, tmp_path):
+        """Single-tenant parity: when EVERY model fails the process-level
+        error propagates (a broken pod must crash-loop visibly)."""
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        sset = ServerSet({"a": make_server(str(empty))})
+        with pytest.raises(RuntimeError, match="failed"):
+            sset.load_all()
+
+
+class TestAdminAuth:
+    def test_admin_surface_requires_token_when_configured(self, model_dirs):
+        sset = ServerSet(
+            {"a": make_server(model_dirs["a"])},
+            allow_admin_load=True, admin_tokens=("s3cret",),
+        )
+        httpd, base = serve_sset(sset)
+        try:
+            assert requests.get(base + "/admin/models").status_code == 401
+            assert requests.post(base + "/admin/models",
+                                 json={"name": "x"}).status_code == 401
+            assert requests.delete(base + "/admin/models/a").status_code == 401
+            hdr = {"Authorization": "Bearer s3cret"}
+            assert requests.get(base + "/admin/models",
+                                headers=hdr).status_code == 200
+            # the non-admin surface stays open
+            assert requests.get(base + "/v1/models").status_code == 200
+        finally:
+            httpd.shutdown()
+
+    def test_mutations_403_without_allow_admin_load(self, model_dirs):
+        sset = ServerSet({"a": make_server(model_dirs["a"])})
+        httpd, base = serve_sset(sset)
+        try:
+            # states are readable, mutations are not
+            assert requests.get(base + "/admin/models").status_code == 200
+            r = requests.post(base + "/admin/models",
+                              json={"name": "b", "model_dir": "/x"})
+            assert r.status_code == 403
+            assert requests.delete(
+                base + "/admin/models/a"
+            ).status_code == 403
+        finally:
+            httpd.shutdown()
+
+
+class TestMetrics:
+    def test_lifecycle_gauges_on_metrics(self, served_a):
+        """Satellite: GET /metrics gains per-model lifecycle gauges plus
+        the pool aggregate, alongside the existing engine snapshot."""
+        _sset, base = served_a
+        m = requests.get(base + "/metrics").json()
+        lc = m["a"]["lifecycle"]
+        for key in ("state", "loads_total", "evictions_total",
+                    "hbm_reserved_bytes", "inflight"):
+            assert key in lc, key
+        assert lc["state"] == READY and lc["loads_total"] == 1
+        assert lc["hbm_reserved_bytes"] > 0
+        pool = m["pool"]
+        assert pool["hbm_reserved_bytes"] >= lc["hbm_reserved_bytes"]
+        assert pool["evictions_total"] == 0
+
+
+class TestCachedPull:
+    def test_pull_model_is_blob_cache_warm_second_time(self, pushed, tmp_path):
+        import filecmp
+
+        from modelx_tpu.dl.blob_cache import BlobCache
+        from modelx_tpu.dl.initializer import pull_model
+
+        cache = BlobCache(str(tmp_path / "cache"))
+        s1 = pull_model(f"{pushed}/library/a@v1", str(tmp_path / "d1"),
+                        cache=cache)
+        assert s1["cache_hits"] == 0 and s1["cache_admitted"] >= 1
+        s2 = pull_model(f"{pushed}/library/a@v1", str(tmp_path / "d2"),
+                        cache=cache)
+        assert s2["cache_hits"] >= 1
+        assert filecmp.cmp(
+            str(tmp_path / "d1" / "model.safetensors"),
+            str(tmp_path / "d2" / "model.safetensors"),
+            shallow=False,
+        )
+
+    @pytest.mark.slow
+    def test_pool_load_uses_injected_cache(self, pushed, model_dirs, tmp_path):
+        from modelx_tpu.dl.blob_cache import BlobCache
+
+        cache = BlobCache(str(tmp_path / "cache"))
+        sset = ServerSet(
+            {"a": make_server(model_dirs["a"])}, allow_admin_load=True,
+            staging_root=str(tmp_path / "staging"),
+        )
+        sset.pool.blob_cache = cache
+        sset.load_all()
+        snap = sset.pool.request_load(
+            "b", ref=f"{pushed}/library/b@v1", wait=True
+        )
+        assert snap["b"]["state"] == READY
+        assert cache.stats["admitted"] >= 1  # the pull teed into the cache
