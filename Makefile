@@ -5,22 +5,32 @@ PY ?= python
 IMAGE ?= modelx-tpu
 TAG ?= $(shell git describe --tags --always 2>/dev/null || echo dev)
 
-.PHONY: all native test chaos lifecycle lint wheel image image-dl compose-up compose-down clean
+.PHONY: all native test chaos slow lifecycle lint wheel image image-dl compose-up compose-down clean
 
-all: native test wheel
+all: native lint test wheel
 
 # native IO engine (ranged HTTP fetch / scatter pread / sha256); auto-built
 # on first use too — this target just prebuilds it
 native:
 	$(PY) -c "from modelx_tpu import native; print(native.build(force=True))"
 
-test:
+# the lint gate runs before tests: a concurrency-rule violation fails the
+# build even when every test happens to pass
+test: lint
 	$(PY) -m pytest tests/ -q
 
 # every deterministic fault sweep in one command: the seeded engine-crash
-# schedules (PR 3) plus the registry torn-write/scrub/GC-race drills
+# schedules (PR 3) plus the registry torn-write/scrub/GC-race drills —
+# run under runtime lockdep (analysis/lockdep.py): the sweeps double as
+# lock-order validation, and an observed order cycle fails the run
 chaos:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
+	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
+
+# the heavy compiled-exactness/soak set trimmed out of tier-1 for the
+# 870 s wall-time budget (ISSUE 6 profiled the tail): every slow-marked
+# test keeps its home here — run before perf- or kernel-touching merges
+slow:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m slow
 
 # model lifecycle drills (ISSUE 5): runtime load/drain/unload/evict,
 # HBM-budget refusal, degraded multi-tenant boot, the bench swap leg —
@@ -31,7 +41,11 @@ lifecycle:
 		"tests/test_bench_smoke.py::TestSwapLeg" -q
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
 
+# two layers: the project-native concurrency/purity gate (always — it is
+# stdlib-only and baseline-governed, see docs/analysis.md), then generic
+# style via ruff when available
 lint:
+	$(PY) -m modelx_tpu.analysis
 	@if $(PY) -m ruff --version >/dev/null 2>&1; then \
 		$(PY) -m ruff check modelx_tpu tests bench.py; \
 	else \

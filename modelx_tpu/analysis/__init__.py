@@ -1,0 +1,30 @@
+"""Project-native static analysis + runtime lock-order checking.
+
+Five PRs of threaded serving work (engine supervisor, lifecycle pool,
+crash-safe GC, blob-cache LRU) rest on invariants that were, until now,
+prose: "heavy teardown runs outside the pool lock", "every handler error
+is typed", "acquire is always pinned by try/finally". This package turns
+those rules into machine checks so the GSPMD-mesh refactor (ROADMAP top
+item) cannot silently reintroduce the hazards we already paid to remove.
+
+Two halves:
+
+- **AST lint** (`lint.py` + `rules/`): ``python -m modelx_tpu.analysis``
+  walks the tree and enforces six rules written against this codebase's
+  real hazards (blocking-under-lock, lock-leak, untyped-handler-error,
+  bare-thread, swallowed-exception, jax-impurity). Findings carry
+  ``file:line``, a rule id, and a fix hint; ``baseline.toml`` suppresses
+  individually vetted sites (justification required) so the gate starts
+  green and only NEW violations fail CI.
+
+- **Runtime lockdep** (`lockdep.py` + `pytest_lockdep.py`): a TSan-lite
+  instrumented Lock/RLock (env-gated ``MODELX_LOCKDEP=1``, zero overhead
+  when off) that records per-thread acquisition order into a global
+  lock-order graph, reports cycles (potential deadlocks) and
+  over-threshold holds with both stacks, and rides the chaos/lifecycle
+  pytest drills as a plugin.
+
+See docs/analysis.md for the rule catalog and workflow.
+"""
+
+from modelx_tpu.analysis.lint import Finding, analyze_paths, main  # noqa: F401

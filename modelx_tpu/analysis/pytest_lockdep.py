@@ -1,0 +1,43 @@
+"""pytest plugin: run the suite under lockdep when ``MODELX_LOCKDEP=1``.
+
+Registered from tests/conftest.py (``pytest_plugins``), so the chaos and
+lifecycle drills — the tests that actually exercise cross-thread lock
+nesting under churn — double as lock-order validation runs:
+
+    MODELX_LOCKDEP=1 python -m pytest tests/ -q -m chaos
+
+When the env var is unset the plugin does nothing (no patching, zero
+overhead). When set, ``threading.Lock``/``RLock`` are instrumented at
+configure time (before test modules import), a summary is printed at the
+end, and any lock-order CYCLE fails the session — a potential deadlock
+observed in a real interleaving is a bug even if this run got lucky.
+Over-threshold holds are reported but do not fail (they are load- and
+machine-dependent; the lint + drills decide what to chase).
+"""
+
+from __future__ import annotations
+
+from modelx_tpu.analysis import lockdep
+
+
+def pytest_configure(config) -> None:
+    if lockdep.enabled():
+        graph = lockdep.install_from_env()
+        config._modelx_lockdep_graph = graph
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config) -> None:
+    graph = getattr(config, "_modelx_lockdep_graph", None)
+    if graph is None:
+        return
+    terminalreporter.section("modelx lockdep")
+    terminalreporter.write_line(graph.render_report())
+
+
+def pytest_sessionfinish(session, exitstatus) -> None:
+    graph = getattr(session.config, "_modelx_lockdep_graph", None)
+    if graph is None:
+        return
+    if graph.cycles and exitstatus == 0:
+        # a lock-order cycle is a deadlock that hasn't happened yet
+        session.exitstatus = 1

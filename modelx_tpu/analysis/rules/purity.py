@@ -1,0 +1,104 @@
+"""jax-impurity: wall-clock / RNG calls inside jitted program builders.
+
+A ``time.time()`` or ``random.random()`` inside a function handed to
+``jax.jit`` doesn't do what it reads like: it executes ONCE at trace
+time, and the traced constant is baked into the compiled program forever
+(every later dispatch replays the same "timestamp"/"random" value). The
+repo's decode/admit/piece programs (models/decode.py, dl/continuous.py)
+are rebuilt rarely and dispatched millions of times, so a frozen impurity
+is both a correctness bug and invisible in small tests.
+
+Detection is project-shaped: the codebase jits named functions
+(``jax.jit(self._prefill_impl, donate_argnums=...)``) or decorates them,
+so the rule collects every name that flows into ``jax.jit``/``jit`` in a
+module and scans those function bodies — including nested defs, which
+also run at trace time — for ``time.*`` clock reads, stdlib/numpy
+``random``, and ``datetime`` now/utcnow. ``jax.random.*`` is explicitly
+fine: it is the pure, key-threaded API these calls should become.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from modelx_tpu.analysis.rules import dotted_name, register
+
+_RULE = "jax-impurity"
+
+_IMPURE_EXACT = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "time.monotonic_ns",
+    "datetime.now", "datetime.utcnow", "datetime.today",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "uuid.uuid4",
+}
+_IMPURE_RANDOM_BASES = {"random", "np.random", "numpy.random"}
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+def _jitted_function_names(tree: ast.Module) -> set[str]:
+    """Bare names of functions that flow into jax.jit in this module:
+    ``jax.jit(fn, ...)``, ``jax.jit(self._impl, ...)``, ``@jax.jit``,
+    ``@partial(jax.jit, ...)``."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and dotted_name(node.func) in _JIT_NAMES:
+            if node.args:
+                target = node.args[0]
+                tail = dotted_name(target).rsplit(".", 1)[-1]
+                if tail:
+                    names.add(tail)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                d = dotted_name(dec)
+                if d in _JIT_NAMES:
+                    names.add(node.name)
+                elif (isinstance(dec, ast.Call)
+                      and dotted_name(dec.func) in _JIT_NAMES):
+                    names.add(node.name)
+                elif (isinstance(dec, ast.Call)
+                      and dotted_name(dec.func).endswith("partial")
+                      and dec.args and dotted_name(dec.args[0]) in _JIT_NAMES):
+                    names.add(node.name)
+    return names
+
+
+def _impure_call(call: ast.Call) -> str | None:
+    name = dotted_name(call.func)
+    if name in _IMPURE_EXACT:
+        return name
+    if isinstance(call.func, ast.Attribute):
+        base = dotted_name(call.func.value)
+        if base in _IMPURE_RANDOM_BASES:
+            return name
+    return None
+
+
+@register(_RULE, "wall-clock/RNG calls inside jitted program builders "
+                 "(frozen at trace time)")
+def jax_impurity(ctx):
+    jitted = _jitted_function_names(ctx.tree)
+    if not jitted:
+        return []
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name not in jitted:
+            continue
+        for inner in ast.walk(node):
+            if not isinstance(inner, ast.Call):
+                continue
+            matched = _impure_call(inner)
+            if matched is None:
+                continue
+            findings.append(ctx.finding(
+                _RULE, inner,
+                f"{matched}() inside jitted builder {node.name!r} executes "
+                "once at trace time and is baked into the compiled program",
+                hint="pass the value in as an argument (timestamps) or "
+                     "thread a jax.random key (randomness); the traced "
+                     "constant silently replays on every dispatch",
+            ))
+    return findings

@@ -181,9 +181,17 @@ class BlobCache:
                 os.unlink(tmp_path)
                 return None
             final = self.entry_path(digest)
+            # plan + perform eviction and the final rename OUTSIDE the
+            # lock (lint: blocking-under-lock): the replace is atomic at
+            # the FS level and entries are content-addressed, so a racing
+            # admit of the same digest lands identical bytes; two racing
+            # admits of different digests can transiently overshoot the
+            # cap by one blob until the next admit's sweep — the cap is a
+            # budget, not an invariant. The lock now guards only stats.
+            evicted = self._evict_for(size, keep=final)
+            os.replace(tmp_path, final)
             with self._lock:
-                self._evict_for(size, keep=final)
-                os.replace(tmp_path, final)
+                self.stats["evicted"] += evicted
                 self.stats["admitted"] += 1
             return final
         except OSError:
@@ -208,11 +216,13 @@ class BlobCache:
         except OSError:
             return []
 
-    def _evict_for(self, incoming: int, keep: str = "") -> None:
-        """LRU-evict (oldest mtime first) until incoming fits under the cap.
-        Caller holds the lock."""
+    def _evict_for(self, incoming: int, keep: str = "") -> int:
+        """LRU-evict (oldest mtime first) until incoming fits under the
+        cap; returns the eviction count. Runs WITHOUT the lock — unlink
+        is idempotent under races and the stats update happens in the
+        caller's locked section."""
         if not self.max_bytes:
-            return
+            return 0
         entries = []
         for name in self._entries():
             path = os.path.join(self.root, name)
@@ -225,6 +235,7 @@ class BlobCache:
             entries.append((st.st_mtime, st.st_size, path))
         entries.sort()
         total = sum(size for _m, size, _p in entries)
+        evicted = 0
         while entries and total + incoming > self.max_bytes:
             _mtime, size, path = entries.pop(0)
             try:
@@ -232,7 +243,8 @@ class BlobCache:
             except OSError:
                 continue
             total -= size
-            self.stats["evicted"] += 1
+            evicted += 1
+        return evicted
 
 
 class CachingByteSource:

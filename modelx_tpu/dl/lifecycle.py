@@ -481,13 +481,20 @@ class ModelPool:
 
                 with trace.span("lifecycle.pull", model=name, ref=e.ref):
                     pull_model(e.ref, dest, cache=self.blob_cache, quiet=True)
+                stale = False
                 with self._lock:
                     if e.state != PULLING:  # raced an unload/retry
-                        shutil.rmtree(dest, ignore_errors=True)
-                        return
-                    e.model_dir = dest
-                    e._staged = True
-                    e.to(LOADING)
+                        stale = True
+                    else:
+                        e.model_dir = dest
+                        e._staged = True
+                        e.to(LOADING)
+                if stale:
+                    # the multi-GB staging rmtree runs OUTSIDE the pool
+                    # lock (lint: blocking-under-lock) — other tenants'
+                    # admission must not stall behind this cleanup
+                    shutil.rmtree(dest, ignore_errors=True)
+                    return
             from modelx_tpu.dl.serve import ModelServer
 
             kwargs = dict(self.sset.server_defaults)
@@ -509,11 +516,15 @@ class ModelPool:
                         e.ref or e.model_dir)
         except BaseException as exc:  # FAILED is a state, not a crash
             logger.warning("runtime load of %s failed: %s", name, exc)
+            staged = ""
             with self._lock:
                 if e._staged and e.model_dir:
-                    shutil.rmtree(e.model_dir, ignore_errors=True)
+                    staged = e.model_dir
                     e.model_dir = ""
                     e._staged = False
+            if staged:
+                # rmtree outside the pool lock, as everywhere else
+                shutil.rmtree(staged, ignore_errors=True)
             self.mark_failed(name, str(exc))
 
     # -- admin: unload / evict ------------------------------------------------

@@ -753,17 +753,22 @@ def load_safetensors(
         successful attempt — backoff sleeps and failed attempts' I/O are a
         retry story, not a width story, and must not read as a collapse
         that permanently sheds fetch parallelism."""
-        governor.acquire()
-        clock.enter("fetch")
         sample = [0, 0.0]
 
         def timer(n: int, secs: float) -> None:
             sample[0], sample[1] = n, secs
 
+        # acquire is pinned by the try/finally IMMEDIATELY (lint:
+        # lock-leak): clock.enter used to sit between acquire and try, so
+        # an exception there would have leaked a governor slot forever
+        governor.acquire()
         try:
-            return _read_with_retry(source, offset, length, out, timer=timer)
+            clock.enter("fetch")
+            try:
+                return _read_with_retry(source, offset, length, out, timer=timer)
+            finally:
+                clock.exit("fetch")
         finally:
-            clock.exit("fetch")
             governor.release(sample[0], sample[1])
 
     # per-blob multi-connection fetch: huge reads split into subranges run

@@ -39,6 +39,16 @@ class OIDCVerifier:
     MIN_REFRESH_INTERVAL_S = 30.0
 
     def __init__(self, issuer: str, jwks_uri: str = "", leeway_s: int = 30) -> None:
+        # cryptography is the optional [auth] extra: fail FAST at
+        # construction (registry boot) with an actionable message, not
+        # per-request inside the signature check with a raw import error
+        try:
+            import cryptography  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "OIDC verification requires the 'cryptography' package; "
+                "install the [auth] extra (pip install 'modelx-tpu[auth]')"
+            ) from e
         self.issuer = issuer.rstrip("/")
         self._jwks_uri = jwks_uri
         self._keys: dict[str, Any] = {}

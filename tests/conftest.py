@@ -18,3 +18,7 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# lockdep rides every run as a plugin but only instruments when
+# MODELX_LOCKDEP=1 (make chaos) — see modelx_tpu/analysis/lockdep.py
+pytest_plugins = ["modelx_tpu.analysis.pytest_lockdep"]

@@ -1,0 +1,550 @@
+"""Tests for the AST lint half of modelx_tpu.analysis.
+
+Each rule gets a seeded violation (the analyzer must flag it and exit
+non-zero) and a negative control (the repo's accepted idiom must stay
+quiet). The last class asserts the repo-wide gate itself: the checked-in
+tree + baseline must be green, or CI is already red.
+"""
+
+import ast
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from modelx_tpu.analysis import lint
+from modelx_tpu.analysis.lint import (
+    BaselineError,
+    Finding,
+    Suppression,
+    _parse_baseline_toml,
+    analyze_paths,
+    apply_baseline,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_lint(tmp_path, source: str, filename: str = "mod.py"):
+    """Lint one synthetic module; returns the findings."""
+    p = tmp_path / filename
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    findings, errors = analyze_paths([str(p)], root=str(tmp_path))
+    assert not errors, errors
+    return findings
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+class TestBlockingUnderLock:
+    def test_sleep_under_with_lock(self, tmp_path):
+        findings = run_lint(tmp_path, """
+            import threading, time
+            lock = threading.Lock()
+            def f():
+                with lock:
+                    time.sleep(1)
+        """)
+        assert rules_of(findings) == ["blocking-under-lock"]
+        assert findings[0].line == 6
+
+    def test_file_io_and_rmtree_under_lock(self, tmp_path):
+        findings = run_lint(tmp_path, """
+            import threading, shutil, os
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def f(self, d):
+                    with self._lock:
+                        shutil.rmtree(d)
+                        os.replace("a", "b")
+        """)
+        assert len(findings) == 2
+        assert rules_of(findings) == ["blocking-under-lock"]
+
+    def test_future_result_and_device_put_under_lock(self, tmp_path):
+        findings = run_lint(tmp_path, """
+            import threading, jax
+            def f(fut, x):
+                mu = threading.Lock()
+                with mu:
+                    fut.result()
+                    jax.device_put(x)
+        """)
+        assert len(findings) == 2
+
+    def test_lock_known_by_assignment_not_name(self, tmp_path):
+        # `self._profiling = threading.Lock()` — the name alone says
+        # nothing, the factory assignment marks it
+        findings = run_lint(tmp_path, """
+            import threading, time
+            class C:
+                def __init__(self):
+                    self._profiling = threading.Lock()
+                def f(self):
+                    with self._profiling:
+                        time.sleep(0.1)
+        """)
+        assert rules_of(findings) == ["blocking-under-lock"]
+
+    def test_provider_seam_under_lock(self, tmp_path):
+        findings = run_lint(tmp_path, """
+            import threading
+            class Store:
+                def __init__(self, fs):
+                    self.fs = fs
+                    self._lock = threading.Lock()
+                def refresh(self, data):
+                    with self._lock:
+                        self.fs.put("index.json", data)
+        """)
+        assert rules_of(findings) == ["blocking-under-lock"]
+
+    def test_nested_def_under_lock_is_exempt(self, tmp_path):
+        # a function DEFINED under the lock runs later, not under it
+        findings = run_lint(tmp_path, """
+            import threading, time
+            def f():
+                lock = threading.Lock()
+                with lock:
+                    def later():
+                        time.sleep(1)
+                    cb = later
+                return cb
+        """)
+        assert findings == []
+
+    def test_condition_wait_is_exempt(self, tmp_path):
+        # Condition.wait releases the lock while waiting — the repo's
+        # drain pattern (ModelPool._drain) must stay legal
+        findings = run_lint(tmp_path, """
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                    self._idle = threading.Condition(self._lock)
+                def drain(self):
+                    with self._lock:
+                        self._idle.wait(timeout=0.5)
+        """)
+        assert findings == []
+
+    def test_work_after_release_is_clean(self, tmp_path):
+        # the collect-under-lock / perform-after pattern the hint teaches
+        findings = run_lint(tmp_path, """
+            import threading, shutil
+            def f(entries):
+                lock = threading.Lock()
+                with lock:
+                    victims = list(entries)
+                for v in victims:
+                    shutil.rmtree(v)
+        """)
+        assert findings == []
+
+
+class TestLockLeak:
+    def test_unpinned_acquire(self, tmp_path):
+        findings = run_lint(tmp_path, """
+            import threading
+            lock = threading.Lock()
+            def f():
+                lock.acquire()
+                do_work()
+                lock.release()
+        """)
+        assert rules_of(findings) == ["lock-leak"]
+
+    def test_acquire_pinned_by_try_finally(self, tmp_path):
+        findings = run_lint(tmp_path, """
+            import threading
+            lock = threading.Lock()
+            def f():
+                lock.acquire()
+                try:
+                    do_work()
+                finally:
+                    lock.release()
+        """)
+        assert findings == []
+
+    def test_acquire_inside_try_whose_finally_releases(self, tmp_path):
+        findings = run_lint(tmp_path, """
+            import threading
+            lock = threading.Lock()
+            def f():
+                try:
+                    lock.acquire()
+                    do_work()
+                finally:
+                    lock.release()
+        """)
+        assert findings == []
+
+    def test_nonblocking_acquire_with_clean_body_not_flagged(self, tmp_path):
+        # the conditional-probe shape is a held region for
+        # blocking-under-lock, but a clean body raises nothing; lock-leak
+        # targets only the statement shape (the probe's failure branch
+        # never holds the lock)
+        findings = run_lint(tmp_path, """
+            import threading
+            lock = threading.Lock()
+            def f():
+                if not lock.acquire(blocking=False):
+                    return None
+                try:
+                    return do_work()
+                finally:
+                    lock.release()
+        """)
+        assert findings == []
+
+    def test_conditional_acquire_blocking_body_flagged(self, tmp_path):
+        # the /admin/profile shape: non-blocking probe, then a sleep inside
+        # the pinned try — held-region detection must cover it
+        findings = run_lint(tmp_path, """
+            import threading, time
+            lock = threading.Lock()
+            def f(seconds):
+                if not lock.acquire(blocking=False):
+                    return None
+                try:
+                    time.sleep(seconds)
+                finally:
+                    lock.release()
+        """)
+        assert rules_of(findings) == ["blocking-under-lock"]
+
+
+class TestUntypedHandlerError:
+    def _handler_mod(self, raise_stmt: str, extra: str = "") -> str:
+        return f"""
+            from http.server import BaseHTTPRequestHandler
+            class Handler(BaseHTTPRequestHandler):
+                def do_POST(self):
+                    {extra}
+                    {raise_stmt}
+        """
+
+    def test_untyped_raise_in_handler(self, tmp_path):
+        findings = run_lint(
+            tmp_path, self._handler_mod('raise RuntimeError("boom")'),
+            filename="modelx_tpu/dl/serve.py")
+        assert rules_of(findings) == ["untyped-handler-error"]
+
+    def test_typed_raise_is_clean(self, tmp_path):
+        findings = run_lint(
+            tmp_path, """
+            from http.server import BaseHTTPRequestHandler
+            from modelx_tpu.dl.serving_errors import QueueFullError
+            class Handler(BaseHTTPRequestHandler):
+                def do_POST(self):
+                    raise QueueFullError(9, 8)
+            """, filename="modelx_tpu/dl/serve.py")
+        assert findings == []
+
+    def test_registry_factory_raise_is_clean(self, tmp_path):
+        findings = run_lint(
+            tmp_path, """
+            from http.server import BaseHTTPRequestHandler
+            from modelx_tpu import errors
+            class Handler(BaseHTTPRequestHandler):
+                def do_GET(self):
+                    raise errors.blob_unknown("sha256:00")
+            """, filename="modelx_tpu/registry/server.py")
+        assert findings == []
+
+    def test_caught_and_mapped_raise_is_clean(self, tmp_path):
+        # raise ValueError inside try / except ValueError -> 400 mapping
+        findings = run_lint(
+            tmp_path, """
+            from http.server import BaseHTTPRequestHandler
+            class Handler(BaseHTTPRequestHandler):
+                def do_POST(self):
+                    try:
+                        raise ValueError("bad field")
+                    except ValueError as e:
+                        self.send_error(400, str(e))
+            """, filename="modelx_tpu/dl/serve.py")
+        assert findings == []
+
+    def test_blanket_exception_backstop_does_not_type(self, tmp_path):
+        findings = run_lint(
+            tmp_path, """
+            from http.server import BaseHTTPRequestHandler
+            class Handler(BaseHTTPRequestHandler):
+                def do_POST(self):
+                    try:
+                        raise KeyError("x")
+                    except Exception:
+                        self.send_error(500)
+            """, filename="modelx_tpu/dl/serve.py")
+        assert rules_of(findings) == ["untyped-handler-error"]
+
+    def test_non_handler_module_out_of_scope(self, tmp_path):
+        findings = run_lint(
+            tmp_path, self._handler_mod('raise RuntimeError("boom")'),
+            filename="modelx_tpu/dl/other.py")
+        assert findings == []
+
+
+class TestBareThread:
+    def test_thread_without_daemon_or_join(self, tmp_path):
+        findings = run_lint(tmp_path, """
+            import threading
+            def f():
+                threading.Thread(target=print).start()
+        """)
+        assert rules_of(findings) == ["bare-thread"]
+
+    def test_daemon_thread_is_clean(self, tmp_path):
+        findings = run_lint(tmp_path, """
+            import threading
+            def f():
+                threading.Thread(target=print, daemon=True).start()
+        """)
+        assert findings == []
+
+    def test_joined_thread_is_clean(self, tmp_path):
+        findings = run_lint(tmp_path, """
+            import threading
+            def f():
+                t = threading.Thread(target=print)
+                t.start()
+                t.join()
+        """)
+        assert findings == []
+
+
+class TestSwallowedException:
+    def test_bare_except_pass(self, tmp_path):
+        findings = run_lint(tmp_path, """
+            def f():
+                try:
+                    work()
+                except:
+                    pass
+        """)
+        assert rules_of(findings) == ["swallowed-exception"]
+
+    def test_broad_silent_except_on_server_path(self, tmp_path):
+        findings = run_lint(tmp_path, """
+            def f():
+                try:
+                    work()
+                except Exception:
+                    pass
+        """, filename="modelx_tpu/dl/serve.py")
+        assert rules_of(findings) == ["swallowed-exception"]
+
+    def test_narrow_typed_cleanup_is_legal(self, tmp_path):
+        # `except OSError: pass` around best-effort unlink is the idiom
+        findings = run_lint(tmp_path, """
+            import os
+            def f(p):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+        """, filename="modelx_tpu/dl/serve.py")
+        assert findings == []
+
+    def test_broad_except_off_server_path_is_legal(self, tmp_path):
+        findings = run_lint(tmp_path, """
+            def f():
+                try:
+                    work()
+                except Exception:
+                    pass
+        """, filename="modelx_tpu/models/llama.py")
+        assert findings == []
+
+
+class TestJaxImpurity:
+    def test_time_in_jitted_builder(self, tmp_path):
+        findings = run_lint(tmp_path, """
+            import jax, time
+            def _step_impl(x):
+                t0 = time.time()
+                return x + t0
+            step = jax.jit(_step_impl)
+        """)
+        assert rules_of(findings) == ["jax-impurity"]
+
+    def test_stdlib_random_in_decorated_jit(self, tmp_path):
+        findings = run_lint(tmp_path, """
+            import jax, random
+            @jax.jit
+            def step(x):
+                return x * random.random()
+        """)
+        assert rules_of(findings) == ["jax-impurity"]
+
+    def test_jax_random_is_pure_and_legal(self, tmp_path):
+        findings = run_lint(tmp_path, """
+            import jax
+            def _sample_impl(key, logits):
+                return jax.random.categorical(key, logits)
+            sample = jax.jit(_sample_impl)
+        """)
+        assert findings == []
+
+    def test_time_outside_jit_is_legal(self, tmp_path):
+        findings = run_lint(tmp_path, """
+            import jax, time
+            def _step_impl(x):
+                return x + 1
+            step = jax.jit(_step_impl)
+            def dispatch(x):
+                t0 = time.time()
+                return step(x), time.time() - t0
+        """)
+        assert findings == []
+
+    def test_method_impl_jitted_from_init(self, tmp_path):
+        # the repo's shape: self._chunk = jax.jit(self._chunk_impl, ...)
+        findings = run_lint(tmp_path, """
+            import jax, time
+            class Decoder:
+                def __init__(self):
+                    self._chunk = jax.jit(self._chunk_impl, donate_argnums=(1,))
+                def _chunk_impl(self, cache):
+                    time.monotonic()
+                    return cache
+        """)
+        assert rules_of(findings) == ["jax-impurity"]
+
+
+class TestBaseline:
+    def _finding(self, **kw):
+        base = dict(rule="blocking-under-lock", file="modelx_tpu/dl/x.py",
+                    line=10, col=4, message="m", scope="C.f")
+        base.update(kw)
+        return Finding(**base)
+
+    def test_scope_match_suppresses(self):
+        sups = [Suppression(rule="blocking-under-lock",
+                            file="modelx_tpu/dl/x.py", scope="C.f", reason="ok")]
+        new, suppressed = apply_baseline([self._finding()], sups)
+        assert new == [] and len(suppressed) == 1
+        assert sups[0].used == 1
+
+    def test_scope_mismatch_does_not_suppress(self):
+        sups = [Suppression(rule="blocking-under-lock",
+                            file="modelx_tpu/dl/x.py", scope="C.g", reason="ok")]
+        new, _ = apply_baseline([self._finding()], sups)
+        assert len(new) == 1
+
+    def test_file_wide_suppression(self):
+        sups = [Suppression(rule="blocking-under-lock",
+                            file="modelx_tpu/dl/x.py", reason="ok")]
+        new, suppressed = apply_baseline(
+            [self._finding(), self._finding(scope="D.g", line=99)], sups)
+        assert new == [] and len(suppressed) == 2
+
+    def test_parse_roundtrip(self):
+        sups = _parse_baseline_toml(textwrap.dedent("""
+            # comment
+            [[suppression]]
+            rule = "lock-leak"
+            file = "modelx_tpu/dl/loader.py"
+            scope = "load_safetensors._gated_read"
+            reason = "vetted: release happens in the governor"
+        """), "test.toml")
+        assert len(sups) == 1
+        assert sups[0].rule == "lock-leak"
+        assert sups[0].scope == "load_safetensors._gated_read"
+
+    def test_missing_reason_rejected(self):
+        with pytest.raises(BaselineError):
+            _parse_baseline_toml(textwrap.dedent("""
+                [[suppression]]
+                rule = "lock-leak"
+                file = "x.py"
+            """), "test.toml")
+
+    def test_empty_reason_rejected(self):
+        with pytest.raises(BaselineError):
+            _parse_baseline_toml(textwrap.dedent("""
+                [[suppression]]
+                rule = "lock-leak"
+                file = "x.py"
+                reason = ""
+            """), "test.toml")
+
+    def test_checked_in_baseline_parses_with_reasons(self):
+        sups = lint.load_baseline(lint.default_baseline_path())
+        assert sups, "checked-in baseline should not be empty"
+        for s in sups:
+            assert s.reason.strip()
+
+
+class TestGate:
+    """The CI contract: the repo is green, a seeded violation is red."""
+
+    def test_repo_gate_is_green(self):
+        rc = lint.main(["--root", REPO_ROOT, "-q"])
+        assert rc == 0
+
+    def test_seeded_violation_fails_nonzero(self, tmp_path):
+        bad = tmp_path / "seeded.py"
+        bad.write_text(textwrap.dedent("""
+            import threading, time
+            lock = threading.Lock()
+            def f():
+                with lock:
+                    time.sleep(5)
+        """))
+        proc = subprocess.run(
+            [sys.executable, "-m", "modelx_tpu.analysis",
+             "--root", str(tmp_path), str(bad)],
+            capture_output=True, text=True, cwd=REPO_ROOT,
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "blocking-under-lock" in proc.stdout
+        assert "seeded.py:5" in proc.stdout or "seeded.py:6" in proc.stdout
+
+    def test_malformed_baseline_exits_2(self, tmp_path):
+        bad_baseline = tmp_path / "baseline.toml"
+        bad_baseline.write_text('[[suppression]]\nrule = "lock-leak"\n')
+        clean = tmp_path / "ok.py"
+        clean.write_text("x = 1\n")
+        rc = lint.main(["--root", str(tmp_path), "--baseline",
+                        str(bad_baseline), str(clean)])
+        assert rc == 2
+
+    def test_list_rules_names_all_six(self, capsys):
+        rc = lint.main(["--list-rules"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        for rid in ("blocking-under-lock", "lock-leak", "untyped-handler-error",
+                    "bare-thread", "swallowed-exception", "jax-impurity"):
+            assert rid in out
+
+
+class TestRepoConventions:
+    """Meta-checks that keep the gate honest as the tree grows."""
+
+    def test_all_rules_have_ids_and_docs(self):
+        from modelx_tpu.analysis.rules import all_rules
+
+        rules = all_rules()
+        assert len(rules) == 6
+        for r in rules:
+            assert r.rule_id and r.rule_doc
+
+    def test_every_python_file_parses(self):
+        # the analyzer silently skipping a syntactically-broken file would
+        # hollow the gate out; assert the walk covers a sane file count
+        findings, errors = analyze_paths(["modelx_tpu"], root=REPO_ROOT)
+        assert errors == []
+        files = {f for f, _ in
+                 ((f.file, f.line) for f in findings)} if findings else set()
+        # the walk itself: at least the package's file count
+        n = sum(1 for _ in lint.iter_python_files(["modelx_tpu"], REPO_ROOT))
+        assert n > 60

@@ -9,6 +9,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import pytest
 import requests
 
+# cryptography is the optional `auth` extra (pyproject): the OIDC verifier
+# lazy-imports it per-call, and environments without it must skip these
+# tests at collection instead of erroring the tier-1 run
+pytest.importorskip("cryptography", reason="install the [auth] extra for OIDC tests")
+
 from modelx_tpu import errors
 from modelx_tpu.registry.auth import OIDCVerifier
 from modelx_tpu.registry.fs import MemoryFSProvider

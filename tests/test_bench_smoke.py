@@ -78,6 +78,9 @@ class TestBenchSmoke:
         assert "model.layers.0.self_attn.q_proj.weight" in arrays
         jax.block_until_ready(arrays)
 
+    # ~11 s; the subprocess roundtrip + schema tests catch bench drift in
+    # tier-1, the full engine drive rides the slow set
+    @pytest.mark.slow
     def test_measure_continuous_signature(self):
         """measure_continuous drives the engine through the same shim the
         bench uses — catches ContinuousBatcher API drift."""

@@ -74,6 +74,9 @@ class TestExactness:
         got = engine.generate(tokens, max_new_tokens=6)
         np.testing.assert_array_equal(got, expected)
 
+    # ~8 s concurrency soak; per-feature exactness (sampled/ragged/stream)
+    # stays tier-1
+    @pytest.mark.slow
     def test_concurrent_mixed_requests_match_solo(self, server, engine):
         """Requests of different lengths/budgets/sampling, submitted
         concurrently, each match their solo result exactly."""
@@ -419,6 +422,8 @@ class TestBatchedAdmission:
     """A burst of same-bucket arrivals admits as ONE compiled program
     (k round-trips -> 1 on a tunneled device) — token-exactly."""
 
+    # ~7 s; mixed-bucket/pow2/multirow admission tests stay tier-1
+    @pytest.mark.slow
     def test_burst_groups_and_matches(self, server):
         cb = ContinuousBatcher(server, max_slots=4, chunk_size=4)
         try:
@@ -576,6 +581,8 @@ class TestPipelineDepth:
     plans are value-independent, so depth only moves sync points."""
 
     @pytest.mark.parametrize("depth", [1, 3])
+    # ~11 s over both depths; default-depth exactness runs everywhere else
+    @pytest.mark.slow
     def test_depth_variants_match_plain(self, server, depth):
         cb = ContinuousBatcher(server, max_slots=4, chunk_size=4,
                                pipeline_depth=depth)
@@ -821,6 +828,8 @@ class TestChunkedPrefillPrefixCache:
         yield cb
         cb.close()
 
+    # ~8 s; prefix-cache+engine second-turn exactness keeps this covered
+    @pytest.mark.slow
     def test_hit_chunk_fills_only_the_suffix(self, server, cached_engine):
         cb = cached_engine
         pieces0, hits0 = cb.stats["prefill_pieces"], cb.prefix_cache.hits

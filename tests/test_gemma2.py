@@ -50,6 +50,9 @@ def _hf_tiny():
 
 
 class TestHFParity:
+    # ~9 s of compile: the sliding-window parity leg rides the slow set
+    # (tier-1 wall-time budget); basic HF parity + the serving e2e stay
+    @pytest.mark.slow
     def test_matches_huggingface_past_the_window(self, tmp_path):
         from modelx_tpu.dl.loader import LocalFileSource, load_safetensors
         from modelx_tpu.dl import safetensors as st
@@ -133,6 +136,8 @@ class TestDetectionInference:
 
 
 class TestDecode:
+    # heaviest single tier-1 test (~21 s of compiled-exactness); slow set
+    @pytest.mark.slow
     def test_kv_cache_decode_matches_full_forward(self):
         """Prefill + single-token cached steps must reproduce the full
         forward's last-position logits at every step — including steps past

@@ -252,6 +252,10 @@ class TestLoader:
 
 
 class TestLoaderFailure:
+    # ~11 s of retry backoff; chaos-marked so `make chaos` (which runs
+    # under lockdep) keeps exercising the deadlock-regression path
+    @pytest.mark.slow
+    @pytest.mark.chaos
     def test_fetch_error_propagates_without_deadlock(self, tmp_path):
         """A mid-load fetch failure must raise, not deadlock the fetch pool
         on the transfer backpressure semaphore (regression: permits leaked

@@ -1,0 +1,258 @@
+"""Runtime lockdep drills: a seeded two-thread lock inversion the graph
+MUST flag, a negative control proving ordered acquisition stays quiet,
+hold-threshold reporting, Condition/RLock protocol compatibility, and the
+install/uninstall env gate.
+
+The inversion drill schedules its interleaving with testing/faults.py
+latency injection (the same seeded scheduling the chaos drills use), and
+the two threads run strictly one-after-the-other — lockdep detects the
+ORDER cycle from the graph, no actual deadlock (or flaky timing) needed.
+"""
+
+import os
+import threading
+
+import pytest
+
+from modelx_tpu.analysis import lockdep
+from modelx_tpu.testing.faults import FaultPlan
+
+
+def _run(fn) -> threading.Thread:
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t
+
+
+class TestInversionDrill:
+    def test_seeded_lock_inversion_is_flagged(self):
+        """Thread 1 takes pool->servers, thread 2 takes servers->pool
+        (the exact shape a careless mesh refactor could introduce between
+        ModelPool._lock and ServerSet._servers_lock). The fault plan's
+        latency schedule paces each thread's critical section; lockdep
+        must report one cycle naming both sites, with both stacks."""
+        graph = lockdep.LockGraph(hold_threshold_ms=10.0)
+        pool_lock = lockdep.make_lock(graph, site="lifecycle.py:pool._lock")
+        servers_lock = lockdep.make_lock(graph, site="serve.py:sset._servers_lock")
+        plan = FaultPlan(seed=7)
+        plan.add("drill.hold", latency_at=[0, 1], latency_s=0.02)
+
+        def t1():
+            with pool_lock:
+                plan.maybe_fail("drill.hold")  # seeded pacing inside the lock
+                with servers_lock:
+                    pass
+
+        def t2():
+            with servers_lock:
+                plan.maybe_fail("drill.hold")
+                with pool_lock:
+                    pass
+
+        th = _run(t1)
+        th.join(timeout=5)
+        assert not th.is_alive()
+        th = _run(t2)  # runs strictly after t1: order cycle, no deadlock
+        th.join(timeout=5)
+        assert not th.is_alive()
+
+        cycles = graph.cycles
+        assert len(cycles) == 1
+        sites = set(cycles[0].path_sites)
+        assert sites == {"lifecycle.py:pool._lock", "serve.py:sset._servers_lock"}
+        report = graph.render_report()
+        assert "potential deadlock" in report
+        assert "earlier lock acquired at" in report
+        assert "cycle-closing acquire at" in report
+        # both scheduled holds (20ms latency under the outer lock) exceeded
+        # the 10ms threshold and carry both stacks
+        holds = graph.long_holds
+        assert {h.site for h in holds} == sites
+        assert all(h.acquire_stack and h.release_stack for h in holds)
+
+    def test_negative_control_ordered_acquisition_stays_quiet(self):
+        """Same locks, same pacing, but both threads honor pool->servers:
+        no cycle, no report — the drill's detection is the inversion, not
+        an artifact of nesting or latency."""
+        graph = lockdep.LockGraph(hold_threshold_ms=10_000.0)
+        pool_lock = lockdep.make_lock(graph, site="pool")
+        servers_lock = lockdep.make_lock(graph, site="servers")
+        plan = FaultPlan(seed=7)
+        plan.add("drill.hold", latency_at=[0, 1], latency_s=0.01)
+
+        def worker():
+            with pool_lock:
+                plan.maybe_fail("drill.hold")
+                with servers_lock:
+                    pass
+
+        threads = [_run(worker), _run(worker)]
+        for t in threads:
+            t.join(timeout=5)
+            assert not t.is_alive()
+        assert graph.cycles == []
+        assert graph.long_holds == []
+        assert "clean" in graph.render_report()
+
+    def test_three_lock_cycle(self):
+        # A->B, B->C, C->A: the cycle spans three sites, found on the
+        # closing edge
+        graph = lockdep.LockGraph()
+        a = lockdep.make_lock(graph, site="A")
+        b = lockdep.make_lock(graph, site="B")
+        c = lockdep.make_lock(graph, site="C")
+        for first, second in ((a, b), (b, c), (c, a)):
+            with first:
+                with second:
+                    pass
+        cycles = graph.cycles
+        assert len(cycles) == 1
+        assert set(cycles[0].path_sites) == {"A", "B", "C"}
+
+    def test_cycle_reported_once_per_site_set(self):
+        graph = lockdep.LockGraph()
+        a = lockdep.make_lock(graph, site="A")
+        b = lockdep.make_lock(graph, site="B")
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+            with b:
+                with a:
+                    pass
+        assert len(graph.cycles) == 1
+
+
+class TestLockSemantics:
+    def test_rlock_reentry_is_not_a_cycle(self):
+        graph = lockdep.LockGraph()
+        rl = lockdep.make_rlock(graph, site="R")
+        with rl:
+            with rl:  # reentrant: inner acquire must not self-edge
+                pass
+        assert graph.cycles == []
+
+    def test_same_site_different_instances_not_a_cycle(self):
+        # the _index_locks pattern: many locks born at one setdefault line
+        graph = lockdep.LockGraph()
+        repo_a = lockdep.make_lock(graph, site="store_fs.py:87")
+        repo_b = lockdep.make_lock(graph, site="store_fs.py:87")
+        with repo_a:
+            with repo_b:
+                pass
+        assert graph.cycles == []
+
+    def test_condition_with_instrumented_rlock(self):
+        """The ModelPool shape: Condition(RLock) with wait/notify across
+        threads. wait() fully releases (the graph must see that), and the
+        protocol methods (_release_save/_acquire_restore/_is_owned) must
+        keep Condition functional."""
+        graph = lockdep.LockGraph()
+        lock = lockdep.make_rlock(graph, site="pool")
+        cv = threading.Condition(lock)
+        ready = []
+
+        def waiter():
+            with cv:
+                while not ready:
+                    cv.wait(timeout=2)
+                ready.append("seen")
+
+        t = _run(waiter)
+        import time
+
+        time.sleep(0.05)
+        with cv:
+            ready.append("go")
+            cv.notify_all()
+        t.join(timeout=5)
+        assert not t.is_alive()
+        assert "seen" in ready
+        assert graph.cycles == []
+
+    def test_nonblocking_acquire_failure_records_nothing(self):
+        graph = lockdep.LockGraph()
+        lk = lockdep.make_lock(graph, site="L")
+        assert lk.acquire()
+        got = [None]
+
+        def contender():
+            got[0] = lk.acquire(blocking=False)
+
+        t = _run(contender)
+        t.join(timeout=5)
+        assert got[0] is False
+        lk.release()
+        assert graph.acquisitions == 1  # the failed acquire never counted
+
+    def test_hold_report_keeps_worst_duration(self):
+        import time
+
+        graph = lockdep.LockGraph(hold_threshold_ms=5.0)
+        lk = lockdep.make_lock(graph, site="L")
+        for wait in (0.01, 0.03, 0.02):
+            with lk:
+                time.sleep(wait)
+        holds = graph.long_holds
+        assert len(holds) == 1  # deduped per site, worst kept
+        assert holds[0].duration_s >= 0.03
+
+
+class TestInstall:
+    def test_env_gate(self, monkeypatch):
+        monkeypatch.delenv(lockdep.ENV_VAR, raising=False)
+        assert not lockdep.enabled()
+        assert lockdep.install_from_env() is None
+        monkeypatch.setenv(lockdep.ENV_VAR, "0")
+        assert not lockdep.enabled()
+        monkeypatch.setenv(lockdep.ENV_VAR, "1")
+        assert lockdep.enabled()
+
+    def test_install_instruments_new_locks_and_uninstall_restores(self):
+        if lockdep.global_graph() is not None and lockdep._saved is not None:
+            pytest.skip("lockdep already installed for this run (MODELX_LOCKDEP=1)")
+        real_lock_factory = threading.Lock
+        graph = lockdep.install()
+        try:
+            a = threading.Lock()
+            b = threading.RLock()
+            assert isinstance(a, lockdep.InstrumentedLock)
+            assert isinstance(b, lockdep.InstrumentedRLock)
+            with a:
+                with b:
+                    pass
+            assert graph.acquisitions >= 1
+            # queue.Queue built now uses instrumented internals and works
+            import queue
+
+            q = queue.Queue()
+            q.put(1)
+            assert q.get() == 1
+        finally:
+            lockdep.uninstall()
+        assert threading.Lock is real_lock_factory
+        # instrumented locks created during the window still function
+        with a:
+            pass
+
+    def test_install_is_idempotent(self):
+        if lockdep.global_graph() is not None and lockdep._saved is not None:
+            pytest.skip("lockdep already installed for this run (MODELX_LOCKDEP=1)")
+        g1 = lockdep.install()
+        try:
+            g2 = lockdep.install()
+            assert g1 is g2
+        finally:
+            lockdep.uninstall()
+            lockdep.uninstall()  # second uninstall is a no-op
+
+
+class TestPluginGate:
+    def test_plugin_registered_in_conftest(self):
+        # the chaos/lifecycle drills run under lockdep via this hook; if
+        # the registration line disappears, MODELX_LOCKDEP=1 silently
+        # stops instrumenting the suite
+        conftest = os.path.join(os.path.dirname(__file__), "conftest.py")
+        with open(conftest, encoding="utf-8") as f:
+            text = f.read()
+        assert "modelx_tpu.analysis.pytest_lockdep" in text

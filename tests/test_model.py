@@ -253,6 +253,8 @@ class TestRaggedDecode:
 
         return dataclasses.replace(llama.LlamaConfig.tiny(), dtype=jnp.float32)
 
+    # ~9 s; ragged exactness stays pinned by the sampling-independence test
+    @pytest.mark.slow
     def test_matches_unbatched_rows(self):
         cfg = self._f32_cfg()
         params = llama.init_params(cfg, jax.random.PRNGKey(3))
@@ -294,6 +296,8 @@ class TestRaggedDecode:
         )
         assert out.shape == (2, 0)
 
+    # ~7 s (mixtral compile); moe ops have their own tier-1 coverage
+    @pytest.mark.slow
     def test_mixtral_ragged_matches_unbatched(self):
         import dataclasses
 

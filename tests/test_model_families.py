@@ -57,6 +57,8 @@ class TestGPT2:
         got = np.asarray(gpt2.forward(params, jnp.asarray(tokens, jnp.int32), cfg)[0])
         np.testing.assert_allclose(got, want, atol=2e-4, rtol=2e-4)
 
+    # ~10 s compiled-exactness; HF parity + engine tests keep gpt2 covered
+    @pytest.mark.slow
     def test_kv_cache_decode_matches_full_forward(self):
         """Cached decode (prefill + per-token steps) must equal argmax over
         repeated full forwards — the llama/mixtral decode contract, now on

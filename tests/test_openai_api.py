@@ -75,6 +75,8 @@ class TestCompletions:
         assert body["usage"]["prompt_tokens"] == 4
         assert body["usage"]["completion_tokens"] == 4
 
+    # ~6 s; single-prompt + n>1 paths keep the veneer covered in tier-1
+    @pytest.mark.slow
     def test_batch_prompts_through_dynamic_batcher(self, front):
         """List prompts coalesce into one ragged decode via the batcher and
         match the unbatched engine's rows exactly."""

@@ -76,6 +76,9 @@ class TestPagedExactness:
         yield cb
         cb.close()
 
+    # ~20 s across both attention modes; greedy/sampled paged exactness
+    # and TestPagedChunkedPrefill keep the coverage in tier-1
+    @pytest.mark.slow
     def test_long_prompt_chunk_prefills_and_matches(self, server, engine):
         """Chunked prefill on the paged engine (both attention modes):
         pieces land into the slot's pages at the running offset — pieces
@@ -149,6 +152,8 @@ class TestPagedExactness:
 
 
 class TestPagedPool:
+    # ~14 s (32-slot soak); pages_recycled + FIFO-wait keep pool coverage
+    @pytest.mark.slow
     def test_32_slots_without_dense_alloc(self, gpt2_server):
         """32 slots on the gpt2 model with a pool an eighth the dense size:
         per-layer state must NOT be a [32, max_len] allocation."""

@@ -4,6 +4,7 @@ train step must be differentiable end-to-end."""
 
 import dataclasses
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -120,6 +121,9 @@ class TestFSDP:
     their non-tp dim over fsdp, batch shards over dp x fsdp, and the loss
     matches the unsharded step."""
 
+    # ~9 s (sharded + unsharded train compile); pipeline-train loss test
+    # keeps the sharded step covered in tier-1
+    @pytest.mark.slow
     def test_fsdp_train_step_matches_unsharded(self):
         from modelx_tpu.dl.sharding import LLAMA_FSDP_RULES
         from modelx_tpu.models.train import (

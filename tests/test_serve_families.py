@@ -355,6 +355,8 @@ class TestAOTWarmup:
 
 
 class TestGenerateBatching:
+    # ~9 s concurrency soak; the http/mixed-group batching tests stay
+    @pytest.mark.slow
     def test_concurrent_ragged_generates_coalesce_and_match(self, checkpoints):
         """Concurrent generate requests of different prompt lengths and
         decode budgets coalesce into one ragged device call and return

@@ -22,6 +22,8 @@ def _run(*args):
 
 
 class TestTrainCLI:
+    # ~18 s (two full train runs + resume); npy/finetune legs stay tier-1
+    @pytest.mark.slow
     def test_synthetic_train_checkpoints_and_resumes(self, tmp_path):
         ck = str(tmp_path / "ck")
         out = _run("--steps", "4", "--batch", "8", "--seq", "16",
