@@ -49,11 +49,35 @@ scheduling (the vLLM/Orca idea), built the TPU way:
   idle row's tokens are discarded on the host and its cache rows are
   overwritten wholesale at the next admission.
 
+- **Pipelined dispatch (ISSUE 7):** the chunk boundary is built so the
+  host's job per boundary is ASYNCHRONOUS. Three pieces compose: (1)
+  dispatch-ahead — the decode carry (cache, tok, offsets) lives on
+  device, so the loop keeps up to ``pipeline_depth`` chunk programs in
+  flight and starts each result's device→host copy at dispatch time
+  (``copy_to_host_async``); the oldest chunk's tokens are fetched one
+  boundary LATE, while a younger chunk runs, so EOS/stop/cancel/deadline
+  detection lags bounded in-flight work but token values never change.
+  (2) multi-chunk decode programs — ``dispatch_depth`` (0 = auto): when
+  every slot is in steady decode (no admission, fill piece, or flush
+  due), one program scans D x ``chunk_size`` steps, amortizing the fixed
+  per-dispatch cost D-fold; depth snaps back to 1 the moment any
+  boundary event is pending, and D is capped so no row's writes pass its
+  validated ``_overrun`` span. (3) boundary-prep overlap — while chunks
+  execute, the loop drains the submit queue and pre-computes the
+  expensive admission prep (poison fingerprint, prefix-cache lookup) for
+  the backlog head, so an admission boundary is "swap prepared inputs +
+  dispatch", not serial host work. Per-boundary host time (minus the
+  token-fetch wait) feeds a histogram surfaced as
+  ``boundary_host_ms_p50/p99`` in ``snapshot()``.
+
 Token-exactness: a request decoded here yields EXACTLY the tokens the same
 request gets from the plain paths — greedy rows by argmax determinism, and
 sampled rows because the per-row (seed, step) stream (ops/sampling.py)
 depends only on the row's own request seed and decode depth, both carried
-per slot. Tests assert byte-equality against ragged_greedy_generate.
+per slot — which also makes token sequences DISPATCH-SCHEDULE-INVARIANT:
+depth-D programs and deep pipelines replay the identical (seed, step)
+sequence, so pipelined output is byte-equal to serial output. Tests assert
+byte-equality against ragged_greedy_generate and across dispatch depths.
 
 No reference equivalent (the reference stores models; it cannot serve
 them); this is the serving half of the BASELINE north star. Bench target:
@@ -82,8 +106,10 @@ from modelx_tpu.dl.serving_errors import (
 from modelx_tpu.models.decode import SEQ_BUCKET, pad_seq_len
 from modelx_tpu.testing import faults as _faults
 from modelx_tpu.utils import trace
+from modelx_tpu.utils.jax_compat import copy_to_host_async
 
 _DONE = object()  # end-of-stream sentinel on per-request output queues
+_NO_HIT = object()  # "no memoized prefix-cache lookup" sentinel (None = a miss)
 
 
 def _fingerprint(ids, n: int) -> tuple:
@@ -191,6 +217,7 @@ class ContinuousBatcher:
                  max_live_tokens: int = 0, speculative_k: int = 0,
                  max_ngram: int = 3, paged_attention: str = "gather",
                  pipeline_depth: int = 2,
+                 dispatch_depth: int = 0,
                  burst_window_ms: float = 1.0,
                  prefill_chunk: int = 0,
                  prefill_budget: int = 0,
@@ -329,6 +356,28 @@ class ContinuousBatcher:
         # the older fill is blocked on (admit/preempt livelock)
         self._preempted: list = []
         self._last_chunk_t: float | None = None  # stall_ms_max tracking
+        # -- pipelined-dispatch bookkeeping ---------------------------------
+        # boundary-prep overlap memo: ticket -> (fingerprint, prefix hit),
+        # computed by _overlap_prep while chunks execute, consumed (popped)
+        # by _gather_prep/_prepare_admit at the admission boundary
+        self._prep_memo: dict = {}
+        # host copy of the device tok vector's LOOKAHEAD tokens: every
+        # chunk program returns its final carry as an extra token column,
+        # so the spec-mode transition reads the value from the already-
+        # fetched block instead of a blocking device sync. None = stale
+        # (a dispatch/admission has advanced tok since the last delivery).
+        self._tok_host: np.ndarray | None = None
+        from collections import deque as _deque
+
+        # per-boundary host time (dispatch-to-dispatch gap minus the time
+        # blocked fetching tokens) — snapshot() serves p50/p99 off this
+        self._boundary_host_ms: "_deque[float]" = _deque(maxlen=512)
+        self._sync_wait_s = 0.0  # blocking-fetch time since the last dispatch
+        self._boundary_syncs = 0  # device->host syncs since the last dispatch
+        self._steady = False  # True = no admission/fill/spec since dispatch
+        self._tokens_in_flight = 0  # planned-but-undelivered tokens
+        self._inflight_chunks = 0  # dispatched-but-unsynced chunk equivalents
+        self._depth_last = 1
 
         # admission is ONE program (prefill + first token + insert-at-slot):
         # on a tunneled device every call costs a host round-trip, so the
@@ -362,9 +411,13 @@ class ContinuousBatcher:
             self._admit_many_paged_impl if paged else self._admit_many_impl,
             donate_argnums=(2, 3),
         )
+        # ONE chunk callable for every dispatch depth: n_steps is a STATIC
+        # argument (jit caches one compiled variant per depth actually
+        # used), so the fault-injection seam (tests/bench wrap self._chunk)
+        # and the env-gated chaos wrap below cover deep programs too
         self._chunk = jax.jit(
             self._chunk_paged_impl if paged else self._chunk_impl,
-            donate_argnums=(1, 2),
+            donate_argnums=(1, 2), static_argnames=("n_steps",),
         )
         # chunked-prefill piece programs: a mid piece only advances the
         # slot's KV (no logits output -> XLA drops the lm_head matmul);
@@ -398,6 +451,19 @@ class ContinuousBatcher:
         # compute. Value-DEPENDENT row exits (stop tokens, client cancels)
         # lag by up to depth chunks of wasted compute, never wrong tokens.
         self.pipeline_depth = max(1, int(pipeline_depth))
+        # decode steps per device program, in CHUNKS: when every slot is in
+        # steady decode (nothing queued/waiting/filling, no first token
+        # owed) one program scans depth x chunk_size steps, amortizing the
+        # fixed per-dispatch cost depth-fold. 0 = auto (AUTO_DISPATCH_DEPTH
+        # in steady decode); 1 = classic per-chunk dispatch. Stop/cancel/
+        # deadline detection lags by the program's span (wasted compute,
+        # never wrong tokens: the (seed, step) streams are schedule-
+        # invariant); _pick_depth also caps depth at every row's remaining
+        # budget so writes stay inside the validated _overrun span.
+        self.dispatch_depth = int(dispatch_depth)
+        if self.dispatch_depth < 0:
+            raise ValueError("dispatch_depth must be >= 0 (0 = auto)")
+        self._depth_cap = self.dispatch_depth or self.AUTO_DISPATCH_DEPTH
         # idle-burst gather window: when the first request hits an IDLE
         # engine, wait this long for co-arrivals before admitting (burst ->
         # one admit program + aligned decode depths). 0 disables.
@@ -450,7 +516,16 @@ class ContinuousBatcher:
         self._suspect_fp: tuple | None = None
         self.stats = {"chunks": 0, "admitted": 0, "active_peak": 0,
                       "prefill_pieces": 0, "stall_ms_max": 0.0,
-                      "engine_restarts": 0, "shed": 0, "expired": 0}
+                      "engine_restarts": 0, "shed": 0, "expired": 0,
+                      # pipelined dispatch: device programs launched
+                      # ("chunks" stays chunk-EQUIVALENTS — a depth-D
+                      # program counts D), the deepest program used, the
+                      # worst steady-decode boundary's blocking sync count
+                      # (must stay <= 1: the one lagged token readback),
+                      # and the high-water planned-but-undelivered tokens
+                      "dispatches": 0, "dispatch_depth_max": 1,
+                      "host_syncs_per_boundary": 0,
+                      "tokens_in_flight_peak": 0, "sync_lag_chunks_max": 0}
         # env-gated chaos drills (default off): MODELX_FAULT_PLAN schedules
         # deterministic dispatch faults against the running engine
         env_plan = _faults.from_env()
@@ -473,6 +548,12 @@ class ContinuousBatcher:
     # a request is quarantined once this many loop crashes are attributed
     # to dispatching its admission/fill work
     POISON_CRASHES = 2
+
+    # dispatch_depth=0 resolves to this in steady decode: deep enough to
+    # amortize the fixed dispatch round-trip, shallow enough that a
+    # streaming client's flush cadence (delivery still splits into
+    # chunk_size pieces) and the stop-detection lag stay bounded
+    AUTO_DISPATCH_DEPTH = 4
 
     # -- compiled programs ----------------------------------------------------
 
@@ -791,13 +872,19 @@ class ContinuousBatcher:
             pool,
         )
 
-    def _chunk_impl(self, params, cache, tok, offsets, steps, temp, top_k, top_p, seeds):
-        """``chunk_size`` decode steps over ALL slots; offsets/steps are
-        per-row (slots joined at different times sit at different depths).
-        ``top_k``/``top_p`` arrive as None when NO active row uses filters —
-        the None variant compiles without the per-step full-vocab sort the
-        filters need (jit caches both variants; values are identical either
-        way since 0 / 1.0 mean "off" per row)."""
+    def _chunk_impl(self, params, cache, tok, offsets, steps, temp, top_k,
+                    top_p, seeds, n_steps=None):
+        """``n_steps`` decode steps over ALL slots (``n_steps`` is STATIC —
+        the default is one ``chunk_size`` chunk, a depth-D dispatch passes
+        D x chunk_size); offsets/steps are per-row (slots joined at
+        different times sit at different depths). ``top_k``/``top_p``
+        arrive as None when NO active row uses filters — the None variant
+        compiles without the per-step full-vocab sort the filters need
+        (jit caches both variants; values are identical either way since
+        0 / 1.0 mean "off" per row). The token block carries one EXTRA
+        trailing column: the scan's final carry (each row's next,
+        not-yet-delivered token), so the host's lagged readback also
+        learns the lookahead value without a second device sync."""
         from modelx_tpu.ops import sampling as sampling_ops
 
         def step_fn(carry, _i):
@@ -810,12 +897,13 @@ class ContinuousBatcher:
             return (cache, nxt[:, None], offsets + 1, steps + 1), tok[:, 0]
 
         (cache, tok, offsets, steps), toks = jax.lax.scan(
-            step_fn, (cache, tok, offsets, steps), jnp.arange(self.chunk_size)
+            step_fn, (cache, tok, offsets, steps),
+            jnp.arange(n_steps or self.chunk_size),
         )
-        return cache, tok, toks.T  # [max_slots, chunk_size]
+        return cache, tok, jnp.concatenate([toks.T, tok], axis=1)
 
     def _chunk_paged_impl(self, params, pool, tok, table, offsets, steps,
-                          temp, top_k, top_p, seeds):
+                          temp, top_k, top_p, seeds, n_steps=None):
         """Paged chunk: each step gathers every slot's pages into a dense
         [max_slots, max_len] view (a TRANSIENT the scheduler frees layer by
         layer — the persistent state is only the pool), runs the family
@@ -860,9 +948,11 @@ class ContinuousBatcher:
             return (pool, nxt[:, None], offsets + 1, steps + 1), tok[:, 0]
 
         (pool, tok, offsets, steps), toks = jax.lax.scan(
-            step_fn, (pool, tok, offsets, steps), jnp.arange(self.chunk_size)
+            step_fn, (pool, tok, offsets, steps),
+            jnp.arange(n_steps or self.chunk_size),
         )
-        return pool, tok, toks.T  # [max_slots, chunk_size]
+        # extra trailing column = the lookahead carry, see _chunk_impl
+        return pool, tok, jnp.concatenate([toks.T, tok], axis=1)
 
     # -- speculative verify (single-occupied greedy slot) ---------------------
 
@@ -931,10 +1021,18 @@ class ContinuousBatcher:
         slot, row = next(iter(self._rows.items()))
         prefix_emit: list[int] = []
         if row.tok_pending:
-            # one host sync for the lookahead token's value: spec mode is
-            # synchronous anyway, and this happens only on the single
-            # chunk->spec transition, not per step
-            tok_val = int(np.asarray(self._tok)[slot, 0])
+            # the lookahead token rides in the last delivered chunk's extra
+            # carry column (_tok_host) — the chunk->spec transition costs
+            # NO extra device sync. The fallback sync only fires when no
+            # delivery refreshed the host copy (shouldn't happen: the loop
+            # drains every in-flight chunk before entering spec mode).
+            if self._tok_host is not None:
+                tok_val = int(self._tok_host[slot])
+            else:
+                t0 = time.monotonic()
+                tok_val = int(np.asarray(self._tok)[slot, 0])
+                self._sync_wait_s += time.monotonic() - t0
+                self._boundary_syncs += 1
             row.seq.append(tok_val)
             prefix_emit = [tok_val]
         else:
@@ -957,7 +1055,12 @@ class ContinuousBatcher:
             self._cache, argm_dev = self._spec_prog(
                 self.server.params, self._cache, *args
             )
+        # THE spec boundary's one blocking readback (verify is inherently
+        # synchronous: acceptance decides the next proposal)
+        t0 = time.monotonic()
         argm = np.asarray(argm_dev)[slot]
+        self._sync_wait_s += time.monotonic() - t0
+        self._boundary_syncs += 1
         self.stats["spec_steps"] = self.stats.get("spec_steps", 0) + 1
         self.stats["spec_proposed"] = self.stats.get("spec_proposed", 0) + len(prop)
         # accept while the model agrees, then its own token at the first
@@ -982,6 +1085,8 @@ class ContinuousBatcher:
         tok_np = np.zeros((self.max_slots, 1), np.int32)
         tok_np[slot, 0] = row.seq[-1]
         self._tok = jnp.asarray(tok_np)
+        self._tok_host = tok_np[:, 0].copy()  # spec knows tok on the host
+        self._steady = False  # spec rounds aren't steady-decode boundaries
         row.skip = 1
         row.tok_pending = False
         piece = np.asarray([new], np.int32)
@@ -1044,10 +1149,16 @@ class ContinuousBatcher:
         failed before the engine unwinds — their preps live only in the
         loop-local list, out of reach of the generic death failsafes."""
         self._backlog_sub(1)  # leaving the not-yet-admitted set, whatever happens
-        fp = _fingerprint(item[0], item[1])  # computed once per request
+        # consume the boundary-prep overlap memo (fingerprint + prefix
+        # lookup computed while the previous chunks executed); fall back to
+        # computing inline for items the overlap pass hadn't reached
+        memo = self._prep_memo.pop(item[3], None)
+        fp = memo[0] if memo is not None else _fingerprint(item[0], item[1])
         self._suspect_fp = fp
         try:
-            prep = self._prepare_admit(item)
+            prep = self._prepare_admit(
+                item, memo_hit=memo[1] if memo is not None else _NO_HIT
+            )
         except BaseException as e:
             item[3].out.put(e)
             for p in to_admit:
@@ -1058,7 +1169,7 @@ class ContinuousBatcher:
             prep["fp"] = fp  # reused by the admit/fill dispatch attribution
             to_admit.append(prep)
 
-    def _prepare_admit(self, item) -> dict | None:
+    def _prepare_admit(self, item, memo_hit=_NO_HIT) -> dict | None:
         """Claim a slot (and, paged, reserve the row's pages) for one
         admissible item and resolve its prefix-cache hit. Pure host-side
         bookkeeping — the device dispatch happens in ``_admit_one`` /
@@ -1083,9 +1194,16 @@ class ContinuousBatcher:
         s = len(ids)
         hit = None
         if self.prefix_cache is not None:
-            # fit-aware lookup: entries whose bucket + suffix bucket exceed
-            # the slot cache are skipped (shorter fitting prefixes still win)
-            hit = self.prefix_cache.lookup(ids, max_total=self.max_len)
+            if memo_hit is not _NO_HIT:
+                # boundary-prep overlap memoized this lookup while the
+                # previous chunks executed (a store racing in since then is
+                # only a missed optimization, never a correctness issue)
+                hit = memo_hit
+            else:
+                # fit-aware lookup: entries whose bucket + suffix bucket
+                # exceed the slot cache are skipped (shorter fitting
+                # prefixes still win)
+                hit = self.prefix_cache.lookup(ids, max_total=self.max_len)
         if self.prefill_chunk > 0:
             to_fill = s - (hit[0] if hit is not None else 0)
             use_fill = pad_seq_len(to_fill) > self.prefill_chunk
@@ -1151,6 +1269,7 @@ class ContinuousBatcher:
         else:
             self._rows[slot] = row
         prep["finished"] = True
+        self._steady = False  # an admission boundary, not steady decode
         self.stats["admitted"] += 1
         self.stats["active_peak"] = max(self.stats["active_peak"], len(self._rows))
 
@@ -1159,6 +1278,7 @@ class ContinuousBatcher:
         prefix-cache-free preparations share ONE [k, Sb] program, the rest
         go one-by-one. If a dispatch dies mid-batch, every not-yet-finished
         preparation's waiter is failed before the engine unwinds."""
+        self._tok_host = None  # admit programs advance the device tok
         try:
             singles: list = []
             groups: dict[int, list] = {}
@@ -1383,6 +1503,7 @@ class ContinuousBatcher:
         self._filling[slot] = fill
         self._fill_order.append(slot)
         prep["finished"] = True
+        self._steady = False  # a fill started: not a steady-decode boundary
 
     def _fill_piece(self, rem: int) -> tuple[int, int, bool]:
         """(bucketed piece length, real tokens taken, is-last) for a fill
@@ -1439,6 +1560,9 @@ class ContinuousBatcher:
         # quarantine): a prompt that crashes the loop mid-fill must not be
         # re-admitted forever
         self._suspect_fp = fill.fp
+        self._steady = False  # a fill boundary, not steady decode
+        if last:
+            self._tok_host = None  # the flip program advances the device tok
         block = np.zeros((1, piece_len), np.int32)
         block[0, :take] = fill.ids[fill.filled: fill.filled + take]
         piece = jnp.asarray(block)
@@ -1567,18 +1691,97 @@ class ContinuousBatcher:
         self._preempted.append((fill.ids, fill.n, fill.samp, fill.ticket))
         self._backlog_add(1)  # back in the not-yet-admitted set
 
+    def _overlap_prep(self) -> None:
+        """Boundary-prep overlap: called while dispatched programs are
+        executing, BEFORE the loop blocks on the oldest result. Drains the
+        submit queue into the FIFO backlog (same arrival order the main
+        pop preserves) and pre-computes the expensive host-side admission
+        prep — the poison fingerprint (an O(prompt) hash) and the
+        prefix-cache lookup — for the backlog's head, so the next
+        admission boundary swaps prepared inputs and dispatches instead of
+        doing that work serially between device programs. A lookup
+        memoized here can go stale against a store that lands afterwards;
+        that misses an optimization, never correctness (the admission
+        paths are exact with or without a hit)."""
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                # the close sentinel is strictly last (close() enqueues it
+                # under the same lock submits take): hand it back for the
+                # main pop's close path
+                self._q.put(None)
+                break
+            if isinstance(item, list):
+                self._waiting.extend(item)
+            else:
+                self._waiting.append(item)
+        # only the head can admit next boundary; +2 covers slots that the
+        # in-flight programs' plans just freed
+        limit = len(self._free) + 2
+        for item in self._waiting[:limit]:
+            ticket = item[3]
+            if ticket.cancelled or ticket in self._prep_memo:
+                continue
+            fp = _fingerprint(item[0], item[1])
+            hit = None
+            if self.prefix_cache is not None:
+                hit = self.prefix_cache.lookup(item[0], max_total=self.max_len)
+            self._prep_memo[ticket] = (fp, hit)
+
+    def _pick_depth(self) -> int:
+        """Chunks per device program for THIS dispatch. Depth > 1 only in
+        steady decode: any pending boundary event (a fill piece due, a
+        backlog/queue item wanting admission, a first token owed) snaps
+        back to per-chunk dispatch so that event isn't delayed by a deep
+        program's span. The cap at every row's remaining budget keeps the
+        deepest write inside the validated ``_overrun`` span (a row that
+        finishes mid-program keeps writing to the program's end, exactly
+        like the existing mid-chunk finish — never more than one
+        chunk_size past its budget).
+
+        Depth walks a POWER-OF-TWO ladder (1, 2, 4, ... cap), not every
+        integer: each distinct depth is a separate compiled ``n_steps``
+        variant, and an arbitrary-depth tail (rem 3 chunks -> depth 3,
+        rem 2 -> depth 2...) would pay a fresh XLA compile MID-LOAD the
+        first time every tail size appears — measured as hundreds of ms
+        landing in the steady-decode boundary histogram. The ladder
+        bounds the variant count at log2(cap)+1 while keeping the deep
+        steady-state program."""
+        if self._depth_cap <= 1 or not self._rows:
+            return 1
+        if (self._filling or self._waiting or self._preempted
+                or self._first_pending or not self._q.empty()):
+            return 1
+        rem_min = min(r.budget - r.emitted for r in self._rows.values())
+        fit = min(self._depth_cap, rem_min // self.chunk_size)
+        if fit <= 1:
+            return 1
+        depth = 1
+        while depth * 2 <= fit:
+            depth *= 2
+        return depth
+
     def _dispatch_chunk(self) -> tuple:
-        """Dispatch one chunk (async) and PLAN its emissions now. Take
-        counts and retirements are value-independent (budgets only), so
-        scheduling runs a full chunk ahead of token delivery — the host's
-        dispatch round-trip (tens of ms on a tunneled rig) overlaps the
-        device decoding the chunk in flight instead of serializing with it."""
+        """Dispatch one decode program (async) and PLAN its emissions now.
+        Take counts and retirements are value-independent (budgets only),
+        so scheduling runs a full program ahead of token delivery — the
+        host's dispatch round-trip (tens of ms on a tunneled rig) overlaps
+        the device decoding the chunks in flight instead of serializing
+        with it. In steady decode the program scans ``depth`` chunks
+        (_pick_depth), amortizing the fixed dispatch cost, and the token
+        block's device->host copy STARTS here so the lagged readback in
+        ``_deliver`` finds the bytes already on their way."""
+        depth = self._pick_depth()
+        n_steps = depth * self.chunk_size
         # filters only when an ACTIVE row asked: the None variant skips the
         # per-step full-vocab sort (retired slots' stale values are garbage
         # rows whose tokens are discarded anyway)
         active = list(self._rows)
         filtered = bool(self._use_filters[active].any())
-        with trace.span("continuous.chunk", active=len(self._rows)):
+        with trace.span("continuous.chunk", active=len(self._rows), depth=depth):
             # .copy() is load-bearing: jax zero-copy-aliases host numpy
             # buffers (CPU backend) and transfers lazily, while this loop
             # mutates the originals (retirement resets, next admissions)
@@ -1594,9 +1797,22 @@ class ContinuousBatcher:
             if self.page_size > 0:
                 args.insert(0, jnp.asarray(self._table.copy()))
             self._cache, self._tok, toks_dev = self._chunk(
-                self.server.params, self._cache, self._tok, *args
+                self.server.params, self._cache, self._tok, *args,
+                n_steps=n_steps,
             )
-        self.stats["chunks"] += 1
+        # start the device->host token copy NOW: it streams back while the
+        # device runs the next program, so the lagged _deliver sync finds
+        # the bytes resident instead of paying the full fetch round-trip
+        copy_to_host_async(toks_dev)
+        self._tok_host = None  # the in-flight program advances tok
+        self.stats["chunks"] += depth
+        self.stats["dispatches"] += 1
+        self._depth_last = depth
+        if depth > self.stats["dispatch_depth_max"]:
+            self.stats["dispatch_depth_max"] = depth
+        self._inflight_chunks += depth
+        if self._inflight_chunks > self.stats["sync_lag_chunks_max"]:
+            self.stats["sync_lag_chunks_max"] = self._inflight_chunks
         now = time.monotonic()
         if self._last_chunk_t is not None:
             # decode-boundary cadence: the max gap between consecutive
@@ -1606,9 +1822,23 @@ class ContinuousBatcher:
             gap_ms = (now - self._last_chunk_t) * 1e3
             if gap_ms > self.stats["stall_ms_max"]:
                 self.stats["stall_ms_max"] = round(gap_ms, 3)
+            # the boundary's HOST cost: the dispatch-to-dispatch gap minus
+            # the time spent blocked on device results — what the pipelined
+            # scheduler is supposed to keep off the critical path
+            host_ms = max(0.0, gap_ms - self._sync_wait_s * 1e3)
+            self._boundary_host_ms.append(host_ms)
+            if (self._steady
+                    and self._boundary_syncs
+                    > self.stats["host_syncs_per_boundary"]):
+                # steady decode must cost at most ONE blocking sync per
+                # boundary (the lagged token readback) — tests assert this
+                self.stats["host_syncs_per_boundary"] = self._boundary_syncs
+        self._sync_wait_s = 0.0
+        self._boundary_syncs = 0
+        self._steady = True
         self._last_chunk_t = now
-        self._offsets += self.chunk_size
-        self._steps += self.chunk_size
+        self._offsets += n_steps
+        self._steps += n_steps
         for slot, fill in self._filling.items():
             # filling slots don't decode: their offsets stay pinned at the
             # fill frontier (the chunk's garbage writes land beyond it and
@@ -1616,12 +1846,14 @@ class ContinuousBatcher:
             self._offsets[slot] = fill.filled
             self._steps[slot] = 0
         plan = []
+        taken = 0
         for slot, row in list(self._rows.items()):
             # the chunk's final carry is this row's next (undelivered)
             # token — the spec step must emit it before verifying onward
             row.tok_pending = True
-            take = min(self.chunk_size - row.skip, row.budget - row.emitted)
+            take = min(n_steps - row.skip, row.budget - row.emitted)
             row.emitted += max(take, 0)
+            taken += max(take, 0)
             done = row.emitted >= row.budget
             plan.append((slot, row, row.skip, take, done))
             row.skip = 0
@@ -1629,7 +1861,10 @@ class ContinuousBatcher:
                 # data-ordered after the in-flight chunk's writes
                 del self._rows[slot]
                 self._release_slot(slot)  # idle rows write harmlessly at 0
-        return toks_dev, plan
+        self._tokens_in_flight += taken
+        if self._tokens_in_flight > self.stats["tokens_in_flight_peak"]:
+            self.stats["tokens_in_flight_peak"] = self._tokens_in_flight
+        return toks_dev, plan, depth
 
     def _deliver_firsts(self) -> None:
         """Hand this iteration's admitted rows their prefill tokens. Blocks
@@ -1641,7 +1876,10 @@ class ContinuousBatcher:
                 row.out.put(_DONE)
                 row.closed = True
                 continue
+            t0 = time.monotonic()
             first_np = first_ref()
+            # device-wait, not host work: keep it out of boundary_host_ms
+            self._sync_wait_s += time.monotonic() - t0
             if row.seq is not None:
                 row.seq.append(int(first_np[0, 0]))
             row.out.put(first_np)
@@ -1651,14 +1889,39 @@ class ContinuousBatcher:
             elif done:
                 row.out.put(_DONE)
 
-    @staticmethod
-    def _deliver(pending: tuple | None) -> None:
-        """Block on an in-flight chunk's tokens and hand them to waiters."""
+    def _put_pieces(self, row: _Row, arr: np.ndarray) -> None:
+        """Hand a row its tokens in flush-cadence pieces: a depth-D
+        program's take splits into <= chunk_size slices so streaming
+        clients keep the per-chunk flush granularity the serial path had
+        (serve.py writes one SSE flush per queue item)."""
+        cs = self.chunk_size
+        for j in range(0, arr.shape[1], cs):
+            row.out.put(arr[:, j:j + cs])
+
+    def _deliver(self, pending: tuple | None) -> None:
+        """Block on an in-flight program's tokens and hand them to waiters.
+        This is the boundary's ONE lagged device sync: the async copy
+        started at dispatch, so in steady pipelined decode this wait is
+        the residue the device hasn't streamed back yet, not a full
+        round-trip. The block's extra trailing column is the lookahead
+        carry (each row's next, undelivered token) — cached host-side for
+        the spec-mode transition. Stop hits here lag dispatch by the
+        in-flight span; the row just closes and its slot frees at the next
+        sweep (its offsets die with the slot — the overrun rewind is the
+        slot release, exactly like the speculative path's rejected tail)."""
         if pending is None:
             return
-        toks_dev, plan = pending
+        toks_dev, plan, depth = pending
+        t0 = time.monotonic()
         toks = np.asarray(toks_dev)
+        self._sync_wait_s += time.monotonic() - t0
+        self._boundary_syncs += 1
+        self._inflight_chunks = max(0, self._inflight_chunks - depth)
+        # valid until the next dispatch/admission advances the device tok
+        # (the dispatch path resets it to None first)
+        self._tok_host = toks[:, -1].copy()
         for slot, row, skip, take, done in plan:
+            self._tokens_in_flight = max(0, self._tokens_in_flight - max(take, 0))
             if row.closed:
                 continue  # stop token already ended the row (and its queue)
             if row.ticket.cancelled:
@@ -1675,12 +1938,12 @@ class ContinuousBatcher:
 
                 cut = stop_cut(piece[0].tolist(), row.stops)
                 if cut is not None:
-                    row.out.put(piece[:, :cut])  # include the stop
+                    self._put_pieces(row, piece[:, :cut])  # include the stop
                     row.out.put(_DONE)
                     row.closed = True
                     continue
             if piece is not None:
-                row.out.put(piece)
+                self._put_pieces(row, piece)
             if done:
                 row.out.put(_DONE)
 
@@ -1703,10 +1966,12 @@ class ContinuousBatcher:
                 ticket = item[3]
                 if ticket.cancelled:
                     self._backlog_sub(1)
+                    self._prep_memo.pop(ticket, None)
                     ticket.out.put(_DONE)
                 elif self._deadline_passed(ticket, now):
                     self.stats["expired"] += 1
                     self._backlog_sub(1)
+                    self._prep_memo.pop(ticket, None)
                     ticket.out.put(
                         DeadlineExceededError(state, self.request_timeout_s)
                     )
@@ -1826,6 +2091,14 @@ class ContinuousBatcher:
         self._preempted = []
         self._suspect_fp = None
         self._last_chunk_t = None
+        self._prep_memo = {}
+        self._tok_host = None
+        self._sync_wait_s = 0.0
+        self._boundary_syncs = 0
+        self._steady = False
+        self._tokens_in_flight = 0
+        self._inflight_chunks = 0
+        self._depth_last = 1
 
     def _loop(self) -> str:
         from collections import deque
@@ -1837,7 +2110,10 @@ class ContinuousBatcher:
                 if not self._rows:
                     # idle (or fill-only) gaps between chunks aren't
                     # decode stalls — don't let them pollute stall_ms_max
+                    # (or the boundary host-time histogram)
                     self._last_chunk_t = None
+                    self._sync_wait_s = 0.0
+                    self._boundary_syncs = 0
                 # gather everything admissible (up to free slots), FIFO: the
                 # backlog of earlier arrivals that found no slot goes first.
                 # Preparation claims the slot/pages immediately so the
@@ -1950,6 +2226,11 @@ class ContinuousBatcher:
                 # retirees are already out of _rows and _fail_active's reach)
                 self._deliver_firsts()
                 if pending:
+                    # the dispatched programs are executing: do the NEXT
+                    # admissions' host prep now (queue drain, fingerprint,
+                    # prefix lookup), THEN block on the oldest result —
+                    # boundary prep rides inside device time
+                    self._overlap_prep()
                     self._deliver(pending[0])
                     pending.popleft()
         except BaseException as e:  # engine death must not hang waiters
@@ -2002,9 +2283,11 @@ class ContinuousBatcher:
         for row, _first, _done in self._first_pending:
             row.out.put(err)
         self._first_pending = []
-        for _toks_dev, plan in pending:
+        for _toks_dev, plan, _depth in pending:
             for _slot, row, _skip, _take, _done in plan:
                 row.out.put(err)
+        self._tokens_in_flight = 0
+        self._inflight_chunks = 0
 
     def _backlog_add(self, n: int) -> None:
         with self._close_lock:
@@ -2045,6 +2328,7 @@ class ContinuousBatcher:
             item[3].out.put(err)
         self._backlog_sub(len(self._waiting))
         self._waiting.clear()
+        self._prep_memo.clear()  # memoized prep died with its backlog
         if drain_queue:
             # broken/close: nothing will ever serve the queue — fail it.
             # A supervised restart SKIPS this: queued rows were never
@@ -2064,6 +2348,28 @@ class ContinuousBatcher:
         snap["active"] = len(self._rows)
         snap["filling"] = len(self._filling)
         snap["waiting"] = len(self._waiting) + len(self._preempted)
+        # pipelined-dispatch surface: the effective depth of the last
+        # program, instantaneous in-flight gauges, and the per-boundary
+        # host-overhead histogram (dispatch-to-dispatch gap minus the
+        # blocking token-fetch wait) — the observable the ISSUE 7 win is
+        # measured by
+        snap["dispatch_depth"] = self._depth_last
+        snap["tokens_in_flight"] = self._tokens_in_flight
+        snap["sync_lag_chunks"] = self._inflight_chunks
+        # snapshot() runs on HTTP handler threads while the engine loop
+        # appends: list(deque) is one C-level copy (atomic under the GIL,
+        # no Python re-entry for float elements); the retry covers any
+        # interpreter where a concurrent append still surfaces as the
+        # "deque mutated during iteration" RuntimeError
+        try:
+            hist_list = list(self._boundary_host_ms)
+        except RuntimeError:
+            hist_list = list(self._boundary_host_ms)
+        if hist_list:
+            hist = np.asarray(hist_list, np.float64)
+            snap["boundary_host_ms_p50"] = round(float(np.percentile(hist, 50)), 3)
+            snap["boundary_host_ms_p99"] = round(float(np.percentile(hist, 99)), 3)
+            snap["boundary_host_ms_count"] = int(hist.size)
         # supervision + bounded-admission surface: the operator's view of
         # the self-healing layer (engine_restarts rides in from stats)
         snap["engine_state"] = self._state

@@ -995,6 +995,7 @@ class ServerSet:
                  kv_live_tokens: int = 0,
                  kv_attention: str = "gather",
                  pipeline_depth: int = 2,
+                 dispatch_depth: int = 0,
                  burst_window_ms: float = 1.0,
                  prefill_chunk: int = 0,
                  prefill_budget: int = 0,
@@ -1048,6 +1049,10 @@ class ServerSet:
         # oldest (hides the per-chunk fetch round-trip; value-dependent row
         # exits lag by up to this many chunks of wasted compute)
         self.pipeline_depth = pipeline_depth
+        # decode chunks scanned per device program in steady decode
+        # (amortizes the fixed dispatch cost; 0 = auto, 1 = per-chunk —
+        # see ContinuousBatcher.dispatch_depth)
+        self.dispatch_depth = dispatch_depth
         # idle-burst gather window (ms): co-arrivals at an idle engine admit
         # as one program + decode in step; 0 disables
         self.burst_window_ms = burst_window_ms
@@ -1185,6 +1190,7 @@ class ServerSet:
                     # mutually exclusive)
                     speculative_k=server.speculative_k,
                     pipeline_depth=self.pipeline_depth,
+                    dispatch_depth=self.dispatch_depth,
                     burst_window_ms=self.burst_window_ms,
                     prefill_chunk=self.prefill_chunk,
                     prefill_budget=self.prefill_budget,

@@ -75,6 +75,18 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
                    "round-trip behind device compute (stop-token and "
                    "disconnect exits lag by up to DEPTH chunks of wasted "
                    "compute; 1 = classic lockstep)")
+@click.option("--dispatch-depth", default=0, type=int,
+              help="continuous batching: decode chunks scanned per device "
+                   "program — in steady decode (no admission, prefill "
+                   "piece, or stream flush due) the engine dispatches "
+                   "DEPTH x stream-chunk-size steps per call, amortizing "
+                   "the fixed dispatch round-trip DEPTH-fold; any pending "
+                   "boundary event snaps back to per-chunk dispatch. "
+                   "EOS/cancel/deadline detection lags by up to the "
+                   "program's span (wasted compute, never wrong tokens — "
+                   "outputs stay byte-exact and streams keep per-chunk "
+                   "flush granularity). 0 = auto (4 in steady decode); "
+                   "1 = classic per-chunk dispatch")
 @click.option("--burst-window-ms", default=1.0, type=float,
               help="continuous batching: when a request hits an IDLE "
                    "engine, wait this long for co-arrivals so the burst "
@@ -149,7 +161,7 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
          dynamic_batch: bool, continuous_batch: bool, max_slots: int,
          kv_page_size: int, kv_live_tokens: int, kv_attention: str,
          max_batch: int, batch_window_ms: float, stream_chunk_size: int,
-         pipeline_depth: int, burst_window_ms: float,
+         pipeline_depth: int, dispatch_depth: int, burst_window_ms: float,
          prefill_chunk: int, prefill_budget: int,
          max_queue_depth: int, request_timeout: float,
          prefix_cache: int, prefix_cache_max_bytes: int,
@@ -241,6 +253,7 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
                      stream_chunk_size=stream_chunk_size,
                      kv_page_size=kv_page_size, kv_live_tokens=kv_live_tokens,
                      kv_attention=kv_attention, pipeline_depth=pipeline_depth,
+                     dispatch_depth=dispatch_depth,
                      burst_window_ms=burst_window_ms,
                      prefill_chunk=prefill_chunk,
                      prefill_budget=prefill_budget,

@@ -14,4 +14,21 @@ except ImportError:  # jax < 0.6: experimental namespace, check_rep spelling
         kw["check_rep"] = kw.pop("check_vma", True)
         return _shard_map_old(f, **kw)
 
-__all__ = ["shard_map"]
+def copy_to_host_async(x):
+    """Start an ASYNC device->host copy of ``x`` and return it.
+
+    The continuous engine's pipelined scheduler calls this right after
+    dispatching a decode program so the token block streams back while the
+    NEXT program runs; the eventual ``np.asarray(x)`` then finds the bytes
+    (mostly) resident instead of paying a blocking round-trip. Maps onto
+    ``jax.Array.copy_to_host_async`` where the installed jax provides it;
+    on arrays/backends without the method (or committed host buffers) it is
+    a no-op — the later blocking read stays correct either way.
+    """
+    start = getattr(x, "copy_to_host_async", None)
+    if start is not None:
+        start()
+    return x
+
+
+__all__ = ["shard_map", "copy_to_host_async"]

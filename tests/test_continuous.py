@@ -188,29 +188,37 @@ class TestScheduling:
         the queue was contended (ADVICE r4)."""
         cb = ContinuousBatcher(server, max_slots=1, chunk_size=4)
         try:
+            # record the engine's ADMISSION order (single-threaded in the
+            # loop, so race-free — completion timestamps measured by
+            # competing drain threads are not: with async token readback
+            # back-to-back finishes land ~0.1 ms apart)
+            admitted: list = []
+            orig_admit = cb._admit_all
+            cb._admit_all = lambda preps: (
+                admitted.extend(p["ticket"] for p in preps),
+                orig_admit(preps),
+            )[1]
             a = cb.submit([7, 7, 7], 48, {})
             first = a.out.get(timeout=30)  # A holds the only slot
             assert isinstance(first, np.ndarray)
             b = cb.submit([1, 2], 4, {})
             time.sleep(0.05)  # order the queue arrivals deterministically
             c = cb.submit([3, 4], 4, {})
-            done: dict[str, float] = {}
 
-            def drain(name, t):
+            def drain(t):
                 while True:
                     item = t.out.get(timeout=60)
                     if not isinstance(item, np.ndarray):
-                        done[name] = time.monotonic()
                         return
 
-            tb = threading.Thread(target=drain, args=("b", b))
-            tc = threading.Thread(target=drain, args=("c", c))
+            tb = threading.Thread(target=drain, args=(b,))
+            tc = threading.Thread(target=drain, args=(c,))
             tb.start()
             tc.start()
-            drain("a", a)
+            drain(a)
             tb.join(60)
             tc.join(60)
-            assert done["b"] < done["c"], (
+            assert admitted.index(b) < admitted.index(c), (
                 "later arrival was admitted before an earlier one"
             )
         finally:
@@ -291,14 +299,21 @@ class TestServingIntegration:
             # the operator/bench surface: engine counters + live gauges
             # ride the endpoint (no internals poking needed)
             for key in ("chunks", "active_peak", "prefill_pieces",
-                        "stall_ms_max", "active", "filling", "waiting"):
+                        "stall_ms_max", "active", "filling", "waiting",
+                        # pipelined-dispatch gauges (ISSUE 7) ride the same
+                        # snapshot: always-present instantaneous values plus
+                        # the dispatch counters
+                        "dispatch_depth", "tokens_in_flight",
+                        "sync_lag_chunks", "dispatches",
+                        "host_syncs_per_boundary"):
                 assert key in cont, key
         finally:
             httpd.shutdown()
 
     def test_serverset_wires_prefill_knobs_to_engine(self, server):
         s = ServerSet({"m": server}, continuous_batch=True, max_slots=2,
-                      stream_chunk_size=4, prefill_chunk=16, prefill_budget=32)
+                      stream_chunk_size=4, prefill_chunk=16, prefill_budget=32,
+                      dispatch_depth=3)
         try:
             cb = s.continuous_for(server)
             # wiring only — chunked-decode exactness is covered by
@@ -306,6 +321,7 @@ class TestServingIntegration:
             assert cb.prefill_chunk == 16
             assert cb.prefill_budget == 32
             assert cb.stats["prefill_chunk"] == 16
+            assert cb.dispatch_depth == 3
         finally:
             for cb in s.cbatchers.values():
                 cb.close()
@@ -706,7 +722,9 @@ class TestChunkedPrefill:
         )
         chunks0 = cb.stats["chunks"]
         try:
-            cb._chunk = lambda *a: (order.append("C"), orig_chunk(*a))[1]
+            cb._chunk = (
+                lambda *a, **kw: (order.append("C"), orig_chunk(*a, **kw))[1]
+            )
             cb._piece_prog = lambda *a: (order.append("P"), orig_piece(*a))[1]
             cb._piece_flip_prog = (
                 lambda *a: (order.append("P"), orig_flip(*a))[1]
