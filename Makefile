@@ -5,7 +5,7 @@ PY ?= python
 IMAGE ?= modelx-tpu
 TAG ?= $(shell git describe --tags --always 2>/dev/null || echo dev)
 
-.PHONY: all native test chaos slow lifecycle lint wheel image image-dl compose-up compose-down clean
+.PHONY: all native test chaos slow lifecycle fleet lint wheel image image-dl compose-up compose-down clean
 
 all: native lint test wheel
 
@@ -40,6 +40,14 @@ lifecycle:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_lifecycle.py \
 		"tests/test_bench_smoke.py::TestSwapLeg" -q
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m chaos
+
+# fleet front-door drills (ISSUE 8): routing / stickiness / failover /
+# rebalance tests plus the pod-kill chaos soak, the latter under runtime
+# lockdep like every other chaos sweep (the router brings its own lock
+# order: placement table, sticky LRU, metrics, in-flight counts)
+fleet:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_router.py tests/test_retry.py -q
+	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_router.py -q -m chaos
 
 # two layers: the project-native concurrency/purity gate (always — it is
 # stdlib-only and baseline-governed, see docs/analysis.md), then generic

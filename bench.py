@@ -1175,6 +1175,148 @@ def measure_model_swap(base: str, workdir: str, *, target_bytes: int = 16 << 20,
     }
 
 
+def measure_fleet(model_dir: str, *, pods: int = 3, clients: int = 4,
+                  requests_per_client: int = 5, conversations: int = 6,
+                  turns: int = 8, new_tokens: int = 8,
+                  max_seq_len: int = 256) -> dict:
+    """Fleet front-door leg (ISSUE 8): N in-process pods behind the
+    router vs ONE pod addressed directly, identical client traffic.
+
+    The pods are HTTP fronts around ONE loaded model (this host has one
+    accelerator, so compute does not multiply with pod count);
+    ``fleet_throughput_scaling`` therefore reads as the ROUTER TAX on this
+    rig — ~1.0 means the front door's placement + proxy layer costs
+    nothing observable at this load; a real fleet's scaling multiplies
+    device counts on top. Also driven: repeated-prefix conversations for
+    ``sticky_hit_ratio`` and a seeded pod kill under traffic for
+    ``failover_recovery_ms`` (kill -> first successful routed response)
+    with ``fleet_dropped_requests`` asserting the zero-drop contract."""
+    import requests as _requests
+
+    from modelx_tpu.dl.serve import ModelServer, ServerSet, serve
+    from modelx_tpu.registry.server import free_port
+    from modelx_tpu.router.registry import PodRegistry
+    from modelx_tpu.router.server import FleetRouter, route_serve
+    from modelx_tpu.testing.faults import PodKillSwitch
+
+    server = ModelServer(model_dir, name="default", max_seq_len=max_seq_len)
+    server.load()
+    vocab = int(getattr(server.cfg, "vocab_size", 0) or 256)
+
+    pod_set = []
+    for _ in range(pods):
+        sset = ServerSet({"default": server})
+        sset.pool.mark_ready("default")
+        httpd = serve(sset, listen=f"127.0.0.1:{free_port()}")
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+        pod_set.append({"httpd": httpd, "url": url,
+                        "kill": PodKillSwitch(httpd)})
+    registry = PodRegistry([p["url"] for p in pod_set], poll_interval_s=0.5)
+    router = FleetRouter(registry, request_timeout_s=60.0)
+    router.start()
+    rhttpd = route_serve(router, listen=f"127.0.0.1:{free_port()}")
+    rbase = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(1, vocab, (8,)).tolist()
+               for _ in range(clients)]
+
+    def drive(base_url: str) -> tuple[int, int, float]:
+        """clients x requests_per_client generates; (ok, errors, seconds)."""
+        counts = {"ok": 0, "err": 0}
+        lock = threading.Lock()
+
+        def client(prompt) -> None:
+            sess = _requests.Session()
+            for _ in range(requests_per_client):
+                try:
+                    r = sess.post(base_url + "/v1/generate",
+                                  json={"tokens": [prompt],
+                                        "max_new_tokens": new_tokens},
+                                  timeout=60)
+                    ok = r.status_code == 200
+                except _requests.RequestException:
+                    ok = False
+                with lock:
+                    counts["ok" if ok else "err"] += 1
+
+        threads = [threading.Thread(target=client, args=(p,), daemon=True)
+                   for p in prompts]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return counts["ok"], counts["err"], time.monotonic() - t0
+
+    out: dict = {"fleet_pods": pods}
+    try:
+        # warm every compiled shape once so both legs measure serving, not
+        # compilation (the same prompt shapes repeat throughout)
+        drive(pod_set[0]["url"])
+        ok_d, err_d, dt_d = drive(pod_set[0]["url"])
+        ok_r, err_r, dt_r = drive(rbase)
+        tps_direct = ok_d * new_tokens / max(dt_d, 1e-9)
+        tps_routed = ok_r * new_tokens / max(dt_r, 1e-9)
+        out["fleet_tokens_per_s_direct"] = round(tps_direct, 1)
+        out["fleet_tokens_per_s_routed"] = round(tps_routed, 1)
+        out["fleet_throughput_scaling"] = (
+            round(tps_routed / tps_direct, 3) if tps_direct > 0 else None
+        )
+        out["fleet_traffic_errors"] = err_d + err_r
+
+        # repeated-prefix conversations -> sticky hit ratio
+        convs = [rng.randint(1, vocab, (8,)).tolist()
+                 for _ in range(conversations)]
+        before = router.sticky.stats()
+        sess = _requests.Session()
+        for _turn in range(turns):
+            for conv in convs:
+                sess.post(rbase + "/v1/generate",
+                          json={"tokens": [conv],
+                                "max_new_tokens": new_tokens}, timeout=60)
+        after = router.sticky.stats()
+        hits = after["sticky_hits"] - before["sticky_hits"]
+        misses = after["sticky_misses"] - before["sticky_misses"]
+        out["sticky_hit_ratio"] = (
+            round(hits / (hits + misses), 4) if hits + misses else None
+        )
+
+        # pod-kill drill: kill the pod that owns a conversation, then time
+        # kill -> first successful response for that same conversation
+        target = convs[0]
+        routes = router.metrics.snapshot()["routes"]
+        victim = max(pod_set, key=lambda p: routes.get(p["url"], 0))
+        dropped = 0
+        victim["kill"].kill()
+        t0 = time.monotonic()
+        recovery_ms = None
+        for _ in range(20):
+            try:
+                r = sess.post(rbase + "/v1/generate",
+                              json={"tokens": [target],
+                                    "max_new_tokens": new_tokens},
+                              timeout=60)
+                if r.status_code == 200:
+                    recovery_ms = (time.monotonic() - t0) * 1e3
+                    break
+                dropped += 1
+            except _requests.RequestException:
+                dropped += 1
+        out["failover_recovery_ms"] = (
+            round(recovery_ms, 1) if recovery_ms is not None else None
+        )
+        out["fleet_dropped_requests"] = dropped
+        snap = router.metrics.snapshot()
+        out["fleet_failovers"] = snap["failovers_total"]
+    finally:
+        rhttpd.shutdown()
+        router.close()
+        for p in pod_set:
+            p["httpd"].shutdown()
+    return out
+
+
 class _Budget:
     """Soft wall-clock budget for the whole capture (BENCH_r05 post-mortem:
     the run exceeded the driver's hard timeout and recorded NOTHING, rc
@@ -1650,6 +1792,19 @@ def main() -> None:
         # under live traffic to C, cold vs blob-cache-warm (ISSUE 5)
         guard("model_swap", lambda: measure_model_swap(base, workdir), 180.0)
 
+        # fleet front-door leg: N pods behind the router vs one pod
+        # direct (router tax on a one-device rig), sticky-hit ratio on
+        # repeated-prefix conversations, pod-kill failover drill (ISSUE 8)
+        def fleet_leg() -> dict:
+            fleet_dir = os.path.join(workdir, "fleet")
+            os.makedirs(fleet_dir, exist_ok=True)
+            build_checkpoint(os.path.join(fleet_dir, "model.safetensors"),
+                             48 * 1024 * 1024, hidden=512, inter=1408,
+                             vocab=8192)
+            return measure_fleet(fleet_dir)
+
+        guard("fleet", fleet_leg, 180.0)
+
         # int8 weight-only serving: per-step weight reads halve, so decode
         # (HBM-bound) speeds up — the quantize flag the serve sidecar ships
         def int8_serving() -> dict:
@@ -1693,7 +1848,39 @@ def main() -> None:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def tiny_main() -> int:
+    """``bench.py --tiny``: the fleet leg alone on a tiny synthetic llama
+    — a seconds-fast CPU smoke (``JAX_PLATFORMS=cpu``) that prints one
+    JSON line carrying ``fleet_throughput_scaling`` / ``sticky_hit_ratio``
+    / ``failover_recovery_ms`` (ISSUE 8 acceptance)."""
+    workdir = tempfile.mkdtemp(prefix="modelx-fleet-tiny-")
+    try:
+        import jax
+
+        from modelx_tpu.dl import safetensors as st
+        from modelx_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        st.write_safetensors(
+            os.path.join(workdir, "model.safetensors"),
+            {k: np.asarray(v) for k, v in params.items()},
+        )
+        out: dict = {"metric": "fleet_throughput_scaling", "value": None,
+                     "unit": "x"}
+        out.update(measure_fleet(workdir, pods=3, clients=2,
+                                 requests_per_client=3, conversations=4,
+                                 turns=12, new_tokens=4, max_seq_len=128))
+        out["value"] = out.get("fleet_throughput_scaling")
+        print(json.dumps(out))
+        return 0
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--leg":
         sys.exit(leg_main(sys.argv[2], sys.argv[3], sys.argv[4], sys.argv[5]))
+    if len(sys.argv) > 1 and sys.argv[1] == "--tiny":
+        sys.exit(tiny_main())
     sys.exit(main())

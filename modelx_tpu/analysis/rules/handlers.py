@@ -37,6 +37,7 @@ _TYPED_NAMES = {
     "ServingError", "QueueFullError", "DeadlineExceededError",
     "PoisonedRequestError", "EngineBrokenError", "ModelLoadingError",
     "ModelUnloadedError", "ModelDrainingError", "ModelFailedError",
+    "NoReadyPodError", "UpstreamSeveredError",
     "APIError", "PoolError", "ErrorInfo", "ChatTemplateRejected",
 }
 # modules whose raises are typed constructors (`raise errors.blob_unknown(...)`)
@@ -54,12 +55,16 @@ _SERVER_PATH_FILES = (
     "modelx_tpu/registry/store_fs.py",
     "modelx_tpu/registry/gc.py",
     "modelx_tpu/registry/scrub.py",
+    "modelx_tpu/router/server.py",
+    "modelx_tpu/router/registry.py",
+    "modelx_tpu/router/rebalance.py",
 )
 
 _HANDLER_MODULES = (
     "modelx_tpu/dl/serve.py",
     "modelx_tpu/dl/openai_api.py",
     "modelx_tpu/registry/server.py",
+    "modelx_tpu/router/server.py",
 )
 
 

@@ -490,6 +490,26 @@ def cmd_serve_model(args: tuple[str, ...]) -> None:
     serve_model_main.main(args=list(args), prog_name="modelx serve-model")
 
 
+# -- route (the fleet front door, modelx-route) -------------------------------
+
+
+@main.command(
+    "route",
+    context_settings={"ignore_unknown_options": True, "help_option_names": []},
+)
+@click.argument("args", nargs=-1, type=click.UNPROCESSED)
+def cmd_route(args: tuple[str, ...]) -> None:
+    """Run the fleet router (same as the ``modelx-route`` console
+    script): a prefix-sticky, lifecycle-aware HTTP front door over many
+    ``modelx serve-model`` pods — same native + OpenAI surface, failover
+    on 429/503/connection errors, optional --allow-rebalance lifecycle
+    spreading (docs/router.md). Args pass through verbatim; the router
+    imports no jax, so this stays registry-command cheap."""
+    from modelx_tpu.router.router_main import main as route_main
+
+    route_main.main(args=list(args), prog_name="modelx route")
+
+
 # -- dl (modelxdl, deploy-time puller) ----------------------------------------
 
 

@@ -9,14 +9,13 @@ media-type/annotations (registry.go:92-107).
 from __future__ import annotations
 
 import io
-import random
-import time
 from typing import Any, BinaryIO, Iterator
 
 import requests
 
 from modelx_tpu import errors
 from modelx_tpu.types import BlobLocation, Descriptor, Index, Manifest
+from modelx_tpu.utils.retry import RetryPolicy, retriable_status
 
 
 _INSECURE = False  # process-wide default, set by the CLI root --insecure
@@ -79,14 +78,13 @@ class RegistryClient:
         return h
 
     def _retry_sleep(self, attempt: int, retry_after: str | None) -> None:
-        delay = self.RETRY_BACKOFF_S * (2 ** attempt)
-        delay += random.uniform(0.0, delay / 2)  # jitter
-        if retry_after:
-            try:
-                delay = max(delay, min(float(retry_after), self.RETRY_AFTER_CAP_S))
-            except ValueError:
-                pass  # HTTP-date form (or garbage): keep the backoff
-        time.sleep(delay)
+        # policy built per call so tests (and operators) can tune the class
+        # or instance attrs without re-plumbing; arithmetic lives in
+        # utils/retry.py, shared with the fleet router's pod poller
+        RetryPolicy(
+            retries=self.retries, backoff_s=self.RETRY_BACKOFF_S,
+            retry_after_cap_s=self.RETRY_AFTER_CAP_S,
+        ).sleep(attempt, retry_after)
 
     def _request(
         self,
@@ -135,7 +133,7 @@ class RegistryClient:
                     err = errors.ErrorInfo(resp.status_code, code, f"{method} {path}: HTTP {resp.status_code}")
                 retry_after = resp.headers.get("Retry-After")
                 resp.close()
-                if not last and (resp.status_code >= 500 or resp.status_code == 429):
+                if not last and retriable_status(resp.status_code):
                     # transient server trouble; 4xx below 429 is
                     # deterministic (auth/not-found) and never retried
                     self._retry_sleep(attempt, retry_after)

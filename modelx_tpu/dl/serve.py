@@ -1200,6 +1200,33 @@ class ServerSet:
                 self.cbatchers[server.name] = cb
         return cb
 
+    def serving_stats(self) -> dict:
+        """Per-model load + locality stats for the fleet router's placement
+        table (rides GET /admin/models next to the lifecycle states):
+        ``queue_depth``/``active``/``waiting`` from the continuous engine
+        (0s when the engine is off — the plain path has no backlog),
+        ``engine_state``, and the prefix cache's entry/byte/hit counters —
+        what prefix-sticky routing ranks pods by."""
+        out: dict = {}
+        # snapshot the mutable set under its lock (remove_server pops
+        # entries at runtime); the per-engine reads below then run
+        # lock-free like /metrics does
+        with self._servers_lock:
+            pairs = [(n, s, self.cbatchers.get(n))
+                     for n, s in self.servers.items()]
+        for name, s, cb in pairs:
+            d: dict = {"queue_depth": 0, "active": 0, "waiting": 0}
+            if cb is not None:
+                snap = cb.snapshot()
+                d["queue_depth"] = int(snap.get("queue_depth", 0))
+                d["active"] = int(snap.get("active", 0))
+                d["waiting"] = int(snap.get("waiting", 0))
+                d["engine_state"] = snap.get("engine_state", "running")
+            if s._prefix_cache is not None:
+                d["prefix_cache"] = s._prefix_cache.stats()
+            out[name] = d
+        return out
+
     def engine_health(self) -> str | None:
         """Worst continuous-engine state across tenants, or None when every
         engine is healthy: "engine-broken" (circuit open — the pod needs a
@@ -1574,6 +1601,11 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                 self._json(200, {
                     "models": sset.pool.states(),
                     "pool": sset.pool.pool_snapshot(),
+                    # per-model serving load + locality stats: the fleet
+                    # router ranks stickiness (prefix-cache state) and
+                    # load (queue depth) from THIS one endpoint instead of
+                    # scraping /metrics too (PR 8)
+                    "serving": sset.serving_stats(),
                 })
             elif self.path == "/v1/models":
                 from modelx_tpu.dl import openai_api as oai

@@ -20,6 +20,17 @@ exception carrying its canonical status:
 - ``ModelFailedError``      -> 503 (the model's load crashed; the slot is
   retryable via the admin API, and the reason rides in the message)
 
+The fleet router (modelx_tpu/router/) speaks the SAME family — a client
+cannot tell one pod from a fleet by error shape — plus two router-only
+classes:
+
+- ``NoReadyPodError``       -> 503 + ``Retry-After`` (no READY pod serves
+  the model right now: every candidate is loading, draining, quarantined,
+  or shedding; the fleet may recover on its own, so back off and retry)
+- ``UpstreamSeveredError``  -> 502 (a pod died MID-STREAM after bytes were
+  already relayed; the router surfaces this typed payload in-stream —
+  never a silently truncated 200 — and quarantines the pod)
+
 Kept dependency-free (no jax, no requests) so the transport layer can
 import it at module top without cost.
 """
@@ -139,6 +150,48 @@ class ModelDrainingError(ServingError):
     def __init__(self, name: str) -> None:
         super().__init__(f"model {name!r} is draining (being unloaded)")
         self.model = name
+
+
+class NoReadyPodError(ServingError):
+    """The fleet router found no READY pod for the model: every candidate
+    is loading/draining/quarantined, or every candidate shed the request
+    (429/503 propagated through the failover chain). 503 + ``Retry-After``:
+    pods poll back to health and the rebalancer may be spreading the model,
+    so the client should back off and retry — the same contract a single
+    pod's ModelLoadingError sets."""
+
+    http_status = 503
+
+    def __init__(self, model: str, detail: str = "",
+                 retry_after: float = 2.0) -> None:
+        super().__init__(
+            f"no ready pod serves model {model!r}"
+            + (f" ({detail})" if detail else "") + "; retry later"
+        )
+        self.model = model
+        self.retry_after = max(1, int(retry_after))
+
+    def headers(self) -> dict[str, str]:
+        return {"Retry-After": str(self.retry_after)}
+
+
+class UpstreamSeveredError(ServingError):
+    """A pod died while the router was mid-relay of its streaming body —
+    bytes are already on the wire, so the status cannot change, but the
+    client must NOT mistake the truncation for a complete response. The
+    router writes this typed payload as the final stream event (502 in the
+    payload; the pod is quarantined and the router's metrics count the
+    severed stream)."""
+
+    http_status = 502
+
+    def __init__(self, pod: str, detail: str = "") -> None:
+        super().__init__(
+            f"upstream pod {pod} died mid-stream"
+            + (f": {detail}" if detail else "")
+            + "; response is incomplete — retry the request"
+        )
+        self.pod = pod
 
 
 class ModelFailedError(ServingError):

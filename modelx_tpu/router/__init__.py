@@ -1,0 +1,42 @@
+"""Fleet router: a prefix-sticky, lifecycle-aware front door over many
+serving pods (PR 8).
+
+PRs 3–7 hardened ONE pod — typed backpressure, runtime model lifecycle,
+pipelined decode. The millions-of-users story needs the layer above: a
+lightweight HTTP router that speaks the same native + OpenAI surfaces,
+spreads load across pods, keeps conversations on the pod whose prefix
+cache already holds them (ServerlessLLM's locality argument: route to
+where live state resides), honors 429/503/Retry-After backpressure with
+in-deadline failover, and — behind ``--allow-rebalance`` — drives the
+pods' admin lifecycle API to spread hot models.
+
+Layering (no jax anywhere in this package — the front door starts in
+milliseconds and runs on boxes with no accelerator):
+
+- ``registry``  — PodRegistry: polls each pod's ``/healthz`` +
+  ``/admin/models`` into a placement table; demotes on poll failure;
+  immediate quarantine when the data path sees a connection die.
+- ``policy``    — sticky keys (the PrefixKVCache fingerprint idea lifted
+  to the HTTP layer) + the pick order: sticky first, then least queue
+  depth among READY pods, never DRAINING/broken.
+- ``server``    — the HTTP front door: proxies native + OpenAI bodies,
+  streams SSE/NDJSON chunk-for-chunk (byte-identical), fails over within
+  the request deadline, surfaces mid-stream pod death as a typed error.
+- ``rebalance`` — queue-pressure driven lifecycle actions (POST/DELETE
+  ``/admin/models``), planning split from execution so the policy is
+  unit-testable.
+- ``router_main`` — the ``modelx route`` / ``modelx-route`` CLI.
+"""
+
+from modelx_tpu.router.policy import StickyTable, sticky_keys
+from modelx_tpu.router.registry import PodRegistry, PodState
+from modelx_tpu.router.server import FleetRouter, route_serve
+
+__all__ = [
+    "FleetRouter",
+    "PodRegistry",
+    "PodState",
+    "StickyTable",
+    "route_serve",
+    "sticky_keys",
+]

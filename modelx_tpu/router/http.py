@@ -1,0 +1,44 @@
+"""Shared HTTP plumbing for the router modules.
+
+The registry poller, the rebalancer, and the front door each talk to
+pods over one lazily-created ``requests.Session`` (deferred import: the
+router package must stay stdlib-importable and start in milliseconds) and
+authenticate against the pods' admin surface with the same bearer token.
+One helper each, used by all three — session construction, injection for
+tests, and header assembly live in exactly one place.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class LazySession:
+    """Thread-safe lazily-created ``requests.Session`` with an injection
+    seam: ``preset`` (any object with ``request(method, url, ...)``)
+    bypasses construction entirely — the tests' fake-transport hook."""
+
+    def __init__(self, preset=None) -> None:
+        self._session = preset
+        self._lock = threading.Lock()
+
+    def get(self):
+        if self._session is None:
+            # construct OUTSIDE the lock (the import is blocking work);
+            # the loser of a first-request race closes its spare
+            import requests
+
+            fresh = requests.Session()
+            publish = False
+            with self._lock:
+                if self._session is None:
+                    self._session = fresh
+                    publish = True
+            if not publish:
+                fresh.close()
+        return self._session
+
+
+def bearer_headers(token: str) -> dict[str, str]:
+    """The pods' admin-surface auth header (empty token = anonymous)."""
+    return {"Authorization": f"Bearer {token}"} if token else {}

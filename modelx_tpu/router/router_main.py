@@ -1,0 +1,115 @@
+"""``modelx-route`` console entrypoint: the fleet front door's command.
+
+    modelx route --pod http://pod-a:8000 --pod http://pod-b:8000 \
+                 --pod http://pod-c:8000 --listen :8100
+
+No jax anywhere on this path: the router runs on plain CPU boxes and
+starts in milliseconds — it is a proxy + placement table, not a compute
+node. See docs/router.md for the full semantics.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+
+import click
+
+from modelx_tpu.router.policy import DEFAULT_WINDOW_TOKENS, StickyTable
+from modelx_tpu.router.rebalance import Rebalancer
+from modelx_tpu.router.registry import PodRegistry
+from modelx_tpu.router.server import FleetRouter, route_serve
+
+
+@click.command("modelx-route")
+@click.option("--pod", "pods", multiple=True, required=True,
+              help="backend pod base URL (repeatable): a modelx-serve "
+                   "instance whose /healthz + /admin/models this router "
+                   "polls and whose /v1 surface it proxies")
+@click.option("--listen", default=":8100", help="listen address")
+@click.option("--default-model", default="default",
+              help="model served for /v1/generate|forward and OpenAI "
+                   "requests that omit 'model' (pods boot their "
+                   "--model-dir tenant as 'default')")
+@click.option("--poll-interval", default=2.0, type=float,
+              help="seconds between placement-table polls; data-path "
+                   "connection failures quarantine a pod immediately, "
+                   "this is only how fast it comes BACK")
+@click.option("--poll-timeout", default=5.0, type=float,
+              help="per-poll HTTP timeout against one pod")
+@click.option("--request-timeout", default=60.0, type=float,
+              help="end-to-end deadline for one proxied request, failover "
+                   "attempts included — exceeding it answers 504")
+@click.option("--connect-timeout", default=5.0, type=float,
+              help="per-attempt TCP connect timeout to a pod")
+@click.option("--sticky-entries", default=4096, type=int,
+              help="conversations remembered for prefix-sticky routing "
+                   "(LRU; eviction costs one suffix re-prefill, never "
+                   "correctness)")
+@click.option("--sticky-window", default=DEFAULT_WINDOW_TOKENS, type=int,
+              help="tokens of prompt head hashed into the sticky key "
+                   "(chars are windowed at 4x this); the window is the "
+                   "conversation's identity — system prompt + opening "
+                   "turn — so the key survives the conversation growing")
+@click.option("--pod-admin-token", default="",
+              help="bearer token for the pods' /admin surface (polling "
+                   "reads it; rebalancing writes it)")
+@click.option("--allow-rebalance", is_flag=True,
+              help="let the router drive the pods' lifecycle API: spread "
+                   "a hot model to an underloaded pod (POST /admin/models "
+                   "with the model's registry ref), unload an idle model "
+                   "to make room after a 507 refusal. Off = observe-only "
+                   "(pressure still lands in /metrics). The pods must run "
+                   "--allow-admin-load")
+@click.option("--rebalance-queue-high", default=4, type=int,
+              help="pressure (relayed sheds + aggregate queue depth per "
+                   "model between steps) at which a model counts as hot")
+@click.option("--rebalance-interval", default=10.0, type=float,
+              help="minimum seconds between rebalance steps")
+@click.option("--rebalance-cooldown", default=60.0, type=float,
+              help="per (pod, model) cooldown after an action — a "
+                   "pressure spike must not flap load/unload")
+def main(pods: tuple[str, ...], listen: str, default_model: str,
+         poll_interval: float, poll_timeout: float, request_timeout: float,
+         connect_timeout: float, sticky_entries: int, sticky_window: int,
+         pod_admin_token: str, allow_rebalance: bool,
+         rebalance_queue_high: int, rebalance_interval: float,
+         rebalance_cooldown: float) -> None:
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(message)s")
+    registry = PodRegistry(
+        list(pods), poll_interval_s=poll_interval,
+        poll_timeout_s=poll_timeout, admin_token=pod_admin_token,
+    )
+    rebalancer = Rebalancer(
+        registry, allow=allow_rebalance, queue_high=rebalance_queue_high,
+        interval_s=rebalance_interval, cooldown_s=rebalance_cooldown,
+        admin_token=pod_admin_token,
+    )
+    router = FleetRouter(
+        registry, sticky=StickyTable(max_entries=sticky_entries),
+        rebalancer=rebalancer, default_model=default_model,
+        request_timeout_s=request_timeout, connect_timeout_s=connect_timeout,
+        sticky_window_tokens=sticky_window,
+    )
+    router.start()
+    httpd = route_serve(router, listen=listen)
+    logging.getLogger("modelx.router").info(
+        "routing %d pods on %s (rebalance %s)", len(pods), listen,
+        "enabled" if allow_rebalance else "observe-only",
+    )
+    stop = threading.Event()
+
+    def _on_signal(num, _frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+    stop.wait()
+    httpd.shutdown()
+    router.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,509 @@
+"""The fleet front door: one HTTP surface, many pods behind it.
+
+Speaks the pods' native (``/v1/generate``, ``/v1/{model}/...``) and
+OpenAI (``/v1/completions``, ``/v1/chat/completions``) surfaces
+UNCHANGED — clients cannot tell the router from a pod by request or
+response shape, including streaming: SSE/NDJSON bodies relay
+chunk-for-chunk, so the routed byte stream is identical to the pod's.
+
+Per request:
+
+1. resolve the model (path segment, OpenAI ``model`` field, or the
+   router default) and compute the sticky key (policy.sticky_key);
+2. build the failover plan: sticky pod first, then READY candidates by
+   effective load (poll-time queue depth + the router's own live
+   in-flight counts), never DRAINING/quarantined pods;
+3. dispatch down the plan within the request deadline — a connection
+   error quarantines the pod (and drops its sticky assignments: the
+   prefix cache died with it) and moves on; a 429/503 (bounded-admission
+   backpressure, engine restarting) moves on and, when every candidate
+   shed, relays the LAST backpressure response verbatim — Retry-After
+   included — and feeds the rebalancer's pressure signal;
+4. streaming: the first body chunk is pulled BEFORE the 200 commits, so
+   an immediately-dying pod still fails over invisibly; after bytes are
+   on the wire a severed pod surfaces as a typed in-stream error payload
+   (``UpstreamSeveredError``, 502 in the payload) — never a silently
+   truncated 200 — and the pod is quarantined.
+
+Non-streaming requests whose pod died mid-body retry FROM SCRATCH on the
+next candidate: nothing was committed to the client, generation is
+re-runnable (greedy is deterministic; sampled requests carry their seed),
+so the client sees one complete answer or one typed error, never a drop.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from modelx_tpu.dl.serving_errors import (
+    DeadlineExceededError,
+    ModelDrainingError,
+    ModelUnloadedError,
+    NoReadyPodError,
+    ServingError,
+    UpstreamSeveredError,
+)
+from modelx_tpu.router.http import LazySession
+from modelx_tpu.router.policy import StickyTable, plan_route, sticky_keys
+from modelx_tpu.router.registry import PodRegistry
+
+logger = logging.getLogger("modelx.router")
+
+# native + OpenAI routes the router proxies; everything else 404s here
+# (the /admin lifecycle surface is per-pod by design — the rebalancer is
+# the only fleet-level writer, and it acts on pods directly)
+_OPENAI_PATHS = ("/v1/completions", "/v1/chat/completions")
+_PLAIN_PATHS = ("/v1/generate", "/v1/forward")
+# statuses that mean "this pod can't take it right now, another might":
+# 429 bounded-admission shed, 503 loading/restarting/broken
+_BACKPRESSURE = (429, 503)
+_HOP_HEADERS = ("content-type", "retry-after")  # relayed from pod responses
+
+
+class RouterMetrics:
+    """Counter surface for GET /metrics; one lock, no I/O under it."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.requests_total = 0
+        self.routes: dict[str, int] = {}          # pod url -> relayed responses
+        self.model_routes: dict[str, int] = {}    # model -> relayed responses
+        self.failovers_total = 0                  # candidate skipped mid-plan
+        self.severed_streams_total = 0            # typed mid-stream deaths
+        self.backpressure_relayed_total = 0       # plan exhausted on 429/503
+        self.no_pod_total = 0                     # NoReadyPodError answered
+
+    def count(self, attr: str, n: int = 1) -> None:
+        with self._lock:
+            setattr(self, attr, getattr(self, attr) + n)
+
+    def routed(self, pod_url: str, model: str) -> None:
+        with self._lock:
+            self.routes[pod_url] = self.routes.get(pod_url, 0) + 1
+            self.model_routes[model] = self.model_routes.get(model, 0) + 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "requests_total": self.requests_total,
+                "routes": dict(self.routes),
+                "model_routes": dict(self.model_routes),
+                "failovers_total": self.failovers_total,
+                "severed_streams_total": self.severed_streams_total,
+                "backpressure_relayed_total": self.backpressure_relayed_total,
+                "no_pod_total": self.no_pod_total,
+            }
+
+
+class FleetRouter:
+    """Routing state shared by every handler thread."""
+
+    def __init__(self, registry: PodRegistry, sticky: StickyTable | None = None,
+                 rebalancer=None, default_model: str = "default",
+                 request_timeout_s: float = 60.0,
+                 connect_timeout_s: float = 5.0,
+                 sticky_window_tokens: int = 0,
+                 session=None) -> None:
+        from modelx_tpu.router.policy import DEFAULT_WINDOW_TOKENS
+
+        self.registry = registry
+        self.sticky = sticky or StickyTable()
+        self.rebalancer = rebalancer
+        self.default_model = default_model
+        self.request_timeout_s = float(request_timeout_s)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.sticky_window_tokens = int(sticky_window_tokens) or DEFAULT_WINDOW_TOKENS
+        self.metrics = RouterMetrics()
+        self._session = LazySession(session)
+        self._inflight: dict[str, int] = {}
+        self._inflight_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._maint: threading.Thread | None = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        self.registry.start()
+        if self.rebalancer is not None:
+            self._maint = threading.Thread(
+                target=self._maintenance, name="router-rebalance", daemon=True
+            )
+            self._maint.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self.registry.stop()
+        if self._maint is not None:
+            self._maint.join(timeout=2.0)
+
+    def _maintenance(self) -> None:
+        while not self._stop.wait(self.registry.poll_interval_s):
+            try:
+                self.rebalancer.maybe_step()
+            except Exception:
+                # rebalancing is an optimization: a failed step must never
+                # kill the loop (the action error counters carry the signal)
+                logger.exception("rebalance step failed")
+
+    # -- plumbing -------------------------------------------------------------
+
+    def http(self):
+        return self._session.get()
+
+    def enter(self, pod_url: str) -> None:
+        with self._inflight_lock:
+            self._inflight[pod_url] = self._inflight.get(pod_url, 0) + 1
+
+    def exit(self, pod_url: str) -> None:
+        with self._inflight_lock:
+            n = self._inflight.get(pod_url, 1)
+            self._inflight[pod_url] = max(0, n - 1)
+
+    def inflight(self) -> dict[str, int]:
+        with self._inflight_lock:
+            return dict(self._inflight)
+
+    def pod_died(self, pod_url: str, reason: str) -> None:
+        """Data-path death: quarantine + drop sticky assignments (the
+        pod's prefix cache died with it)."""
+        self.registry.quarantine(pod_url, reason)
+        self.sticky.forget_pod(pod_url)
+
+    def resolve_model(self, path: str, req: dict) -> str | None:
+        """The model a request addresses; None = unroutable path."""
+        if path in _OPENAI_PATHS:
+            return str(req.get("model") or self.default_model)
+        if path in _PLAIN_PATHS:
+            return self.default_model
+        parts = path.split("/")
+        if (len(parts) == 4 and parts[1] == "v1"
+                and parts[3] in ("generate", "forward") and parts[2]):
+            return parts[2]
+        return None
+
+    def snapshot(self) -> dict:
+        out = {
+            "router": dict(self.metrics.snapshot(), **self.sticky.stats()),
+            "pods": self.registry.snapshot(),
+            "inflight": self.inflight(),
+        }
+        if self.rebalancer is not None:
+            out["rebalance"] = self.rebalancer.snapshot()
+        return out
+
+
+def _error_body(path: str, e: ServingError) -> bytes:
+    """One typed error, shaped for the surface it crosses: OpenAI paths
+    get the ``{"error": {...}}`` object, native paths the flat form —
+    identical to what a single pod would have answered."""
+    if path in _OPENAI_PATHS:
+        return json.dumps({"error": {
+            "message": str(e), "type": e.api_type, "code": e.http_status,
+        }}).encode()
+    return json.dumps({"error": str(e)}).encode()
+
+
+def _stream_error_payload(content_type: str, path: str, e: ServingError) -> bytes:
+    body = _error_body(path, e)
+    if "text/event-stream" in content_type:
+        return b"data: " + body + b"\n\n"
+    return body + b"\n"
+
+
+def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServer:
+    """Start the front door (mirrors dl/serve.serve: returns the live
+    ThreadingHTTPServer; caller owns shutdown)."""
+    import requests
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def _json(self, status: int, obj, headers: dict | None = None) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            try:
+                self.wfile.write(body)
+            except OSError:
+                pass  # client went away; nothing to salvage
+
+        def _serving_error(self, path: str, e: ServingError) -> None:
+            body = _error_body(path, e)
+            self.send_response(e.http_status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in e.headers().items():
+                self.send_header(k, v)
+            self.end_headers()
+            try:
+                self.wfile.write(body)
+            except OSError:
+                pass
+
+        # -- reads ------------------------------------------------------------
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                ready = [p for p in router.registry.pods()
+                         if p.healthy and p.ready_models()]
+                if ready:
+                    self._json(200, {"status": "ok", "ready_pods": len(ready)})
+                else:
+                    # the fleet may be booting/draining through a poll:
+                    # tell the LB when to look again, like a pod would
+                    self._json(503, {"status": "no-ready-pods"},
+                               headers={"Retry-After": "2"})
+            elif self.path == "/livez":
+                # the router holds no device state and self-heals by
+                # polling: alive as long as the process answers
+                self._json(200, {"status": "ok"})
+            elif self.path == "/metrics":
+                self._json(200, router.snapshot())
+            elif self.path == "/v1/models":
+                fleet = router.registry.models()
+                self._json(200, {
+                    "object": "list",
+                    "data": [{"id": name, "object": "model"}
+                             for name in sorted(fleet)],
+                    "default": router.default_model,
+                    "models": fleet,
+                })
+            else:
+                self._json(404, {"error": "not found"})
+
+        # -- proxy ------------------------------------------------------------
+
+        def do_POST(self):
+            router.metrics.count("requests_total")
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            raw = self.rfile.read(length) if length else b""
+            try:
+                req = json.loads(raw) if raw else {}
+            except ValueError as e:
+                return self._json(400, {"error": f"bad request: {e}"})
+            if not isinstance(req, dict):
+                return self._json(400, {"error": "request body must be a JSON object"})
+            model = router.resolve_model(self.path, req)
+            if model is None:
+                return self._json(404, {"error": "not found"})
+            try:
+                self._route(model, req, raw)
+            except ServingError as e:
+                self._serving_error(self.path, e)
+
+        def _route(self, model: str, req: dict, raw: bytes) -> None:
+            """Walk the failover plan until one pod's response is relayed.
+            Raises typed ServingErrors (mapped by the caller); relays pod
+            statuses — success AND deterministic errors — verbatim."""
+            deadline = time.monotonic() + router.request_timeout_s
+            keys = sticky_keys(model, req, self.path,
+                               window_tokens=router.sticky_window_tokens)
+            stream = bool(req.get("stream", False))
+            plan = plan_route(model, router.registry.candidates(model),
+                              router.sticky, keys, router.inflight())
+            if not plan:
+                # mirror the single-pod routing contract (PR 5): a name no
+                # pod has ever heard of 404s; DRAINING everywhere is 409;
+                # LOADING/PULLING/FAILED — or READY on pods that are all
+                # demoted right now — is the retryable 503 + Retry-After
+                state = router.registry.known_state(model)
+                if state is None:
+                    # typed so the OpenAI surface gets its error OBJECT
+                    # shape (a pod's 404 is oai.APIError-shaped there)
+                    raise ModelUnloadedError(model)
+                if state == "DRAINING":
+                    raise ModelDrainingError(model)
+                router.metrics.count("no_pod_total")
+                raise NoReadyPodError(model, detail=f"fleet state: {state}")
+            last_bp = None  # (status, body, headers) of the last 429/503
+            for pod in plan:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise DeadlineExceededError("routing", router.request_timeout_s)
+                router.enter(pod.url)
+                try:
+                    status, bp = self._try_pod(pod, raw, stream, remaining)
+                finally:
+                    router.exit(pod.url)
+                if status is not None:
+                    router.metrics.routed(pod.url, model)
+                    live = router.registry.pod(pod.url)
+                    if status == 200 and live is not None and live.healthy:
+                        # only successful work on a still-live pod warms
+                        # its prefix cache; a relayed 400/404 — or a 200
+                        # whose stream the pod severed (it is quarantined
+                        # by now) — must not pin the conversation there
+                        router.sticky.assign(keys, pod.url)
+                    return
+                if bp is not None:
+                    last_bp = bp
+                router.metrics.count("failovers_total")
+            # plan exhausted: backpressure propagates verbatim (the pods'
+            # Retry-After is the fleet's honest answer); pure connection
+            # failure becomes the typed no-pod 503
+            if router.rebalancer is not None:
+                router.rebalancer.observe_shed(model)
+            if last_bp is not None:
+                status, body, headers = last_bp
+                router.metrics.count("backpressure_relayed_total")
+                self.send_response(status)
+                for k, v in headers:
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                try:
+                    self.wfile.write(body)
+                except OSError:
+                    pass
+                return
+            router.metrics.count("no_pod_total")
+            raise NoReadyPodError(model, detail="every candidate failed")
+
+        def _try_pod(self, pod, raw: bytes, stream: bool, remaining: float):
+            """One dispatch. Returns (status, backpressure): ``status``
+            non-None when a response (any status outside the backpressure
+            set) went to the client; ``backpressure`` carries a 429/503
+            for the exhausted-plan path. (None, None) = connection-level
+            failure, pod quarantined."""
+            try:
+                resp = router.http().request(
+                    "POST", pod.url + self.path, data=raw,
+                    headers={"Content-Type": "application/json"},
+                    stream=True,
+                    timeout=(router.connect_timeout_s, remaining),
+                )
+            except requests.exceptions.ReadTimeout:
+                # the pod ACCEPTED and is just slower than the remaining
+                # deadline: the request's problem, not the pod's — no
+                # quarantine (that would cascade a slow query into
+                # fleet-wide sticky-cache loss); the plan loop's deadline
+                # check turns this into the client's 504
+                return None, None
+            except requests.RequestException as e:
+                router.pod_died(pod.url, f"dispatch: {e}")
+                return None, None
+            try:
+                if resp.status_code in _BACKPRESSURE:
+                    try:
+                        body = resp.content
+                    except requests.RequestException as e:
+                        # the pod died while we read its 429/503 body:
+                        # that's a connection failure, not backpressure
+                        router.pod_died(pod.url, f"backpressure body: {e}")
+                        return None, None
+                    bp = (
+                        resp.status_code,
+                        body,
+                        [(k, v) for k, v in resp.headers.items()
+                         if k.lower() in _HOP_HEADERS],
+                    )
+                    return None, bp
+                if stream and resp.status_code == 200:
+                    ok = self._relay_stream(pod, resp)
+                else:
+                    ok = self._relay_buffered(pod, resp)
+                return (resp.status_code if ok else None), None
+            finally:
+                resp.close()
+
+        def _relay_buffered(self, pod, resp) -> bool:
+            """Non-streaming relay: buffer the whole pod body first — a
+            pod death mid-body lands HERE, before anything commits to the
+            client, so the caller can retry the next candidate (zero
+            dropped non-streaming requests under pod kill)."""
+            try:
+                body = resp.content
+            except requests.exceptions.ReadTimeout:
+                # slow pod, not dead pod: no quarantine; nothing committed,
+                # so the plan loop's deadline check answers the 504
+                return False
+            except requests.RequestException as e:
+                router.pod_died(pod.url, f"body read: {e}")
+                return False
+            self.send_response(resp.status_code)
+            for k, v in resp.headers.items():
+                if k.lower() in _HOP_HEADERS:
+                    self.send_header(k, v)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            try:
+                self.wfile.write(body)
+            except OSError:
+                pass  # client went away after the pod did its work
+            return True
+
+        def _relay_stream(self, pod, resp) -> bool:
+            """Streaming relay, chunk-for-chunk. The FIRST chunk is pulled
+            before the 200 commits (immediate pod death still fails over);
+            after commitment a severed pod writes the typed
+            UpstreamSeveredError payload in-stream, then the terminator —
+            the client always learns the stream is incomplete."""
+            content_type = resp.headers.get("Content-Type", "application/json")
+            it = resp.iter_content(chunk_size=None)
+            try:
+                first = next(it, b"")
+            except requests.RequestException as e:
+                router.pod_died(pod.url, f"stream open: {e}")
+                return False
+            self.send_response(200)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def write_chunk(payload: bytes) -> None:
+                if not payload:
+                    return
+                self.wfile.write(f"{len(payload):x}\r\n".encode())
+                self.wfile.write(payload + b"\r\n")
+
+            try:
+                try:
+                    write_chunk(first)
+                    for chunk in it:
+                        write_chunk(chunk)
+                except requests.exceptions.ReadTimeout:
+                    # the pod is alive but a token gap outran the deadline:
+                    # typed in-stream 504, no quarantine (the pod keeps its
+                    # warm caches; only THIS stream is over budget)
+                    err = DeadlineExceededError(
+                        "streaming", router.request_timeout_s)
+                    write_chunk(_stream_error_payload(
+                        content_type, self.path, err))
+                except requests.RequestException as e:
+                    # the pod died with bytes already relayed: typed error
+                    # event, quarantine, count — NEVER a silent truncation
+                    router.pod_died(pod.url, f"mid-stream: {e}")
+                    router.metrics.count("severed_streams_total")
+                    err = UpstreamSeveredError(pod.url, type(e).__name__)
+                    logger.warning("stream severed: %s", err)
+                    write_chunk(_stream_error_payload(
+                        content_type, self.path, err))
+            except OSError:
+                pass  # client went away mid-relay
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+            return True
+
+    host, _, port = listen.rpartition(":")
+    httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)), Handler)
+    httpd.daemon_threads = True
+    # tighter shutdown poll than the stdlib default: the router restarts
+    # (and test teardowns) should not idle half a second per instance
+    t = threading.Thread(target=lambda: httpd.serve_forever(poll_interval=0.1),
+                         name="router-http", daemon=True)
+    t.start()
+    return httpd

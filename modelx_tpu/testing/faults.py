@@ -18,7 +18,10 @@ apply them to the three seams the framework exposes:
   latency on every provider op — the registry torn-write/scrub drills;
 - ``FSRegistryStore(fault_plan=...)`` fires ``store.manifest_persisted``
   between manifest persist and index refresh, so stale-index recovery is
-  a deterministic test.
+  a deterministic test;
+- ``PodKillSwitch`` hard-kills a live serving pod's HTTP server (listener
+  closed, live connections RST) — the fleet router's pod-death drills:
+  mid-stream death must surface typed, failover must cover the rest.
 
 Determinism: schedules are either explicit call indices (``errors_at``)
 or drawn once per op from ``random.Random(seed ^ crc(op))`` at rule-add
@@ -231,6 +234,80 @@ class FaultyFSProvider:
     def __getattr__(self, name):
         # pass through provider extras (e.g. LocalFSProvider.local_path)
         return getattr(self.inner, name)
+
+
+class PodKillSwitch:
+    """Abrupt pod death for fleet-router drills (PR 8).
+
+    A clean ``httpd.shutdown()`` lets in-flight handlers FINISH — the
+    opposite of a crash. This switch models the real thing: every accepted
+    connection socket is tracked, and ``kill()`` closes the listener and
+    severs every live connection (``shutdown(SHUT_RDWR)`` — a plain
+    ``close()`` would only drop a reference while the handler's
+    rfile/wfile keep the fd alive), so a client mid-stream sees a severed
+    TCP stream — truncated chunked body, no terminator — not a graceful
+    error event, and new connections are refused.
+
+    Seeded scheduling composes with :class:`FaultPlan`: drive the kill
+    from an exact call index by firing an op per relayed chunk and calling
+    ``kill()`` when the scheduled error lands (see ``fire_kills``); the
+    drill replays byte-identically.
+    """
+
+    def __init__(self, httpd) -> None:
+        self._httpd = httpd
+        self._conns: list = []
+        self._lock = threading.Lock()
+        self.killed = False
+        orig_get_request = httpd.get_request
+
+        def get_request():
+            sock, addr = orig_get_request()
+            with self._lock:
+                self._conns.append(sock)
+            return sock, addr
+
+        httpd.get_request = get_request
+
+    def kill(self) -> None:
+        """Idempotent hard death: refuse new connections, sever live ones
+        mid-whatever-they-were-doing."""
+        import socket as _socket
+
+        with self._lock:
+            if self.killed:
+                return
+            self.killed = True
+            conns = list(self._conns)
+        try:
+            self._httpd.socket.close()
+        except OSError:
+            pass  # already closed: the death is what matters
+        for sock in conns:
+            try:
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass  # connection already gone
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def fire_kills(self, plan: FaultPlan, op: str = "pod.kill"):
+        """A per-event hook: call the returned function once per relayed
+        chunk/request; when the plan schedules an error at that index the
+        pod dies THERE. Returns True when the kill fired."""
+
+        def hook() -> bool:
+            act = plan.fire(op)
+            if act.latency_s:
+                time.sleep(act.latency_s)
+            if act.error is not None:
+                self.kill()
+                return True
+            return False
+
+        return hook
 
 
 def wrap_dispatch(fn, plan: FaultPlan, op: str = "engine.dispatch"):

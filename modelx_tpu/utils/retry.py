@@ -1,0 +1,83 @@
+"""Shared exponential-backoff + ``Retry-After`` retry policy.
+
+Grown out of ``RegistryClient._request`` (client/remote.py), which had the
+only control-plane retry loop in the tree; the fleet router's pod poller
+needs the identical stance (PR 8), so the arithmetic lives here once:
+
+- exponential backoff with decorrelating jitter: ``backoff_s * 2^attempt``
+  plus ``uniform(0, delay/2)`` — a fleet of sidecars (or a router's worth
+  of pod pollers) retrying the same endpoint must not re-collide;
+- a server ``Retry-After`` wins when LONGER than the computed backoff,
+  capped so a hostile or buggy header can't park the caller for minutes;
+- only the numeric-seconds form of ``Retry-After`` is honored — the
+  HTTP-date form (or garbage) keeps the backoff, matching the client's
+  historical behavior.
+
+Dependency-free (stdlib only): the transport layers import it at module
+top without cost, and the router front door must start in milliseconds.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+
+def parse_retry_after(value: str | None, cap_s: float) -> float | None:
+    """Seconds a ``Retry-After`` header asks for, capped; None for the
+    HTTP-date form, garbage, or a missing header (caller keeps its own
+    backoff). Negative values clamp to 0 (retry now, but still a valid
+    server hint)."""
+    if not value:
+        return None
+    try:
+        return min(max(float(value), 0.0), cap_s)
+    except ValueError:
+        return None  # HTTP-date form (or garbage): keep the backoff
+
+
+class RetryPolicy:
+    """One retry stance: how many attempts, how long between them.
+
+    ``delay_s`` is pure arithmetic + jitter (unit-testable without
+    sleeping); ``sleep`` applies it. ``attempts`` iterates attempt
+    indices so call sites keep the familiar ``for attempt in
+    policy.attempts()`` shape with ``policy.last(attempt)`` telling them
+    when to stop swallowing errors.
+    """
+
+    def __init__(self, retries: int = 3, backoff_s: float = 0.2,
+                 retry_after_cap_s: float = 5.0,
+                 sleep=time.sleep, rng=random.uniform) -> None:
+        self.retries = max(1, int(retries))
+        self.backoff_s = float(backoff_s)
+        self.retry_after_cap_s = float(retry_after_cap_s)
+        self._sleep = sleep
+        self._rng = rng
+
+    def attempts(self) -> range:
+        return range(self.retries)
+
+    def last(self, attempt: int) -> bool:
+        return attempt >= self.retries - 1
+
+    def delay_s(self, attempt: int, retry_after: str | None = None) -> float:
+        """Backoff before the attempt AFTER ``attempt`` (0-based):
+        exponential with jitter; a longer (numeric, capped) server
+        ``Retry-After`` wins."""
+        delay = self.backoff_s * (2 ** attempt)
+        delay += self._rng(0.0, delay / 2)  # jitter
+        hinted = parse_retry_after(retry_after, self.retry_after_cap_s)
+        if hinted is not None:
+            delay = max(delay, hinted)
+        return delay
+
+    def sleep(self, attempt: int, retry_after: str | None = None) -> None:
+        self._sleep(self.delay_s(attempt, retry_after))
+
+
+def retriable_status(status: int) -> bool:
+    """The transient-server-trouble statuses every retry loop in the tree
+    agrees on: 5xx and 429. 4xx below 429 is deterministic (auth /
+    not-found / validation) and never retried."""
+    return status >= 500 or status == 429

@@ -313,6 +313,48 @@ class TestSwapLeg:
         assert out["swap_cache_hits"] >= 1
 
 
+class TestFleetLeg:
+    @pytest.mark.slow
+    def test_measure_fleet_schema(self, tmp_path):
+        """The fleet front-door leg end to end on a tiny model (ISSUE 8):
+        3 pods behind the router vs one pod direct, repeated-prefix
+        conversations, and a pod kill under traffic — schema-checks the
+        load-bearing JSON keys and the zero-drop failover contract."""
+        import jax
+        import numpy as np
+
+        import bench
+        from modelx_tpu.dl import safetensors as st
+        from modelx_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        st.write_safetensors(
+            str(tmp_path / "model.safetensors"),
+            {k: np.asarray(v) for k, v in params.items()},
+        )
+        out = bench.measure_fleet(
+            str(tmp_path), pods=3, clients=2, requests_per_client=2,
+            conversations=3, turns=6, new_tokens=4, max_seq_len=128,
+        )
+        for key in ("fleet_pods", "fleet_tokens_per_s_direct",
+                    "fleet_tokens_per_s_routed", "fleet_throughput_scaling",
+                    "fleet_traffic_errors", "sticky_hit_ratio",
+                    "failover_recovery_ms", "fleet_dropped_requests",
+                    "fleet_failovers"):
+            assert key in out, key
+        assert out["fleet_pods"] == 3
+        assert out["fleet_traffic_errors"] == 0
+        assert out["fleet_throughput_scaling"] is not None
+        # repeated-prefix traffic actually stuck (3 convs x 6 turns: 15/18)
+        assert out["sticky_hit_ratio"] is not None
+        assert out["sticky_hit_ratio"] >= 0.8
+        # the kill drill recovered with zero dropped requests
+        assert out["failover_recovery_ms"] is not None
+        assert out["fleet_dropped_requests"] == 0
+        assert out["fleet_failovers"] >= 1
+
+
 class TestBenchBudget:
     """The r05-timeout fix (rc 124, nothing recorded): the soft budget
     skips stages that no longer fit — NAMED in timed_out_legs — records

@@ -199,11 +199,17 @@ class TestEndToEndLifecycle:
             assert models["models"]["b"]["lifecycle"]["state"] == READY
             assert {d["id"] for d in models["data"]} >= {"a", "b"}
 
-            # GET /admin/models shows both READY + the pool accounting
+            # GET /admin/models shows both READY + the pool accounting +
+            # the per-model serving block the fleet router ranks pods by
+            # (queue depth + prefix-cache stats from ONE endpoint, PR 8)
             admin = requests.get(base + "/admin/models").json()
             assert admin["models"]["a"]["state"] == READY
             assert admin["models"]["b"]["state"] == READY
             assert admin["pool"]["hbm_reserved_bytes"] > 0
+            assert set(admin["serving"]) == {"a", "b"}
+            for stats in admin["serving"].values():
+                assert stats["queue_depth"] == 0  # nothing in flight now
+                assert "active" in stats and "waiting" in stats
 
             # DELETE A with a request in flight: drain waits, new requests
             # 409, completion flips to 404

@@ -1,0 +1,85 @@
+"""utils/retry.py — the shared exponential-backoff + Retry-After policy
+(PR 8 satellite: factored out of RegistryClient._request, now also the
+fleet router's pod-poller stance). The client-side integration tests live
+in test_client.py::TestControlPlaneRetries; these cover the arithmetic."""
+
+import pytest
+
+from modelx_tpu.utils.retry import RetryPolicy, parse_retry_after, retriable_status
+
+
+class TestParseRetryAfter:
+    def test_numeric_seconds(self):
+        assert parse_retry_after("2", cap_s=5.0) == 2.0
+        assert parse_retry_after("0.3", cap_s=5.0) == 0.3
+
+    def test_cap_bounds_hostile_header(self):
+        # a buggy/hostile server must not park the caller for minutes
+        assert parse_retry_after("86400", cap_s=5.0) == 5.0
+
+    def test_negative_clamps_to_zero(self):
+        assert parse_retry_after("-3", cap_s=5.0) == 0.0
+
+    def test_http_date_form_ignored(self):
+        # the historical client behavior: only numeric seconds are honored
+        assert parse_retry_after("Wed, 21 Oct 2025 07:28:00 GMT", cap_s=5.0) is None
+
+    def test_garbage_and_missing_ignored(self):
+        assert parse_retry_after("soon", cap_s=5.0) is None
+        assert parse_retry_after("", cap_s=5.0) is None
+        assert parse_retry_after(None, cap_s=5.0) is None
+
+
+class TestRetryPolicy:
+    def _policy(self, **kw):
+        # deterministic jitter (upper bound) so delay assertions are exact
+        kw.setdefault("rng", lambda a, b: b)
+        kw.setdefault("sleep", lambda s: None)
+        return RetryPolicy(**kw)
+
+    def test_exponential_backoff_with_jitter_bound(self):
+        p = self._policy(backoff_s=0.2)
+        # backoff * 2^attempt, jitter adds at most half the base delay
+        assert p.delay_s(0) == pytest.approx(0.2 * 1.5)
+        assert p.delay_s(1) == pytest.approx(0.4 * 1.5)
+        assert p.delay_s(2) == pytest.approx(0.8 * 1.5)
+
+    def test_jitter_is_decorrelating_not_fixed(self):
+        draws = []
+        p = RetryPolicy(backoff_s=0.2, rng=lambda a, b: draws.append((a, b)) or a)
+        p.delay_s(1)
+        assert draws == [(0.0, pytest.approx(0.2))]  # uniform(0, delay/2)
+
+    def test_longer_retry_after_wins(self):
+        p = self._policy(backoff_s=0.01, retry_after_cap_s=5.0)
+        assert p.delay_s(0, retry_after="0.3") == pytest.approx(0.3)
+
+    def test_shorter_retry_after_loses_to_backoff(self):
+        p = self._policy(backoff_s=1.0, retry_after_cap_s=5.0)
+        assert p.delay_s(0, retry_after="0.01") == pytest.approx(1.5)
+
+    def test_retry_after_cap(self):
+        p = self._policy(backoff_s=0.01, retry_after_cap_s=2.0)
+        assert p.delay_s(0, retry_after="9999") == pytest.approx(2.0)
+
+    def test_sleep_applies_delay(self):
+        slept = []
+        p = RetryPolicy(backoff_s=0.2, rng=lambda a, b: 0.0,
+                        sleep=slept.append)
+        p.sleep(1, None)
+        assert slept == [pytest.approx(0.4)]
+
+    def test_attempts_and_last(self):
+        p = self._policy(retries=3)
+        assert list(p.attempts()) == [0, 1, 2]
+        assert not p.last(0) and not p.last(1) and p.last(2)
+
+    def test_at_least_one_attempt(self):
+        assert RetryPolicy(retries=0).retries == 1
+
+    def test_retriable_statuses(self):
+        assert retriable_status(500) and retriable_status(503)
+        assert retriable_status(429)
+        # deterministic 4xx never retries (auth / not-found / validation)
+        assert not retriable_status(404) and not retriable_status(400)
+        assert not retriable_status(409) and not retriable_status(200)

@@ -1,0 +1,1126 @@
+"""Fleet router tests (ISSUE 8): prefix-sticky routing, lifecycle-aware
+placement, backpressure failover, streaming byte-exactness, pod-kill
+drills, and rebalancing.
+
+Two pod flavors:
+
+- ``FakePod``: a scripted HTTP stand-in (milliseconds) for policy /
+  registry / failover mechanics — statuses, queue depths, admin
+  recording, truncated bodies;
+- real pods: ``ServerSet``s around ONE shared tiny loaded ``ModelServer``
+  (the model loads once per module; each pod is just an HTTP front), for
+  the acceptance drills — sticky hit ratio > 0.9, zero dropped
+  non-streaming requests under a pod kill, routed streams byte-identical
+  to direct pod output.
+
+The fleet soak (threads x kills) carries ``slow`` + ``chaos``; everything
+else is tier-1 and fast."""
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+import requests
+
+import jax
+
+from modelx_tpu.dl import safetensors as st
+from modelx_tpu.dl.serve import ModelServer, ServerSet, serve
+from modelx_tpu.models import llama
+from modelx_tpu.registry.server import free_port
+from modelx_tpu.router.policy import (
+    StickyTable,
+    _buckets,
+    plan_route,
+    sticky_keys,
+)
+from modelx_tpu.router.rebalance import Rebalancer, plan_actions
+from modelx_tpu.router.registry import PodRegistry, PodState
+from modelx_tpu.router.server import FleetRouter, route_serve
+from modelx_tpu.testing.faults import FaultPlan, PodKillSwitch
+
+
+# -- fake pods -----------------------------------------------------------------
+
+
+class FakePod:
+    """Scripted serving pod: answers the poll surface (/healthz,
+    /admin/models) from attributes tests mutate directly, and the /v1
+    surface with configurable status / stream / failure behavior."""
+
+    def __init__(self, models=None, healthz=(200, {"status": "ok"})):
+        self.models = dict(models or {"default": {"state": "READY"}})
+        self.serving: dict = {}
+        self.pool: dict = {}
+        self.healthz = healthz
+        self.post_status: int | None = None   # e.g. 429 to shed everything
+        self.post_headers: dict = {}
+        self.stream_script: list[bytes] | None = None
+        self.truncate_body = False            # mid-body death (non-stream)
+        self.shed_truncated = False           # dies WHILE sending its 429
+        self.load_status = 202                # POST /admin/models answer
+        self.requests: list = []              # recorded /v1 POST paths
+        self.admin_loads: list = []
+        self.admin_unloads: list = []
+        pod = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, status, obj, headers=None):
+                body = json.dumps(obj).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    status, body = pod.healthz
+                    self._json(status, body)
+                elif self.path == "/admin/models":
+                    self._json(200, {"models": pod.models,
+                                     "serving": pod.serving,
+                                     "pool": pod.pool})
+                else:
+                    self._json(404, {"error": "not found"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                raw = self.rfile.read(length) if length else b""
+                if self.path == "/admin/models":
+                    req = json.loads(raw)
+                    pod.admin_loads.append(req)
+                    if pod.load_status < 400:
+                        pod.models[req["name"]] = {"state": "READY",
+                                                   "ref": req.get("ref", "")}
+                    return self._json(pod.load_status, {"ok": True})
+                pod.requests.append((self.path, raw))
+                if pod.shed_truncated:
+                    # a 429 whose body never completes: pod death mid-shed
+                    self.send_response(429)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Retry-After", "1")
+                    self.send_header("Content-Length", "1000")
+                    self.end_headers()
+                    self.wfile.write(b"y" * 10)
+                    self.wfile.flush()
+                    import socket as _socket
+
+                    self.connection.shutdown(_socket.SHUT_RDWR)
+                    return
+                if pod.post_status is not None:
+                    return self._json(pod.post_status, {"error": "scripted"},
+                                      headers=pod.post_headers)
+                if pod.truncate_body:
+                    # promise 1000 body bytes, deliver 10, then sever the
+                    # connection: the router's buffered relay must treat
+                    # this as pod death BEFORE committing to its client
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", "1000")
+                    self.end_headers()
+                    self.wfile.write(b"x" * 10)
+                    self.wfile.flush()
+                    import socket as _socket
+
+                    self.connection.shutdown(_socket.SHUT_RDWR)
+                    return
+                req = json.loads(raw) if raw else {}
+                if req.get("stream") and pod.stream_script is not None:
+                    self.send_response(200)
+                    ct = ("text/event-stream"
+                          if pod.stream_script and
+                          pod.stream_script[0].startswith(b"data:")
+                          else "application/x-ndjson")
+                    self.send_header("Content-Type", ct)
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    for chunk in pod.stream_script:
+                        self.wfile.write(f"{len(chunk):x}\r\n".encode())
+                        self.wfile.write(chunk + b"\r\n")
+                    self.wfile.write(b"0\r\n\r\n")
+                    return
+                self._json(200, {"tokens": [[1, 2, 3]], "pod": pod.url})
+
+            def do_DELETE(self):
+                if self.path.startswith("/admin/models/"):
+                    name = self.path.split("/")[3].split("?")[0]
+                    pod.admin_unloads.append(name)
+                    pod.models.pop(name, None)
+                    return self._json(202, {"ok": True})
+                self._json(404, {"error": "not found"})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        # hard death on close: a plain shutdown() leaves keep-alive
+        # connections (e.g. the registry's pooled poll session) serving —
+        # the opposite of the pod death these tests model
+        self.killswitch = PodKillSwitch(self.httpd)
+        threading.Thread(
+            target=lambda: self.httpd.serve_forever(poll_interval=0.05),
+            daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.killswitch.kill()
+        self.httpd.shutdown()
+
+
+def wait_for(cond, timeout=2.0):
+    """Poll ``cond`` until truthy (post-relay bookkeeping — route counters,
+    sticky assignment — lands a beat after the client has its bytes)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        got = cond()
+        if got:
+            return got
+        time.sleep(0.005)
+    raise AssertionError("condition not met within timeout")
+
+
+def make_router(pod_urls, **kw):
+    """Router over ``pod_urls`` with a manually-polled registry (tests
+    call ``registry.poll_once()``; no background threads to race)."""
+    registry = PodRegistry(pod_urls, poll_interval_s=60.0, poll_timeout_s=2.0)
+    registry.poll_once()
+    router = FleetRouter(registry, request_timeout_s=10.0,
+                         connect_timeout_s=2.0, **kw)
+    httpd = route_serve(router, listen=f"127.0.0.1:{free_port()}")
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    return SimpleNamespace(registry=registry, router=router,
+                           httpd=httpd, base=base)
+
+
+# -- policy units --------------------------------------------------------------
+
+
+class TestStickyKeys:
+    def test_token_ladder_longest_first(self):
+        ids = list(range(40))
+        keys = sticky_keys("m", {"tokens": [ids]}, "/v1/generate",
+                           window_tokens=64)
+        assert [k[2] for k in keys] == [32, 16, 8, 4]
+        assert all(k[0] == "m" and k[1] == "tok" for k in keys)
+
+    def test_growing_conversation_shares_head_buckets(self):
+        turn1 = list(range(10))
+        turn2 = turn1 + [99, 98, 97, 96, 95, 94]  # history + new tokens
+        k1 = sticky_keys("m", {"tokens": [turn1]}, "/v1/generate")
+        k2 = sticky_keys("m", {"tokens": [turn2]}, "/v1/generate")
+        # the longest-prefix property: turn 2's shorter buckets equal
+        # turn 1's (same head bytes), which is what keeps it sticky
+        assert set(k1) & set(k2)
+
+    def test_model_isolates_keys(self):
+        a = sticky_keys("a", {"tokens": [[1, 2, 3, 4, 5]]}, "/v1/generate")
+        b = sticky_keys("b", {"tokens": [[1, 2, 3, 4, 5]]}, "/v1/generate")
+        assert not set(a) & set(b)
+
+    def test_text_and_chat_forms(self):
+        t = sticky_keys("m", {"prompt": "  hello world, this is a prompt"},
+                        "/v1/completions")
+        assert t and all(k[1] == "text" for k in t)
+        c = sticky_keys("m", {"messages": [
+            {"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hi"},
+        ]}, "/v1/chat/completions")
+        assert c and all(k[1] == "chat" for k in c)
+        # whitespace inside JSON framing must not change the chat identity
+        c2 = sticky_keys("m", {"messages": [
+            {"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hi"},
+            {"role": "assistant", "content": "hello"},
+        ]}, "/v1/chat/completions")
+        assert set(c) & set(c2)  # grown conversation shares head buckets
+
+    def test_short_prompt_gets_exact_head_key(self):
+        keys = sticky_keys("m", {"tokens": [[7, 8]]}, "/v1/generate")
+        assert len(keys) == 1 and keys[0][2] == 2
+
+    def test_no_prompt_no_keys(self):
+        assert sticky_keys("m", {}, "/v1/generate") == []
+        assert sticky_keys("m", {"tokens": "garbage"}, "/v1/generate") == []
+
+    def test_buckets_ladder(self):
+        assert _buckets(64) == [64, 32, 16, 8, 4]
+        assert _buckets(10) == [8, 4]
+        assert _buckets(1) == [4]
+
+
+class TestStickyTable:
+    def _keys(self, n):
+        return [("m", "tok", b, n) for b in (16, 8, 4)]
+
+    def test_miss_then_hit(self):
+        t = StickyTable()
+        keys = self._keys(1)
+        assert t.lookup(keys, {"p1"}) is None
+        t.assign(keys, "p1")
+        assert t.lookup(keys, {"p1", "p2"}) == "p1"
+        assert t.stats()["sticky_hits"] == 1
+        assert t.stats()["sticky_misses"] == 1
+
+    def test_longest_bucket_wins(self):
+        t = StickyTable()
+        t.assign([("m", "tok", 4, 1)], "short")
+        t.assign([("m", "tok", 16, 1)], "long")
+        keys = [("m", "tok", 16, 1), ("m", "tok", 4, 1)]
+        assert t.lookup(keys, {"short", "long"}) == "long"
+
+    def test_dead_candidate_is_miss(self):
+        t = StickyTable()
+        keys = self._keys(2)
+        t.assign(keys, "dead")
+        assert t.lookup(keys, {"alive"}) is None
+
+    def test_forget_pod(self):
+        t = StickyTable()
+        t.assign(self._keys(3), "p1")
+        t.assign(self._keys(4), "p2")
+        t.forget_pod("p1")
+        assert t.lookup(self._keys(3), {"p1", "p2"}) is None
+        assert t.lookup(self._keys(4), {"p1", "p2"}) == "p2"
+
+    def test_lru_bound(self):
+        t = StickyTable(max_entries=4)
+        for i in range(10):
+            t.assign([("m", "tok", 4, i)], f"p{i}")
+        assert t.stats()["entries"] == 4
+
+    def test_keyless_counts_nothing(self):
+        t = StickyTable()
+        assert t.lookup([], {"p"}) is None
+        assert t.stats()["sticky_hit_ratio"] is None
+
+
+class TestPlanRoute:
+    def _pod(self, url, depth=0):
+        return PodState(url, healthy=True,
+                        models={"m": {"state": "READY"}},
+                        serving={"m": {"queue_depth": depth}})
+
+    def test_least_loaded_first(self):
+        pods = [self._pod("b", 5), self._pod("a", 1), self._pod("c", 0)]
+        plan = plan_route("m", pods, StickyTable(), [], {})
+        assert [p.url for p in plan] == ["c", "a", "b"]
+
+    def test_router_inflight_counts(self):
+        pods = [self._pod("a", 0), self._pod("b", 0)]
+        plan = plan_route("m", pods, StickyTable(), [], {"a": 3})
+        assert [p.url for p in plan] == ["b", "a"]
+
+    def test_sticky_pod_leads_plan(self):
+        pods = [self._pod("a", 0), self._pod("b", 9)]
+        sticky = StickyTable()
+        keys = [("m", "tok", 4, 7)]
+        sticky.assign(keys, "b")
+        plan = plan_route("m", pods, sticky, keys, {})
+        assert [p.url for p in plan] == ["b", "a"]
+
+    def test_empty_candidates(self):
+        assert plan_route("m", [], StickyTable(), [], {}) == []
+
+
+# -- registry ------------------------------------------------------------------
+
+
+class TestPodRegistry:
+    def test_poll_builds_placement_table(self):
+        fp = FakePod(models={"default": {"state": "READY"},
+                             "warming": {"state": "LOADING"}})
+        fp.serving = {"default": {"queue_depth": 2, "active": 1}}
+        try:
+            reg = PodRegistry([fp.url], poll_interval_s=60.0)
+            reg.poll_once()
+            pod = reg.pod(fp.url)
+            assert pod.healthy and pod.serves("default")
+            assert not pod.serves("warming")
+            assert pod.queue_depth("default") == 3
+            assert reg.known_state("warming") == "LOADING"
+            assert [p.url for p in reg.candidates("default")] == [fp.url]
+            assert reg.candidates("warming") == []
+        finally:
+            fp.close()
+
+    def test_candidates_rank_by_queue_depth(self):
+        fps = [FakePod() for _ in range(3)]
+        for fp, depth in zip(fps, (5, 0, 2)):
+            fp.serving = {"default": {"queue_depth": depth}}
+        try:
+            reg = PodRegistry([fp.url for fp in fps], poll_interval_s=60.0)
+            reg.poll_once()
+            got = [p.queue_depth("default") for p in reg.candidates("default")]
+            assert got == [0, 2, 5]
+        finally:
+            for fp in fps:
+                fp.close()
+
+    def test_poll_failure_demotes(self):
+        fp = FakePod()
+        reg = PodRegistry([fp.url], poll_interval_s=60.0,
+                          poll_timeout_s=0.5)
+        reg.poll_once()
+        assert reg.pod(fp.url).healthy
+        fp.close()
+        reg.poll_once()
+        pod = reg.pod(fp.url)
+        assert not pod.healthy and pod.status == "unreachable"
+        assert pod.consecutive_failures >= 1
+        assert reg.candidates("default") == []
+
+    def test_unready_healthz_demotes(self):
+        fp = FakePod(healthz=(503, {"status": "loading"}))
+        try:
+            reg = PodRegistry([fp.url], poll_interval_s=60.0)
+            reg.poll_once()
+            assert not reg.pod(fp.url).healthy
+            # lifecycle detail still lands: the router can say "LOADING"
+            assert reg.known_state("default") == "READY"
+        finally:
+            fp.close()
+
+    def test_degraded_pod_still_routable(self):
+        fp = FakePod(healthz=(200, {"status": "degraded",
+                                    "failed": {"bad": "boom"}}))
+        try:
+            reg = PodRegistry([fp.url], poll_interval_s=60.0)
+            reg.poll_once()
+            assert reg.pod(fp.url).healthy
+        finally:
+            fp.close()
+
+    def test_quarantine_immediate_and_poll_recovers(self):
+        fp = FakePod()
+        try:
+            reg = PodRegistry([fp.url], poll_interval_s=60.0)
+            reg.poll_once()
+            reg.quarantine(fp.url, "drill")
+            pod = reg.pod(fp.url)
+            assert not pod.healthy and pod.status == "quarantined"
+            assert reg.candidates("default") == []
+            reg.poll_once()  # pod is actually fine: next poll restores it
+            assert reg.pod(fp.url).healthy
+        finally:
+            fp.close()
+
+    def test_quarantine_survives_concurrent_poll_round(self):
+        """A data-path quarantine landing WHILE a poll round is
+        mid-collection must not be overwritten by the round's stale
+        healthy sample — only the NEXT round (which samples the pod
+        after the observed death) may restore the pod."""
+        hold = threading.Event()
+        release = threading.Event()
+
+        class Resp:
+            status_code = 200
+            content = b"x"
+            headers: dict = {}
+
+            def __init__(self, body):
+                self._body = body
+
+            def json(self):
+                return self._body
+
+            def close(self):
+                pass
+
+        class Sess:
+            slow = False
+
+            def request(self, method, url, **kw):
+                if url.endswith("/healthz"):
+                    if Sess.slow:
+                        hold.set()  # the round is now mid-collection
+                        release.wait(5)
+                    return Resp({"status": "ok"})
+                return Resp({"models": {"default": {"state": "READY"}},
+                             "serving": {}, "pool": {}})
+
+        url = "http://pod-x:1"
+        reg = PodRegistry([url], poll_interval_s=60.0, session=Sess())
+        reg.poll_once()
+        assert reg.pod(url).healthy
+        Sess.slow = True
+        t = threading.Thread(target=reg.poll_once, daemon=True)
+        t.start()
+        assert hold.wait(5)
+        reg.quarantine(url, "data-path death mid-round")
+        release.set()
+        t.join(timeout=5)
+        pod = reg.pod(url)
+        assert not pod.healthy and pod.status == "quarantined"
+        Sess.slow = False
+        reg.poll_once()  # a round sampling AFTER the death restores
+        assert reg.pod(url).healthy
+
+    def test_duplicate_and_empty_urls_refused(self):
+        with pytest.raises(ValueError):
+            PodRegistry([])
+        with pytest.raises(ValueError):
+            PodRegistry(["http://x:1", "http://x:1/"])
+
+
+# -- rebalance planning --------------------------------------------------------
+
+
+class TestPlanActions:
+    def _pods(self):
+        a = PodState("http://a", healthy=True,
+                     models={"hot": {"state": "READY", "ref": "lib/hot@v1",
+                                     "inflight": 2}},
+                     serving={"hot": {"queue_depth": 9}})
+        b = PodState("http://b", healthy=True,
+                     models={"cold": {"state": "READY", "inflight": 0,
+                                      "loads_total": 1}},
+                     serving={"cold": {"queue_depth": 0}})
+        return a, b
+
+    def test_hot_model_spreads_to_non_serving_pod(self):
+        a, b = self._pods()
+        actions = plan_actions([a, b], {"hot": 9}, queue_high=4)
+        assert len(actions) == 1
+        act = actions[0]
+        assert (act.kind, act.pod, act.model, act.ref) == (
+            "load", "http://b", "hot", "lib/hot@v1")
+
+    def test_no_ref_no_spread(self):
+        a, b = self._pods()
+        a.models["hot"].pop("ref")
+        assert plan_actions([a, b], {"hot": 9}, queue_high=4) == []
+
+    def test_below_threshold_no_action(self):
+        a, b = self._pods()
+        assert plan_actions([a, b], {"hot": 3}, queue_high=4) == []
+
+    def test_make_room_unloads_idle_donor(self):
+        a, b = self._pods()
+        actions = plan_actions([a, b], {}, queue_high=4,
+                               make_room_on={"http://b": "hot"})
+        assert [(x.kind, x.pod, x.model) for x in actions] == [
+            ("unload", "http://b", "cold")]
+
+    def test_busy_donor_not_unloaded(self):
+        a, b = self._pods()
+        b.models["cold"]["inflight"] = 1
+        assert plan_actions([a, b], {}, queue_high=4,
+                            make_room_on={"http://b": "hot"}) == []
+
+    def test_one_spread_per_step(self):
+        a, b = self._pods()
+        a.models["hot2"] = {"state": "READY", "ref": "lib/hot2@v1"}
+        acts = plan_actions([a, b], {"hot": 9, "hot2": 8}, queue_high=4)
+        assert len(acts) == 1 and acts[0].model == "hot"  # hottest first
+
+
+class TestRebalancerE2E:
+    def test_pressure_spreads_hot_model(self):
+        a = FakePod(models={"hot": {"state": "READY", "ref": "lib/hot@v1",
+                                    "inflight": 0}})
+        a.serving = {"hot": {"queue_depth": 9}}
+        b = FakePod(models={})
+        try:
+            reg = PodRegistry([a.url, b.url], poll_interval_s=60.0)
+            reg.poll_once()
+            rb = Rebalancer(reg, allow=True, queue_high=4, interval_s=0.0)
+            done = rb.step()
+            assert [d["action"] for d in done] == ["load"]
+            assert b.admin_loads == [{"name": "hot", "ref": "lib/hot@v1"}]
+            reg.poll_once()
+            assert any(p.url == b.url for p in reg.candidates("hot"))
+            # cooldown: an immediate second step must not re-act
+            a.serving = {"hot": {"queue_depth": 9}}
+            assert rb.step() == []
+        finally:
+            a.close()
+            b.close()
+
+    def test_disabled_rebalancer_observes_only(self):
+        a = FakePod(models={"hot": {"state": "READY", "ref": "lib/hot@v1"}})
+        a.serving = {"hot": {"queue_depth": 9}}
+        b = FakePod(models={})
+        try:
+            reg = PodRegistry([a.url, b.url], poll_interval_s=60.0)
+            reg.poll_once()
+            rb = Rebalancer(reg, allow=False, queue_high=4, interval_s=0.0)
+            assert rb.pressure().get("hot") == 9
+            assert rb.step() == []
+            assert b.admin_loads == []
+            assert rb.snapshot()["enabled"] is False
+        finally:
+            a.close()
+            b.close()
+
+    def test_507_refusal_makes_room_next_step(self):
+        a = FakePod(models={"hot": {"state": "READY", "ref": "lib/hot@v1"}})
+        a.serving = {"hot": {"queue_depth": 9}}
+        b = FakePod(models={"cold": {"state": "READY", "inflight": 0}})
+        b.load_status = 507
+        try:
+            reg = PodRegistry([a.url, b.url], poll_interval_s=60.0)
+            reg.poll_once()
+            # DEFAULT cooldown: a 507-refused load must not cool the
+            # (pod, model) pair, or the make-room retry it schedules
+            # would be blocked by its own refusal
+            rb = Rebalancer(reg, allow=True, queue_high=4, interval_s=0.0)
+            done = rb.step()
+            assert [d.get("status") for d in done] == [507]
+            reg.poll_once()
+            b.load_status = 202  # room will exist once the donor unloads
+            done2 = rb.step()
+            assert [(d["action"], d["model"]) for d in done2] == [
+                ("unload", "cold"), ("load", "hot")]
+            assert b.admin_unloads == ["cold"]
+            assert b.admin_loads[-1] == {"name": "hot", "ref": "lib/hot@v1"}
+        finally:
+            a.close()
+            b.close()
+
+
+# -- the HTTP front door (fake pods) -------------------------------------------
+
+
+class TestRouterHTTP:
+    def test_health_metrics_models(self):
+        fp = FakePod()
+        rt = make_router([fp.url])
+        try:
+            h = requests.get(rt.base + "/healthz")
+            assert h.status_code == 200 and h.json()["ready_pods"] == 1
+            assert requests.get(rt.base + "/livez").status_code == 200
+            m = requests.get(rt.base + "/metrics").json()
+            assert "router" in m and fp.url in m["pods"]["pods"]
+            models = requests.get(rt.base + "/v1/models").json()
+            assert models["data"] == [{"id": "default", "object": "model"}]
+            assert models["models"]["default"][fp.url] == "READY"
+            assert requests.get(rt.base + "/nope").status_code == 404
+        finally:
+            rt.httpd.shutdown()
+            fp.close()
+
+    def test_no_ready_pods_healthz(self):
+        fp = FakePod(healthz=(503, {"status": "loading"}))
+        rt = make_router([fp.url])
+        try:
+            h = requests.get(rt.base + "/healthz")
+            assert h.status_code == 503
+            assert h.headers.get("Retry-After")
+        finally:
+            rt.httpd.shutdown()
+            fp.close()
+
+    def test_routes_and_records(self):
+        fp = FakePod()
+        rt = make_router([fp.url])
+        try:
+            r = requests.post(rt.base + "/v1/generate",
+                              json={"tokens": [[1, 2, 3, 4]],
+                                    "max_new_tokens": 4})
+            assert r.status_code == 200 and r.json()["pod"] == fp.url
+            snap = wait_for(
+                lambda: (s := rt.router.snapshot()["router"])
+                and s["routes"].get(fp.url) == 1 and s)
+            assert snap["model_routes"]["default"] == 1
+        finally:
+            rt.httpd.shutdown()
+            fp.close()
+
+    def test_unknown_model_404_loading_503_draining_409(self):
+        fp = FakePod(models={"default": {"state": "READY"},
+                             "warming": {"state": "LOADING"},
+                             "leaving": {"state": "DRAINING"}})
+        rt = make_router([fp.url])
+        try:
+            r = requests.post(rt.base + "/v1/ghost/generate",
+                              json={"tokens": [[1]]})
+            assert r.status_code == 404
+            r = requests.post(rt.base + "/v1/warming/generate",
+                              json={"tokens": [[1]]})
+            assert r.status_code == 503
+            assert r.headers.get("Retry-After")
+            assert "warming" in r.json()["error"]
+            r = requests.post(rt.base + "/v1/leaving/generate",
+                              json={"tokens": [[1]]})
+            assert r.status_code == 409
+        finally:
+            rt.httpd.shutdown()
+            fp.close()
+
+    def test_openai_error_shape(self):
+        fp = FakePod(models={"warming": {"state": "LOADING"}})
+        rt = make_router([fp.url])
+        try:
+            r = requests.post(rt.base + "/v1/completions",
+                              json={"model": "warming", "prompt": "hi"})
+            assert r.status_code == 503
+            err = r.json()["error"]
+            assert err["type"] == "server_error" and err["code"] == 503
+            # unknown model keeps the OpenAI error-OBJECT shape too — a
+            # client can't tell the router from a pod by error shape
+            r = requests.post(rt.base + "/v1/completions",
+                              json={"model": "ghost", "prompt": "hi"})
+            assert r.status_code == 404
+            err = r.json()["error"]
+            assert err["type"] == "not_found_error" and err["code"] == 404
+        finally:
+            rt.httpd.shutdown()
+            fp.close()
+
+    def test_bad_bodies_400(self):
+        fp = FakePod()
+        rt = make_router([fp.url])
+        try:
+            r = requests.post(rt.base + "/v1/generate", data=b"not json",
+                              headers={"Content-Type": "application/json"})
+            assert r.status_code == 400
+            r = requests.post(rt.base + "/v1/generate", json=[1, 2])
+            assert r.status_code == 400
+        finally:
+            rt.httpd.shutdown()
+            fp.close()
+
+    def test_backpressure_fails_over_then_relays(self):
+        shedding = FakePod()
+        shedding.post_status = 429
+        shedding.post_headers = {"Retry-After": "7"}
+        # force the shedding pod first in plan: zero depth + lower url sort
+        # is unreliable, so give the healthy pod visible queue depth
+        healthy = FakePod()
+        healthy.serving = {"default": {"queue_depth": 5}}
+        rt = make_router([shedding.url, healthy.url])
+        try:
+            r = requests.post(rt.base + "/v1/generate",
+                              json={"tokens": [[1, 2, 3, 4]]})
+            assert r.status_code == 200 and r.json()["pod"] == healthy.url
+            assert rt.router.metrics.snapshot()["failovers_total"] == 1
+            # both shed: the LAST backpressure relays verbatim,
+            # Retry-After included
+            healthy.post_status = 503
+            healthy.post_headers = {"Retry-After": "3"}
+            r = requests.post(rt.base + "/v1/generate",
+                              json={"tokens": [[1, 2, 3, 4]]})
+            assert r.status_code in (429, 503)
+            assert r.headers.get("Retry-After") in ("3", "7")
+            snap = rt.router.metrics.snapshot()
+            assert snap["backpressure_relayed_total"] == 1
+        finally:
+            rt.httpd.shutdown()
+            shedding.close()
+            healthy.close()
+
+    def test_connection_failure_fails_over_and_quarantines(self):
+        doomed = FakePod()
+        doomed.serving = {"default": {"queue_depth": 0}}
+        alive = FakePod()
+        alive.serving = {"default": {"queue_depth": 5}}
+        rt = make_router([doomed.url, alive.url])
+        try:
+            doomed.close()  # dies AFTER the poll: the table still likes it
+            r = requests.post(rt.base + "/v1/generate",
+                              json={"tokens": [[1, 2, 3, 4]]})
+            assert r.status_code == 200 and r.json()["pod"] == alive.url
+            pod = rt.registry.pod(doomed.url)
+            assert not pod.healthy and pod.status == "quarantined"
+            assert rt.router.metrics.snapshot()["failovers_total"] == 1
+        finally:
+            rt.httpd.shutdown()
+            alive.close()
+
+    def test_backpressure_body_death_is_connection_failure(self):
+        # a pod that dies WHILE sending its 429 is a dead pod, not
+        # backpressure: quarantine + failover, the client still gets 200
+        liar = FakePod()
+        liar.shed_truncated = True
+        honest = FakePod()
+        honest.serving = {"default": {"queue_depth": 5}}
+        rt = make_router([liar.url, honest.url])
+        try:
+            r = requests.post(rt.base + "/v1/generate",
+                              json={"tokens": [[1, 2, 3, 4]]})
+            assert r.status_code == 200 and r.json()["pod"] == honest.url
+            pod = rt.registry.pod(liar.url)
+            assert not pod.healthy and pod.status == "quarantined"
+        finally:
+            rt.httpd.shutdown()
+            liar.close()
+            honest.close()
+
+    def test_truncated_body_retries_from_scratch(self):
+        # mid-BODY pod death on a non-streaming request: nothing was
+        # committed to the client, so the router retries the next
+        # candidate — the client sees one complete 200, zero drops
+        liar = FakePod()
+        liar.truncate_body = True
+        honest = FakePod()
+        honest.serving = {"default": {"queue_depth": 5}}
+        rt = make_router([liar.url, honest.url])
+        try:
+            r = requests.post(rt.base + "/v1/generate",
+                              json={"tokens": [[1, 2, 3, 4]]})
+            assert r.status_code == 200 and r.json()["pod"] == honest.url
+            assert not rt.registry.pod(liar.url).healthy
+        finally:
+            rt.httpd.shutdown()
+            liar.close()
+            honest.close()
+
+    def test_sse_stream_relays_byte_identical(self):
+        fp = FakePod()
+        fp.stream_script = [
+            b'data: {"choices": [{"text": "he"}]}\n\n',
+            b'data: {"choices": [{"text": "llo"}]}\n\n',
+            b"data: [DONE]\n\n",
+        ]
+        rt = make_router([fp.url])
+        try:
+            r = requests.post(rt.base + "/v1/completions",
+                              json={"model": "default", "prompt": "hi",
+                                    "stream": True}, stream=True)
+            assert r.status_code == 200
+            assert "event-stream" in r.headers["Content-Type"]
+            body = b"".join(r.iter_content(chunk_size=None))
+            assert body == b"".join(fp.stream_script)
+        finally:
+            rt.httpd.shutdown()
+            fp.close()
+
+    def test_shed_feeds_rebalancer_pressure(self):
+        fp = FakePod()
+        fp.post_status = 429
+        registry = PodRegistry([fp.url], poll_interval_s=60.0)
+        registry.poll_once()
+        rb = Rebalancer(registry, allow=False)
+        router = FleetRouter(registry, rebalancer=rb, request_timeout_s=5.0)
+        httpd = route_serve(router, listen=f"127.0.0.1:{free_port()}")
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            r = requests.post(base + "/v1/generate", json={"tokens": [[1]]})
+            assert r.status_code == 429
+            assert rb.pressure().get("default") == 1
+        finally:
+            httpd.shutdown()
+            fp.close()
+
+
+# -- real pods: the acceptance drills ------------------------------------------
+
+
+def write_tiny(dirpath: str, seed: int = 0):
+    cfg = llama.LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+    os.makedirs(dirpath, exist_ok=True)
+    st.write_safetensors(
+        os.path.join(dirpath, "model.safetensors"),
+        {k: np.asarray(v) for k, v in params.items()},
+    )
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def tiny_server(tmp_path_factory):
+    """ONE loaded tiny model shared by every real pod in this module —
+    pods are HTTP fronts around it, so N pods cost one load."""
+    d = str(tmp_path_factory.mktemp("router-model"))
+    write_tiny(d)
+    server = ModelServer(d, mesh_spec="dp=1", max_seq_len=128, name="default")
+    server.load()
+    return server
+
+
+def new_pod(tiny_server):
+    """A real serving pod around the shared loaded model (its own
+    ServerSet + HTTP server; kill one without touching the others)."""
+    sset = ServerSet({"default": tiny_server})
+    sset.pool.mark_ready("default")
+    httpd = serve(sset, listen=f"127.0.0.1:{free_port()}")
+    return SimpleNamespace(
+        sset=sset, httpd=httpd,
+        url=f"http://127.0.0.1:{httpd.server_address[1]}")
+
+
+@pytest.fixture(scope="module")
+def fleet(tiny_server):
+    """3 real pods behind a live router (background poller running)."""
+    pods = [new_pod(tiny_server) for _ in range(3)]
+    registry = PodRegistry([p.url for p in pods], poll_interval_s=0.2)
+    router = FleetRouter(registry, request_timeout_s=30.0)
+    router.start()
+    httpd = route_serve(router, listen=f"127.0.0.1:{free_port()}")
+    ns = SimpleNamespace(
+        pods=pods, registry=registry, router=router, httpd=httpd,
+        base=f"http://127.0.0.1:{httpd.server_address[1]}")
+    yield ns
+    httpd.shutdown()
+    router.close()
+    for p in pods:
+        p.httpd.shutdown()
+
+
+class TestPodServingStats:
+    def test_admin_models_serving_block(self, tiny_server):
+        """The pod-side satellite: /admin/models carries the per-model
+        serving block (engine queue depth + prefix-cache stats) the
+        router ranks placement by — one endpoint, no /metrics scrape."""
+        from modelx_tpu.models.decode import PrefixKVCache
+
+        sset = ServerSet({"default": tiny_server}, continuous_batch=True)
+        sset.pool.mark_ready("default")
+        cb = None
+        try:
+            tiny_server._prefix_cache = PrefixKVCache(4)
+            cb = sset.continuous_for(tiny_server)  # force engine creation
+            httpd = serve(sset, listen=f"127.0.0.1:{free_port()}")
+            try:
+                url = f"http://127.0.0.1:{httpd.server_address[1]}"
+                admin = requests.get(url + "/admin/models").json()
+                stats = admin["serving"]["default"]
+                assert stats["queue_depth"] == 0
+                assert stats["engine_state"] == "running"
+                assert stats["prefix_cache"]["entries"] == 0
+                assert "hits" in stats["prefix_cache"]
+                # and the registry reads it into the placement table
+                reg = PodRegistry([url], poll_interval_s=60.0)
+                reg.poll_once()
+                assert reg.pod(url).queue_depth("default") == 0
+                assert "prefix_cache" in reg.pod(url).serving["default"]
+            finally:
+                httpd.shutdown()
+        finally:
+            tiny_server._prefix_cache = None
+            if cb is not None:
+                cb.close()
+                cb.release_device_state()
+
+
+class TestFleetAcceptance:
+    def test_sticky_hit_ratio_above_point_nine(self, fleet):
+        """Repeated-prefix conversations: after each conversation's first
+        turn every request sticky-hits, and each conversation pins to one
+        pod. (/v1/forward traffic: routing semantics are identical to
+        generate, and the single-forward pods keep the drill inside a
+        couple of compiled shapes.)"""
+        rng = np.random.RandomState(7)
+        convs = [[int(t) for t in rng.randint(1, 60, size=8)]
+                 for _ in range(6)]
+        before = fleet.router.sticky.stats()
+        conv_pods = [set() for _ in convs]
+        routes0 = dict(fleet.router.metrics.snapshot()["routes"])
+        done = sum(routes0.values())
+        for turn in range(12):
+            for i, conv in enumerate(convs):
+                r = requests.post(fleet.base + "/v1/forward",
+                                  json={"tokens": [conv]})
+                assert r.status_code == 200
+                # grow like a multi-turn chat (history + new turn); the
+                # HEAD stays fixed, which is what stickiness keys on
+                conv.extend(int(t) % 50 + 1
+                            for t in r.json()["logits_argmax"][0][-4:])
+                # route accounting lands a beat after the response: wait
+                # for THIS request's count before attributing it
+                done += 1
+                routes1 = wait_for(
+                    lambda: (s := fleet.router.metrics.snapshot()["routes"])
+                    and sum(s.values()) >= done and s)
+                conv_pods[i].update(
+                    u for u in routes1
+                    if routes1[u] != routes0.get(u, 0))
+                routes0 = dict(routes1)
+        after = fleet.router.sticky.stats()
+        hits = after["sticky_hits"] - before["sticky_hits"]
+        misses = after["sticky_misses"] - before["sticky_misses"]
+        ratio = hits / (hits + misses)
+        assert ratio > 0.9, f"sticky ratio {ratio:.3f} (h={hits} m={misses})"
+        # stickiness is per conversation: each stayed on one pod
+        assert all(len(pods) == 1 for pods in conv_pods), conv_pods
+
+    def test_routed_equals_direct(self, fleet):
+        body = {"tokens": [[3, 5, 7, 9, 11]], "max_new_tokens": 8}
+        direct = requests.post(fleet.pods[0].url + "/v1/generate", json=body)
+        routed = requests.post(fleet.base + "/v1/generate", json=body)
+        assert direct.status_code == routed.status_code == 200
+        assert routed.json()["tokens"] == direct.json()["tokens"]
+
+    def test_streaming_byte_identical(self, fleet):
+        """The routed NDJSON stream is byte-for-byte the pod's stream."""
+        body = {"tokens": [[2, 4, 6, 8]], "max_new_tokens": 16,
+                "stream": True}
+        direct = requests.post(fleet.pods[0].url + "/v1/generate",
+                               json=body, stream=True)
+        direct_bytes = b"".join(direct.iter_content(chunk_size=None))
+        routed = requests.post(fleet.base + "/v1/generate",
+                               json=body, stream=True)
+        routed_bytes = b"".join(routed.iter_content(chunk_size=None))
+        assert routed.status_code == 200
+        assert routed_bytes == direct_bytes
+        assert routed_bytes.endswith(b'{"done": true}\n')
+
+    def test_pod_kill_zero_dropped_nonstreaming(self, tiny_server):
+        """Kill the pod taking traffic: every non-streaming request still
+        answers 200 (retry-from-scratch failover), the dead pod is
+        quarantined, nothing is silently dropped."""
+        pods = [new_pod(tiny_server) for _ in range(3)]
+        # attach BEFORE any traffic: the switch only severs connections it
+        # has seen accepted, and the router's pooled keep-alive connection
+        # must die with the pod
+        kills = {p.url: PodKillSwitch(p.httpd) for p in pods}
+        registry = PodRegistry([p.url for p in pods], poll_interval_s=60.0)
+        registry.poll_once()
+        router = FleetRouter(registry, request_timeout_s=30.0)
+        httpd = route_serve(router, listen=f"127.0.0.1:{free_port()}")
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            body = {"tokens": [[1, 2, 3, 4]]}
+            r = requests.post(base + "/v1/forward", json=body)
+            assert r.status_code == 200
+            expect = r.json()["logits_argmax"]
+            # kill whichever pod served that first request
+            routes = wait_for(
+                lambda: (s := router.metrics.snapshot()["routes"])
+                and sum(s.values()) == 1 and s)
+            victim = next(p for p in pods if routes.get(p.url))
+            kills[victim.url].kill()
+            for _ in range(12):
+                r = requests.post(base + "/v1/forward", json=body)
+                assert r.status_code == 200
+                assert r.json()["logits_argmax"] == expect  # same model
+            dead = registry.pod(victim.url)
+            assert not dead.healthy and dead.status == "quarantined"
+            assert router.metrics.snapshot()["failovers_total"] >= 1
+        finally:
+            httpd.shutdown()
+            for p in pods:
+                p.httpd.shutdown()
+
+    def test_midstream_pod_death_is_typed_never_silent(self, tiny_server):
+        """Seeded drill (ISSUE 8 satellite): the pod dies after relaying K
+        stream chunks. The router must end the stream with the typed
+        UpstreamSeveredError payload — a client can always tell truncation
+        from completion — and quarantine the pod."""
+        pod = new_pod(tiny_server)
+        kill = PodKillSwitch(pod.httpd)
+        plan = FaultPlan(seed=11)
+        # die at the 3rd relayed chunk; the scheduled latency gives the
+        # router time to consume the first two (loopback TCP, determinism)
+        plan.add("pod.kill", errors_at=[2], error=RuntimeError("pod dies"),
+                 latency_at=[2], latency_s=0.3)
+        hook = kill.fire_kills(plan)
+        orig = pod.sset.stream_source
+
+        def severed_source(server, tokens, n, samp, stop_token_ids=None):
+            gen = orig(server, tokens, n, samp,
+                       stop_token_ids=stop_token_ids)
+
+            def run():
+                for piece in gen:
+                    hook()
+                    yield piece
+
+            return run()
+
+        pod.sset.stream_source = severed_source
+        registry = PodRegistry([pod.url], poll_interval_s=60.0)
+        registry.poll_once()
+        router = FleetRouter(registry, request_timeout_s=20.0)
+        httpd = route_serve(router, listen=f"127.0.0.1:{free_port()}")
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            r = requests.post(
+                base + "/v1/generate",
+                json={"tokens": [[9, 8, 7]], "max_new_tokens": 48,
+                      "stream": True},
+                stream=True)
+            assert r.status_code == 200
+            lines = list(r.iter_lines())
+            # some tokens relayed, then the TYPED error — never a body
+            # that just stops (the {"done": true} terminator must be
+            # absent and the error named)
+            payloads = [json.loads(ln) for ln in lines if ln]
+            assert any("tokens" in p for p in payloads)
+            errs = [p for p in payloads if "error" in p]
+            assert len(errs) == 1
+            assert "died mid-stream" in errs[0]["error"]
+            assert "incomplete" in errs[0]["error"]
+            assert not any(p.get("done") for p in payloads)
+            assert router.metrics.snapshot()["severed_streams_total"] == 1
+            assert registry.pod(pod.url).status == "quarantined"
+        finally:
+            httpd.shutdown()
+            pod.httpd.shutdown()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestFleetSoak:
+    def test_concurrent_soak_with_pod_kill(self, tiny_server):
+        """8 client threads x repeated-prefix traffic while a pod dies
+        mid-soak: every non-streaming response is a 200 with the expected
+        deterministic tokens (failover absorbs the kill), the dead pod is
+        quarantined, and sticky routing stays consistent for survivors."""
+        pods = [new_pod(tiny_server) for _ in range(3)]
+        kill0 = PodKillSwitch(pods[0].httpd)  # before any traffic
+        registry = PodRegistry([p.url for p in pods], poll_interval_s=0.2)
+        router = FleetRouter(registry, request_timeout_s=30.0)
+        router.start()
+        httpd = route_serve(router, listen=f"127.0.0.1:{free_port()}")
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        failures: list = []
+        expected: dict = {}
+        rng = np.random.RandomState(3)
+        prompts = [[int(t) for t in rng.randint(1, 60, size=6)]
+                   for _ in range(8)]
+        for p in prompts:
+            r = requests.post(base + "/v1/generate",
+                              json={"tokens": [p], "max_new_tokens": 4})
+            assert r.status_code == 200
+            expected[tuple(p)] = r.json()["tokens"]
+
+        stop = threading.Event()
+
+        def client(idx: int):
+            prompt = prompts[idx]
+            for _ in range(15):
+                try:
+                    r = requests.post(
+                        base + "/v1/generate",
+                        json={"tokens": [prompt], "max_new_tokens": 4},
+                        timeout=30)
+                    if r.status_code != 200:
+                        failures.append((idx, r.status_code, r.text[:200]))
+                    elif r.json()["tokens"] != expected[tuple(prompt)]:
+                        failures.append((idx, "wrong tokens"))
+                except requests.RequestException as e:
+                    failures.append((idx, repr(e)))
+                if stop.is_set():
+                    time.sleep(0.01)
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(len(prompts))]
+        try:
+            for t in threads:
+                t.start()
+            time.sleep(0.4)
+            kill0.kill()  # die under load
+            stop.set()
+            for t in threads:
+                t.join(timeout=60)
+            assert not failures, failures[:5]
+            assert not registry.pod(pods[0].url).healthy
+            snap = router.metrics.snapshot()
+            assert snap["requests_total"] >= 8 * 15
+        finally:
+            httpd.shutdown()
+            router.close()
+            for p in pods:
+                p.httpd.shutdown()
