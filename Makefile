@@ -5,7 +5,7 @@ PY ?= python
 IMAGE ?= modelx-tpu
 TAG ?= $(shell git describe --tags --always 2>/dev/null || echo dev)
 
-.PHONY: all native test chaos slow lifecycle fleet lint wheel image image-dl compose-up compose-down clean
+.PHONY: all native test chaos slow lifecycle fleet overload lint wheel image image-dl compose-up compose-down clean
 
 all: native lint test wheel
 
@@ -48,6 +48,14 @@ lifecycle:
 fleet:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_router.py tests/test_retry.py -q
 	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_router.py -q -m chaos
+
+# overload-protection drills (ISSUE 9): admission fairness / deadline
+# propagation / retry-budget / breaker units + HTTP drills, then the
+# 3-client storm with a mid-storm pod kill under runtime lockdep (the
+# admission controller brings its own condition-variable lock order)
+overload:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_admission.py -q -m "not slow"
+	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_admission.py -q -m chaos
 
 # two layers: the project-native concurrency/purity gate (always — it is
 # stdlib-only and baseline-governed, see docs/analysis.md), then generic

@@ -1282,6 +1282,101 @@ def measure_fleet(model_dir: str, *, pods: int = 3, clients: int = 4,
             round(hits / (hits + misses), 4) if hits + misses else None
         )
 
+        # fair-share storm (ISSUE 9): two clients — one 10x hotter —
+        # saturate the SAME pods through a second, admission-enabled
+        # router (fair_share + bounded backlog + retry budget on; the
+        # main router above keeps observe-only defaults, which is itself
+        # the no-behavior-change leg). Reported: Jain index of per-client
+        # goodput (1.0 = equal shares; FIFO would give the hot client
+        # ~10x), sheds by priority class, and retry amplification
+        # (upstream attempts per logical request; ~1.0 = no retry storm).
+        from modelx_tpu.router.admission import (
+            AdmissionController,
+            RetryBudget,
+            jain_index,
+        )
+
+        fair_registry = PodRegistry([p["url"] for p in pod_set],
+                                    poll_interval_s=0.5)
+        fair_router = FleetRouter(
+            fair_registry, request_timeout_s=30.0,
+            admission=AdmissionController(fair_share=2, max_backlog=8),
+            retry_budget=RetryBudget(ratio=0.2),
+        )
+        fair_router.start()
+        fhttpd = route_serve(fair_router, listen=f"127.0.0.1:{free_port()}")
+        fbase = f"http://127.0.0.1:{fhttpd.server_address[1]}"
+        try:
+            storm_prompt = rng.randint(1, vocab, (8,)).tolist()
+            goodput = {"hot": 0, "cold": 0}
+            storm_lock = threading.Lock()
+            stop_at = time.monotonic() + 5.0
+
+            def storm_client(name: str) -> None:
+                # /v1/forward traffic, like the sticky drill: admission
+                # semantics are identical for every proxied verb, and the
+                # single-forward service time packs enough completions
+                # into the window for the Jain index to mean something
+                sess = _requests.Session()
+                while time.monotonic() < stop_at:
+                    try:
+                        r = sess.post(
+                            fbase + "/v1/forward",
+                            json={"tokens": [storm_prompt]},
+                            headers={"X-ModelX-Client": name},
+                            timeout=30)
+                        ok = r.status_code == 200
+                    except _requests.RequestException:
+                        ok = False
+                    if ok:
+                        # goodput counts only completions INSIDE the
+                        # window: the backlogged (hot) client's queued
+                        # waiters all drain after stop_at, and counting
+                        # that tail would credit the monopolist with the
+                        # very backlog fairness denied it
+                        if time.monotonic() <= stop_at:
+                            with storm_lock:
+                                goodput[name] += 1
+                    else:
+                        # back off briefly on a shed: a zero-sleep 429
+                        # spin across 20 threads would burn the one-CPU
+                        # rig's cycles against the very router being
+                        # measured (real clients honor Retry-After)
+                        time.sleep(0.05)
+
+            # 10x rate asymmetry by connection count: 20 hot vs 2 cold.
+            # The cold client needs >= 2 connections to OCCUPY its fair
+            # slot share — a single closed-loop connection waits a full
+            # service time between its own grants and can never reach
+            # 50% goodput no matter how fair the scheduler is
+            storm_threads = [
+                threading.Thread(target=storm_client, args=("hot",),
+                                 daemon=True)
+                for _ in range(20)
+            ] + [
+                threading.Thread(target=storm_client, args=("cold",),
+                                 daemon=True)
+                for _ in range(2)
+            ]
+            for t in storm_threads:
+                t.start()
+            for t in storm_threads:
+                t.join()
+            out["fair_share_jain_index"] = jain_index(
+                [goodput["hot"], goodput["cold"]])
+            out["fair_share_goodput"] = dict(goodput)
+            adm = fair_router.admission.snapshot()
+            out["shed_429_count_by_class"] = dict(adm["shed_by_class"])
+            fm = fair_router.metrics.snapshot()
+            dispatched = fm["requests_total"] - fm["admission_shed_total"]
+            out["retry_amplification"] = (
+                round(fm["upstream_attempts_total"] / dispatched, 3)
+                if dispatched > 0 else None
+            )
+        finally:
+            fhttpd.shutdown()
+            fair_router.close()
+
         # pod-kill drill: kill the pod that owns a conversation, then time
         # kill -> first successful response for that same conversation
         target = convs[0]

@@ -58,6 +58,7 @@ _SERVER_PATH_FILES = (
     "modelx_tpu/router/server.py",
     "modelx_tpu/router/registry.py",
     "modelx_tpu/router/rebalance.py",
+    "modelx_tpu/router/admission.py",
 )
 
 _HANDLER_MODULES = (

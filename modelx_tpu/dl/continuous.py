@@ -127,15 +127,23 @@ class _Ticket:
     gone — the row's slot frees at the next chunk boundary instead of
     decoding to its full budget into a queue nobody drains (ADVICE r4).
     ``deadline`` (monotonic seconds, None = none) is set at submit from the
-    engine's --request-timeout: the loop expires the request at the next
-    chunk boundary once passed, whatever state it is in."""
+    engine's --request-timeout CLAMPED by any per-request budget the
+    transport propagated (the router's ``X-ModelX-Deadline-Ms``): the loop
+    expires the request at the next chunk boundary once passed, whatever
+    state it is in; ``timeout_s`` records the effective budget so the 504
+    names the number that actually applied."""
 
-    __slots__ = ("out", "cancelled", "deadline")
+    __slots__ = ("out", "cancelled", "deadline", "timeout_s", "restart")
 
     def __init__(self) -> None:
         self.out: "queue.Queue" = queue.Queue()
         self.cancelled = False
         self.deadline: float | None = None
+        self.timeout_s: float = 0.0
+        # set when a preempted fill re-enters the backlog: its exact
+        # restart goes ahead of newer arrivals (re-grab livelock guard),
+        # so priority-aware inserts must never cut in front of it
+        self.restart = False
 
     def cancel(self) -> None:
         self.cancelled = True
@@ -1186,9 +1194,7 @@ class ContinuousBatcher:
         if ticket.deadline is not None and time.monotonic() > ticket.deadline:
             # expired while queued: 504 BEFORE occupying a slot
             self.stats["expired"] += 1
-            ticket.out.put(
-                DeadlineExceededError("waiting for a slot", self.request_timeout_s)
-            )
+            ticket.out.put(self._deadline_error(ticket, "waiting for a slot"))
             return None
         slot = self._free.pop()
         s = len(ids)
@@ -1688,6 +1694,7 @@ class ContinuousBatcher:
         self._fill_order.remove(slot)
         self._release_slot(slot)
         self.stats["fill_preempts"] += 1
+        fill.ticket.restart = True  # head-of-backlog pin: see _Ticket
         self._preempted.append((fill.ids, fill.n, fill.samp, fill.ticket))
         self._backlog_add(1)  # back in the not-yet-admitted set
 
@@ -1715,9 +1722,10 @@ class ContinuousBatcher:
                 self._q.put(None)
                 break
             if isinstance(item, list):
-                self._waiting.extend(item)
+                for row_item in item:
+                    self._backlog_insert(row_item)
             else:
-                self._waiting.append(item)
+                self._backlog_insert(item)
         # only the head can admit next boundary; +2 covers slots that the
         # in-flight programs' plans just freed
         limit = len(self._free) + 2
@@ -1972,9 +1980,7 @@ class ContinuousBatcher:
                     self.stats["expired"] += 1
                     self._backlog_sub(1)
                     self._prep_memo.pop(ticket, None)
-                    ticket.out.put(
-                        DeadlineExceededError(state, self.request_timeout_s)
-                    )
+                    ticket.out.put(self._deadline_error(ticket, state))
                 else:
                     keep.append(item)
             lst[:] = keep
@@ -1990,14 +1996,12 @@ class ContinuousBatcher:
             if self._deadline_passed(fill.ticket, now):
                 self.stats["expired"] += 1
                 self._drop_fill(
-                    slot, DeadlineExceededError("prefilling", self.request_timeout_s)
+                    slot, self._deadline_error(fill.ticket, "prefilling")
                 )
         for row in self._rows.values():
             if not row.closed and self._deadline_passed(row.ticket, now):
                 self.stats["expired"] += 1
-                row.out.put(
-                    DeadlineExceededError("decoding", self.request_timeout_s)
-                )
+                row.out.put(self._deadline_error(row.ticket, "decoding"))
                 row.closed = True  # the sweep below frees the slot
 
     def _sweep_closed(self) -> None:
@@ -2157,7 +2161,8 @@ class ContinuousBatcher:
                         # so the whole burst hits ONE admission boundary
                         # (and shares an admit program) regardless of how
                         # fast this loop drains the queue
-                        self._waiting.extend(item)
+                        for row_item in item:
+                            self._backlog_insert(row_item)
                         continue
                     if item is None:
                         err = RuntimeError("continuous batcher closed")
@@ -2177,7 +2182,7 @@ class ContinuousBatcher:
                         # no slot (or, paged, not enough free pages): hold in
                         # the FIFO backlog and decode on — a retire this
                         # chunk frees capacity for it
-                        self._waiting.append(item)
+                        self._backlog_insert(item)
                         break
                     self._gather_prep(item, to_admit)
                 if to_admit:
@@ -2288,6 +2293,33 @@ class ContinuousBatcher:
                 row.out.put(err)
         self._tokens_in_flight = 0
         self._inflight_chunks = 0
+
+    @staticmethod
+    def _is_batch(item) -> bool:
+        samp = item[2]
+        return isinstance(samp, dict) and samp.get("priority") == "batch"
+
+    def _backlog_insert(self, item) -> None:
+        """Priority-aware FIFO: an interactive item queues ahead of the
+        TRAILING run of batch items, FIFO within each class — when the
+        backlog is mixed, the boundary scheduler admits interactive work
+        first (the router's shed-batch-first contract, continued inside
+        the engine). Two bounds on the cut-in: a restart-pinned ticket
+        (a preempted fill spliced at the head — its exact restart must
+        stay ahead of newer arrivals) is never crossed, and the backward
+        scan touches only the trailing batch run, so with no batch work
+        queued (the universal case) this IS a plain O(1) append."""
+        if not self._is_batch(item):
+            i = len(self._waiting)
+            while i > 0:
+                queued = self._waiting[i - 1]
+                if not self._is_batch(queued) or queued[3].restart:
+                    break
+                i -= 1
+            if i < len(self._waiting):
+                self._waiting.insert(i, item)
+                return
+        self._waiting.append(item)
 
     def _backlog_add(self, n: int) -> None:
         with self._close_lock:
@@ -2438,22 +2470,39 @@ class ContinuousBatcher:
             self._backlog += rows
             self._q.put(payload)
 
-    def _stamp_deadline(self, ticket: _Ticket) -> None:
-        if self.request_timeout_s > 0:
-            ticket.deadline = time.monotonic() + self.request_timeout_s
+    def _stamp_deadline(self, ticket: _Ticket, timeout_s: float | None = None) -> None:
+        """Effective budget = min(engine --request-timeout, the caller's
+        propagated remainder). A failover hop that re-submits therefore
+        never re-grants a fresh full timeout: the engine stops working
+        for a caller whose original budget is gone."""
+        eff = self.request_timeout_s if self.request_timeout_s > 0 else 0.0
+        if timeout_s is not None and timeout_s > 0:
+            eff = min(eff, float(timeout_s)) if eff > 0 else float(timeout_s)
+        if eff > 0:
+            ticket.deadline = time.monotonic() + eff
+            ticket.timeout_s = eff
 
-    def submit(self, ids: list[int], max_new_tokens: int, samp: dict) -> _Ticket:
+    def _deadline_error(self, ticket: _Ticket, state: str) -> DeadlineExceededError:
+        return DeadlineExceededError(
+            state, ticket.timeout_s or self.request_timeout_s
+        )
+
+    def submit(self, ids: list[int], max_new_tokens: int, samp: dict,
+               timeout_s: float | None = None) -> _Ticket:
         """Enqueue one prompt row; the returned ticket carries the output
         queue and a ``cancel()`` the transport calls when its client goes
-        away (the engine then frees the slot at the next chunk boundary)."""
+        away (the engine then frees the slot at the next chunk boundary).
+        ``timeout_s`` clamps the engine deadline to a propagated
+        per-request remainder (deadline propagation, ISSUE 9)."""
         self._validate(ids, max_new_tokens)
         self._check_quarantine(ids, max_new_tokens)
         ticket = _Ticket()
-        self._stamp_deadline(ticket)
+        self._stamp_deadline(ticket, timeout_s)
         self._enqueue((list(ids), int(max_new_tokens), dict(samp), ticket), 1)
         return ticket
 
-    def submit_many(self, rows: list[tuple[list[int], int, dict]]) -> list[_Ticket]:
+    def submit_many(self, rows: list[tuple[list[int], int, dict]],
+                    timeout_s: float | None = None) -> list[_Ticket]:
         """Enqueue several rows as ONE burst: the engine admits them at the
         same chunk boundary, so same-bucket rows share an admit program
         deterministically (a loop of ``submit`` calls races the engine
@@ -2463,7 +2512,7 @@ class ContinuousBatcher:
             self._check_quarantine(ids, n)
         tickets = [_Ticket() for _ in rows]
         for t in tickets:
-            self._stamp_deadline(t)
+            self._stamp_deadline(t, timeout_s)
         self._enqueue([
             (list(ids), int(n), dict(samp), t)
             for (ids, n, samp), t in zip(rows, tickets)
@@ -2491,7 +2540,9 @@ class ContinuousBatcher:
 
     def generate(self, tokens: np.ndarray, max_new_tokens: int = 16,
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
-                 seed: int = 0, stop_token_ids=None) -> np.ndarray:
+                 seed: int = 0, stop_token_ids=None,
+                 timeout_s: float | None = None,
+                 priority: str = "interactive") -> np.ndarray:
         """[B, S + m], matching ModelServer.generate: rows of a multi-row
         request become independent slots with seeds seed+i (the same
         per-row streams the ragged path derives). With ``stop_token_ids``,
@@ -2506,9 +2557,10 @@ class ContinuousBatcher:
         tickets = self.submit_many([
             (tokens[i].tolist(), max_new_tokens,
              {"temperature": temperature, "top_k": top_k, "top_p": top_p,
-              "seed": (seed + i) % (2**31), "stop_token_ids": stops})
+              "seed": (seed + i) % (2**31), "stop_token_ids": stops,
+              "priority": priority})
             for i in range(b)
-        ])
+        ], timeout_s=timeout_s)
         outs = [t.out for t in tickets]
         rows = []
         emitted = 0
@@ -2531,7 +2583,8 @@ class ContinuousBatcher:
     def stream(self, tokens: np.ndarray, max_new_tokens: int = 16,
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                seed: int = 0, chunk_size: int = 0,
-               stop_token_ids=None) -> Iterator[np.ndarray]:
+               stop_token_ids=None, timeout_s: float | None = None,
+               priority: str = "interactive") -> Iterator[np.ndarray]:
         """Single-row streaming: yields [1, k] arrays of new tokens as the
         engine decodes them (k == 1 for the prefill token, then up to the
         ENGINE's chunk size — the per-request chunk_size arg is accepted for
@@ -2543,7 +2596,9 @@ class ContinuousBatcher:
         ticket = self.submit(
             tokens[0].tolist(), max_new_tokens,
             {"temperature": temperature, "top_k": top_k, "top_p": top_p,
-             "seed": seed, "stop_token_ids": list(stop_token_ids or ())},
+             "seed": seed, "stop_token_ids": list(stop_token_ids or ()),
+             "priority": priority},
+            timeout_s=timeout_s,
         )
         try:
             for piece in self._drain_row(ticket.out):

@@ -42,6 +42,8 @@ from typing import Iterator
 
 import numpy as np
 
+from modelx_tpu.dl.serving_errors import deadline_kwargs
+
 logger = logging.getLogger("modelx.serve")
 
 OBJ_COMPLETION = "text_completion"
@@ -419,8 +421,14 @@ def eos_for(tok, req: dict) -> tuple[int, ...]:
     return tok.eos_ids()
 
 
-def run_completion(sset, req: dict, chat: bool) -> dict:
-    """Non-streaming completions/chat: returns the OpenAI response body."""
+def run_completion(sset, req: dict, chat: bool,
+                   timeout_s: float | None = None,
+                   priority: str = "interactive") -> dict:
+    """Non-streaming completions/chat: returns the OpenAI response body.
+    ``timeout_s``/``priority`` are the transport's propagated deadline
+    remainder and priority class — honored by the continuous engine
+    (clamping its per-request expiry), ignored by engines without
+    deadline machinery."""
     server = resolve_model(sset, req)
     tok = tokenizer_for(server)
     prompts = parse_prompts(req, chat, server)
@@ -448,18 +456,20 @@ def run_completion(sset, req: dict, chat: bool) -> dict:
     ]
     # the continuous engine can retire a row's slot AT its EOS; other
     # engines decode the full budget and the EOS trim happens below
-    stops_kw = (
-        {"stop_token_ids": list(eos)}
-        if eos and engine is sset.cbatchers.get(server.name)
-        else {}
-    )
+    continuous = engine is sset.cbatchers.get(server.name)
+    stops_kw = {"stop_token_ids": list(eos)} if eos and continuous else {}
+    # the deadline remainder + priority class reach only the continuous
+    # engine (per-request expiry clamp, interactive-first backlog); other
+    # engines have no deadline machinery to honor them with
+    deadline_kw = deadline_kwargs(timeout_s, priority) if continuous else {}
 
     def _one(ids: list[int]) -> list[list[int]]:
         # n samples of one prompt = n rows of the same ids in ONE engine
         # call: every engine derives per-row (seed + i) streams for
         # multi-row requests, which is exactly OpenAI's n semantics
         batch = np.asarray([ids] * n_samples, np.int32)
-        out = engine.generate(batch, max_new_tokens=n_tokens, **stops_kw, **samp)
+        out = engine.generate(batch, max_new_tokens=n_tokens,
+                              **stops_kw, **deadline_kw, **samp)
         return [row[len(ids):].tolist() for row in out]
 
     if len(id_rows) > 1 and engine is not server:
@@ -536,10 +546,14 @@ def run_completion(sset, req: dict, chat: bool) -> dict:
     return body
 
 
-def stream_completion(sset, req: dict, chat: bool) -> Iterator[dict]:
+def stream_completion(sset, req: dict, chat: bool,
+                      timeout_s: float | None = None,
+                      priority: str = "interactive") -> Iterator[dict]:
     """SSE event bodies for stream=true (single prompt only). The first
     ``next()`` performs all validation — callers pull one event before
-    committing a 200 so bad requests still fail with their real status."""
+    committing a 200 so bad requests still fail with their real status.
+    ``timeout_s``/``priority`` propagate to the continuous engine like
+    the non-streaming path's."""
     server = resolve_model(sset, req)
     tok = tokenizer_for(server)
     prompts = parse_prompts(req, chat, server)
@@ -581,7 +595,8 @@ def stream_completion(sset, req: dict, chat: bool) -> Iterator[dict]:
         # an EOS hit ends decode early (the stream layer drops the EOS
         # token from the content and reports finish_reason "stop")
         gen = sset.stream_source(server, np.asarray([ids], np.int32), n_tokens,
-                                 samp, stop_token_ids=list(eos) or None)
+                                 samp, stop_token_ids=list(eos) or None,
+                                 **deadline_kwargs(timeout_s, priority))
         # prime generation BEFORE yielding anything: the transport commits
         # its 200 after the first event, and a compile/decode failure must
         # surface as a real status even for chat (whose first event is the

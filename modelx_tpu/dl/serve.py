@@ -55,7 +55,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from modelx_tpu.dl import families as fam
-from modelx_tpu.dl.serving_errors import ModelLoadingError, ServingError
+from modelx_tpu.dl.serving_errors import (
+    DEADLINE_HEADER,
+    PRIORITY_HEADER,
+    DeadlineExceededError,
+    ModelLoadingError,
+    ServingError,
+    deadline_kwargs,
+    parse_deadline_ms,
+    parse_priority,
+)
 from modelx_tpu.parallel.mesh import make_mesh
 from modelx_tpu.utils import trace
 
@@ -1265,15 +1274,20 @@ class ServerSet:
         return server
 
     def stream_source(self, server: ModelServer, tokens, n: int, samp: dict,
-                      stop_token_ids=None):
+                      stop_token_ids=None, timeout_s: float | None = None,
+                      priority: str = "interactive"):
         """Streaming analogue of engine_for: a token-chunk iterator.
         Single-row streams join the continuous engine when enabled; all
         paths honor the operator's --stream-chunk-size and end early on a
-        stop-token hit."""
+        stop-token hit. ``timeout_s``/``priority`` (a propagated
+        X-ModelX-Deadline-Ms remainder + priority class) reach only the
+        continuous engine — the plain path has no deadline machinery, so
+        the handler's up-front expiry check is its whole contract."""
         cb = self.continuous_for(server)
         if cb is not None and tokens.shape[0] == 1:
             return cb.stream(tokens, max_new_tokens=n,
-                             stop_token_ids=stop_token_ids, **samp)
+                             stop_token_ids=stop_token_ids,
+                             timeout_s=timeout_s, priority=priority, **samp)
         return server.generate_stream(
             tokens, max_new_tokens=n, chunk_size=self.stream_chunk_size,
             stop_token_ids=stop_token_ids, **samp
@@ -1365,6 +1379,25 @@ class ServerSet:
         return m.group("model") if m else None
 
 
+def propagated_timeout(headers) -> float | None:
+    """The caller's remaining budget from ``X-ModelX-Deadline-Ms``
+    (stamped by the fleet router per upstream attempt; the header name
+    AND its parser are shared with the router via serving_errors so the
+    two halves of the wire contract cannot drift): None = no propagated
+    deadline, else remaining seconds (0.0 = the caller's budget is
+    ALREADY gone — answer 504 without doing any work). The engine clamps
+    its own --request-timeout to this remainder, so a router failover
+    never re-grants a fresh full timeout."""
+    return parse_deadline_ms(headers.get(DEADLINE_HEADER))
+
+
+def request_priority(headers) -> str:
+    """Priority class from ``X-ModelX-Priority`` (shared parser: batch
+    only on an explicit opt-in). Batch rows queue behind interactive
+    ones at the engine's admission boundary."""
+    return parse_priority(headers.get(PRIORITY_HEADER))
+
+
 def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingHTTPServer:
     sset = servers if isinstance(servers, ServerSet) else ServerSet({servers.name: servers})
 
@@ -1414,13 +1447,16 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                 except OSError:
                     pass
 
-        def _stream_generate(self, server, tokens, n, samp, stop_ids=None) -> None:
+        def _stream_generate(self, server, tokens, n, samp, stop_ids=None,
+                             timeout_s=None, priority="interactive") -> None:
             """One NDJSON line of NEW tokens per decoded chunk, then
             {"done": true}; concatenates to the non-streaming result.
             Single-row streams ride the continuous engine when enabled, so
             N concurrent SSE clients share one running decode instead of
             contending with N independent loops."""
-            gen = sset.stream_source(server, tokens, n, samp, stop_token_ids=stop_ids)
+            gen = sset.stream_source(server, tokens, n, samp,
+                                     stop_token_ids=stop_ids,
+                                     **deadline_kwargs(timeout_s, priority))
             try:
                 # pull the first chunk BEFORE committing a 200: an
                 # unsupported family / bad request must still be a 4xx
@@ -1462,9 +1498,22 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                 except ServingError as e:
                     api = oai.api_error_for(e)
                     return self._json(api.status, api.payload, headers=e.headers())
+            # deadline propagation + priority class, same contract as the
+            # native path: expired budgets 504 in the OpenAI error shape
+            # before any engine work, live ones clamp the engine deadline
+            timeout_s = propagated_timeout(self.headers)
+            priority = request_priority(self.headers)
+            if timeout_s is not None and timeout_s <= 0:
+                e = DeadlineExceededError("admitting", timeout_s)
+                api = oai.api_error_for(e)
+                if sset.pool is not None:
+                    sset.pool.exit(name)
+                return self._json(api.status, api.payload, headers=e.headers())
             try:
                 if bool(req.get("stream", False)):
-                    events = oai.stream_completion(sset, req, chat)
+                    events = oai.stream_completion(sset, req, chat,
+                                                   timeout_s=timeout_s,
+                                                   priority=priority)
                     try:
                         # validation + compile errors must surface as a real
                         # status, so pull the first event before the 200
@@ -1493,7 +1542,8 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                             else {"error": {"message": str(e), "type": "server_error"}}
                         ),
                     )
-                return self._json(200, oai.run_completion(sset, req, chat))
+                return self._json(200, oai.run_completion(
+                    sset, req, chat, timeout_s=timeout_s, priority=priority))
             except oai.APIError as e:
                 # typed lifecycle 503s raised inside the API layer carry
                 # Retry-After like the native surface's (satellite:
@@ -1695,6 +1745,17 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
             except ServingError as e:
                 return self._json(e.http_status, {"error": str(e)},
                                   headers=e.headers())
+            # deadline propagation (ISSUE 9): the router stamps each
+            # upstream attempt's REMAINING budget — a failover hop must
+            # not restart the clock. Already-expired budgets 504 before
+            # any tokenization or engine work; live ones clamp the
+            # engine's own --request-timeout below.
+            timeout_s = propagated_timeout(self.headers)
+            priority = request_priority(self.headers)
+            if timeout_s is not None and timeout_s <= 0:
+                e = DeadlineExceededError("admitting", timeout_s)
+                return self._json(e.http_status, {"error": str(e)},
+                                  headers=e.headers())
             if "text" in req and "tokens" in req:
                 # generating from the tokens while silently dropping the text
                 # would answer the wrong prompt; make the caller pick one
@@ -1842,7 +1903,9 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                                 "error": "stop_token_ids with stream is "
                                 "single-row only"
                             })
-                        return self._stream_generate(server, tokens, n, samp, stop_ids)
+                        return self._stream_generate(
+                            server, tokens, n, samp, stop_ids,
+                            timeout_s=timeout_s, priority=priority)
                     engine = sset.engine_for(
                         server, tokens.shape[0], samp["temperature"]
                     )
@@ -1850,9 +1913,13 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                         # the continuous engine honors stops server-side:
                         # every row's slot frees at its stop token (short
                         # rows come back padded with the stop; the trim
-                        # below cuts at the FIRST stop either way)
+                        # below cuts at the FIRST stop either way) — and
+                        # the propagated deadline remainder clamps the
+                        # per-request expiry
                         out = engine.generate(tokens, max_new_tokens=n,
-                                              stop_token_ids=stop_ids, **samp)
+                                              stop_token_ids=stop_ids,
+                                              timeout_s=timeout_s,
+                                              priority=priority, **samp)
                     else:
                         out = engine.generate(tokens, max_new_tokens=n, **samp)
                     rows = out.tolist()

@@ -37,6 +37,54 @@ import it at module top without cost.
 
 from __future__ import annotations
 
+# The overload-protection wire contract (ISSUE 9), shared by the router
+# (which stamps these on every upstream attempt) and the pods (which
+# honor them): the request's REMAINING deadline budget in milliseconds,
+# its priority class, and an explicit fairness identity. Defined here —
+# the one dependency-free module both sides already import — so the
+# router and pod halves of the contract cannot drift apart.
+DEADLINE_HEADER = "X-ModelX-Deadline-Ms"
+PRIORITY_HEADER = "X-ModelX-Priority"
+CLIENT_HEADER = "X-ModelX-Client"
+
+PRIORITY_INTERACTIVE = "interactive"
+PRIORITY_BATCH = "batch"
+
+
+def parse_priority(value) -> str:
+    """Header value -> priority class; anything but an explicit "batch"
+    is interactive (the default class must be the safe one)."""
+    return PRIORITY_BATCH if str(value or "").strip().lower() == PRIORITY_BATCH \
+        else PRIORITY_INTERACTIVE
+
+
+def parse_deadline_ms(value) -> float | None:
+    """``X-ModelX-Deadline-Ms`` header value -> remaining seconds
+    (>= 0.0; 0.0 = the caller's budget is already gone), or None when the
+    header is absent/malformed (no propagated deadline — the receiver's
+    own budget stands). ONE parser for both halves of the wire contract:
+    the router's clamp and the pod's honor must read the same number."""
+    if not value:
+        return None
+    try:
+        return max(0, int(float(value))) / 1000.0
+    except (TypeError, ValueError, OverflowError):
+        # OverflowError: "inf"/"1e400" parse as float but refuse int() —
+        # malformed like the rest, never an escaped handler exception
+        return None
+
+
+def deadline_kwargs(timeout_s: float | None, priority: str) -> dict:
+    """Engine-call kwargs for a propagated deadline/priority, included
+    ONLY when actually stamped — direct-pod traffic (and legacy-signature
+    test doubles of ``stream_source``) keep the pre-contract call shape."""
+    kw: dict = {}
+    if timeout_s is not None:
+        kw["timeout_s"] = timeout_s
+    if priority != PRIORITY_INTERACTIVE:
+        kw["priority"] = priority
+    return kw
+
 
 class ServingError(RuntimeError):
     """Base for typed serving failures; ``http_status`` is the canonical
@@ -56,9 +104,14 @@ class QueueFullError(ServingError):
     http_status = 429
     api_type = "rate_limit_error"
 
-    def __init__(self, depth: int, limit: int, retry_after: float = 1.0) -> None:
+    def __init__(self, depth: int, limit: int, retry_after: float = 1.0,
+                 message: str | None = None) -> None:
+        # ``message`` lets a non-backlog shed (the router's per-client
+        # rate ceiling) name its real cause instead of a queue that may
+        # not even exist; the 429 + Retry-After contract is unchanged
         super().__init__(
-            f"admission queue full ({depth} waiting, limit {limit}); retry later"
+            message
+            or f"admission queue full ({depth} waiting, limit {limit}); retry later"
         )
         self.retry_after = max(1, int(retry_after))
 

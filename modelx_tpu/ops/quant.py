@@ -64,13 +64,19 @@ class QTensor:
 
 
 try:  # auxdata is always None (pure pair pytree); empty-bytes round-trip
-    jax.export.register_pytree_node_serialization(
+    # import the submodule explicitly: jax < 0.6 doesn't bind ``export``
+    # on bare ``import jax``, so registering via ``jax.export.*`` only
+    # worked when some earlier import (aot_cache) had already bound it —
+    # an import-order dependency that silently skipped registration
+    from jax import export as _jax_export
+
+    _jax_export.register_pytree_node_serialization(
         QTensor,
         serialized_name="modelx_tpu.ops.quant.QTensor",
         serialize_auxdata=lambda aux: b"",
         deserialize_auxdata=lambda b: None,
     )
-except (AttributeError, ValueError):  # older jax / double registration
+except (ImportError, AttributeError, ValueError):  # older jax / double reg
     pass
 
 

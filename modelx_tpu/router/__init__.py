@@ -17,25 +17,39 @@ milliseconds and runs on boxes with no accelerator):
   ``/admin/models`` into a placement table; demotes on poll failure;
   immediate quarantine when the data path sees a connection die.
 - ``policy``    — sticky keys (the PrefixKVCache fingerprint idea lifted
-  to the HTTP layer) + the pick order: sticky first, then least queue
-  depth among READY pods, never DRAINING/broken.
+  to the HTTP layer) + the pick order: sticky first, then bounded-load
+  rendezvous anchor on a miss (two router replicas agree without shared
+  state), then least queue depth among READY pods, never DRAINING/broken.
+- ``admission`` — overload protection (PR 9, observe-only by default):
+  per-client weighted fair admission with drain-rate Retry-After,
+  Finagle-style retry budgets, per-pod 5xx circuit breakers, and the
+  deadline/priority header contract the pods honor.
 - ``server``    — the HTTP front door: proxies native + OpenAI bodies,
   streams SSE/NDJSON chunk-for-chunk (byte-identical), fails over within
-  the request deadline, surfaces mid-stream pod death as a typed error.
+  the request deadline (stamping the remaining budget upstream per
+  attempt), surfaces mid-stream pod death as a typed error.
 - ``rebalance`` — queue-pressure driven lifecycle actions (POST/DELETE
   ``/admin/models``), planning split from execution so the policy is
   unit-testable.
 - ``router_main`` — the ``modelx route`` / ``modelx-route`` CLI.
 """
 
+from modelx_tpu.router.admission import (
+    AdmissionController,
+    BreakerBoard,
+    RetryBudget,
+)
 from modelx_tpu.router.policy import StickyTable, sticky_keys
 from modelx_tpu.router.registry import PodRegistry, PodState
 from modelx_tpu.router.server import FleetRouter, route_serve
 
 __all__ = [
+    "AdmissionController",
+    "BreakerBoard",
     "FleetRouter",
     "PodRegistry",
     "PodState",
+    "RetryBudget",
     "StickyTable",
     "route_serve",
     "sticky_keys",

@@ -40,6 +40,10 @@ from collections import OrderedDict
 DEFAULT_WINDOW_TOKENS = 64
 MIN_WINDOW_TOKENS = 4
 CHARS_PER_TOKEN = 4
+# bounded-load rendezvous: the HRW anchor holds only while its effective
+# load is within this many requests of the least-loaded candidate —
+# replica agreement is worth a small queueing premium, not a hotspot
+HRW_LOAD_SLACK = 4
 
 
 def _crc(data: bytes) -> int:
@@ -181,6 +185,18 @@ class StickyTable:
             }
 
 
+def rendezvous_pod(key: tuple, candidates):
+    """Highest-random-weight (rendezvous) choice for a sticky key: every
+    candidate scores ``crc32(key | url)`` and the max wins. Deterministic
+    from (key, candidate set) alone — two router replicas that have never
+    exchanged a byte pick the SAME pod for the same prefix, and removing
+    a pod only remaps the conversations that scored it highest (the
+    consistent-hashing property, without a ring to maintain)."""
+    seed = repr(key).encode()
+    return max(candidates,
+               key=lambda p: (_crc(seed + b"|" + p.url.encode()), p.url))
+
+
 def plan_route(model: str, candidates, sticky: StickyTable,
                keys: list[tuple], inflight: dict[str, int]) -> list:
     """The ordered failover plan for one request: the sticky pod first
@@ -189,17 +205,36 @@ def plan_route(model: str, candidates, sticky: StickyTable,
     in-flight count per pod (the poll is up to an interval stale; the
     router's counts are exact for the traffic it originated).
 
+    A sticky MISS with a prompt falls back to rendezvous hashing on the
+    request's SMALLEST window key (the most stable fingerprint across a
+    growing conversation — and shared by every conversation with the
+    same opening head, so common system prompts colocate their prefix
+    KV) instead of the queue-depth tiebreak alone: two router replicas
+    then agree on the anchor pod without shared state. The anchor is
+    BOUNDED-LOAD, though: when its effective load exceeds the
+    least-loaded candidate's by more than ``HRW_LOAD_SLACK``, the plan
+    reverts to pure load order — a hot prefix herd must not pile onto
+    one pod past the point where losing replica agreement is cheaper
+    than the queueing. Failover order after the anchor stays by load,
+    and keyless requests (no prompt) route purely by load.
+
     Returns PodState objects; empty means no READY pod serves the model.
     """
     if not candidates:
         return []
     by_url = {p.url: p for p in candidates}
     url = sticky.lookup(keys, by_url)
-    ordered = sorted(
-        candidates,
-        key=lambda p: (inflight.get(p.url, 0) + p.queue_depth(model), p.url),
-    )
+
+    def load(p) -> int:
+        return inflight.get(p.url, 0) + p.queue_depth(model)
+
+    ordered = sorted(candidates, key=lambda p: (load(p), p.url))
     if url is None:
-        return ordered
+        if not keys:
+            return ordered
+        anchor = rendezvous_pod(keys[-1], candidates)
+        if load(anchor) > load(ordered[0]) + HRW_LOAD_SLACK:
+            return ordered
+        return [anchor] + [p for p in ordered if p.url != anchor.url]
     first = by_url[url]
     return [first] + [p for p in ordered if p.url != url]

@@ -16,6 +16,11 @@ import threading
 
 import click
 
+from modelx_tpu.router.admission import (
+    AdmissionController,
+    BreakerBoard,
+    RetryBudget,
+)
 from modelx_tpu.router.policy import DEFAULT_WINDOW_TOKENS, StickyTable
 from modelx_tpu.router.rebalance import Rebalancer
 from modelx_tpu.router.registry import PodRegistry
@@ -70,12 +75,45 @@ from modelx_tpu.router.server import FleetRouter, route_serve
 @click.option("--rebalance-cooldown", default=60.0, type=float,
               help="per (pod, model) cooldown after an action — a "
                    "pressure spike must not flap load/unload")
+@click.option("--fair-share", default=0, type=int,
+              help="concurrent upstream slots granted by the weighted "
+                   "fair scheduler: under saturation each active client "
+                   "converges to its fair share of pod queue slots "
+                   "instead of FIFO-by-arrival (0 = observe-only: "
+                   "per-client accounting lands in /metrics but nothing "
+                   "queues or sheds)")
+@click.option("--client-rate", default=0.0, type=float,
+              help="per-client request ceiling (req/s, burst 2x) keyed "
+                   "by API token / X-ModelX-Client / source IP; exceeding "
+                   "it sheds the typed 429 with a Retry-After from the "
+                   "bucket's refill clock (0 = off)")
+@click.option("--max-router-backlog", default=0, type=int,
+              help="requests the fair scheduler may hold waiting for an "
+                   "upstream slot; a full backlog sheds 429 — batch "
+                   "class first — with Retry-After computed from the "
+                   "observed drain rate (0 = unbounded)")
+@click.option("--retry-budget", default=0.0, type=float,
+              help="failover retry budget ratio (Finagle-style): first "
+                   "attempts deposit this many tokens, each failover "
+                   "attempt withdraws 1, so a fleet-wide brownout "
+                   "degrades to ~one upstream attempt per request "
+                   "instead of one per candidate (0 = unlimited retries)")
+@click.option("--breaker-threshold", default=0, type=int,
+              help="consecutive non-connection 5xx answers that OPEN a "
+                   "per-pod circuit breaker (skipped until a half-open "
+                   "probe succeeds); backpressure 429/503 never counts "
+                   "(0 = observe-only: would-open counts in /metrics)")
+@click.option("--breaker-cooldown", default=10.0, type=float,
+              help="seconds an OPEN breaker waits before letting one "
+                   "half-open probe request through")
 def main(pods: tuple[str, ...], listen: str, default_model: str,
          poll_interval: float, poll_timeout: float, request_timeout: float,
          connect_timeout: float, sticky_entries: int, sticky_window: int,
          pod_admin_token: str, allow_rebalance: bool,
          rebalance_queue_high: int, rebalance_interval: float,
-         rebalance_cooldown: float) -> None:
+         rebalance_cooldown: float, fair_share: int, client_rate: float,
+         max_router_backlog: int, retry_budget: float,
+         breaker_threshold: int, breaker_cooldown: float) -> None:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
     registry = PodRegistry(
@@ -92,6 +130,13 @@ def main(pods: tuple[str, ...], listen: str, default_model: str,
         rebalancer=rebalancer, default_model=default_model,
         request_timeout_s=request_timeout, connect_timeout_s=connect_timeout,
         sticky_window_tokens=sticky_window,
+        admission=AdmissionController(
+            fair_share=fair_share, client_rate=client_rate,
+            max_backlog=max_router_backlog,
+        ),
+        retry_budget=RetryBudget(ratio=retry_budget),
+        breakers=BreakerBoard(threshold=breaker_threshold,
+                              cooldown_s=breaker_cooldown),
     )
     router.start()
     httpd = route_serve(router, listen=listen)

@@ -44,8 +44,19 @@ from modelx_tpu.dl.serving_errors import (
     ModelDrainingError,
     ModelUnloadedError,
     NoReadyPodError,
+    QueueFullError,
     ServingError,
     UpstreamSeveredError,
+)
+from modelx_tpu.router.admission import (
+    DEADLINE_HEADER,
+    PRIORITY_HEADER,
+    AdmissionController,
+    BreakerBoard,
+    RetryBudget,
+    client_key,
+    parse_deadline_ms,
+    parse_priority,
 )
 from modelx_tpu.router.http import LazySession
 from modelx_tpu.router.policy import StickyTable, plan_route, sticky_keys
@@ -76,6 +87,10 @@ class RouterMetrics:
         self.severed_streams_total = 0            # typed mid-stream deaths
         self.backpressure_relayed_total = 0       # plan exhausted on 429/503
         self.no_pod_total = 0                     # NoReadyPodError answered
+        self.upstream_attempts_total = 0          # dispatches, retries included
+        self.retry_budget_exhausted_total = 0     # failover stopped by budget
+        self.breaker_skipped_total = 0            # candidates skipped while open
+        self.admission_shed_total = 0             # 429s the admission layer sent
 
     def count(self, attr: str, n: int = 1) -> None:
         with self._lock:
@@ -96,6 +111,10 @@ class RouterMetrics:
                 "severed_streams_total": self.severed_streams_total,
                 "backpressure_relayed_total": self.backpressure_relayed_total,
                 "no_pod_total": self.no_pod_total,
+                "upstream_attempts_total": self.upstream_attempts_total,
+                "retry_budget_exhausted_total": self.retry_budget_exhausted_total,
+                "breaker_skipped_total": self.breaker_skipped_total,
+                "admission_shed_total": self.admission_shed_total,
             }
 
 
@@ -107,6 +126,9 @@ class FleetRouter:
                  request_timeout_s: float = 60.0,
                  connect_timeout_s: float = 5.0,
                  sticky_window_tokens: int = 0,
+                 admission: AdmissionController | None = None,
+                 retry_budget: RetryBudget | None = None,
+                 breakers: BreakerBoard | None = None,
                  session=None) -> None:
         from modelx_tpu.router.policy import DEFAULT_WINDOW_TOKENS
 
@@ -117,6 +139,13 @@ class FleetRouter:
         self.request_timeout_s = float(request_timeout_s)
         self.connect_timeout_s = float(connect_timeout_s)
         self.sticky_window_tokens = int(sticky_window_tokens) or DEFAULT_WINDOW_TOKENS
+        # the overload-protection layer (router/admission.py): per-client
+        # fair admission, Finagle-style retry budget, per-pod breakers —
+        # the zero-knob defaults are all observe-only (accounting runs,
+        # nothing queues, sheds, or skips)
+        self.admission = admission or AdmissionController()
+        self.retry_budget = retry_budget or RetryBudget()
+        self.breakers = breakers or BreakerBoard()
         self.metrics = RouterMetrics()
         self._session = LazySession(session)
         self._inflight: dict[str, int] = {}
@@ -169,9 +198,22 @@ class FleetRouter:
 
     def pod_died(self, pod_url: str, reason: str) -> None:
         """Data-path death: quarantine + drop sticky assignments (the
-        pod's prefix cache died with it)."""
+        pod's prefix cache died with it). The breaker entry resets too —
+        quarantine owns recovery now, and a stale OPEN state must not
+        block the pod's first routed request after the poll restores it."""
         self.registry.quarantine(pod_url, reason)
         self.sticky.forget_pod(pod_url)
+        self.breakers.forget(pod_url)
+
+    def budget_for(self, headers) -> float:
+        """This request's total budget in seconds: the router's own
+        --request-timeout, CLAMPED by an incoming ``X-ModelX-Deadline-Ms``
+        (a chained router, or a client that knows its own patience) — the
+        budget only ever shrinks as it crosses hops."""
+        incoming = parse_deadline_ms(headers.get(DEADLINE_HEADER))
+        if incoming is None:  # absent/malformed: the router's budget stands
+            return self.request_timeout_s
+        return min(self.request_timeout_s, incoming)
 
     def resolve_model(self, path: str, req: dict) -> str | None:
         """The model a request addresses; None = unroutable path."""
@@ -190,6 +232,9 @@ class FleetRouter:
             "router": dict(self.metrics.snapshot(), **self.sticky.stats()),
             "pods": self.registry.snapshot(),
             "inflight": self.inflight(),
+            "admission": self.admission.snapshot(),
+            "retry_budget": self.retry_budget.snapshot(),
+            "breakers": self.breakers.snapshot(),
         }
         if self.rebalancer is not None:
             out["rebalance"] = self.rebalancer.snapshot()
@@ -297,16 +342,38 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
             model = router.resolve_model(self.path, req)
             if model is None:
                 return self._json(404, {"error": "not found"})
+            # the overload-protection front gate: fairness identity +
+            # priority class feed the admission controller BEFORE any pod
+            # sees the request; the deadline clamps to an incoming
+            # X-ModelX-Deadline-Ms so a chained hop never re-grants budget
+            client = client_key(self.headers, self.client_address)
+            priority = parse_priority(self.headers.get(PRIORITY_HEADER))
+            budget = router.budget_for(self.headers)
+            deadline = time.monotonic() + budget
             try:
-                self._route(model, req, raw)
+                router.admission.admit(client, priority=priority,
+                                       deadline=deadline, budget_s=budget)
+            except ServingError as e:
+                # 429 = overload shed; 504 = the caller's own budget
+                # expired while queued (same status the routing loop
+                # would answer a moment later)
+                if isinstance(e, QueueFullError):
+                    router.metrics.count("admission_shed_total")
+                return self._serving_error(self.path, e)
+            try:
+                self._route(model, req, raw, deadline, budget, priority)
             except ServingError as e:
                 self._serving_error(self.path, e)
+            finally:
+                router.admission.release(client)
 
-        def _route(self, model: str, req: dict, raw: bytes) -> None:
+        def _route(self, model: str, req: dict, raw: bytes,
+                   deadline: float, budget: float, priority: str) -> None:
             """Walk the failover plan until one pod's response is relayed.
             Raises typed ServingErrors (mapped by the caller); relays pod
-            statuses — success AND deterministic errors — verbatim."""
-            deadline = time.monotonic() + router.request_timeout_s
+            statuses — success AND deterministic errors — verbatim.
+            Failover attempts beyond the first draw from the retry
+            budget, and candidates with an OPEN breaker are skipped."""
             keys = sticky_keys(model, req, self.path,
                                window_tokens=router.sticky_window_tokens)
             stream = bool(req.get("stream", False))
@@ -327,13 +394,32 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
                 router.metrics.count("no_pod_total")
                 raise NoReadyPodError(model, detail=f"fleet state: {state}")
             last_bp = None  # (status, body, headers) of the last 429/503
+            attempted = False
             for pod in plan:
+                if not router.breakers.allow(pod.url):
+                    # breaker OPEN: this pod is mid-5xx-burst; skip it
+                    # without spending deadline or a retry token on it
+                    router.metrics.count("breaker_skipped_total")
+                    continue
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
-                    raise DeadlineExceededError("routing", router.request_timeout_s)
+                    # the 504 names the budget that ACTUALLY applied —
+                    # which an incoming deadline header may have clamped
+                    # below the router's own --request-timeout
+                    raise DeadlineExceededError("routing", budget)
+                if not attempted:
+                    router.retry_budget.record_attempt()
+                elif not router.retry_budget.allow_retry():
+                    # brownout protection: sustained failover is capped at
+                    # the budget's ratio of recent traffic — degrade to
+                    # ~one upstream attempt per request, no retry storms
+                    router.metrics.count("retry_budget_exhausted_total")
+                    break
+                attempted = True
                 router.enter(pod.url)
                 try:
-                    status, bp = self._try_pod(pod, raw, stream, remaining)
+                    status, bp = self._try_pod(pod, raw, stream, remaining,
+                                               priority)
                 finally:
                     router.exit(pod.url)
                 if status is not None:
@@ -370,16 +456,28 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
             router.metrics.count("no_pod_total")
             raise NoReadyPodError(model, detail="every candidate failed")
 
-        def _try_pod(self, pod, raw: bytes, stream: bool, remaining: float):
+        def _try_pod(self, pod, raw: bytes, stream: bool, remaining: float,
+                     priority: str):
             """One dispatch. Returns (status, backpressure): ``status``
             non-None when a response (any status outside the backpressure
             set) went to the client; ``backpressure`` carries a 429/503
             for the exhausted-plan path. (None, None) = connection-level
-            failure, pod quarantined."""
+            failure, pod quarantined.
+
+            Every attempt stamps the REMAINING deadline budget
+            (X-ModelX-Deadline-Ms) and the priority class upstream: a
+            failover attempt never re-grants the pod a fresh full
+            timeout, and the pod's engine stops decoding for callers
+            whose budget is gone (dl/serve.py honors the header)."""
+            router.metrics.count("upstream_attempts_total")
             try:
                 resp = router.http().request(
                     "POST", pod.url + self.path, data=raw,
-                    headers={"Content-Type": "application/json"},
+                    headers={
+                        "Content-Type": "application/json",
+                        DEADLINE_HEADER: str(max(1, int(remaining * 1000))),
+                        PRIORITY_HEADER: priority,
+                    },
                     stream=True,
                     timeout=(router.connect_timeout_s, remaining),
                 )
@@ -408,11 +506,26 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
                         [(k, v) for k, v in resp.headers.items()
                          if k.lower() in _HOP_HEADERS],
                     )
+                    # a 429/503 is a pod working CORRECTLY under load:
+                    # backpressure must never trip the 5xx breaker
+                    router.breakers.record(pod.url, True)
                     return None, bp
                 if stream and resp.status_code == 200:
                     ok = self._relay_stream(pod, resp)
                 else:
                     ok = self._relay_buffered(pod, resp)
+                if ok:
+                    # unexpected 5xx answers feed the pod's breaker (the
+                    # non-connection failure signal quarantine can't see).
+                    # 504 is exempt like 429/503: a pod expiring requests
+                    # whose PROPAGATED budget ran out is honoring this
+                    # PR's deadline contract, not malfunctioning — tight
+                    # caller deadlines must not open a healthy breaker.
+                    # Relay-failure paths settle elsewhere — death
+                    # quarantines + forgets, a slow read stays neutral
+                    router.breakers.record(
+                        pod.url,
+                        resp.status_code < 500 or resp.status_code == 504)
                 return (resp.status_code if ok else None), None
             finally:
                 resp.close()

@@ -341,7 +341,8 @@ class TestFleetLeg:
                     "fleet_tokens_per_s_routed", "fleet_throughput_scaling",
                     "fleet_traffic_errors", "sticky_hit_ratio",
                     "failover_recovery_ms", "fleet_dropped_requests",
-                    "fleet_failovers"):
+                    "fleet_failovers", "fair_share_jain_index",
+                    "shed_429_count_by_class", "retry_amplification"):
             assert key in out, key
         assert out["fleet_pods"] == 3
         assert out["fleet_traffic_errors"] == 0
@@ -352,6 +353,15 @@ class TestFleetLeg:
         # the kill drill recovered with zero dropped requests
         assert out["failover_recovery_ms"] is not None
         assert out["fleet_dropped_requests"] == 0
+        # the fair-share storm (ISSUE 9): one client at 10x the rate of
+        # the other converges to ~equal goodput shares through the
+        # admission-enabled router (FIFO would read ~0.6), sheds are
+        # typed by class, and healthy pods mean no retry amplification
+        assert out["fair_share_jain_index"] is not None
+        assert out["fair_share_jain_index"] >= 0.9
+        assert set(out["shed_429_count_by_class"]) == {"interactive", "batch"}
+        assert out["retry_amplification"] is not None
+        assert out["retry_amplification"] <= 1.2
         assert out["fleet_failovers"] >= 1
 
 

@@ -60,11 +60,14 @@ class FakePod:
         self.healthz = healthz
         self.post_status: int | None = None   # e.g. 429 to shed everything
         self.post_headers: dict = {}
+        self.status_script: list[int] | None = None  # per-request statuses
+        self.post_delay_s = 0.0               # think time before answering
         self.stream_script: list[bytes] | None = None
         self.truncate_body = False            # mid-body death (non-stream)
         self.shed_truncated = False           # dies WHILE sending its 429
         self.load_status = 202                # POST /admin/models answer
         self.requests: list = []              # recorded /v1 POST paths
+        self.seen_headers: list = []          # request headers per /v1 POST
         self.admin_loads: list = []
         self.admin_unloads: list = []
         pod = self
@@ -107,7 +110,16 @@ class FakePod:
                                                    "ref": req.get("ref", "")}
                     return self._json(pod.load_status, {"ok": True})
                 pod.requests.append((self.path, raw))
-                if pod.shed_truncated:
+                pod.seen_headers.append({k.lower(): v
+                                         for k, v in self.headers.items()})
+                if pod.post_delay_s:
+                    time.sleep(pod.post_delay_s)
+                if pod.status_script:
+                    status = pod.status_script.pop(0)
+                    if status != 200:
+                        return self._json(status, {"error": "scripted"},
+                                          headers=pod.post_headers)
+                elif pod.shed_truncated:
                     # a 429 whose body never completes: pod death mid-shed
                     self.send_response(429)
                     self.send_header("Content-Type", "application/json")
@@ -1018,9 +1030,9 @@ class TestFleetAcceptance:
         hook = kill.fire_kills(plan)
         orig = pod.sset.stream_source
 
-        def severed_source(server, tokens, n, samp, stop_token_ids=None):
+        def severed_source(server, tokens, n, samp, stop_token_ids=None, **kw):
             gen = orig(server, tokens, n, samp,
-                       stop_token_ids=stop_token_ids)
+                       stop_token_ids=stop_token_ids, **kw)
 
             def run():
                 for piece in gen:
