@@ -5,7 +5,7 @@ PY ?= python
 IMAGE ?= modelx-tpu
 TAG ?= $(shell git describe --tags --always 2>/dev/null || echo dev)
 
-.PHONY: all native test chaos slow lifecycle fleet overload lint wheel image image-dl compose-up compose-down clean
+.PHONY: all native test chaos slow lifecycle fleet overload programs lint wheel image image-dl compose-up compose-down clean
 
 all: native lint test wheel
 
@@ -56,6 +56,14 @@ fleet:
 overload:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_admission.py -q -m "not slow"
 	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_admission.py -q -m chaos
+
+# compiled-program registry drills (ISSUE 11): bundle build/install/
+# corruption/skew units + registry round-trips, then the slow set
+# (byte-exact bundle-vs-plain equality, chaos swap drill) under runtime
+# lockdep — the program install/publish hooks ride the pool's lock order
+programs:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_program_store.py -q -m "not slow"
+	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_program_store.py -q -m slow
 
 # two layers: the project-native concurrency/purity gate (always — it is
 # stdlib-only and baseline-governed, see docs/analysis.md), then generic

@@ -51,6 +51,7 @@ _SERVER_PATH_FILES = (
     "modelx_tpu/dl/openai_api.py",
     "modelx_tpu/dl/continuous.py",
     "modelx_tpu/dl/lifecycle.py",
+    "modelx_tpu/dl/program_store.py",
     "modelx_tpu/registry/server.py",
     "modelx_tpu/registry/store_fs.py",
     "modelx_tpu/registry/gc.py",

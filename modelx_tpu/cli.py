@@ -309,7 +309,21 @@ def cmd_verify(ref: str, quiet: bool, remote_: bool) -> None:
                     "--remote scrubs the whole repository; drop the @version "
                     "(or verify that version locally without --remote)"
                 )
-            out = r.client(quiet=True).remote.scrub(r.repository)
+            remote = r.client(quiet=True).remote
+            out = remote.scrub(r.repository)
+            # the scrub result is blob-level; count the compiled-program
+            # descriptors client-side so the audit reports how many of the
+            # verified blobs are program bundles
+            from modelx_tpu.types import MediaTypeModelProgram
+
+            count = 0
+            for m in remote.get_index(r.repository).manifests:
+                manifest = remote.get_manifest(r.repository, m.name)
+                count += sum(
+                    1 for b in manifest.blobs
+                    if b.media_type == MediaTypeModelProgram
+                )
+            out["program_blobs"] = count
             click.echo(json.dumps(out))
             if not out.get("clean", False):
                 sys.exit(1)
@@ -401,6 +415,129 @@ def cmd_gc(ref: str, grace: float | None) -> None:
         r = parse_reference(ref)
         result = r.client(quiet=True).remote.garbage_collect(r.repository, grace_s=grace)
         click.echo(json.dumps(result))
+    except (errors.ErrorInfo, ValueError) as e:
+        _fail(e)
+
+
+# -- programs (compiled-program bundles, dl/program_store.py) -----------------
+
+
+@main.group("programs")
+def cmd_programs() -> None:
+    """Compiled-program bundles: AOT executables shipped with the model."""
+
+
+@cmd_programs.command("list")
+@click.argument("ref", shell_complete=_complete_ref)
+def cmd_programs_list(ref: str) -> None:
+    """List the program bundles attached to a version (or, without
+    @version, to every version of the repository)."""
+    from modelx_tpu.types import (
+        AnnotationProgramBackend,
+        AnnotationProgramCode,
+        AnnotationProgramCount,
+        AnnotationProgramJax,
+        MediaTypeModelProgram,
+    )
+
+    try:
+        r = parse_reference(ref)
+        if not r.repository:
+            raise ValueError("reference must include a repository")
+        remote = r.client(quiet=True).remote
+        versions = [r.version] if r.version else [
+            m.name for m in remote.get_index(r.repository).manifests
+        ]
+        rows = []
+        for ver in versions:
+            manifest = remote.get_manifest(r.repository, ver)
+            for b in manifest.blobs:
+                if b.media_type != MediaTypeModelProgram:
+                    continue
+                rows.append([
+                    ver, b.name,
+                    b.annotations.get(AnnotationProgramCount, "?"),
+                    b.annotations.get(AnnotationProgramJax, "?"),
+                    b.annotations.get(AnnotationProgramBackend, "?"),
+                    b.annotations.get(AnnotationProgramCode, "?"),
+                    human_size(b.size),
+                ])
+        _table(["VERSION", "BUNDLE", "PROGRAMS", "JAX", "BACKEND", "CODE", "SIZE"], rows)
+    except (errors.ErrorInfo, ValueError) as e:
+        _fail(e)
+
+
+@cmd_programs.command("push")
+@click.argument("ref", shell_complete=_complete_ref)
+@click.option("--quantize", type=click.Choice(["int8"]), default=None,
+              help="export the surface for int8 weight-only deploys "
+                   "(the program shapes differ from bf16)")
+@click.option("--cache-dir", default="",
+              help="AOT cache dir to export into and bundle from (default: "
+                   "a temp dir — export, publish, discard)")
+def cmd_programs_push(ref: str, quantize: str | None, cache_dir: str) -> None:
+    """Export a model version's compiled surface and attach it as a
+    program bundle. Works from the manifest's tensor index alone — no
+    weight bytes are pulled; the next pod's pull then boots
+    compile-warm."""
+    import tempfile
+
+    try:
+        r = parse_reference(ref)
+        if not r.repository or not r.version:
+            raise ValueError("programs push needs repo@version "
+                             "(bundles pin the exact version they compile for)")
+        from modelx_tpu.dl import program_store
+        from modelx_tpu.dl.serve import enable_compile_cache
+
+        client = r.client(quiet=True)
+        manifest = client.get_manifest(r.repository, r.version)
+        with tempfile.TemporaryDirectory(prefix="modelx-programs-") as tmp:
+            out_dir = cache_dir or tmp
+            enable_compile_cache(out_dir)
+            family, cfg, sds, mesh = program_store.plan_from_manifest(
+                client, r.repository, manifest, quantize=quantize
+            )
+            keys = program_store.export_surface(family, cfg, sds, mesh, out_dir)
+            data = program_store.build_bundle(out_dir, keys=keys)
+            if data is None:
+                raise ValueError("no programs exported; nothing to push")
+            desc = program_store.publish(client.remote, r.repository, r.version, data)
+        click.echo(json.dumps({
+            "name": desc.name, "digest": str(desc.digest), "size": desc.size,
+            "programs": len(keys), "family": family.name,
+        }))
+    except (errors.ErrorInfo, ValueError, OSError) as e:
+        _fail(e)
+
+
+@cmd_programs.command("prune")
+@click.argument("ref", shell_complete=_complete_ref)
+def cmd_programs_prune(ref: str) -> None:
+    """Detach program bundles from a version (or every version without
+    @version). The blobs become unreferenced — the next gc sweep collects
+    them; weights and tokenizer files are untouched."""
+    from modelx_tpu.types import MediaTypeModelProgram
+
+    try:
+        r = parse_reference(ref)
+        if not r.repository:
+            raise ValueError("reference must include a repository")
+        remote = r.client(quiet=True).remote
+        versions = [r.version] if r.version else [
+            m.name for m in remote.get_index(r.repository).manifests
+        ]
+        removed = 0
+        for ver in versions:
+            manifest = remote.get_manifest(r.repository, ver)
+            keep = [b for b in manifest.blobs
+                    if b.media_type != MediaTypeModelProgram]
+            if len(keep) == len(manifest.blobs):
+                continue
+            removed += len(manifest.blobs) - len(keep)
+            manifest.blobs = keep
+            remote.put_manifest(r.repository, ver, manifest)
+        click.echo(json.dumps({"removed": removed, "versions": len(versions)}))
     except (errors.ErrorInfo, ValueError) as e:
         _fail(e)
 

@@ -156,8 +156,12 @@ def verify_repo(
     version: str = "",
     log: Callable[[str], None] = lambda s: None,
 ) -> dict:
-    """Re-hash every referenced blob; returns {versions, blobs, bytes,
-    errors: [str]} (shared blobs across versions hash once)."""
+    """Re-hash every referenced blob; returns {versions, blobs,
+    program_blobs, bytes, errors: [str]} (shared blobs across versions
+    hash once; program_blobs counts the compiled-program bundle
+    descriptors among them)."""
+    from modelx_tpu.types import MediaTypeModelProgram
+
     if version:
         versions = [version]
     else:
@@ -167,6 +171,7 @@ def verify_repo(
     problems: list[str] = []
     total_bytes = 0
     blob_count = 0
+    program_count = 0
     for ver in versions:
         try:
             manifest = remote.get_manifest(repository, ver)
@@ -175,6 +180,8 @@ def verify_repo(
             continue
         for desc in manifest.all_descriptors():
             blob_count += 1
+            if desc.media_type == MediaTypeModelProgram:
+                program_count += 1
             if desc.digest in seen:
                 if seen[desc.digest]:
                     problems.append(f"{ver}/{desc.name}: {seen[desc.digest]}")
@@ -197,4 +204,5 @@ def verify_repo(
             else:
                 log(f"ok    {ver}/{desc.name}")
     return {"versions": len(versions), "blobs": blob_count,
+            "program_blobs": program_count,
             "bytes": total_bytes, "errors": problems}

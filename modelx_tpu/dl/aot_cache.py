@@ -54,6 +54,24 @@ def _version_tag() -> str:
     return _code_version
 
 
+def code_version() -> str:
+    """Public handle on the package-source digest, for callers that stamp
+    artifacts with the environment they were built in (dl/program_store.py):
+    a bundle exported by different code must be rejected at install, not
+    deserialize a pre-fix program."""
+    return _version_tag()
+
+
+def artifact_name(key: str) -> str:
+    """Filename of the serialized export for ``key`` — the single naming
+    convention shared by load_or_compile and the program-store bundler."""
+    return f"aot-{key}.bin"
+
+
+def artifact_path(cache_dir: str, key: str) -> str:
+    return os.path.join(cache_dir, artifact_name(key))
+
+
 def cache_key(*parts) -> str:
     """Stable digest over everything that shapes the compiled program —
     including the framework version + git commit, because the program BODY
@@ -90,7 +108,7 @@ def load_or_compile(fn, args: tuple, cache_dir: str, key: str):
     persist it. Every failure falls back to the plain trace+lower+compile —
     the cache is an optimization, never load-bearing.
     """
-    path = os.path.join(cache_dir, f"aot-{key}.bin")
+    path = artifact_path(cache_dir, key)
     if os.path.isfile(path):
         try:
             with open(path, "rb") as f:
@@ -104,14 +122,24 @@ def load_or_compile(fn, args: tuple, cache_dir: str, key: str):
                 pass
     try:
         exp = jax.export.export(jax.jit(fn))(*args)
-        compiled = jax.jit(exp.call).lower(*args).compile()
+        # compile the serialize->deserialize ROUNDTRIP, not the in-memory
+        # export: the roundtrip perturbs the module bytes enough to change
+        # the persistent-XLA-cache key, so compiling `exp` directly would
+        # file that cache's executable under a key no warm start (which
+        # only ever sees deserialized artifacts) can hit — measured, the
+        # warm compile then pays the full XLA compile despite a "warm"
+        # cache dir. Compiling the roundtrip writes the entry the warm
+        # path (and every pod installing this node's program bundle,
+        # dl/program_store.py) will actually look up, and proves the
+        # artifact deserializes before it is persisted or shipped.
+        blob = exp.serialize()
+        warm = jax.export.deserialize(bytearray(blob))
+        compiled = jax.jit(warm.call).lower(*args).compile()
     except Exception as e:
         logger.warning("aot export failed (%s); plain compile", e)
         return jax.jit(fn).lower(*args).compile()
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
-        blob = exp.serialize()  # before open: a serialize error (e.g. an
-        # unregistered pytree node) must not leave an empty tmp file behind
         os.makedirs(cache_dir, exist_ok=True)
         with open(tmp, "wb") as f:
             f.write(blob)

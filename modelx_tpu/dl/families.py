@@ -668,6 +668,22 @@ def abstract_params(infos: dict, rules: Rules | None = None, mesh=None,
     return out
 
 
+def forward_program_key(family: Family, cfg, mode: str, token_shape: tuple,
+                        mesh, param_sds: dict) -> str:
+    """The aot_cache key for one (family, cfg, mode, shape, mesh, params)
+    program — the single source of key truth shared by precompile_forward,
+    precompile_score and the program-store bundler (dl/program_store.py), so
+    a published bundle's artifact names always match what a warm boot asks
+    the cache for."""
+    from modelx_tpu.dl import aot_cache
+
+    return aot_cache.cache_key(
+        family.name, cfg, mode, token_shape,
+        tuple(mesh.shape.items()) if mesh is not None else None,
+        aot_cache.describe_sds(param_sds),
+    )
+
+
 def precompile_forward(family: Family, cfg, param_sds: dict, token_shape: tuple,
                        mesh=None, mode: str = "forward", cache_dir: str = ""):
     """AOT-compile the prefill forward for one token shape from abstract
@@ -695,10 +711,44 @@ def precompile_forward(family: Family, cfg, param_sds: dict, token_shape: tuple,
     if cache_dir:
         from modelx_tpu.dl import aot_cache
 
-        key = aot_cache.cache_key(
-            family.name, cfg, mode, token_shape,
-            tuple(mesh.shape.items()) if mesh is not None else None,
-            aot_cache.describe_sds(param_sds),
+        key = forward_program_key(family, cfg, mode, token_shape, mesh, param_sds)
+        return aot_cache.load_or_compile(fn, (param_sds, tok), cache_dir, key)
+    return jax.jit(fn).lower(param_sds, tok).compile()
+
+
+def precompile_score(family: Family, cfg, param_sds: dict, token_shape: tuple,
+                     top_k: int = 0, mesh=None, cache_dir: str = ""):
+    """AOT-compile the scoring program (per-token logprobs of the given
+    continuations, optional top-k alternatives) for one padded token shape.
+    Body must stay identical to what serve.score_logprobs_rows historically
+    jitted inline — routing it through here lets the export ride the aot
+    cache and the program-store bundle like the forward ladder does.
+    Call the result with (params, tokens) of exactly ``token_shape``."""
+    import jax.numpy as jnp
+
+    k = int(top_k)
+
+    def fn(params, toks):
+        logits = family.forward(params, toks, cfg, mesh=mesh)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)  # [B, Lb, V]
+        nxt = jnp.concatenate(
+            [toks[:, 1:], jnp.zeros((toks.shape[0], 1), jnp.int32)],
+            axis=1,
+        )
+        chosen = jnp.take_along_axis(
+            lp, nxt[..., None], axis=-1
+        )[..., 0]  # position j scores token j+1
+        if k:
+            top_lp, top_id = jax.lax.top_k(lp, k)
+            return chosen, top_id, top_lp
+        return chosen, None, None
+
+    tok = jax.ShapeDtypeStruct(token_shape, jnp.int32)
+    if cache_dir:
+        from modelx_tpu.dl import aot_cache
+
+        key = forward_program_key(
+            family, cfg, f"score:{int(top_k)}", token_shape, mesh, param_sds
         )
         return aot_cache.load_or_compile(fn, (param_sds, tok), cache_dir, key)
     return jax.jit(fn).lower(param_sds, tok).compile()

@@ -25,6 +25,7 @@ from modelx_tpu.types import (
     AnnotationTensorIndex,
     BlobLocationPurposeDownload,
     Manifest,
+    MediaTypeModelProgram,
 )
 
 logger = logging.getLogger("modelx.dl")
@@ -35,6 +36,9 @@ def filter_blobs(manifest: Manifest, model_files: list[str]) -> Manifest:
 
     A modelFiles entry matches a blob when the blob is the entry itself or
     the entry's first path element (nested files live inside dir blobs).
+    Program bundles always ride along: modelFiles names weight/tokenizer
+    files, and silently filtering the compiled programs out would make a
+    selective pull boot cold for no reason.
     """
     if not model_files:
         return manifest
@@ -44,7 +48,10 @@ def filter_blobs(manifest: Manifest, model_files: list[str]) -> Manifest:
         if entry:
             wanted.add(entry)
             wanted.add(entry.split("/", 1)[0])  # top-level dir blob
-    blobs = [b for b in manifest.blobs if b.name in wanted]
+    blobs = [
+        b for b in manifest.blobs
+        if b.name in wanted or b.media_type == MediaTypeModelProgram
+    ]
     return Manifest(
         schema_version=manifest.schema_version,
         media_type=manifest.media_type,
@@ -195,6 +202,9 @@ def pull_model(uri: str, dest: str, cache=None, quiet: bool = True) -> dict:
         "dest": dest,
         "blobs": len(selected.blobs),
         "bytes": sum(b.size for b in selected.blobs),
+        "program_blobs": sum(
+            1 for b in selected.blobs if b.media_type == MediaTypeModelProgram
+        ),
         "cache_hits": cache_hits,
         "cache_admitted": admitted,
         "pull_seconds": round(time.monotonic() - t0, 3),

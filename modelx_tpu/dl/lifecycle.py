@@ -171,6 +171,10 @@ class ModelPool:
         # the local blob cache the pull path tees through (None = the
         # process default, dl/blob_cache.configure_default / --blob-cache-dir)
         self.blob_cache = blob_cache
+        # --publish-programs: after a ref-based load reaches READY, export
+        # this pod's compiled surface to the model's registry version so
+        # the next puller boots warm (dl/program_store.py)
+        self.publish_programs = False
         self.drain_timeout_s = float(drain_timeout_s)
         self._lock = threading.RLock()
         self._idle = threading.Condition(self._lock)  # inflight hit zero
@@ -514,6 +518,19 @@ class ModelPool:
                 return
             logger.info("model %s loaded at runtime (%s)", name,
                         e.ref or e.model_dir)
+            if self.publish_programs and e.ref:
+                # after READY, off the serving path: the model is already
+                # taking traffic — a publish failure only costs the next
+                # puller its warm start
+                from modelx_tpu.dl import program_store
+                from modelx_tpu.dl.serve import compile_cache_dir
+
+                try:
+                    program_store.publish_for_server(
+                        e.ref, server, compile_cache_dir()
+                    )
+                except Exception:
+                    logger.exception("program publish for %s failed", name)
         except BaseException as exc:  # FAILED is a state, not a crash
             logger.warning("runtime load of %s failed: %s", name, exc)
             staged = ""

@@ -1,0 +1,544 @@
+"""Compiled-program registry: ship AOT executables with the weights.
+
+Cold start is compile-bound, not byte-bound (BENCH r05: warm weights ready
+in ~330-480 ms while trace+lower+compile costs ~1.8 s of a ~2.3 s TTFT).
+dl/aot_cache.py already removes that cost for a *node* that compiled
+before; this module removes it for the *fleet*: the serialized
+``jax.export`` artifacts for a model's compiled surface (the pow2
+admit-width forward ladder, the first-token program, the score programs)
+are bundled into one deterministic tar and attached to the model version
+as a real manifest descriptor with its own mediaType
+(``application/vnd.modelx.program.v1``) — NOT an annotation — so sha256
+verification, scrub/quarantine, upload markers and GC referenced-digest
+tracking apply to program bytes exactly as they do to weight bytes.
+
+Flow: the first pod to compile publishes (``--publish-programs`` /
+``modelx programs push``); every later pod's pull brings the bundle
+through the blob cache and ``install_bundle`` drops the artifacts into
+the local AOT cache *before* the first compile, so
+``aot_cache.load_or_compile`` warm-starts. The store is an optimization,
+never load-bearing: any miss, version skew, truncation or corruption is
+logged and the caller proceeds to the plain trace+lower+compile path —
+a registry wiped of program blobs behaves exactly like today.
+
+A bundle carries two member kinds, both required for a truly warm boot:
+the ``jax.export`` artifacts (``aot-<hex>.bin`` — skip trace+lower) and
+the persistent-XLA-cache executables those exports compile into
+(``jit_call-<hex>-cache`` — skip the backend compile; ``jit_call`` is
+the module name every aot_cache compile carries, so the engine's donated
+decode programs, which compile under their own names and are
+deliberately node-local, never ship). XLA entries are content-addressed
+by jax itself — an entry built for a different backend/topology/flag set
+has a key the puller never computes, so at worst it sits unused.
+
+Trust boundary: member names inside a bundle must look like AOT cache
+entries or XLA executables (the two regexes below) and every member is
+re-hashed against the bundle's own meta.json before it touches the cache
+dir — a tampered or truncated bundle installs nothing. The bundle is
+keyed by environment (jax version, backend, package-source digest):
+programs exported by different code never deserialize here, they are
+skipped wholesale.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import io
+import json
+import logging
+import os
+import re
+import tarfile
+
+from modelx_tpu.types import (
+    AnnotationProgramBackend,
+    AnnotationProgramCode,
+    AnnotationProgramCount,
+    AnnotationProgramJax,
+    Descriptor,
+    Digest,
+    Manifest,
+    MediaTypeModelProgram,
+)
+
+logger = logging.getLogger("modelx.programs")
+
+BUNDLE_FORMAT = 1
+META_MEMBER = "meta.json"
+# the only shapes of member name a bundle may carry: an AOT cache entry
+# (serialized jax.export) or the persistent-XLA-cache executable such an
+# export compiles into. Anything else (paths, traversal, stray files,
+# jax's -atime bookkeeping companions) is rejected at install.
+_ARTIFACT_RE = re.compile(r"^aot-[0-9a-f]{8,64}\.bin$")
+_XLA_RE = re.compile(r"^jit_call-[0-9a-f]{64}-cache$")
+
+
+def _member_name_ok(name: str) -> bool:
+    return bool(_ARTIFACT_RE.match(name) or _XLA_RE.match(name))
+
+
+def _env() -> tuple[str, str, str]:
+    import jax
+
+    from modelx_tpu.dl import aot_cache
+
+    return jax.__version__, jax.default_backend(), aot_cache.code_version()
+
+
+def env_key() -> str:
+    """Digest of (jax version, backend, package-source digest) — the bundle
+    compatibility domain. One bundle per environment coexists in a
+    manifest; republishing from the same environment replaces it."""
+    jx, backend, code = _env()
+    h = hashlib.sha256(f"{jx}\x00{backend}\x00{code}".encode())
+    return h.hexdigest()[:12]
+
+
+def bundle_name() -> str:
+    """Dotfile on purpose: push.parse_manifest_from_dir skips dotfiles, so
+    a model dir holding a pulled bundle re-pushes cleanly — programs only
+    ever attach to a manifest through :func:`publish`."""
+    return f".programs-{env_key()}.tar"
+
+
+# --- bundle build -------------------------------------------------------------
+
+
+def build_bundle(cache_dir: str, keys=None) -> bytes | None:
+    """Pack serialized exports from ``cache_dir`` into a deterministic tar
+    (sorted members, zeroed mtimes/owners): same artifacts => same bytes
+    => same content address, so republishing an unchanged surface is a
+    registry no-op. ``keys=None`` bundles every AOT entry in the dir;
+    otherwise only the named cache keys (missing ones are skipped — the
+    bundle describes what this node actually compiled). The dir's
+    ``jit_call`` XLA executables always ride along: jax content-addresses
+    them internally, so they cannot be mapped to cache keys from here,
+    and an extra entry costs bytes while a missing one costs every puller
+    the backend compile. Returns None when there is nothing to ship."""
+    from modelx_tpu.dl import aot_cache
+
+    if keys is None:
+        paths = sorted(glob.glob(os.path.join(cache_dir, "aot-*.bin")))
+    else:
+        paths = sorted(
+            aot_cache.artifact_path(cache_dir, k) for k in dict.fromkeys(keys)
+        )
+    paths += sorted(glob.glob(os.path.join(cache_dir, "jit_call-*-cache")))
+    artifacts = []
+    members = []
+    for path in paths:
+        name = os.path.basename(path)
+        if not _member_name_ok(name):
+            continue
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            logger.warning("program bundle: skipping unreadable %s: %s", name, e)
+            continue
+        artifacts.append(
+            {"name": name, "sha256": hashlib.sha256(data).hexdigest(), "size": len(data)}
+        )
+        members.append((name, data))
+    if not members:
+        return None
+    jx, backend, code = _env()
+    meta = {
+        "formatVersion": BUNDLE_FORMAT,
+        "jax": jx,
+        "backend": backend,
+        "codeVersion": code,
+        "artifacts": artifacts,
+    }
+    meta_bytes = json.dumps(meta, sort_keys=True, separators=(",", ":")).encode()
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w", format=tarfile.USTAR_FORMAT) as tar:
+        for name, data in [(META_MEMBER, meta_bytes)] + members:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = 0
+            info.uid = info.gid = 0
+            info.uname = info.gname = ""
+            info.mode = 0o644
+            tar.addfile(info, io.BytesIO(data))
+    return buf.getvalue()
+
+
+# --- bundle install -----------------------------------------------------------
+
+
+def install_bundle(data: bytes, cache_dir: str) -> dict:
+    """Install a bundle's artifacts into the local AOT cache dir.
+
+    Never raises: every failure mode — undecodable tar, missing/invalid
+    meta, environment skew, tampered or truncated member — is logged,
+    counted, and skipped, so the caller's compile path simply stays cold.
+    Existing cache entries are never overwritten (the local node's own
+    exports are at least as fresh as any bundle)."""
+    stats = {"installed": 0, "present": 0, "skipped": 0, "reasons": []}
+
+    def _skip(reason: str, n: int = 1) -> dict:
+        stats["skipped"] += n
+        stats["reasons"].append(reason)
+        logger.warning("program install: %s", reason)
+        return stats
+
+    try:
+        tar = tarfile.open(fileobj=io.BytesIO(data), mode="r:")
+    except (tarfile.TarError, ValueError, EOFError) as e:
+        return _skip(f"unreadable bundle: {e}")
+    with tar:
+        try:
+            member = tar.getmember(META_MEMBER)
+            meta = json.loads(tar.extractfile(member).read())
+        except (KeyError, tarfile.TarError, ValueError, AttributeError, OSError) as e:
+            return _skip(f"bundle meta unreadable: {e}")
+        if not isinstance(meta, dict) or meta.get("formatVersion") != BUNDLE_FORMAT:
+            return _skip(f"unsupported bundle format {meta.get('formatVersion')!r}"
+                         if isinstance(meta, dict) else "bundle meta is not an object")
+        jx, backend, code = _env()
+        got = (meta.get("jax"), meta.get("backend"), meta.get("codeVersion"))
+        if got != (jx, backend, code):
+            # the whole bundle is for another world: programs exported by
+            # different code/framework must never deserialize here
+            return _skip(
+                "version skew: bundle built for jax=%s backend=%s code=%s, "
+                "local jax=%s backend=%s code=%s" % (*got, jx, backend, code),
+                n=len(meta.get("artifacts") or ()),
+            )
+        os.makedirs(cache_dir, exist_ok=True)
+        for art in meta.get("artifacts") or ():
+            name = art.get("name", "") if isinstance(art, dict) else ""
+            if not _member_name_ok(name):
+                _skip(f"artifact name {name!r} rejected")
+                continue
+            target = os.path.join(cache_dir, name)
+            if os.path.exists(target):
+                stats["present"] += 1
+                continue
+            try:
+                blob = tar.extractfile(tar.getmember(name)).read()
+            except (KeyError, tarfile.TarError, AttributeError, OSError) as e:
+                _skip(f"artifact {name} unreadable: {e}")
+                continue
+            if len(blob) != art.get("size") or hashlib.sha256(blob).hexdigest() != art.get(
+                "sha256"
+            ):
+                _skip(f"artifact {name} fails hash/size check; not installing")
+                continue
+            tmp = f"{target}.tmp.{os.getpid()}"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(blob)
+                os.replace(tmp, target)  # atomic: racing installs/compiles
+            except OSError as e:
+                _skip(f"artifact {name} write failed: {e}")
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    logger.debug("program install: tmp cleanup failed for %s", tmp)
+                continue
+            stats["installed"] += 1
+    return stats
+
+
+def install_from_dir(model_dir: str, cache_dir: str) -> dict:
+    """Install every pulled program bundle found in a model dir (the
+    lifecycle/boot path: pull_model drops ``.programs-*.tar`` next to the
+    weights). Aggregated stats; never raises."""
+    total = {"bundles": 0, "installed": 0, "present": 0, "skipped": 0, "reasons": []}
+    for path in sorted(glob.glob(os.path.join(model_dir, ".programs-*.tar"))):
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            total["reasons"].append(f"{os.path.basename(path)}: {e}")
+            logger.warning("program install: cannot read %s: %s", path, e)
+            continue
+        total["bundles"] += 1
+        stats = install_bundle(data, cache_dir)
+        for k in ("installed", "present", "skipped"):
+            total[k] += stats[k]
+        total["reasons"].extend(stats["reasons"])
+    return total
+
+
+# --- registry plumbing --------------------------------------------------------
+
+
+def program_descriptors(manifest: Manifest) -> list[Descriptor]:
+    return [b for b in manifest.blobs if b.media_type == MediaTypeModelProgram]
+
+
+def publish(remote, repository: str, version: str, data: bytes) -> Descriptor:
+    """Attach a bundle to an existing model version as a real descriptor.
+
+    The blob uploads first (content-addressed dedup via HEAD), then the
+    manifest is re-PUT with the descriptor merged in by name — same-env
+    republish replaces, other-env bundles coexist. The server's commit
+    verification re-checks every referenced digest; a delta-shaped 400
+    gets one blob re-upload + retry, the push.Pusher discipline."""
+    from modelx_tpu import errors
+    from modelx_tpu.client.push import commit_delta_digests
+
+    meta = _bundle_meta(data)
+    name = bundle_name()
+    desc = Descriptor(
+        name=name,
+        media_type=MediaTypeModelProgram,
+        digest=Digest.from_bytes(data),
+        size=len(data),
+        annotations={
+            AnnotationProgramJax: meta["jax"],
+            AnnotationProgramBackend: meta["backend"],
+            AnnotationProgramCode: meta["codeVersion"],
+            # programs, not members: the XLA executables are support acts
+            AnnotationProgramCount: str(_program_count(meta)),
+        },
+    )
+    if not remote.head_blob(repository, desc.digest):
+        remote.upload_blob_content(repository, desc, data)
+    manifest = remote.get_manifest(repository, version)
+    manifest.blobs = [b for b in manifest.blobs if b.name != name] + [desc]
+    try:
+        remote.put_manifest(repository, version, manifest)
+    except errors.ErrorInfo as e:
+        if str(desc.digest) not in commit_delta_digests(e):
+            raise
+        # our blob lost a race (GC sweep / quarantine between upload and
+        # commit): re-push it and commit once more
+        remote.upload_blob_content(repository, desc, data)
+        remote.put_manifest(repository, version, manifest)
+    return desc
+
+
+def _bundle_meta(data: bytes) -> dict:
+    with tarfile.open(fileobj=io.BytesIO(data), mode="r:") as tar:
+        meta = json.loads(tar.extractfile(tar.getmember(META_MEMBER)).read())
+    if not isinstance(meta, dict) or not isinstance(meta.get("artifacts"), list):
+        raise ValueError("program bundle meta.json is not a bundle manifest")
+    return meta
+
+
+def _program_count(meta: dict) -> int:
+    """Exported programs in a bundle meta (XLA executable members not
+    counted — one program may or may not carry one, and "how many compiled
+    surfaces warm-start" is the number every caller reports)."""
+    return sum(
+        1 for a in meta.get("artifacts") or ()
+        if isinstance(a, dict) and _ARTIFACT_RE.match(a.get("name", ""))
+    )
+
+
+def bundle_program_count(data: bytes) -> int:
+    return _program_count(_bundle_meta(data))
+
+
+def pull_and_install(client, repository: str, manifest: Manifest,
+                     cache_dir: str, cache=None) -> dict:
+    """Fetch the manifest's program bundles (blob cache first — re-swaps
+    are disk-warm) and install them into the local AOT cache. Corrupt
+    bytes (digest mismatch) are logged and skipped, never installed;
+    transport errors likewise — the caller's compile path just stays
+    cold. Never raises."""
+    total = {"bundles": 0, "installed": 0, "present": 0, "skipped": 0, "reasons": []}
+    for desc in program_descriptors(manifest):
+        # a bundle stamped for another environment is skew by construction;
+        # don't spend bytes on it (install_bundle re-checks via meta.json
+        # anyway, for bundles with absent/wrong annotations)
+        code = desc.annotations.get(AnnotationProgramCode)
+        if code is not None and code != _env()[2]:
+            total["skipped"] += 1
+            total["reasons"].append(f"{desc.name}: version skew (annotation)")
+            continue
+        try:
+            data = _read_blob(client, repository, desc, cache=cache)
+        except Exception as e:
+            total["reasons"].append(f"{desc.name}: {e}")
+            logger.warning("program pull: %s unavailable: %s", desc.name, e)
+            continue
+        if data is None:
+            total["reasons"].append(f"{desc.name}: digest mismatch")
+            continue
+        total["bundles"] += 1
+        stats = install_bundle(data, cache_dir)
+        for k in ("installed", "present", "skipped"):
+            total[k] += stats[k]
+        total["reasons"].extend(stats["reasons"])
+    return total
+
+
+def _read_blob(client, repository: str, desc: Descriptor, cache=None) -> bytes | None:
+    """Blob bytes via the local blob cache when possible, the registry
+    otherwise; always digest-verified (None = corrupt). Network reads are
+    admitted into the cache so the next swap is disk-warm."""
+    if cache is not None and desc.digest:
+        hit = cache.lookup(desc.digest, expected_size=desc.size or -1)
+        if hit is not None:
+            try:
+                with open(hit, "rb") as f:
+                    data = f.read()
+                if str(Digest.from_bytes(data)) == str(desc.digest):
+                    return data
+                logger.warning("program pull: cached %s corrupt; refetching", desc.name)
+            except OSError as e:
+                logger.warning("program pull: cache read of %s failed: %s", desc.name, e)
+    data = b"".join(client.remote.get_blob_content(repository, desc.digest))
+    if str(Digest.from_bytes(data)) != str(desc.digest):
+        logger.warning(
+            "program pull: %s/%s bytes do not match their address; discarding",
+            repository, desc.name,
+        )
+        return None
+    if cache is not None and desc.digest:
+        _admit(cache, str(desc.digest), data)
+    return data
+
+
+def _admit(cache, digest: str, data: bytes) -> None:
+    import tempfile
+
+    try:
+        fd, tmp = tempfile.mkstemp(dir=cache.root, prefix=".programs-admit-")
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+    except OSError as e:
+        logger.warning("program pull: blob-cache spool failed: %s", e)
+        return
+    if cache.admit_file(digest, tmp) is None:
+        logger.warning("program pull: blob-cache admit refused %s", digest)
+
+
+# --- compiled-surface export --------------------------------------------------
+
+
+def export_surface(family, cfg, param_sds: dict, mesh, cache_dir: str,
+                   widths=(1, 2, 4, 8), seq: int = 16,
+                   first_token_shapes=((1, 4), (1, 16)),
+                   score_shapes=((1, 16),), top_ks=(0,)) -> list[str]:
+    """Compile (and thereby serialize into ``cache_dir``) the model's
+    standard compiled surface from abstract params — no weights needed:
+    the pow2 admit-width forward ladder (serve's batcher shapes), the
+    first-token programs (the TTFT path), and the score programs. Returns
+    the cache keys, in bundle order. Per-program failures are logged and
+    skipped — an unexportable rung only loses its own warm start."""
+    from modelx_tpu.dl import families as fam
+
+    keys: list[str] = []
+
+    def _one(label, key, fn):
+        try:
+            fn()
+        except Exception as e:
+            logger.warning("program export %s failed: %s", label, e)
+            return
+        keys.append(key)
+
+    for w in widths:
+        shape = (int(w), int(seq))
+        key = fam.forward_program_key(family, cfg, "argmax_all", shape, mesh, param_sds)
+        _one(f"argmax_all{shape}", key, lambda shape=shape: fam.precompile_forward(
+            family, cfg, param_sds, shape, mesh=mesh, mode="argmax_all",
+            cache_dir=cache_dir))
+    for shape in first_token_shapes:
+        key = fam.forward_program_key(family, cfg, "argmax_last", shape, mesh, param_sds)
+        _one(f"argmax_last{shape}", key, lambda shape=shape: fam.precompile_forward(
+            family, cfg, param_sds, shape, mesh=mesh, mode="argmax_last",
+            cache_dir=cache_dir))
+    for shape in score_shapes:
+        for k in top_ks:
+            key = fam.forward_program_key(
+                family, cfg, f"score:{int(k)}", shape, mesh, param_sds
+            )
+            _one(f"score{shape}:{k}", key, lambda shape=shape, k=k: fam.precompile_score(
+                family, cfg, param_sds, shape, top_k=int(k), mesh=mesh,
+                cache_dir=cache_dir))
+    return keys
+
+
+def plan_from_manifest(client, repository: str, manifest: Manifest,
+                       quantize: str | None = None, cache=None):
+    """(family, cfg, param_sds, mesh) for a model known only by its
+    manifest — the tensor-index annotations (ranged header reads as the
+    fallback) fully determine the compiled surface, so ``modelx programs
+    push`` can export without pulling a single weight byte."""
+    import struct
+
+    import jax
+
+    from modelx_tpu.dl import families as fam
+    from modelx_tpu.dl import safetensors as st
+    from modelx_tpu.dl.initializer import _blob_source
+    from modelx_tpu.dl.loader import fuse_expert_tensors
+    from modelx_tpu.parallel.mesh import make_mesh
+    from modelx_tpu.types import AnnotationTensorIndex
+
+    infos: dict = {}
+    for blob in manifest.blobs:
+        if not blob.name.endswith(".safetensors"):
+            continue
+        if AnnotationTensorIndex in blob.annotations:
+            parsed, _off = st.parse_index_annotation(blob.annotations[AnnotationTensorIndex])
+        else:
+            source = _blob_source(client, repository, blob, cache=cache)
+            try:
+                (hlen,) = struct.unpack("<Q", bytes(source.read_range(0, 8)))
+                parsed = st.parse_header(bytes(source.read_range(8, hlen)))
+            finally:
+                if hasattr(source, "close"):
+                    source.close()
+        infos.update(parsed)
+    if not infos:
+        raise ValueError(f"{repository}: manifest has no safetensors blobs")
+    family = fam.detect(list(infos))
+    infos = fuse_expert_tensors(infos, family.rules)
+    cfg = family.infer_config(fam.abstract_params(infos))
+    mesh = make_mesh(f"dp={len(jax.devices())}")
+    sds = fam.abstract_params(infos, family.rules, mesh, quantize=quantize)
+    return family, cfg, sds, mesh
+
+
+def publish_for_server(ref: str, server, cache_dir: str) -> Descriptor | None:
+    """Best-effort publish of a freshly loaded server's compiled surface —
+    the ``--publish-programs`` hook dl/lifecycle.py runs after mark_ready.
+    Bundles the surface keys this server's shapes map to (only those its
+    AOT cache actually holds) and attaches them to the model version it
+    was loaded from. Returns the descriptor, or None when there is
+    nothing to publish."""
+    from modelx_tpu.client.reference import parse_reference
+    from modelx_tpu.dl import families as fam
+
+    sds = getattr(server, "_param_sds", None)
+    if not cache_dir or sds is None or server.family is None:
+        return None
+    keys = [
+        fam.forward_program_key(server.family, server.cfg, "argmax_all",
+                                shape, server.mesh, sds)
+        for shape in server.WARMUP_TOKEN_SHAPES
+    ]
+    for (lb, bb, top_k) in list(server._score_progs):
+        keys.append(fam.forward_program_key(
+            server.family, server.cfg, f"score:{int(top_k)}", (bb, lb),
+            server.mesh, sds,
+        ))
+    from modelx_tpu.dl import aot_cache
+
+    keys = [k for k in keys if os.path.isfile(aot_cache.artifact_path(cache_dir, k))]
+    data = build_bundle(cache_dir, keys=keys)
+    if data is None:
+        return None
+    parsed = parse_reference(ref)
+    if not parsed.version:
+        # a bare ref resolves "latest" on GET, but publishing must pin the
+        # exact version whose surface this is — refuse rather than mint a
+        # literal "latest" version in the registry
+        logger.warning("programs publish skipped: %s names no version", ref)
+        return None
+    client = parsed.client(quiet=True)
+    desc = publish(client.remote, parsed.repository, parsed.version, data)
+    logger.info(
+        "published %d compiled programs for %s (%s, %d bytes)",
+        len(keys), ref, desc.name, desc.size,
+    )
+    return desc

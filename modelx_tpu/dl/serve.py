@@ -196,7 +196,17 @@ def enable_compile_cache(path: str = "") -> None:
     try:
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        # no min-compile-time floor: the program store (dl/program_store.py)
+        # ships this cache's executables fleet-wide, and a program under
+        # the default 1 s threshold would stay cold on EVERY pod — small
+        # entries cost bytes once, a fleet of retraces costs TTFT always
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        # keep the cache-dir PATH out of the cache key: with XLA side
+        # caches on, jax points xla_gpu_per_fusion_autotune_cache_dir at a
+        # subdir of `path`, which lands in the hashed compile options — so
+        # two pods with different cache dirs (or the bench's fresh per-leg
+        # dirs) could never hit each other's shipped executables
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
         _compile_cache_dir = path
     except Exception as e:  # cache is an optimization, never fatal
         logger.warning("compile cache unavailable: %s", e)
@@ -257,6 +267,7 @@ class ModelServer:
         self.family: fam.Family | None = None
         self.params: dict | None = None
         self._forward_aot: dict[tuple, object] = {}
+        self._param_sds: dict | None = None  # abstract params, set by load()
         self._decoders: dict[int, object] = {}  # chunk_size -> ChunkedDecoder
         self._score_progs: dict[tuple, object] = {}  # (len bucket, top_k)
         self._decoders_lock = threading.Lock()
@@ -285,6 +296,23 @@ class ModelServer:
             paths = sorted(glob.glob(os.path.join(self.model_dir, "*.safetensors")))
             if not paths:
                 raise FileNotFoundError(f"no safetensors under {self.model_dir}")
+            # program-store bundles pulled alongside the weights install
+            # into the AOT cache BEFORE any compile below — the warmup
+            # thread then warm-starts from another pod's exports. Purely
+            # an optimization: any failure just compiles cold.
+            cache_dir = compile_cache_dir()
+            if cache_dir:
+                from modelx_tpu.dl import program_store
+
+                try:
+                    pstats = program_store.install_from_dir(self.model_dir, cache_dir)
+                    if pstats["bundles"] or pstats["skipped"]:
+                        self.stats["programs"] = {
+                            k: pstats[k]
+                            for k in ("bundles", "installed", "present", "skipped")
+                        }
+                except Exception as e:
+                    logger.warning("program bundle install failed: %s", e)
             # detect the family from the headers so the right partition rules
             # apply from the first byte fetched
             infos_all: dict = {}
@@ -315,6 +343,9 @@ class ModelServer:
             sds = fam.abstract_params(
                 infos_all, self.family.rules, self.mesh, quantize=self.quantize
             )
+            # kept for the program store: surface keys (publish) and score
+            # program AOT routing both need the abstract params later
+            self._param_sds = sds
             compile_thread = threading.Thread(
                 target=self._precompile_warmup, args=(sds,), daemon=True
             )
@@ -476,6 +507,26 @@ class ModelServer:
             if prog is None:
                 with self._decoders_lock:
                     prog = self._score_progs.get(key)
+                    if prog is None and self._param_sds is not None:
+                        # route through the AOT cache (families.precompile_score
+                        # shares the inline closure's exact body): warm pods —
+                        # and pods that pulled a program bundle — skip the
+                        # trace+lower; any failure falls through to the
+                        # plain jit below
+                        cache_dir = compile_cache_dir()
+                        if cache_dir:
+                            try:
+                                prog = fam.precompile_score(
+                                    self.family, self.cfg, self._param_sds,
+                                    (bb, lb), top_k=int(top_k), mesh=self.mesh,
+                                    cache_dir=cache_dir,
+                                )
+                                self._score_progs[key] = prog
+                            except Exception as e:
+                                logger.warning(
+                                    "score precompile %s failed (%s); plain jit",
+                                    key, e,
+                                )
                     if prog is None:
                         family, cfg, mesh = self.family, self.cfg, self.mesh
 

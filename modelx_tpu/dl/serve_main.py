@@ -145,6 +145,12 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
                    "/admin/models pulls+loads a registry ref while traffic "
                    "is live, DELETE /admin/models/{name} drains and frees "
                    "one (GET /admin/models always reports states)")
+@click.option("--publish-programs", is_flag=True,
+              help="after a runtime (registry-ref) load reaches READY, "
+                   "export the pod's compiled programs and attach them to "
+                   "the model version as a program bundle "
+                   "(application/vnd.modelx.program.v1) so the next "
+                   "puller boots compile-warm")
 @click.option("--admin-token", "admin_tokens", multiple=True,
               help="bearer token accepted on the /admin surface "
                    "(repeatable; none = anonymous admin — dev pods only)")
@@ -167,6 +173,7 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
          prefix_cache: int, prefix_cache_max_bytes: int,
          quantize: str | None, speculative_k: int,
          hbm_budget_bytes: int, evict_idle: bool, allow_admin_load: bool,
+         publish_programs: bool,
          admin_tokens: tuple[str, ...], staging_dir: str,
          loras: tuple[str, ...], drain_seconds: float) -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
@@ -269,6 +276,15 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
         prefix_cache_size=prefix_cache,
         prefix_cache_max_bytes=prefix_cache_max_bytes,
     )
+    if publish_programs:
+        if sset.pool is not None:
+            sset.pool.publish_programs = True
+        if not allow_admin_load:
+            logging.getLogger("modelx.serve").warning(
+                "--publish-programs only fires on runtime (registry-ref) "
+                "loads; without --allow-admin-load none happen — use "
+                "`modelx programs push` to publish for boot-loaded models"
+            )
     if evict_idle and not hbm_budget_bytes:
         logging.getLogger("modelx.serve").warning(
             "--evict-idle is inert without --hbm-budget-bytes "

@@ -36,7 +36,7 @@ import time
 
 def measure_once(base: str, repo: str, cache_dir: str = "",
                  version: str = "v1", quantize: str | None = None,
-                 blob_cache_dir: str = "") -> dict:
+                 blob_cache_dir: str = "", publish_programs: bool = False) -> dict:
     import jax
     import numpy as np
 
@@ -70,6 +70,19 @@ def measure_once(base: str, repo: str, cache_dir: str = "",
     t0 = time.monotonic()
     client = Client(base, quiet=True)
     manifest = client.get_manifest(repo, version)
+    # program bundles published by an earlier pod install into the AOT
+    # cache BEFORE the compile thread starts — the trace+lower is then a
+    # deserialize. On-the-clock on purpose: the pull+install cost is part
+    # of the TTFT being measured. Never load-bearing: any failure leaves
+    # the compile path cold.
+    programs_installed = 0
+    if cache_dir:
+        from modelx_tpu.dl import program_store
+
+        pstats = program_store.pull_and_install(
+            client, repo, manifest, cache_dir, cache=blob_cache
+        )
+        programs_installed = pstats["installed"] + pstats["present"]
     infos: dict = {}
     blobs = []
     for blob in manifest.blobs:
@@ -140,6 +153,23 @@ def measure_once(base: str, repo: str, cache_dir: str = "",
     first = fwd(params, jax.numpy.asarray(prompt))
     np.asarray(first)
     t_token = time.monotonic()
+    programs_published = 0
+    if publish_programs and cache_dir:
+        # off the clock: publishing is the NEXT pod's warm start, not part
+        # of this one's TTFT
+        from modelx_tpu.dl import program_store
+
+        try:
+            data = program_store.build_bundle(cache_dir)
+            if data is not None:
+                program_store.publish(client.remote, repo, version, data)
+                programs_published = program_store.bundle_program_count(data)
+        except Exception as e:
+            import logging
+
+            logging.getLogger("modelx.programs").warning(
+                "ttft program publish failed: %s", e
+            )
     return {
         "ttft_ms": round((t_token - t0) * 1e3, 1),
         "plan_ms": round((t_plan - t0) * 1e3, 1),
@@ -152,19 +182,27 @@ def measure_once(base: str, repo: str, cache_dir: str = "",
         # how many safetensors blobs the local blob cache served (zero
         # network reads); == len(blobs) on a fully warm restart
         "warm_blobs": warm_blobs,
+        # AOT artifacts available locally after the bundle install (pulled
+        # + already-present); > 0 means the compile leg warm-started
+        "programs_installed": programs_installed,
+        "programs_published": programs_published,
     }
 
 
 def main(argv: list[str]) -> int:
     if len(argv) < 3:
         print("usage: python -m modelx_tpu.dl.ttft <registry> <repo> "
-              "[cache_dir] [quantize] [blob_cache_dir]", file=sys.stderr)
+              "[cache_dir] [quantize] [blob_cache_dir] [publish]",
+              file=sys.stderr)
         return 2
     out = measure_once(
         argv[1], argv[2],
         cache_dir=argv[3] if len(argv) > 3 else "",
         quantize=(argv[4] or None) if len(argv) > 4 else None,
         blob_cache_dir=argv[5] if len(argv) > 5 else "",
+        # "publish" as argv[6]: after measuring, export+attach this
+        # process's compiled programs (the bench's first-pod-pays leg)
+        publish_programs=(len(argv) > 6 and argv[6] == "publish"),
     )
     print(json.dumps(out))
     return 0

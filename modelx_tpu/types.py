@@ -30,6 +30,11 @@ MediaTypeModelManifestJson = "application/vnd.modelx.model.manifest.v1.json"
 MediaTypeModelConfigYaml = "application/vnd.modelx.model.config.v1.yaml"
 MediaTypeModelFile = "application/vnd.modelx.model.file.v1"
 MediaTypeModelDirectoryTarGz = "application/vnd.modelx.model.directory.v1.tar+gzip"
+# compiled-program bundle (dl/program_store.py): a deterministic tar of
+# serialized jax.export artifacts, attached to a model version as a real
+# blob descriptor — sha256 verification, scrub/quarantine, upload markers
+# and GC reference tracking all apply to it unchanged
+MediaTypeModelProgram = "application/vnd.modelx.program.v1"
 
 # --- annotation keys ---------------------------------------------------------
 
@@ -38,6 +43,13 @@ AnnotationFileMode = "filemode"  # types.go:13
 AnnotationShardMesh = "modelx.shard.mesh"
 AnnotationShardSpec = "modelx.shard.spec"
 AnnotationTensorIndex = "modelx.tensor.index"
+# program-bundle environment stamp (jax version / backend / code digest):
+# lets a puller pick the matching bundle from the manifest alone — the
+# install path re-verifies against the bundle's own meta.json regardless
+AnnotationProgramJax = "modelx.program.jax"
+AnnotationProgramBackend = "modelx.program.backend"
+AnnotationProgramCode = "modelx.program.code"
+AnnotationProgramCount = "modelx.program.artifacts"
 
 # --- blob location purposes (types.go:16-19) ---------------------------------
 

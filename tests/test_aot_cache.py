@@ -65,7 +65,10 @@ class TestAOTCache:
         cold = fam.precompile_forward(
             family, cfg, sds, (1, 4), mesh=mesh, mode="argmax_last", cache_dir=cache
         )
-        assert calls["export"] == 1 and calls["deserialize"] == 0
+        # the cold path compiles the serialize->deserialize ROUNDTRIP of
+        # its export (one deserialize), so the persistent-XLA-cache entry
+        # lands under the key warm starts compute (dl/program_store.py)
+        assert calls["export"] == 1 and calls["deserialize"] == 1
         blobs = [f for f in os.listdir(cache) if f.startswith("aot-")]
         assert len(blobs) == 1
 
@@ -73,7 +76,7 @@ class TestAOTCache:
             family, cfg, sds, (1, 4), mesh=mesh, mode="argmax_last", cache_dir=cache
         )
         # warm start read the blob instead of retracing
-        assert calls["export"] == 1 and calls["deserialize"] == 1
+        assert calls["export"] == 1 and calls["deserialize"] == 2
 
         p = {k: jnp.asarray(np.asarray(v)) for k, v in params.items()}
         np.testing.assert_array_equal(np.asarray(cold(p, tokens)), np.asarray(warm(p, tokens)))

@@ -1,0 +1,581 @@
+"""Compiled-program registry (ISSUE 11, dl/program_store.py).
+
+Three layers, mirroring the trust boundary:
+
+- **bundle units** (fake artifacts, no compiles): deterministic build,
+  install round-trip for both member kinds, and the full corruption /
+  skew / truncation ladder — every bad input installs nothing and never
+  raises.
+- **registry round-trip** (hermetic in-process RegistryServer): the
+  bundle is a *real descriptor*, so publish/pull, GC referenced-digest
+  tracking (incl. the in-flight upload-marker drill), scrub/quarantine,
+  `verify` counting and the pull paths are asserted against the same
+  invariants weights get.
+- **real compiles** (one tier-1 warm-boot test; byte-exact equality and
+  the pool swap drill are slow-marked, `make programs`).
+"""
+
+import hashlib
+import io
+import json
+import os
+import shutil
+import tarfile
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from modelx_tpu.client.client import Client
+from modelx_tpu.dl import aot_cache
+from modelx_tpu.dl import program_store as ps
+from modelx_tpu.registry.fs import MemoryFSProvider
+from modelx_tpu.registry.server import Options, RegistryServer, free_port
+from modelx_tpu.registry.store_fs import FSRegistryStore
+from modelx_tpu.types import Digest, MediaTypeModelProgram
+
+AOT_NAME = "aot-" + "ab" * 8 + ".bin"
+AOT_NAME2 = "aot-" + "cd" * 8 + ".bin"
+XLA_NAME = "jit_call-" + "0" * 64 + "-cache"
+
+
+def fill_cache(d: str, members=((AOT_NAME, b"export-one"), (XLA_NAME, b"xla-exec"))):
+    os.makedirs(d, exist_ok=True)
+    for name, data in members:
+        with open(os.path.join(d, name), "wb") as f:
+            f.write(data)
+
+
+# --- bundle units -------------------------------------------------------------
+
+
+class TestBundle:
+    def test_build_is_deterministic(self, tmp_path):
+        d = str(tmp_path / "cache")
+        fill_cache(d)
+        a = ps.build_bundle(d)
+        b = ps.build_bundle(d)
+        assert a == b and a is not None
+        with tarfile.open(fileobj=io.BytesIO(a), mode="r:") as tar:
+            names = tar.getnames()
+        assert names[0] == ps.META_MEMBER
+        assert set(names[1:]) == {AOT_NAME, XLA_NAME}
+
+    def test_empty_dir_builds_nothing(self, tmp_path):
+        assert ps.build_bundle(str(tmp_path / "empty")) is None
+
+    def test_program_count_excludes_xla_members(self, tmp_path):
+        d = str(tmp_path / "cache")
+        fill_cache(d)
+        data = ps.build_bundle(d)
+        assert ps.bundle_program_count(data) == 1  # the XLA exec rides along
+
+    def test_keys_selects_subset_but_xla_always_rides(self, tmp_path):
+        d = str(tmp_path / "cache")
+        fill_cache(d, members=(
+            (AOT_NAME, b"one"), (AOT_NAME2, b"two"), (XLA_NAME, b"exec"),
+        ))
+        data = ps.build_bundle(d, keys=["ab" * 8])
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r:") as tar:
+            names = set(tar.getnames())
+        assert AOT_NAME in names and XLA_NAME in names
+        assert AOT_NAME2 not in names
+
+    def test_install_roundtrip_and_idempotence(self, tmp_path):
+        src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+        fill_cache(src)
+        data = ps.build_bundle(src)
+        stats = ps.install_bundle(data, dst)
+        assert stats["installed"] == 2 and stats["skipped"] == 0
+        for name, want in ((AOT_NAME, b"export-one"), (XLA_NAME, b"xla-exec")):
+            with open(os.path.join(dst, name), "rb") as f:
+                assert f.read() == want
+        again = ps.install_bundle(data, dst)
+        assert again["installed"] == 0 and again["present"] == 2
+
+    def test_install_never_overwrites_local_entries(self, tmp_path):
+        src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+        fill_cache(src)
+        fill_cache(dst, members=((AOT_NAME, b"local-fresher"),))
+        ps.install_bundle(ps.build_bundle(src), dst)
+        with open(os.path.join(dst, AOT_NAME), "rb") as f:
+            assert f.read() == b"local-fresher"
+
+    def test_env_key_tracks_code_version(self, monkeypatch):
+        k0 = ps.env_key()
+        assert ps.bundle_name() == f".programs-{k0}.tar"
+        assert ps.bundle_name().startswith(".")  # push skips dotfiles
+        monkeypatch.setattr(aot_cache, "_code_version", "f" * 16)
+        assert ps.env_key() != k0
+
+
+class TestBundleHardening:
+    """The fallback ladder: every bad input is logged + skipped, never
+    raised, and never touches the cache dir."""
+
+    def test_garbage_bytes_install_nothing(self, tmp_path):
+        d = str(tmp_path / "dst")
+        stats = ps.install_bundle(b"this is not a tar archive", d)
+        assert stats["installed"] == 0 and stats["skipped"] >= 1
+        assert not os.path.exists(d) or not os.listdir(d)
+
+    def test_truncated_bundle_installs_nothing(self, tmp_path):
+        src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+        fill_cache(src)
+        data = ps.build_bundle(src)
+        # cuts chosen to bite real content (the tail of a small tar is
+        # record padding a naive len-based cut would miss): mid-meta,
+        # mid-member-header, mid-member-data
+        for cut in (600, 1100, 2000):
+            stats = ps.install_bundle(data[:cut], dst)
+            assert stats["installed"] == 0, cut
+        assert not os.path.exists(dst) or not os.listdir(dst)
+
+    def test_version_skew_skips_wholesale(self, tmp_path, monkeypatch):
+        src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+        fill_cache(src)
+        data = ps.build_bundle(src)
+        monkeypatch.setattr(aot_cache, "_code_version", "f" * 16)
+        stats = ps.install_bundle(data, dst)
+        assert stats["installed"] == 0 and stats["skipped"] == 2
+        assert any("version skew" in r for r in stats["reasons"])
+        assert not os.path.exists(dst) or not os.listdir(dst)
+
+    def test_tampered_member_skipped_others_install(self, tmp_path):
+        src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+        fill_cache(src)
+        data = ps.build_bundle(src)
+        # corrupt the aot member's bytes in place (same length: the size
+        # check passes and the tar stays well-formed, only the sha256
+        # re-hash can catch it)
+        tampered = data.replace(b"export-one", b"export-onE")
+        assert tampered != data
+        stats = ps.install_bundle(tampered, dst)
+        assert stats["installed"] == 1  # the untouched XLA member
+        assert stats["skipped"] == 1
+        assert any("hash/size" in r for r in stats["reasons"])
+        assert not os.path.exists(os.path.join(dst, AOT_NAME))
+
+    def test_traversal_and_stray_names_rejected(self, tmp_path):
+        dst = str(tmp_path / "dst")
+        evil = [("../evil.bin", b"x"), ("aot-UPPER.bin", b"x"),
+                ("jit_other-" + "0" * 64 + "-cache", b"x"),
+                (XLA_NAME + "-atime", b"x")]
+        meta = {
+            "formatVersion": ps.BUNDLE_FORMAT,
+            "jax": jax.__version__, "backend": jax.default_backend(),
+            "codeVersion": aot_cache.code_version(),
+            "artifacts": [
+                {"name": n, "sha256": hashlib.sha256(b).hexdigest(), "size": 1}
+                for n, b in evil
+            ],
+        }
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w", format=tarfile.USTAR_FORMAT) as tar:
+            for name, blob in [(ps.META_MEMBER, json.dumps(meta).encode())] + evil:
+                info = tarfile.TarInfo(name)
+                info.size = len(blob)
+                tar.addfile(info, io.BytesIO(blob))
+        stats = ps.install_bundle(buf.getvalue(), dst)
+        assert stats["installed"] == 0 and stats["skipped"] == len(evil)
+        assert not (tmp_path / "evil.bin").exists()
+        assert not os.path.exists(dst) or not os.listdir(dst)
+
+    def test_wrong_format_version_rejected(self, tmp_path):
+        src = str(tmp_path / "src")
+        fill_cache(src)
+        data = ps.build_bundle(src)
+        # bump formatVersion inside meta.json without resizing the tar
+        mutated = data.replace(b'"formatVersion":1', b'"formatVersion":9')
+        stats = ps.install_bundle(mutated, str(tmp_path / "dst"))
+        assert stats["installed"] == 0
+
+    def test_install_from_dir_aggregates(self, tmp_path):
+        src, model, dst = (str(tmp_path / p) for p in ("src", "model", "dst"))
+        fill_cache(src)
+        os.makedirs(model)
+        with open(os.path.join(model, ps.bundle_name()), "wb") as f:
+            f.write(ps.build_bundle(src))
+        with open(os.path.join(model, ".programs-deadbeef0000.tar"), "wb") as f:
+            f.write(b"junk bundle from another env")
+        total = ps.install_from_dir(model, dst)
+        assert total["installed"] == 2
+        assert total["reasons"]  # the junk one logged, not raised
+
+
+# --- registry round-trip ------------------------------------------------------
+
+
+REPO = "library/prog"
+
+
+@pytest.fixture
+def server_store():
+    store = FSRegistryStore(MemoryFSProvider())
+    srv = RegistryServer(Options(listen=f"127.0.0.1:{free_port()}"), store=store)
+    base = srv.serve_background()
+    yield base, store
+    srv.shutdown()
+
+
+@pytest.fixture
+def pushed(server_store, tmp_path):
+    base, store = server_store
+    d = tmp_path / "m"
+    d.mkdir()
+    (d / "modelx.yaml").write_text("description: prog-test\nframework: jax\n")
+    (d / "weights.bin").write_bytes(b"W" * 2048)
+    client = Client(base, quiet=True)
+    client.push(REPO, "v1", str(d))
+    return base, store, client
+
+
+@pytest.fixture
+def bundle(tmp_path):
+    d = str(tmp_path / "pubcache")
+    fill_cache(d)
+    return ps.build_bundle(d)
+
+
+class TestRegistry:
+    def test_publish_is_a_real_descriptor(self, pushed, bundle):
+        base, store, client = pushed
+        desc = ps.publish(client.remote, REPO, "v1", bundle)
+        manifest = client.get_manifest(REPO, "v1")
+        (got,) = ps.program_descriptors(manifest)
+        assert got.media_type == MediaTypeModelProgram
+        assert got.name == ps.bundle_name()
+        assert str(got.digest) == str(Digest.from_bytes(bundle))
+        assert got.annotations["modelx.program.code"] == aot_cache.code_version()
+        assert got.annotations["modelx.program.artifacts"] == "1"
+        assert desc.size == len(bundle)
+        # weights untouched
+        assert any(b.name == "weights.bin" for b in manifest.blobs)
+
+    def test_republish_replaces_other_env_coexists(self, pushed, bundle,
+                                                   tmp_path, monkeypatch):
+        base, store, client = pushed
+        ps.publish(client.remote, REPO, "v1", bundle)
+        ps.publish(client.remote, REPO, "v1", bundle)
+        assert len(ps.program_descriptors(client.get_manifest(REPO, "v1"))) == 1
+        monkeypatch.setattr(aot_cache, "_code_version", "f" * 16)
+        d2 = str(tmp_path / "othercache")
+        fill_cache(d2, members=((AOT_NAME2, b"other-env"),))
+        ps.publish(client.remote, REPO, "v1", ps.build_bundle(d2))
+        assert len(ps.program_descriptors(client.get_manifest(REPO, "v1"))) == 2
+
+    def test_pull_and_install_through_blob_cache(self, pushed, bundle, tmp_path):
+        from modelx_tpu.dl.blob_cache import BlobCache
+
+        base, store, client = pushed
+        ps.publish(client.remote, REPO, "v1", bundle)
+        cache = BlobCache(str(tmp_path / "bc"))
+        manifest = client.get_manifest(REPO, "v1")
+        s1 = ps.pull_and_install(client, REPO, manifest, str(tmp_path / "c1"),
+                                 cache=cache)
+        assert s1["installed"] == 2 and s1["bundles"] == 1
+        assert cache.stats["admitted"] >= 1
+        s2 = ps.pull_and_install(client, REPO, manifest, str(tmp_path / "c2"),
+                                 cache=cache)
+        assert s2["installed"] == 2
+        assert cache.stats["hits"] >= 1  # second swap is disk-warm
+
+    def test_skew_annotation_skips_without_fetching(self, pushed, bundle,
+                                                    monkeypatch):
+        base, store, client = pushed
+        ps.publish(client.remote, REPO, "v1", bundle)
+        manifest = client.get_manifest(REPO, "v1")
+        monkeypatch.setattr(aot_cache, "_code_version", "f" * 16)
+        fetches = []
+        monkeypatch.setattr(
+            client.remote, "get_blob_content",
+            lambda *a, **k: fetches.append(a) or iter(()),
+        )
+        stats = ps.pull_and_install(client, REPO, manifest, "/nonexistent")
+        assert stats["installed"] == 0
+        assert any("version skew" in r for r in stats["reasons"])
+        assert not fetches  # no bytes spent on a bundle we cannot use
+
+    def test_gc_keeps_referenced_collects_pruned(self, pushed, bundle):
+        from modelx_tpu.registry.gc import gc_blobs
+
+        base, store, client = pushed
+        desc = ps.publish(client.remote, REPO, "v1", bundle)
+        assert gc_blobs(store, REPO, grace_s=0).deleted == 0
+        assert store.exists_blob(REPO, str(desc.digest))
+        # prune detaches the descriptors; the next sweep collects the bytes
+        manifest = client.get_manifest(REPO, "v1")
+        manifest.blobs = [b for b in manifest.blobs
+                          if b.media_type != MediaTypeModelProgram]
+        client.remote.put_manifest(REPO, "v1", manifest)
+        result = gc_blobs(store, REPO, grace_s=0)
+        assert result.deleted == 1
+        assert not store.exists_blob(REPO, str(desc.digest))
+
+    def test_gc_spares_in_flight_program_upload(self, pushed, bundle):
+        """Upload-marker drill: the bundle blob is uploaded but its
+        manifest not yet committed; an aggressive sweep must not eat it."""
+        from modelx_tpu.registry.gc import gc_blobs
+        from modelx_tpu.types import Descriptor
+
+        base, store, client = pushed
+        desc = Descriptor(name=ps.bundle_name(), media_type=MediaTypeModelProgram,
+                          digest=Digest.from_bytes(bundle), size=len(bundle))
+        client.remote.upload_blob_content(REPO, desc, bundle)
+        time.sleep(0.05)
+        result = gc_blobs(store, REPO, grace_s=0.01)  # blob older than grace
+        assert result.skipped_in_flight >= 1
+        assert store.exists_blob(REPO, str(desc.digest))
+        # commit completes the publish: referenced now, marker cleared
+        manifest = client.get_manifest(REPO, "v1")
+        manifest.blobs = manifest.blobs + [desc]
+        client.remote.put_manifest(REPO, "v1", manifest)
+        assert gc_blobs(store, REPO, grace_s=0).deleted == 0
+
+    def test_scrub_quarantines_tampered_bundle_pull_degrades(self, pushed,
+                                                             bundle, tmp_path):
+        from modelx_tpu.registry import scrub
+        from modelx_tpu.registry.store import blob_digest_path
+
+        base, store, client = pushed
+        desc = ps.publish(client.remote, REPO, "v1", bundle)
+        junk = b"Z" * len(bundle)
+        store.fs.put(blob_digest_path(REPO, str(desc.digest)),
+                     io.BytesIO(junk), len(junk), "application/octet-stream")
+        manifest = client.get_manifest(REPO, "v1")
+        # before the scrub notices: the puller's own digest check discards
+        stats = ps.pull_and_install(client, REPO, manifest, str(tmp_path / "c"))
+        assert stats["installed"] == 0
+        assert any("mismatch" in r for r in stats["reasons"])
+        result = scrub.scrub_repository(store, REPO)
+        assert str(desc.digest) in result.quarantined
+        # after quarantine the read 404s; still no raise, compile stays cold
+        stats = ps.pull_and_install(client, REPO, manifest, str(tmp_path / "c2"))
+        assert stats["installed"] == 0 and stats["reasons"]
+
+    def test_verify_counts_program_blobs(self, pushed, bundle):
+        from modelx_tpu.client.ops import verify_repo
+
+        base, store, client = pushed
+        ps.publish(client.remote, REPO, "v1", bundle)
+        report = verify_repo(client.remote, REPO)
+        assert report["program_blobs"] == 1
+        assert report["errors"] == []
+
+    def test_pull_model_lands_bundle_next_to_weights(self, pushed, bundle,
+                                                     tmp_path):
+        from modelx_tpu.dl.initializer import pull_model
+
+        base, store, client = pushed
+        ps.publish(client.remote, REPO, "v1", bundle)
+        dest = str(tmp_path / "dest")
+        stats = pull_model(f"{base}/{REPO}@v1", dest)
+        assert stats["program_blobs"] == 1
+        assert os.path.isfile(os.path.join(dest, ps.bundle_name()))
+
+    def test_cli_list_and_prune(self, pushed, bundle):
+        from click.testing import CliRunner
+
+        from modelx_tpu.cli import main as cli_main
+
+        base, store, client = pushed
+        ps.publish(client.remote, REPO, "v1", bundle)
+        r = CliRunner().invoke(cli_main, ["programs", "list", f"{base}/{REPO}@v1"])
+        # the table may ellipsize the full name; the prefix is enough
+        assert r.exit_code == 0 and ".programs-" in r.output
+        r = CliRunner().invoke(cli_main, ["programs", "prune", f"{base}/{REPO}@v1"])
+        assert r.exit_code == 0 and json.loads(r.output)["removed"] == 1
+        assert ps.program_descriptors(client.get_manifest(REPO, "v1")) == []
+
+
+def test_filter_blobs_keeps_programs():
+    from modelx_tpu.dl.initializer import filter_blobs
+    from modelx_tpu.types import Descriptor, Manifest
+
+    manifest = Manifest(blobs=[
+        Descriptor(name="model.safetensors", digest="sha256:" + "a" * 64, size=1),
+        Descriptor(name="tokenizer.json", digest="sha256:" + "b" * 64, size=1),
+        Descriptor(name=".programs-aaaabbbbcccc.tar", digest="sha256:" + "c" * 64,
+                   size=1, media_type=MediaTypeModelProgram),
+    ])
+    kept = filter_blobs(manifest, ["model.safetensors"])
+    names = [b.name for b in kept.blobs]
+    assert names == ["model.safetensors", ".programs-aaaabbbbcccc.tar"]
+
+
+# --- real compiles ------------------------------------------------------------
+
+
+def write_tiny(dirpath: str, seed: int = 0, vocab: int = 512):
+    from modelx_tpu.dl import safetensors as st
+    from modelx_tpu.models import llama
+
+    cfg = llama.LlamaConfig.tiny(vocab_size=vocab)
+    params = llama.init_params(cfg, jax.random.PRNGKey(seed))
+    os.makedirs(dirpath, exist_ok=True)
+    st.write_safetensors(
+        os.path.join(dirpath, "model.safetensors"),
+        {k: np.asarray(v) for k, v in params.items()},
+    )
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def tiny_dir(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("prog-model"))
+    write_tiny(d)
+    return d
+
+
+def export_warmup_surface(model_dir: str, cache_dir: str) -> list[str]:
+    """Publisher side of the drill: export ONLY the warmup rung
+    (argmax_all at the (1, 16) batcher shape) from the checkpoint header,
+    exactly what a booting ModelServer compiles first."""
+    from modelx_tpu.dl import families as fam
+    from modelx_tpu.dl import safetensors as st
+    from modelx_tpu.dl.loader import fuse_expert_tensors
+    from modelx_tpu.parallel.mesh import make_mesh
+
+    infos, _ = st.read_header_from_file(os.path.join(model_dir, "model.safetensors"))
+    family = fam.detect(list(infos))
+    infos = fuse_expert_tensors(infos, family.rules)
+    mesh = make_mesh("dp=1")
+    cfg = family.infer_config(fam.abstract_params(infos))
+    sds = fam.abstract_params(infos, family.rules, mesh)
+    return ps.export_surface(family, cfg, sds, mesh, cache_dir,
+                             widths=(1,), seq=16, first_token_shapes=(),
+                             score_shapes=())
+
+
+def make_warm_model_dir(tiny_dir: str, tmp_path) -> tuple[str, str]:
+    """(model dir carrying a published bundle, publisher cache dir)."""
+    pub_cache = str(tmp_path / "pub-cache")
+    keys = export_warmup_surface(tiny_dir, pub_cache)
+    assert keys, "warmup export produced no programs"
+    data = ps.build_bundle(pub_cache)
+    model_dir = str(tmp_path / "warm-model")
+    os.makedirs(model_dir)
+    shutil.copy(os.path.join(tiny_dir, "model.safetensors"), model_dir)
+    with open(os.path.join(model_dir, ps.bundle_name()), "wb") as f:
+        f.write(data)
+    return model_dir, pub_cache
+
+
+class TestServerBoot:
+    def test_load_installs_bundle_before_compile(self, tiny_dir, tmp_path,
+                                                 monkeypatch):
+        """The tier-1 end-to-end: a model dir carrying another pod's
+        bundle boots with its warmup compile warm-started (deserialize,
+        no export) and the AOT program serving the batcher shape."""
+        from modelx_tpu.dl import serve as serve_mod
+
+        model_dir, _ = make_warm_model_dir(tiny_dir, tmp_path)
+        monkeypatch.setattr(serve_mod, "_compile_cache_dir",
+                            str(tmp_path / "pod-cache"))
+        calls = {"export": 0}
+        real_export = jax.export.export
+        monkeypatch.setattr(
+            jax.export, "export",
+            lambda *a, **kw: calls.__setitem__("export", calls["export"] + 1)
+            or real_export(*a, **kw),
+        )
+        srv = serve_mod.ModelServer(model_dir, mesh_spec="dp=1",
+                                    max_seq_len=64, name="warm")
+        srv.load()
+        assert srv.stats["programs"]["installed"] >= 1
+        assert calls["export"] == 0  # warmup warm-started from the bundle
+        assert (1, 16) in srv._forward_aot
+        out = srv.forward_argmax(np.ones((1, 16), np.int32))
+        assert np.asarray(out).shape == (1, 16)  # argmax_all: per position
+
+
+@pytest.mark.slow
+class TestByteExact:
+    def test_bundle_vs_plain_outputs_identical(self, tiny_dir, tmp_path,
+                                               monkeypatch):
+        """Acceptance: a bundle-warm server and a plain-compiled server
+        produce byte-identical tokens — greedy and same-seed sampled."""
+        from modelx_tpu.dl import serve as serve_mod
+
+        prompt = np.array([[1, 2, 3, 4, 5, 6, 7, 8]], np.int32)
+
+        monkeypatch.setattr(serve_mod, "_compile_cache_dir", "")
+        plain = serve_mod.ModelServer(tiny_dir, mesh_spec="dp=1",
+                                      max_seq_len=64, name="plain")
+        plain.load()
+        greedy_plain = np.asarray(plain.generate(prompt, max_new_tokens=8))
+        sampled_plain = np.asarray(plain.generate(
+            prompt, max_new_tokens=8, temperature=0.8, top_k=8, seed=7))
+
+        model_dir, _ = make_warm_model_dir(tiny_dir, tmp_path)
+        monkeypatch.setattr(serve_mod, "_compile_cache_dir",
+                            str(tmp_path / "pod-cache"))
+        warm = serve_mod.ModelServer(model_dir, mesh_spec="dp=1",
+                                     max_seq_len=64, name="warm")
+        warm.load()
+        assert warm.stats["programs"]["installed"] >= 1
+        np.testing.assert_array_equal(
+            greedy_plain, np.asarray(warm.generate(prompt, max_new_tokens=8)))
+        np.testing.assert_array_equal(
+            sampled_plain,
+            np.asarray(warm.generate(prompt, max_new_tokens=8,
+                                     temperature=0.8, top_k=8, seed=7)))
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestPoolSwap:
+    def test_swap_publishes_then_next_swap_installs(self, tiny_dir, tmp_path,
+                                                    monkeypatch):
+        """The fleet drill end to end through the lifecycle pool: pod 1
+        swap-loads a ref with --publish-programs (the manifest gains the
+        bundle), pod 2's swap of the same ref installs it."""
+        from modelx_tpu.dl.lifecycle import READY
+        from modelx_tpu.dl import serve as serve_mod
+
+        store = FSRegistryStore(MemoryFSProvider())
+        srv = RegistryServer(Options(listen=f"127.0.0.1:{free_port()}"),
+                             store=store)
+        base = srv.serve_background()
+        try:
+            client = Client(base, quiet=True)
+            client.push("library/swap", "v1", tiny_dir)
+            ref = f"{base}/library/swap@v1"
+
+            def swap(tag: str, publish: bool) -> dict:
+                monkeypatch.setattr(serve_mod, "_compile_cache_dir",
+                                    str(tmp_path / f"cache-{tag}"))
+                a_dir = str(tmp_path / f"a-{tag}")
+                # different vocab: the resident tenant's own warmup keys
+                # must not pre-warm the keys the bundle ships, or
+                # "installed" degrades to "present"
+                write_tiny(a_dir, vocab=256)
+                sset = serve_mod.ServerSet(
+                    {"a": serve_mod.ModelServer(a_dir, mesh_spec="dp=1",
+                                                max_seq_len=64, name="a")},
+                    allow_admin_load=True,
+                    staging_root=str(tmp_path / f"staging-{tag}"),
+                )
+                sset.load_all()
+                sset.pool.publish_programs = publish
+                snap = sset.pool.request_load("b", ref=ref, wait=True)
+                assert snap["b"]["state"] == READY
+                return sset.servers["b"].stats
+
+            swap("pub", publish=True)
+            # the publish runs after mark_ready, off the serving path —
+            # wait=True returns at READY, so give the hook a moment
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                manifest = client.get_manifest("library/swap", "v1")
+                if ps.program_descriptors(manifest):
+                    break
+                time.sleep(0.1)
+            assert len(ps.program_descriptors(manifest)) == 1
+            stats = swap("warm", publish=False)
+            assert stats["programs"]["installed"] >= 1
+        finally:
+            srv.shutdown()
