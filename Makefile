@@ -5,7 +5,7 @@ PY ?= python
 IMAGE ?= modelx-tpu
 TAG ?= $(shell git describe --tags --always 2>/dev/null || echo dev)
 
-.PHONY: all native test chaos slow lifecycle fleet overload programs lint wheel image image-dl compose-up compose-down clean
+.PHONY: all native test chaos slow lifecycle fleet overload programs continuation lint wheel image image-dl compose-up compose-down clean
 
 all: native lint test wheel
 
@@ -56,6 +56,16 @@ fleet:
 overload:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_admission.py -q -m "not slow"
 	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_admission.py -q -m chaos
+
+# live-continuation drills (ISSUE 12): engine resume determinism, the
+# resume wire contract on both HTTP surfaces, the boundary watchdog, and
+# coordinated drain — then the router splice tests plus the kill/drain
+# soak under runtime lockdep (continuation adds the stream-session and
+# re-plan paths to the router's lock order)
+continuation:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_continuation.py -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_router.py -q -k Continuation
+	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_router.py -q -m chaos
 
 # compiled-program registry drills (ISSUE 11): bundle build/install/
 # corruption/skew units + registry round-trips, then the slow set

@@ -1468,6 +1468,166 @@ def measure_fleet(model_dir: str, *, pods: int = 3, clients: int = 4,
     return out
 
 
+def measure_continuation(model_dir: str, *, pods: int = 2, clients: int = 8,
+                         new_tokens: int = 16,
+                         max_seq_len: int = 128) -> dict:
+    """Stream-continuation drill (ISSUE 12): a seeded mid-stream pod kill
+    behind the router under ``clients`` concurrent seeded SAMPLED streams
+    (identical prompt+seed, so prefix stickiness pins them ALL to the
+    dying pod). The router must resume every committed stream on a
+    surviving pod token-exactly — ``tokens_lost`` asserts the zero-loss
+    contract against an uninterrupted reference stream — and the only
+    client-visible cost is one stall, ``continuation_gap_ms`` (last
+    pre-kill line -> first post-resume line, read as the max inter-line
+    arrival gap across clients; the kill is armed at a line boundary so
+    other gaps are per-token decode intervals)."""
+    import requests as _requests
+
+    from modelx_tpu.dl.serve import ModelServer, ServerSet, serve
+    from modelx_tpu.registry.server import free_port
+    from modelx_tpu.router.registry import PodRegistry
+    from modelx_tpu.router.server import FleetRouter, route_serve
+    from modelx_tpu.testing.faults import PodKillSwitch
+
+    server = ModelServer(model_dir, name="default", max_seq_len=max_seq_len)
+    server.load()
+    vocab = int(getattr(server.cfg, "vocab_size", 0) or 256)
+
+    rng = np.random.RandomState(23)
+    prompt = rng.randint(1, vocab, (6,)).tolist()
+    body = {"tokens": [prompt], "max_new_tokens": new_tokens, "stream": True,
+            "temperature": 0.9, "top_k": 8, "top_p": 0.95, "seed": 1234}
+
+    # continuous-engine pods around the ONE loaded model: the resume
+    # contract needs per-step sample streams (chunked single-row NDJSON)
+    pod_set = []
+    for _ in range(pods):
+        sset = ServerSet({"default": server}, continuous_batch=True,
+                         max_slots=2, stream_chunk_size=4)
+        sset.pool.mark_ready("default")
+        httpd = serve(sset, listen=f"127.0.0.1:{free_port()}")
+        pod_set.append({"sset": sset, "httpd": httpd,
+                        "url": f"http://127.0.0.1:{httpd.server_address[1]}",
+                        "kill": PodKillSwitch(httpd, sset=sset)})
+
+    def read_lines(resp) -> tuple[list, list]:
+        """NDJSON payloads + per-line arrival stamps (chunk_size=1 so a
+        line's stamp is its flush time, not a buffer boundary)."""
+        payloads, stamps = [], []
+        for raw in resp.iter_lines(chunk_size=1):
+            if raw:
+                stamps.append(time.monotonic())
+                payloads.append(json.loads(raw))
+        return payloads, stamps
+
+    out: dict = {}
+    router = None
+    rhttpd = None
+    try:
+        # reference: an uninterrupted direct stream (also warms the
+        # compiled shapes, so the routed leg's gap is not a compile)
+        r = _requests.post(pod_set[0]["url"] + "/v1/generate", json=body,
+                           stream=True, timeout=120)
+        if r.status_code != 200:
+            raise RuntimeError(f"reference stream failed: {r.text[:200]}")
+        ref, _ = read_lines(r)
+        ref_ids = [p["tokens"][0][0] for p in ref if "tokens" in p]
+        if len(ref_ids) != new_tokens or not ref[-1].get("done"):
+            raise RuntimeError(f"malformed reference stream: {ref}")
+
+        registry = PodRegistry([p["url"] for p in pod_set],
+                               poll_interval_s=0.5)
+        router = FleetRouter(registry, request_timeout_s=60.0)
+        router.start()
+        rhttpd = route_serve(router, listen=f"127.0.0.1:{free_port()}")
+        rbase = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+
+        # arm EVERY pod (placement is the router's call): at piece 2 of
+        # the first stream served, the serving pod hard-dies at a line
+        # boundary — listener closed, live connections severed
+        fired = threading.Event()
+        for p in pod_set:
+            orig = p["sset"].stream_source
+
+            def src(server_, tokens, n, samp, stop_token_ids=None,
+                    _orig=orig, _pod=p, **kw):
+                gen = _orig(server_, tokens, n, samp,
+                            stop_token_ids=stop_token_ids, **kw)
+
+                def run():
+                    for i, piece in enumerate(gen):
+                        if i == 2 and not fired.is_set():
+                            fired.set()
+                            time.sleep(0.3)  # router drains pieces 0-1
+                            _pod["kill"].kill()
+                            raise RuntimeError("pod dies")
+                        yield piece
+
+                return run()
+
+            p["sset"].stream_source = src
+
+        results: list = [None] * clients
+        errors: list = []
+
+        def client(i: int) -> None:
+            try:
+                r_ = _requests.post(rbase + "/v1/generate", json=body,
+                                    stream=True, timeout=120)
+                if r_.status_code != 200:
+                    raise RuntimeError(f"status {r_.status_code}")
+                results[i] = read_lines(r_)
+            except Exception as e:  # surfaced below — the drill must fail
+                errors.append(f"client {i}: {e!r}")
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise RuntimeError("; ".join(errors[:3]))
+        if not fired.is_set():
+            raise RuntimeError("seeded kill never fired")
+
+        # zero-loss contract, per client: reference tokens NOT reproduced
+        # in order (a wrong token loses the whole tail — the stream
+        # diverged), summed across the fleet of streams
+        lost = 0
+        worst_gap = None
+        for got, stamps in results:
+            got_ids = [p_["tokens"][0][0] for p_ in got if "tokens" in p_]
+            prefix = 0
+            for a, b in zip(got_ids, ref_ids):
+                if a != b:
+                    break
+                prefix += 1
+            lost += len(ref_ids) - prefix
+            for a, b in zip(stamps, stamps[1:]):
+                if worst_gap is None or b - a > worst_gap:
+                    worst_gap = b - a
+        out["continuation_clients"] = clients
+        out["tokens_lost"] = lost
+        snap = router.metrics.snapshot()
+        out["streams_continued"] = snap["streams_continued_total"]
+        out["streams_severed"] = snap["severed_streams_total"]
+        out["continuation_gap_ms"] = (
+            round(worst_gap * 1e3, 1) if worst_gap is not None else None
+        )
+    finally:
+        if rhttpd is not None:
+            rhttpd.shutdown()
+        if router is not None:
+            router.close()
+        for p in pod_set:
+            p["httpd"].shutdown()
+            for cb in p["sset"].cbatchers.values():
+                cb.close()
+                cb.release_device_state()
+    return out
+
+
 class _Budget:
     """Soft wall-clock budget for the whole capture (BENCH_r05 post-mortem:
     the run exceeded the driver's hard timeout and recorded NOTHING, rc
@@ -1975,6 +2135,22 @@ def main() -> None:
 
         guard("fleet", fleet_leg, 180.0)
 
+        # stream-continuation drill: seeded mid-stream pod kill behind the
+        # router on a seeded sampled stream; the resume contract must hold
+        # token-exactly (tokens_lost == 0) and the cost is one stall
+        # (continuation_gap_ms) — ISSUE 12 acceptance
+        def continuation_leg() -> dict:
+            cont_dir = os.path.join(workdir, "fleet")
+            if not os.path.exists(os.path.join(cont_dir,
+                                               "model.safetensors")):
+                os.makedirs(cont_dir, exist_ok=True)
+                build_checkpoint(
+                    os.path.join(cont_dir, "model.safetensors"),
+                    48 * 1024 * 1024, hidden=512, inter=1408, vocab=8192)
+            return measure_continuation(cont_dir)
+
+        guard("continuation", continuation_leg, 120.0)
+
         # int8 weight-only serving: per-step weight reads halve, so decode
         # (HBM-bound) speeds up — the quantize flag the serve sidecar ships
         def int8_serving() -> dict:
@@ -2020,9 +2196,11 @@ def main() -> None:
 
 def tiny_main() -> int:
     """``bench.py --tiny``: the CPU proxy capture (``JAX_PLATFORMS=cpu``),
-    one JSON line. Two stages: the fleet leg on a tiny synthetic llama
+    one JSON line. Three stages: the fleet leg on a tiny synthetic llama
     (``fleet_throughput_scaling`` / ``sticky_hit_ratio`` /
-    ``failover_recovery_ms``, ISSUE 8), then the compiled-program registry
+    ``failover_recovery_ms``, ISSUE 8), the stream-continuation drill
+    (``tokens_lost`` == 0 / ``continuation_gap_ms``, ISSUE 12), then
+    the compiled-program registry
     acceptance (ISSUE 11) against a real registry subprocess — a
     bundle-warm second process's compile leg vs the cold publisher's
     (``program_warm_compile_ratio``, pass <= 0.5), and the lifecycle
@@ -2048,6 +2226,11 @@ def tiny_main() -> int:
                                  requests_per_client=3, conversations=4,
                                  turns=12, new_tokens=4, max_seq_len=128))
         out["value"] = out.get("fleet_throughput_scaling")
+
+        # stream-continuation drill (ISSUE 12): seeded mid-stream pod
+        # kill behind the router; tokens_lost must read 0
+        out.update(measure_continuation(workdir, new_tokens=12,
+                                        max_seq_len=128))
 
         # --- compiled-program registry (ISSUE 11), CPU proxy ---
         # bench-shaped small checkpoint, not LlamaConfig.tiny: the ratio

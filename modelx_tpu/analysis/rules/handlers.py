@@ -38,6 +38,7 @@ _TYPED_NAMES = {
     "PoisonedRequestError", "EngineBrokenError", "ModelLoadingError",
     "ModelUnloadedError", "ModelDrainingError", "ModelFailedError",
     "NoReadyPodError", "UpstreamSeveredError",
+    "MalformedResumeError", "ResumeExhaustedError",
     "APIError", "PoolError", "ErrorInfo", "ChatTemplateRejected",
 }
 # modules whose raises are typed constructors (`raise errors.blob_unknown(...)`)

@@ -234,7 +234,8 @@ class ContinuousBatcher:
                  supervise: bool = True,
                  restart_backoff_s: float = 0.25,
                  max_crashes: int = 5,
-                 crash_window_s: float = 60.0) -> None:
+                 crash_window_s: float = 60.0,
+                 boundary_watchdog_s: float = 0.0) -> None:
         if server.family.decode_fns is None:
             raise ValueError(f"family {server.family.name} has no cached decode")
         self.server = server
@@ -522,6 +523,17 @@ class ContinuousBatcher:
         # of being re-admitted into another crash
         self._poison: dict[tuple, int] = {}
         self._suspect_fp: tuple | None = None
+        # -- hang watchdog --------------------------------------------------
+        # boundary_watchdog_s > 0: a monitor thread treats a boundary that
+        # makes no progress for this long (while rows are active) as a
+        # crash — the supervisor only heals crashes, and a WEDGED device
+        # dispatch (real on TPU: a hung transfer or collective) would
+        # otherwise hold the loop, and every waiter, forever. Off by
+        # default: first-touch XLA compiles legitimately take seconds, so
+        # the operator picks a window that clears them.
+        self.boundary_watchdog_s = float(boundary_watchdog_s)
+        self._watch_stall: BaseException | None = None
+        self._progress_t: float | None = None
         self.stats = {"chunks": 0, "admitted": 0, "active_peak": 0,
                       "prefill_pieces": 0, "stall_ms_max": 0.0,
                       "engine_restarts": 0, "shed": 0, "expired": 0,
@@ -550,8 +562,15 @@ class ContinuousBatcher:
             self.stats["paged_attention"] = (
                 "in-place" if self._fwd_paged is not None else "gather"
             )
+        if self.boundary_watchdog_s > 0:
+            self.stats["watchdog_stalls"] = 0
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
+        if self.boundary_watchdog_s > 0:
+            self._watch_thread = threading.Thread(
+                target=self._watchdog, daemon=True
+            )
+            self._watch_thread.start()
 
     # a request is quarantined once this many loop crashes are attributed
     # to dispatching its admission/fill work
@@ -565,10 +584,14 @@ class ContinuousBatcher:
 
     # -- compiled programs ----------------------------------------------------
 
-    def _sample_first(self, logits, last_idx, temp, top_k, top_p, seed):
-        """Each row's first token: step 0 of its sample stream, matching
-        ragged/stream decode byte-for-byte. Row-wise: works for the [1, S]
-        single admission and the [k, S] batched admission alike."""
+    def _sample_first(self, logits, last_idx, temp, top_k, top_p, seed,
+                      step=0):
+        """Each row's first token: step ``step`` of its sample stream (0
+        for a fresh request; a RESUMED request that re-prefilled
+        prompt + k emitted tokens continues at step k, so the token is
+        byte-identical to the one the interrupted stream would have
+        emitted next). Row-wise: works for the [1, S] single admission
+        and the [k, S] batched admission alike."""
         from modelx_tpu.ops import sampling as sampling_ops
 
         idx = jnp.broadcast_to(
@@ -577,11 +600,11 @@ class ContinuousBatcher:
         last = jnp.take_along_axis(logits, idx, axis=1)[:, 0, :]
         return sampling_ops.sample(
             last.astype(jnp.float32), jax.random.PRNGKey(0), temp,
-            top_k=top_k, top_p=top_p, seeds=seed, step=0,
+            top_k=top_k, top_p=top_p, seeds=seed, step=step,
         )
 
     def _admit_many_impl(self, params, prompts, cache, tok, row_lens, slots,
-                         temp, top_k, top_p, seeds):
+                         temp, top_k, top_p, seeds, first_steps):
         """A burst of same-bucket admissions as ONE program: prefill the
         [max_slots, Sb] block into a fresh scratch cache, sample every
         row's first token (step 0 of its own seed stream — identical to k
@@ -595,7 +618,8 @@ class ContinuousBatcher:
         log2(max_slots) sizes per prompt bucket."""
         small = self._init_cache(prompts.shape[0], prompts.shape[1])
         logits, small = self._fwd(params, prompts, kv_cache=small, cache_offset=0)
-        firsts = self._sample_first(logits, row_lens - 1, temp, top_k, top_p, seeds)
+        firsts = self._sample_first(logits, row_lens - 1, temp, top_k, top_p,
+                                    seeds, step=first_steps)
         cache = jax.tree_util.tree_map(
             lambda big, lit: big.at[slots, : lit.shape[1]].set(lit, mode="drop"),
             cache, small,
@@ -604,7 +628,8 @@ class ContinuousBatcher:
         return cache, tok, firsts
 
     def _admit_many_paged_impl(self, params, prompts, pool, tok, row_lens,
-                               slots, page_ids, temp, top_k, top_p, seeds):
+                               slots, page_ids, temp, top_k, top_p, seeds,
+                               first_steps):
         """Paged batched admission: same one-program shape, writing each
         row's scratch rows into its reserved pages (``page_ids`` is
         [max_slots, n_prompt_pages] — same bucket means the same page
@@ -614,7 +639,8 @@ class ContinuousBatcher:
         sb = prompts.shape[1]
         small = self._init_cache(prompts.shape[0], sb)
         logits, small = self._fwd(params, prompts, kv_cache=small, cache_offset=0)
-        firsts = self._sample_first(logits, row_lens - 1, temp, top_k, top_p, seeds)
+        firsts = self._sample_first(logits, row_lens - 1, temp, top_k, top_p,
+                                    seeds, step=first_steps)
         ps = self.page_size
 
         def write(pool_leaf, small_leaf):
@@ -630,12 +656,13 @@ class ContinuousBatcher:
         return pool, tok, firsts
 
     def _finish_admit(self, small, logits, cache, tok, last_idx, slot,
-                      temp, top_k, top_p, seed):
+                      temp, top_k, top_p, seed, first_step):
         """Shared admit tail: sample the row's first token and insert the
         scratch cache + token into ``slot`` of the donated engine state.
         Returns (cache, tok, first, small) — ``small`` goes back to the
         host for the prefix cache."""
-        first = self._sample_first(logits, last_idx, temp, top_k, top_p, seed)
+        first = self._sample_first(logits, last_idx, temp, top_k, top_p, seed,
+                                   step=first_step)
 
         def put(big, little):
             return jax.lax.dynamic_update_slice(
@@ -647,13 +674,15 @@ class ContinuousBatcher:
         return cache, tok, first, small
 
     def _finish_admit_paged(self, small, logits, pool, tok, last_idx, slot,
-                            page_ids, temp, top_k, top_p, seed, span: int):
+                            page_ids, temp, top_k, top_p, seed, first_step,
+                            span: int):
         """Paged admit tail: sample the first token, then write the scratch
         cache's first ``span`` rows into the slot's reserved pages. ``span``
         is STATIC (the prompt bucket / trim length), so the write unrolls
         to ceil(span/page_size) dynamic_update_slices — compiled once per
         prompt bucket, exactly like the prefill itself."""
-        first = self._sample_first(logits, last_idx, temp, top_k, top_p, seed)
+        first = self._sample_first(logits, last_idx, temp, top_k, top_p, seed,
+                                   step=first_step)
         tok = jax.lax.dynamic_update_slice(tok, first[:, None], (slot, 0))
         ps = self.page_size
 
@@ -673,19 +702,19 @@ class ContinuousBatcher:
         return pool, tok, first, small
 
     def _admit_paged_impl(self, params, prompt, pool, tok, row_len, slot,
-                          page_ids, temp, top_k, top_p, seed):
+                          page_ids, temp, top_k, top_p, seed, first_step):
         """Paged admission: prefill into a [1, Sb] scratch cache, then the
         paged admit tail (pages instead of a slot-row insert)."""
         small = self._init_cache(1, prompt.shape[1])
         logits, small = self._fwd(params, prompt, kv_cache=small, cache_offset=0)
         return self._finish_admit_paged(
             small, logits, pool, tok, row_len - 1, slot, page_ids,
-            temp, top_k, top_p, seed, span=prompt.shape[1],
+            temp, top_k, top_p, seed, first_step, span=prompt.shape[1],
         )
 
     def _admit_cached_paged_impl(self, params, suffix, pool, tok, suffix_len,
                                  plen, slot, stored, page_ids, temp, top_k,
-                                 top_p, seed, trim_len: int):
+                                 top_p, seed, trim_len: int, first_step=0):
         """Prefix-hit paged admission: stored KV + suffix prefill (the
         dense cached-admit's semantics, see _admit_cached_impl), written
         out page by page."""
@@ -700,22 +729,22 @@ class ContinuousBatcher:
         small = jax.tree_util.tree_map(lambda c: c[:, :trim_len], small)
         return self._finish_admit_paged(
             small, logits, pool, tok, suffix_len - 1, slot, page_ids,
-            temp, top_k, top_p, seed, span=trim_len,
+            temp, top_k, top_p, seed, first_step, span=trim_len,
         )
 
     def _admit_impl(self, params, prompt, cache, tok, row_len, slot,
-                    temp, top_k, top_p, seed):
+                    temp, top_k, top_p, seed, first_step):
         """One program per admission: prefill the [1, S] prompt into a
         scratch cache (allocated INSIDE the jit — zeros fuse, no host
         transfer), then the shared admit tail."""
         small = self._init_cache(1, prompt.shape[1])
         logits, small = self._fwd(params, prompt, kv_cache=small, cache_offset=0)
         return self._finish_admit(small, logits, cache, tok, row_len - 1, slot,
-                                  temp, top_k, top_p, seed)
+                                  temp, top_k, top_p, seed, first_step)
 
     def _admit_cached_impl(self, params, suffix, cache, tok, suffix_len, plen,
                            slot, stored, temp, top_k, top_p, seed,
-                           trim_len: int):
+                           trim_len: int, first_step=0):
         """Prefix-hit admission: the scratch cache starts as the STORED
         prefix KV (extended with zeros for the suffix bucket) and only the
         [1, Sb] suffix block prefills, at offset ``plen``. KV values are a
@@ -736,7 +765,7 @@ class ContinuousBatcher:
         logits, small = self._fwd(params, suffix, kv_cache=small, cache_offset=plen)
         small = jax.tree_util.tree_map(lambda c: c[:, :trim_len], small)
         return self._finish_admit(small, logits, cache, tok, suffix_len - 1, slot,
-                                  temp, top_k, top_p, seed)
+                                  temp, top_k, top_p, seed, first_step)
 
     # -- chunked prefill piece programs ---------------------------------------
 
@@ -778,14 +807,16 @@ class ContinuousBatcher:
         return self._scatter_row(cache, row, slot)
 
     def _piece_flip_impl(self, params, piece, cache, tok, filled, slot,
-                         last_idx, temp, top_k, top_p, seed):
+                         last_idx, temp, top_k, top_p, seed, first_step):
         """The LAST piece: land its KV and sample the row's first token
-        from the piece's final real position — step 0 of the row's
-        (seed, step) stream, byte-identical to single-program admission."""
+        from the piece's final real position — step ``first_step`` of the
+        row's (seed, step) stream (0 fresh, k on resume), byte-identical
+        to single-program admission."""
         row = self._gather_row(cache, slot)
         logits, row = self._fwd(params, piece, kv_cache=row, cache_offset=filled)
         cache = self._scatter_row(cache, row, slot)
-        first = self._sample_first(logits, last_idx, temp, top_k, top_p, seed)
+        first = self._sample_first(logits, last_idx, temp, top_k, top_p, seed,
+                                   step=first_step)
         tok = jax.lax.dynamic_update_slice(tok, first[:, None], (slot, 0))
         return cache, tok, first
 
@@ -821,11 +852,12 @@ class ContinuousBatcher:
 
     def _piece_flip_paged_impl(self, params, piece, pool, tok, table_row,
                                filled, slot, last_idx, temp, top_k, top_p,
-                               seed, write_page_ids, page_start):
+                               seed, write_page_ids, page_start, first_step):
         dense = self._gather_pages(pool, table_row)
         logits, dense = self._fwd(params, piece, kv_cache=dense, cache_offset=filled)
         pool = self._scatter_piece_pages(pool, dense, write_page_ids, page_start)
-        first = self._sample_first(logits, last_idx, temp, top_k, top_p, seed)
+        first = self._sample_first(logits, last_idx, temp, top_k, top_p, seed,
+                                   step=first_step)
         tok = jax.lax.dynamic_update_slice(tok, first[:, None], (slot, 0))
         return pool, tok, first
 
@@ -1251,7 +1283,10 @@ class ContinuousBatcher:
         k_val = int(samp.get("top_k", 0))
         p_val = float(samp.get("top_p", 1.0))
         self._offsets[slot] = s
-        self._steps[slot] = 1  # prefill consumed step 0
+        # a resumed request re-prefilled prompt + k emitted tokens and its
+        # first token here was sampled at step k — the row continues the
+        # original (seed, step) stream, not a fresh one
+        self._steps[slot] = int(samp.get("resume_step", 0)) + 1
         self._temp[slot] = float(samp.get("temperature", 0.0))
         self._top_k[slot] = k_val
         self._top_p[slot] = p_val
@@ -1340,6 +1375,7 @@ class ContinuousBatcher:
         top_k = np.zeros(m, np.int32)
         top_p = np.ones(m, np.float32)
         seeds = np.zeros(m, np.int32)
+        first_steps = np.zeros(m, np.int32)
         for i, p in enumerate(preps):
             prompts[i, : p["s"]] = p["ids"]
             row_lens[i] = p["s"]
@@ -1348,6 +1384,7 @@ class ContinuousBatcher:
             top_k[i] = int(p["samp"].get("top_k", 0))
             top_p[i] = float(p["samp"].get("top_p", 1.0))
             seeds[i] = int(p["samp"].get("seed", 0))
+            first_steps[i] = int(p["samp"].get("resume_step", 0))
         args = [self.server.params, jnp.asarray(prompts), self._cache,
                 self._tok, jnp.asarray(row_lens), jnp.asarray(slots)]
         if self.page_size > 0:
@@ -1361,7 +1398,7 @@ class ContinuousBatcher:
         # program samples once — the chunk scan's per-step sort-skip
         # optimization has nothing to save on a one-shot program
         args += [jnp.asarray(temp), jnp.asarray(top_k), jnp.asarray(top_p),
-                 jnp.asarray(seeds)]
+                 jnp.asarray(seeds), jnp.asarray(first_steps)]
         self._cache, self._tok, firsts = self._admit_many_prog(*args)
         block = {"dev": firsts, "np": None}
 
@@ -1390,6 +1427,7 @@ class ContinuousBatcher:
         top_k = np.asarray([k_val], np.int32) if filters else None
         top_p = np.asarray([p_val], np.float32) if filters else None
         seed = np.asarray([samp.get("seed", 0)], np.int32)
+        first_step = np.asarray([samp.get("resume_step", 0)], np.int32)
         if hit is not None:
             plen, stored = hit
             suffix = ids[plen:]
@@ -1401,14 +1439,14 @@ class ContinuousBatcher:
                     self.server.params, jnp.asarray(block), self._cache,
                     self._tok, jnp.asarray([len(suffix)], np.int32),
                     jnp.int32(plen), jnp.int32(slot), stored, prompt_pages,
-                    temp, top_k, top_p, seed, pad_seq_len(s),
+                    temp, top_k, top_p, seed, pad_seq_len(s), first_step,
                 )
             else:
                 self._cache, self._tok, first, small = self._admit_cached_prog(
                     self.server.params, jnp.asarray(block), self._cache, self._tok,
                     jnp.asarray([len(suffix)], np.int32), jnp.int32(plen),
                     jnp.int32(slot), stored, temp, top_k, top_p, seed,
-                    pad_seq_len(s),
+                    pad_seq_len(s), first_step,
                 )
         else:
             pad_s = pad_seq_len(s)
@@ -1418,12 +1456,13 @@ class ContinuousBatcher:
                 admitted = self._admit_prog(
                     self.server.params, jnp.asarray(prompt), self._cache,
                     self._tok, jnp.asarray([s], np.int32), jnp.int32(slot),
-                    prompt_pages, temp, top_k, top_p, seed,
+                    prompt_pages, temp, top_k, top_p, seed, first_step,
                 )
             else:
                 admitted = self._admit_prog(
                     self.server.params, jnp.asarray(prompt), self._cache, self._tok,
-                    jnp.asarray([s], np.int32), jnp.int32(slot), temp, top_k, top_p, seed,
+                    jnp.asarray([s], np.int32), jnp.int32(slot), temp, top_k, top_p,
+                    seed, first_step,
                 )
             if self.prefix_cache is None:
                 self._cache, self._tok, first = admitted
@@ -1610,6 +1649,7 @@ class ContinuousBatcher:
         top_k = np.asarray([samp.get("top_k", 0)], np.int32)
         top_p = np.asarray([samp.get("top_p", 1.0)], np.float32)
         seed = np.asarray([samp.get("seed", 0)], np.int32)
+        first_step = np.asarray([samp.get("resume_step", 0)], np.int32)
         last_idx = jnp.asarray([take - 1], jnp.int32)
         with trace.span("continuous.prefill_flip", tokens=take):
             if self.page_size > 0:
@@ -1617,12 +1657,13 @@ class ContinuousBatcher:
                     self.server.params, piece, self._cache, self._tok,
                     table_row, offset, jnp.int32(slot), last_idx,
                     temp, top_k, top_p, seed, write_page_ids, page_start,
+                    first_step,
                 )
             else:
                 self._cache, self._tok, first = self._piece_flip_prog(
                     self.server.params, piece, self._cache, self._tok,
                     offset, jnp.int32(slot), last_idx,
-                    temp, top_k, top_p, seed,
+                    temp, top_k, top_p, seed, first_step,
                 )
         del self._filling[slot]
         self._fill_order.remove(slot)
@@ -2056,6 +2097,51 @@ class ContinuousBatcher:
                 "continuous engine restarted (restart #%d)", self._restarts
             )
 
+    def _watchdog(self) -> None:
+        """Hang monitor (``boundary_watchdog_s`` > 0): the supervisor only
+        heals CRASHES — a device dispatch that never returns (real on TPU:
+        a wedged transfer or a hung collective) would hold the loop, and
+        every waiter, forever. This thread watches the loop's per-boundary
+        progress stamp; a stall past the window with rows active fails
+        every waiter NOW (the ticket queues are thread-safe, and the
+        wedged loop is inside a device call, not mutating row state),
+        flips the state to "restarting" so /healthz drains, and leaves a
+        pending error the loop raises the moment the dispatch returns —
+        the stall then feeds the ordinary crash/restart/breaker path. A
+        second put from that path is harmless: consumers stop at their
+        first error item. The poll is window/4 but capped at 250ms — the
+        check is a handful of attribute reads, and a short cadence keeps
+        detection prompt even under a large warm-up-safe window (or one
+        an operator tightens on a live engine once compiles clear)."""
+        while not self._closed_ev.wait(
+                max(0.01, min(0.25, self.boundary_watchdog_s / 4))):
+            if self._watch_stall is not None or self._state != "running":
+                continue
+            last = self._progress_t
+            busy = bool(self._rows or self._filling or self._first_pending)
+            if not busy or last is None:
+                continue
+            stalled_s = time.monotonic() - last
+            if stalled_s <= self.boundary_watchdog_s:
+                continue
+            err = EngineBrokenError(
+                f"boundary watchdog: no dispatch progress in "
+                f"{stalled_s:.2f}s (window {self.boundary_watchdog_s}s)"
+            )
+            self._watch_stall = err
+            self.stats["watchdog_stalls"] += 1
+            self._state = "restarting"  # readiness drains while wedged
+            logging.getLogger("modelx.serve").error(
+                "continuous engine stalled: no boundary progress in %.2fs "
+                "(watchdog %.2fs) — failing %d active row(s)",
+                stalled_s, self.boundary_watchdog_s,
+                len(self._rows) + len(self._filling),
+            )
+            for row in list(self._rows.values()):
+                row.out.put(err)
+            for fill in list(self._filling.values()):
+                fill.ticket.out.put(err)
+
     def _rebuild(self) -> None:
         """Fresh engine state after a crash: new KV cache (or page pool),
         zeroed host vectors, every slot free. The compiled programs are
@@ -2097,6 +2183,8 @@ class ContinuousBatcher:
         self._last_chunk_t = None
         self._prep_memo = {}
         self._tok_host = None
+        self._watch_stall = None
+        self._progress_t = None
         self._sync_wait_s = 0.0
         self._boundary_syncs = 0
         self._steady = False
@@ -2110,6 +2198,12 @@ class ContinuousBatcher:
         pending: "deque[tuple]" = deque()  # in-flight chunks, oldest first
         try:
             while True:
+                if self._watch_stall is not None:
+                    # the watchdog declared this boundary stalled while a
+                    # dispatch was wedged; it already failed the waiters —
+                    # unwind into the supervisor so the state rebuilds
+                    raise self._watch_stall
+                self._progress_t = time.monotonic()
                 self._sweep_closed()
                 if not self._rows:
                     # idle (or fill-only) gaps between chunks aren't
@@ -2584,21 +2678,42 @@ class ContinuousBatcher:
                temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                seed: int = 0, chunk_size: int = 0,
                stop_token_ids=None, timeout_s: float | None = None,
-               priority: str = "interactive") -> Iterator[np.ndarray]:
+               priority: str = "interactive",
+               resume_step: int = 0) -> Iterator[np.ndarray]:
         """Single-row streaming: yields [1, k] arrays of new tokens as the
         engine decodes them (k == 1 for the prefill token, then up to the
         ENGINE's chunk size — the per-request chunk_size arg is accepted for
         interface parity and ignored). A stop-token hit ends the stream
-        early and frees the slot."""
+        early and frees the slot.
+
+        ``resume_step`` = k > 0 CONTINUES an interrupted stream: the caller
+        passes ``tokens`` = original prompt + the k tokens already emitted,
+        ``max_new_tokens`` = the ORIGINAL budget minus k, and the original
+        ``seed`` — the row re-prefills (chunked prefill and prefix-cache
+        seeding apply unchanged) and its first token is sampled at step k
+        of the original (seed, step) stream, so the continuation is
+        byte-identical to the tokens the interrupted stream would have
+        emitted (schedule-invariance, see the module docstring)."""
         tokens = np.asarray(tokens, np.int32)
         if tokens.shape[0] != 1:
             raise ValueError("continuous stream is single-row")
+        resume_step = int(resume_step)
+        if resume_step < 0:
+            raise ValueError("resume_step must be >= 0")
+        if resume_step >= tokens.shape[1]:
+            # ids = prompt + emitted, so a valid resume always leaves at
+            # least the original prompt's first token ahead of the frontier
+            raise ValueError(
+                f"resume_step {resume_step} >= row length {tokens.shape[1]} "
+                "(pass prompt + emitted tokens)"
+            )
+        samp = {"temperature": temperature, "top_k": top_k, "top_p": top_p,
+                "seed": seed, "stop_token_ids": list(stop_token_ids or ()),
+                "priority": priority}
+        if resume_step:
+            samp["resume_step"] = resume_step
         ticket = self.submit(
-            tokens[0].tolist(), max_new_tokens,
-            {"temperature": temperature, "top_k": top_k, "top_p": top_p,
-             "seed": seed, "stop_token_ids": list(stop_token_ids or ()),
-             "priority": priority},
-            timeout_s=timeout_s,
+            tokens[0].tolist(), max_new_tokens, samp, timeout_s=timeout_s,
         )
         try:
             for piece in self._drain_row(ticket.out):

@@ -42,7 +42,11 @@ from typing import Iterator
 
 import numpy as np
 
-from modelx_tpu.dl.serving_errors import deadline_kwargs
+from modelx_tpu.dl.serving_errors import (
+    MalformedResumeError,
+    ResumeExhaustedError,
+    deadline_kwargs,
+)
 
 logger = logging.getLogger("modelx.serve")
 
@@ -548,12 +552,22 @@ def run_completion(sset, req: dict, chat: bool,
 
 def stream_completion(sset, req: dict, chat: bool,
                       timeout_s: float | None = None,
-                      priority: str = "interactive") -> Iterator[dict]:
+                      priority: str = "interactive",
+                      resume=None) -> Iterator[dict]:
     """SSE event bodies for stream=true (single prompt only). The first
     ``next()`` performs all validation — callers pull one event before
     committing a 200 so bad requests still fail with their real status.
     ``timeout_s``/``priority`` propagate to the continuous engine like
-    the non-streaming path's."""
+    the non-streaming path's.
+
+    ``resume`` is a parsed ``(emitted_token_ids, seed)`` pair (the shared
+    ``serving_errors.parse_resume`` output): the row re-prefills
+    prompt + emitted and continues the ORIGINAL (seed, step) sample
+    stream at step k, so the continuation's tokens are byte-identical to
+    the ones the severed stream would have produced. The SSE content
+    resumes from the text the emitted tokens decode to — already on the
+    dead stream's wire, never re-sent. Typed: malformed 400, resume past
+    the budget or EOS 422 (the original stream was complete)."""
     server = resolve_model(sset, req)
     tok = tokenizer_for(server)
     prompts = parse_prompts(req, chat, server)
@@ -581,6 +595,28 @@ def stream_completion(sset, req: dict, chat: bool,
     include_usage = bool((opts or {}).get("include_usage", False))
 
     eos = eos_for(tok, req)  # validates ignore_eos BEFORE counting
+    resume_step = 0
+    resume_ids: list[int] = []
+    if resume is not None:
+        emitted, rseed = resume
+        vocab = getattr(server.cfg, "vocab_size", 0) or 0
+        if vocab and max(emitted) >= vocab:
+            raise MalformedResumeError(f"emitted token ids must be in [0, {vocab})")
+        if len(emitted) >= n_tokens:
+            # the original stream was COMPLETE: nothing left to decode
+            raise ResumeExhaustedError(
+                f"{len(emitted)} tokens already emitted of a "
+                f"{n_tokens}-token budget")
+        if any(t in set(eos) for t in emitted):
+            raise ResumeExhaustedError("an EOS token was already emitted")
+        # resume.seed pins the effective seed: this surface derives a
+        # RANDOM seed when the request omits one, and a continuation must
+        # rejoin the original stream, not start a fresh one
+        samp["seed"] = int(rseed)
+        resume_ids = [int(t) for t in emitted]
+        resume_step = len(resume_ids)
+        ids = list(ids) + resume_ids
+        n_tokens -= resume_step
     server.stats["requests"] += 1
     # a stop sequence can straddle decode chunks ("hello wo" + "rld"):
     # hold back the longest prefix a stop could still complete, so no text
@@ -594,9 +630,11 @@ def stream_completion(sset, req: dict, chat: bool,
         # continuous engine when enabled, operator chunk size either way;
         # an EOS hit ends decode early (the stream layer drops the EOS
         # token from the content and reports finish_reason "stop")
+        kw = deadline_kwargs(timeout_s, priority)
+        if resume_step:
+            kw["resume_step"] = resume_step
         gen = sset.stream_source(server, np.asarray([ids], np.int32), n_tokens,
-                                 samp, stop_token_ids=list(eos) or None,
-                                 **deadline_kwargs(timeout_s, priority))
+                                 samp, stop_token_ids=list(eos) or None, **kw)
         # prime generation BEFORE yielding anything: the transport commits
         # its 200 after the first event, and a compile/decode failure must
         # surface as a real status even for chat (whose first event is the
@@ -617,9 +655,13 @@ def stream_completion(sset, req: dict, chat: bool,
                 **envelope,
                 "choices": [{"index": 0, "delta": {"role": "assistant"}, "finish_reason": None}],
             }
-        sent = ""
-        text = ""
-        new_ids: list[int] = []
+        # a resumed stream's emitted tokens decoded to text ALREADY on the
+        # severed stream's wire: seed the sent/decoded state with them so
+        # only genuinely new text is emitted (glyph-stable decode still
+        # runs over the full generated prefix, emitted included)
+        sent = tok.decode(resume_ids) if resume_ids else ""
+        text = sent
+        new_ids: list[int] = list(resume_ids)
         eos_count = 0
         finish = "length"
         pieces = gen if first_piece is None else itertools.chain((first_piece,), gen)

@@ -42,6 +42,7 @@ for a cold pjit.
 from __future__ import annotations
 
 import glob
+import itertools
 import json
 import logging
 import os
@@ -58,12 +59,17 @@ from modelx_tpu.dl import families as fam
 from modelx_tpu.dl.serving_errors import (
     DEADLINE_HEADER,
     PRIORITY_HEADER,
+    RESUME_EMITTED_HEADER,
+    RESUME_SEED_HEADER,
     DeadlineExceededError,
+    MalformedResumeError,
     ModelLoadingError,
+    ResumeExhaustedError,
     ServingError,
     deadline_kwargs,
     parse_deadline_ms,
     parse_priority,
+    parse_resume,
 )
 from modelx_tpu.parallel.mesh import make_mesh
 from modelx_tpu.utils import trace
@@ -1061,6 +1067,7 @@ class ServerSet:
                  prefill_budget: int = 0,
                  max_queue_depth: int = 0,
                  request_timeout_s: float = 0.0,
+                 boundary_watchdog_s: float = 0.0,
                  hbm_budget_bytes: int = 0,
                  evict_idle: bool = False,
                  allow_admin_load: bool = False,
@@ -1127,6 +1134,10 @@ class ServerSet:
         # than request_timeout_s expire with 504 at chunk boundaries
         self.max_queue_depth = max_queue_depth
         self.request_timeout_s = request_timeout_s
+        # no-progress boundary watchdog for the continuous engine: a wedged
+        # device dispatch (real on TPU) is treated as a crash after this
+        # many seconds so the restart/breaker machinery applies (0 = off)
+        self.boundary_watchdog_s = boundary_watchdog_s
         self.max_batch = max_batch
         self.batch_window_ms = batch_window_ms
         self.stream_chunk_size = stream_chunk_size
@@ -1137,6 +1148,11 @@ class ServerSet:
         # set on SIGTERM: /healthz flips to 503 so load balancers stop
         # routing here while in-flight requests finish (graceful drain)
         self.draining = False
+        # live POST count (streams included, until their last byte): the
+        # drain loop in serve_main waits for this to reach zero before
+        # closing engines, instead of sleeping a fixed interval
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         # the lifecycle pool (dl/lifecycle.py): state machine + HBM budget
         # + in-flight accounting for every tenant, boot-time set included
         from modelx_tpu.dl.lifecycle import ModelPool
@@ -1145,6 +1161,22 @@ class ServerSet:
             self, hbm_budget_bytes=hbm_budget_bytes, evict_idle=evict_idle,
             allow_admin_load=allow_admin_load, staging_root=staging_root,
         )
+
+    def request_began(self) -> None:
+        """Count a POST as in-flight until its last byte is written — a
+        streaming response stays in-flight for its whole body, which is
+        what the SIGTERM drain loop must wait out."""
+        with self._inflight_lock:
+            self._inflight += 1
+
+    def request_ended(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+
+    @property
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
 
     def add_server(self, name: str, server: ModelServer) -> None:
         """Insert a runtime-loaded model into the routing set (the pool's
@@ -1256,6 +1288,7 @@ class ServerSet:
                     prefill_budget=self.prefill_budget,
                     max_queue_depth=self.max_queue_depth,
                     request_timeout_s=self.request_timeout_s,
+                    boundary_watchdog_s=self.boundary_watchdog_s,
                 )
                 self.cbatchers[server.name] = cb
         return cb
@@ -1326,19 +1359,29 @@ class ServerSet:
 
     def stream_source(self, server: ModelServer, tokens, n: int, samp: dict,
                       stop_token_ids=None, timeout_s: float | None = None,
-                      priority: str = "interactive"):
+                      priority: str = "interactive", resume_step: int = 0):
         """Streaming analogue of engine_for: a token-chunk iterator.
         Single-row streams join the continuous engine when enabled; all
         paths honor the operator's --stream-chunk-size and end early on a
         stop-token hit. ``timeout_s``/``priority`` (a propagated
         X-ModelX-Deadline-Ms remainder + priority class) reach only the
         continuous engine — the plain path has no deadline machinery, so
-        the handler's up-front expiry check is its whole contract."""
+        the handler's up-front expiry check is its whole contract.
+        ``resume_step`` > 0 continues a severed stream token-exactly (the
+        row is ``prompt + emitted`` and sampling restarts at step k) —
+        continuous-engine only; the plain path has no per-step sample
+        streams to rejoin, so the handler refuses resume before we get
+        here (MalformedResumeError, 400)."""
         cb = self.continuous_for(server)
         if cb is not None and tokens.shape[0] == 1:
             return cb.stream(tokens, max_new_tokens=n,
                              stop_token_ids=stop_token_ids,
-                             timeout_s=timeout_s, priority=priority, **samp)
+                             timeout_s=timeout_s, priority=priority,
+                             resume_step=resume_step, **samp)
+        if resume_step:
+            raise MalformedResumeError(
+                "resume requires the continuous engine (single-row stream)"
+            )
         return server.generate_stream(
             tokens, max_new_tokens=n, chunk_size=self.stream_chunk_size,
             stop_token_ids=stop_token_ids, **samp
@@ -1499,15 +1542,23 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                     pass
 
         def _stream_generate(self, server, tokens, n, samp, stop_ids=None,
-                             timeout_s=None, priority="interactive") -> None:
-            """One NDJSON line of NEW tokens per decoded chunk, then
-            {"done": true}; concatenates to the non-streaming result.
-            Single-row streams ride the continuous engine when enabled, so
-            N concurrent SSE clients share one running decode instead of
-            contending with N independent loops."""
+                             timeout_s=None, priority="interactive",
+                             resume_step=0) -> None:
+            """NDJSON token stream, then {"done": true}; concatenates to
+            the non-streaming result. Single-row streams emit ONE token
+            per line ({"tokens": [[t]]}): position-independent framing, so
+            a router splicing a continuation (resume after a pod death)
+            produces a body byte-identical to the uninterrupted stream
+            regardless of where the original died relative to chunk
+            boundaries. Multi-row streams (plain path only) keep one line
+            per decoded chunk. Single-row streams ride the continuous
+            engine when enabled, so N concurrent clients share one running
+            decode instead of contending with N independent loops."""
+            kw = deadline_kwargs(timeout_s, priority)
+            if resume_step:
+                kw["resume_step"] = resume_step
             gen = sset.stream_source(server, tokens, n, samp,
-                                     stop_token_ids=stop_ids,
-                                     **deadline_kwargs(timeout_s, priority))
+                                     stop_token_ids=stop_ids, **kw)
             try:
                 # pull the first chunk BEFORE committing a 200: an
                 # unsupported family / bad request must still be a 4xx
@@ -1523,9 +1574,15 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
 
             def payloads():
                 if first is not None:
-                    yield json.dumps({"tokens": first.tolist()}).encode() + b"\n"
-                    for piece in gen:
-                        yield json.dumps({"tokens": piece.tolist()}).encode() + b"\n"
+                    for piece in itertools.chain([first], gen):
+                        rows = piece.tolist()
+                        if len(rows) == 1:
+                            for t in rows[0]:
+                                yield (json.dumps({"tokens": [[t]]}).encode()
+                                       + b"\n")
+                        else:
+                            yield (json.dumps({"tokens": rows}).encode()
+                                   + b"\n")
                 yield b'{"done": true}\n'
 
             self._stream_chunks(
@@ -1561,10 +1618,32 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                     sset.pool.exit(name)
                 return self._json(api.status, api.payload, headers=e.headers())
             try:
+                # mid-stream failover resume (ISSUE 12): the SAME wire
+                # block as the native surface — router headers win over a
+                # native ``resume`` field. Validation and token-exact
+                # continuation run here too; the fleet router only
+                # SPLICES native NDJSON streams (docs/router.md), but the
+                # pod-side contract must not differ between surfaces.
+                resume = None
+                hdr_e = self.headers.get(RESUME_EMITTED_HEADER)
+                hdr_s = self.headers.get(RESUME_SEED_HEADER)
+                if hdr_e is not None or hdr_s is not None:
+                    resume = parse_resume(hdr_e, hdr_s)
+                else:
+                    rz = req.get("resume")
+                    if rz is not None:
+                        if not isinstance(rz, dict):
+                            raise MalformedResumeError(
+                                "resume must be an object with emitted + seed")
+                        resume = parse_resume(rz.get("emitted"), rz.get("seed"))
+                if resume is not None and not bool(req.get("stream", False)):
+                    raise MalformedResumeError(
+                        "resume requires a streaming request")
                 if bool(req.get("stream", False)):
                     events = oai.stream_completion(sset, req, chat,
                                                    timeout_s=timeout_s,
-                                                   priority=priority)
+                                                   priority=priority,
+                                                   resume=resume)
                     try:
                         # validation + compile errors must surface as a real
                         # status, so pull the first event before the 200
@@ -1720,6 +1799,18 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                 self._json(404, {"error": "not found"})
 
         def do_POST(self):
+            # pod-level in-flight accounting for coordinated drain: a
+            # SIGTERM'd pod stops admitting (ready flips false) and waits
+            # for this count — streams included, until their LAST byte —
+            # to reach zero before closing engines (serve_main's
+            # --drain-grace loop), instead of sleeping a fixed interval
+            sset.request_began()
+            try:
+                self._do_POST()
+            finally:
+                sset.request_ended()
+
+        def _do_POST(self):
             length = int(self.headers.get("Content-Length", 0) or 0)
             try:
                 req = json.loads(self.rfile.read(length)) if length else {}
@@ -1945,6 +2036,66 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                                 "error": "stop_token_ids must be a list of up "
                                 "to 16 in-vocab token ids"
                             })
+                    # mid-stream failover resume (ISSUE 12): both surfaces
+                    # carry the same block — X-ModelX-Resume-* headers (the
+                    # router's continuation path) win over the native
+                    # ``resume`` field (a resumed client request that is
+                    # itself being continued keeps the router's LONGER
+                    # emitted list); each surface is both-or-neither
+                    resume = None
+                    resume_step = 0
+                    try:
+                        hdr_e = self.headers.get(RESUME_EMITTED_HEADER)
+                        hdr_s = self.headers.get(RESUME_SEED_HEADER)
+                        if hdr_e is not None or hdr_s is not None:
+                            resume = parse_resume(hdr_e, hdr_s)
+                        else:
+                            rz = req.get("resume")
+                            if rz is not None:
+                                if not isinstance(rz, dict):
+                                    raise MalformedResumeError(
+                                        "resume must be an object with "
+                                        "emitted + seed")
+                                resume = parse_resume(rz.get("emitted"),
+                                                      rz.get("seed"))
+                        if resume is not None:
+                            emitted, rseed = resume
+                            if (not bool(req.get("stream", False))
+                                    or tokens.shape[0] != 1):
+                                raise MalformedResumeError(
+                                    "resume requires a single-row "
+                                    "streaming request")
+                            if vocab and max(emitted) >= vocab:
+                                raise MalformedResumeError(
+                                    f"emitted token ids must be in "
+                                    f"[0, {vocab})")
+                            if len(emitted) >= n:
+                                # the original stream was COMPLETE — the
+                                # router finishes the client stream (done
+                                # line) instead of re-decoding anything
+                                raise ResumeExhaustedError(
+                                    f"{len(emitted)} tokens already "
+                                    f"emitted of a {n}-token budget")
+                            if stop_ids and any(t in stop_ids
+                                                for t in emitted):
+                                raise ResumeExhaustedError(
+                                    "a stop token was already emitted")
+                    except ServingError as e:
+                        return self._json(e.http_status, {"error": str(e)},
+                                          headers=e.headers())
+                    if resume is not None:
+                        # re-prefill prompt + emitted (chunked prefill and
+                        # the prefix cache apply unchanged) and continue
+                        # the ORIGINAL (seed, step) sample stream at step
+                        # k; resume.seed pins the effective seed — the
+                        # OpenAI surface derives a random one when the
+                        # request omits it, and a continuation must not
+                        samp["seed"] = rseed
+                        resume_step = len(emitted)
+                        tokens = np.concatenate(
+                            [tokens, np.asarray([emitted], np.int32)],
+                            axis=1)
+                        n -= resume_step
                     if bool(req.get("stream", False)):
                         if stop_ids and tokens.shape[0] > 1:
                             # per-row early stop breaks the [B, k]-aligned
@@ -1956,7 +2107,8 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                             })
                         return self._stream_generate(
                             server, tokens, n, samp, stop_ids,
-                            timeout_s=timeout_s, priority=priority)
+                            timeout_s=timeout_s, priority=priority,
+                            resume_step=resume_step)
                     engine = sset.engine_for(
                         server, tokens.shape[0], samp["temperature"]
                     )

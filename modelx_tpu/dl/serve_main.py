@@ -11,6 +11,7 @@ from __future__ import annotations
 import logging
 import signal
 import threading
+import time
 
 import click
 
@@ -160,6 +161,22 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
 @click.option("--drain-seconds", default=5.0, type=float,
               help="on SIGTERM, serve 503 on /healthz for this long (so load "
                    "balancers drain) before stopping")
+@click.option("--drain-grace", default=0.0, type=float,
+              help="coordinated drain: on SIGTERM, stop admission and wait "
+                   "for in-flight requests (streams included, to their last "
+                   "byte) to reach zero, up to this many seconds, instead "
+                   "of the fixed --drain-seconds sleep. The fleet router "
+                   "proactively CONTINUES this pod's live streams elsewhere "
+                   "once /healthz reports draining (docs/router.md), so the "
+                   "count drains fast (0 = fixed-sleep drain)")
+@click.option("--boundary-watchdog-s", default=0.0, type=float,
+              help="continuous batching: treat a device dispatch that makes "
+                   "no chunk-boundary progress for this many seconds as a "
+                   "crash — the engine's restart/breaker machinery applies "
+                   "and waiters get EngineBrokenError instead of hanging "
+                   "forever (a wedged TPU dispatch is otherwise silent; "
+                   "0 = off). Size it well above the worst legitimate "
+                   "boundary: first-request compiles run minutes on TPU")
 def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen: str,
          max_seq_len: int, compile_cache: bool,
          blob_cache_dir: str, blob_cache_max_bytes: int,
@@ -175,7 +192,8 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
          hbm_budget_bytes: int, evict_idle: bool, allow_admin_load: bool,
          publish_programs: bool,
          admin_tokens: tuple[str, ...], staging_dir: str,
-         loras: tuple[str, ...], drain_seconds: float) -> None:
+         loras: tuple[str, ...], drain_seconds: float,
+         drain_grace: float, boundary_watchdog_s: float) -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     from modelx_tpu.parallel.distributed import initialize
 
@@ -266,6 +284,7 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
                      prefill_budget=prefill_budget,
                      max_queue_depth=max_queue_depth,
                      request_timeout_s=request_timeout,
+                     boundary_watchdog_s=boundary_watchdog_s,
                      hbm_budget_bytes=hbm_budget_bytes,
                      evict_idle=evict_idle,
                      allow_admin_load=allow_admin_load,
@@ -310,7 +329,24 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
     # whether it started the shutdown or lands MID-drain — exits now
     # (an Event wait, unlike time.sleep, isn't resumed after the handler)
     sset.draining = True
-    if not abort.is_set() and drain_seconds > 0:
+    if not abort.is_set() and drain_grace > 0:
+        # coordinated drain: admission is off (ready is False -> /healthz
+        # 503 "draining"), so the in-flight count only falls. The fleet
+        # router sees DRAINING and proactively continues this pod's live
+        # streams on other pods (token-exact resume), so streams hand off
+        # instead of running to completion here. Exit as soon as the pod
+        # is idle; the grace bound caps a stuck stream.
+        log = logging.getLogger("modelx.serve")
+        log.info("draining: waiting up to %.0fs for %d in-flight "
+                 "request(s)", drain_grace, sset.inflight)
+        deadline = time.monotonic() + drain_grace
+        while sset.inflight > 0 and time.monotonic() < deadline:
+            if abort.wait(timeout=0.05):
+                break  # Ctrl-C mid-drain: exit now
+        if sset.inflight > 0:
+            log.warning("drain grace expired with %d request(s) still "
+                        "in flight", sset.inflight)
+    elif not abort.is_set() and drain_seconds > 0:
         logging.getLogger("modelx.serve").info(
             "draining for %.0fs before shutdown", drain_seconds)
         abort.wait(timeout=drain_seconds)

@@ -28,8 +28,21 @@ classes:
   the model right now: every candidate is loading, draining, quarantined,
   or shedding; the fleet may recover on its own, so back off and retry)
 - ``UpstreamSeveredError``  -> 502 (a pod died MID-STREAM after bytes were
-  already relayed; the router surfaces this typed payload in-stream —
-  never a silently truncated 200 — and quarantines the pod)
+  already relayed and CONTINUATION was exhausted; the router surfaces
+  this typed payload in-stream — never a silently truncated 200 — and
+  quarantines the pod)
+
+Live request continuation (ISSUE 12) adds a resume block to the wire
+contract — a native ``resume`` field and the ``X-ModelX-Resume-*``
+headers, parsed by ONE function here so the router and pod halves cannot
+drift — plus two typed refusals:
+
+- ``MalformedResumeError``  -> 400 (the resume block cannot be honored as
+  stated; the router falls back to the typed severed error rather than
+  silently restarting a stream the client already holds half of)
+- ``ResumeExhaustedError``  -> 422 (the resume frontier is at or past the
+  request's end — every budgeted token, or a stop token, was already
+  emitted; the router COMPLETES the client stream instead of erroring)
 
 Kept dependency-free (no jax, no requests) so the transport layer can
 import it at module top without cost.
@@ -46,6 +59,15 @@ from __future__ import annotations
 DEADLINE_HEADER = "X-ModelX-Deadline-Ms"
 PRIORITY_HEADER = "X-ModelX-Priority"
 CLIENT_HEADER = "X-ModelX-Client"
+
+# Live request continuation (ISSUE 12): a re-issued request carries the
+# tokens the CLIENT already received and the original sample-stream seed,
+# so the receiving pod re-prefills prompt + emitted, pins the seed, and
+# continues the (seed, step) stream at step k = len(emitted) — emitting
+# byte-identical tokens from k+1 on. Self-contained: the pod derives the
+# resume point entirely from this block plus the original request body.
+RESUME_EMITTED_HEADER = "X-ModelX-Resume-Emitted"
+RESUME_SEED_HEADER = "X-ModelX-Resume-Seed"
 
 PRIORITY_INTERACTIVE = "interactive"
 PRIORITY_BATCH = "batch"
@@ -72,6 +94,61 @@ def parse_deadline_ms(value) -> float | None:
         # OverflowError: "inf"/"1e400" parse as float but refuse int() —
         # malformed like the rest, never an escaped handler exception
         return None
+
+
+def parse_resume(emitted_value, seed_value):
+    """Resume block -> ``(emitted token ids, seed)``, or None when absent.
+    ONE parser for both wire surfaces: ``emitted_value`` is either the
+    header's comma-separated string or the native field's id list;
+    ``seed_value`` the header string or native int. Anything the pod
+    cannot honor AS STATED raises ``MalformedResumeError`` (400) — a
+    resume must never be silently treated as a fresh request, because the
+    caller splices the continuation into a stream the client already
+    holds the first k tokens of."""
+    if emitted_value is None and seed_value is None:
+        return None
+    if emitted_value is None or seed_value is None:
+        raise MalformedResumeError(
+            "resume requires both the emitted tokens and the original seed"
+        )
+    try:
+        seed = int(str(seed_value).strip())
+    except (TypeError, ValueError):
+        raise MalformedResumeError(
+            f"resume seed {seed_value!r} is not an integer"
+        ) from None
+    if not 0 <= seed < 2**31:
+        raise MalformedResumeError(f"resume seed {seed} out of [0, 2^31)")
+    if isinstance(emitted_value, str):
+        parts = [p for p in emitted_value.split(",") if p.strip()]
+    elif isinstance(emitted_value, (list, tuple)):
+        parts = list(emitted_value)
+    else:
+        raise MalformedResumeError("resume emitted must be a token id list")
+    if not parts:
+        raise MalformedResumeError("resume emitted is empty: nothing to resume")
+    emitted = []
+    for p in parts:
+        try:
+            t = int(str(p).strip())
+        except (TypeError, ValueError):
+            raise MalformedResumeError(
+                f"resume emitted token {p!r} is not an integer"
+            ) from None
+        if t < 0:
+            raise MalformedResumeError(f"resume emitted token {t} is negative")
+        emitted.append(t)
+    return emitted, seed
+
+
+def resume_headers(emitted, seed) -> dict[str, str]:
+    """The resume block as headers — what the router stamps on a
+    continuation attempt (the original body is re-sent verbatim, so the
+    resume state rides out-of-band exactly like the deadline)."""
+    return {
+        RESUME_EMITTED_HEADER: ",".join(str(int(t)) for t in emitted),
+        RESUME_SEED_HEADER: str(int(seed)),
+    }
 
 
 def deadline_kwargs(timeout_s: float | None, priority: str) -> dict:
@@ -245,6 +322,34 @@ class UpstreamSeveredError(ServingError):
             + "; response is incomplete — retry the request"
         )
         self.pod = pod
+
+
+class MalformedResumeError(ServingError):
+    """The request carried a resume block the pod cannot honor as stated
+    (missing seed, non-integer or negative tokens, empty emitted list,
+    or a resume on a surface/path that cannot replay it). 400: the
+    caller must fall back to its typed severed error, never silently
+    restart the stream — the client already holds the first k tokens."""
+
+    http_status = 400
+    api_type = "invalid_request_error"
+
+    def __init__(self, detail: str) -> None:
+        super().__init__(f"malformed resume: {detail}")
+
+
+class ResumeExhaustedError(ServingError):
+    """The resume frontier is at or past the request's end: every
+    budgeted token — or a stop token — was already emitted, so there is
+    nothing left to continue. 422, distinct from the 400 family: the
+    block was well-formed and the original stream was COMPLETE, so the
+    router finishes the client stream instead of surfacing an error."""
+
+    http_status = 422
+    api_type = "invalid_request_error"
+
+    def __init__(self, detail: str) -> None:
+        super().__init__(f"resume exhausted: {detail}")
 
 
 class ModelFailedError(ServingError):

@@ -21,9 +21,19 @@ Per request:
    included — and feeds the rebalancer's pressure signal;
 4. streaming: the first body chunk is pulled BEFORE the 200 commits, so
    an immediately-dying pod still fails over invisibly; after bytes are
-   on the wire a severed pod surfaces as a typed in-stream error payload
-   (``UpstreamSeveredError``, 502 in the payload) — never a silently
-   truncated 200 — and the pod is quarantined.
+   on the wire a native single-row token stream whose pod dies (or
+   announces draining) is CONTINUED: the router re-plans within the
+   remaining deadline and retry budget and re-issues the request with
+   the ``X-ModelX-Resume-*`` block set to the tokens already relayed —
+   the pod re-prefills prompt + emitted and rejoins the original
+   (seed, step) sample stream, so the spliced body is byte-identical to
+   the uninterrupted one. Only when continuation is exhausted (budget
+   dry, deadline gone, no candidate, resume refused) does the client
+   see the typed in-stream error payload (``UpstreamSeveredError``, 502
+   in the payload) — never a silently truncated 200. OpenAI SSE streams
+   keep the typed-502 behavior (text deltas are not splice-exact across
+   a re-decode; the pod-side resume contract covers that surface for
+   direct callers).
 
 Non-streaming requests whose pod died mid-body retry FROM SCRATCH on the
 next candidate: nothing was committed to the client, generation is
@@ -47,6 +57,8 @@ from modelx_tpu.dl.serving_errors import (
     QueueFullError,
     ServingError,
     UpstreamSeveredError,
+    parse_resume,
+    resume_headers,
 )
 from modelx_tpu.router.admission import (
     DEADLINE_HEADER,
@@ -84,7 +96,11 @@ class RouterMetrics:
         self.routes: dict[str, int] = {}          # pod url -> relayed responses
         self.model_routes: dict[str, int] = {}    # model -> relayed responses
         self.failovers_total = 0                  # candidate skipped mid-plan
-        self.severed_streams_total = 0            # typed mid-stream deaths
+        self.severed_streams_total = 0            # client-visible severed streams
+        self.streams_continued_total = 0          # mid-stream failovers spliced
+        self.continuation_attempts_total = 0      # continuation dispatches
+        self.continuation_failed_total = 0        # continuation exhausted
+        self.drain_handoffs_total = 0             # proactive DRAINING hand-offs
         self.backpressure_relayed_total = 0       # plan exhausted on 429/503
         self.no_pod_total = 0                     # NoReadyPodError answered
         self.upstream_attempts_total = 0          # dispatches, retries included
@@ -109,6 +125,10 @@ class RouterMetrics:
                 "model_routes": dict(self.model_routes),
                 "failovers_total": self.failovers_total,
                 "severed_streams_total": self.severed_streams_total,
+                "streams_continued_total": self.streams_continued_total,
+                "continuation_attempts_total": self.continuation_attempts_total,
+                "continuation_failed_total": self.continuation_failed_total,
+                "drain_handoffs_total": self.drain_handoffs_total,
                 "backpressure_relayed_total": self.backpressure_relayed_total,
                 "no_pod_total": self.no_pod_total,
                 "upstream_attempts_total": self.upstream_attempts_total,
@@ -259,6 +279,104 @@ def _stream_error_payload(content_type: str, path: str, e: ServingError) -> byte
     return body + b"\n"
 
 
+class _StreamSession:
+    """Client side of ONE committed continuable stream, shared by every
+    upstream attempt that feeds it (the original dispatch and any
+    continuations after a sever).
+
+    Continuable streams are the native single-row NDJSON token streams:
+    the pod emits one ``{"tokens": [[t]]}`` line per token, so relaying
+    COMPLETE lines only — partial lines buffer here and die with their
+    upstream — keeps the client's wire at a token boundary at all times,
+    and ``emitted`` is exactly the resume block a continuation must
+    carry. A spliced stream is then byte-identical to an uninterrupted
+    one. In-stream ``{"error": ...}`` lines from the pod (engine broke
+    mid-decode, pod-side expiry) are HELD rather than relayed: a
+    continuation may still save the stream, and the held line is the
+    honest fallback when it can't."""
+
+    def __init__(self, handler, path: str, seed: int,
+                 base_emitted: list[int] | None = None) -> None:
+        self._handler = handler
+        self.path = path
+        self.seed = int(seed)
+        # the client's OWN resume block (it is continuing a stream some
+        # earlier connection severed): those tokens are on the client's
+        # wire already, so OUR continuations must prepend them
+        self.base_emitted = [int(t) for t in (base_emitted or [])]
+        self.committed = False
+        self.content_type = "application/json"
+        self.client_gone = False
+        self.done = False              # the done line reached the client
+        self.severed = False           # current upstream died mid-stream
+        self.deadline_hit = False      # upstream read outran the deadline
+        self.drain_handoff = False     # sever was a proactive drain pickup
+        self.continued = False         # >= 1 continuation attempt relayed
+        self.sever_pod = ""            # last pod that severed (for the 502)
+        self.pod_error: bytes | None = None  # held in-stream error line
+        self.emitted: list[int] = []   # token ids on the client's wire
+        self._buf = b""
+
+    def commit(self, content_type: str) -> None:
+        if self.committed:
+            return
+        self.committed = True
+        self.content_type = content_type
+        h = self._handler
+        h.send_response(200)
+        h.send_header("Content-Type", content_type)
+        h.send_header("Cache-Control", "no-cache")
+        h.send_header("Transfer-Encoding", "chunked")
+        h.end_headers()
+
+    def write(self, payload: bytes) -> None:
+        if not payload or self.client_gone:
+            return
+        try:
+            self._handler.wfile.write(f"{len(payload):x}\r\n".encode())
+            self._handler.wfile.write(payload + b"\r\n")
+        except OSError:
+            self.client_gone = True
+
+    def reset_for_attempt(self) -> None:
+        """A new upstream is about to feed this stream: drop the dead
+        upstream's partial line and sever mark (the client wire state —
+        ``emitted``/``done`` — is exactly what carries over)."""
+        self.severed = False
+        self.pod_error = None
+        self._buf = b""
+
+    def feed(self, data: bytes) -> None:
+        self._buf += data
+        while not self.severed:
+            line, sep, rest = self._buf.partition(b"\n")
+            if not sep:
+                break
+            self._buf = rest
+            self._feed_line(line + sep)
+
+    def _feed_line(self, line: bytes) -> None:
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            obj = None
+        if isinstance(obj, dict) and "error" in obj:
+            self.pod_error = line
+            self.severed = True
+            return
+        if isinstance(obj, dict) and obj.get("done"):
+            self.done = True
+        elif isinstance(obj, dict) and isinstance(obj.get("tokens"), list):
+            for row in obj["tokens"]:
+                self.emitted.extend(int(t) for t in row)
+        self.write(line)
+
+    def resume_block(self) -> dict[str, str]:
+        """The continuation headers: every token the CLIENT has, original
+        effective seed — the pod re-prefills and rejoins the stream."""
+        return resume_headers(self.base_emitted + self.emitted, self.seed)
+
+
 def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServer:
     """Start the front door (mirrors dl/serve.serve: returns the live
     ThreadingHTTPServer; caller owns shutdown)."""
@@ -377,6 +495,32 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
             keys = sticky_keys(model, req, self.path,
                                window_tokens=router.sticky_window_tokens)
             stream = bool(req.get("stream", False))
+            # mid-stream failover continuation applies to the native
+            # single-row NDJSON token stream: the pod frames one token
+            # per line, so the router can account exactly which ids are
+            # on the client's wire and resume token-exactly. The
+            # effective seed is the request's (or its own resume block's
+            # — a client continuing an already-continued stream).
+            sess = None
+            if stream and self.path not in _OPENAI_PATHS:
+                toks = req.get("tokens")
+                continuable = isinstance(toks, list) and len(toks) == 1
+                seed, base = 0, []
+                if continuable:
+                    try:
+                        seed = int(req.get("seed", 0) or 0)
+                        rz = req.get("resume")
+                        if isinstance(rz, dict):
+                            parsed = parse_resume(rz.get("emitted"),
+                                                  rz.get("seed"))
+                            if parsed is not None:
+                                base, seed = list(parsed[0]), parsed[1]
+                    except (ServingError, TypeError, ValueError):
+                        # the pod types the 400; nothing to continue
+                        continuable = False
+                if continuable:
+                    sess = _StreamSession(self, self.path, seed,
+                                          base_emitted=base)
             plan = plan_route(model, router.registry.candidates(model),
                               router.sticky, keys, router.inflight())
             if not plan:
@@ -419,7 +563,7 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
                 router.enter(pod.url)
                 try:
                     status, bp = self._try_pod(pod, raw, stream, remaining,
-                                               priority)
+                                               priority, sess)
                 finally:
                     router.exit(pod.url)
                 if status is not None:
@@ -431,6 +575,12 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
                         # whose stream the pod severed (it is quarantined
                         # by now) — must not pin the conversation there
                         router.sticky.assign(keys, pod.url)
+                    if sess is not None and sess.committed:
+                        # a continuable stream's endgame: continue a
+                        # severed one within the remaining deadline +
+                        # retry budget, then write the one terminator
+                        self._finish_stream(model, keys, sess, raw,
+                                            deadline, budget, priority)
                     return
                 if bp is not None:
                     last_bp = bp
@@ -457,7 +607,7 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
             raise NoReadyPodError(model, detail="every candidate failed")
 
         def _try_pod(self, pod, raw: bytes, stream: bool, remaining: float,
-                     priority: str):
+                     priority: str, sess=None):
             """One dispatch. Returns (status, backpressure): ``status``
             non-None when a response (any status outside the backpressure
             set) went to the client; ``backpressure`` carries a 429/503
@@ -511,7 +661,10 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
                     router.breakers.record(pod.url, True)
                     return None, bp
                 if stream and resp.status_code == 200:
-                    ok = self._relay_stream(pod, resp)
+                    if sess is not None:
+                        ok = self._relay_continuable(pod, resp, sess)
+                    else:
+                        ok = self._relay_stream(pod, resp)
                 else:
                     ok = self._relay_buffered(pod, resp)
                 if ok:
@@ -610,6 +763,211 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
             except OSError:
                 pass
             return True
+
+        # -- stream continuation (ISSUE 12) -------------------------------
+
+        def _relay_continuable(self, pod, resp, sess) -> bool:
+            """One upstream attempt feeding a continuable stream. The
+            first chunk is pulled before the 200 commits (an immediately
+            dying pod still fails over from scratch); after that the
+            session relays complete token lines and this method only
+            CLASSIFIES how the attempt ended — sever, drain hand-off,
+            deadline — for ``_finish_stream``/``_continue_stream`` to
+            act on. Returns False only when nothing was relayed and the
+            pod died opening the stream."""
+            content_type = resp.headers.get("Content-Type",
+                                            "application/json")
+            it = resp.iter_content(chunk_size=None)
+            try:
+                first = next(it, b"")
+            except requests.RequestException as e:
+                router.pod_died(pod.url, f"stream open: {e}")
+                return False
+            sess.commit(content_type)
+            sess.reset_for_attempt()
+            try:
+                sess.feed(first)
+                for chunk in it:
+                    if sess.severed or sess.client_gone or sess.done:
+                        break
+                    live = router.registry.pod(pod.url)
+                    if (live is not None and live.status == "draining"
+                            and not sess.done):
+                        # coordinated drain: the pod asked to be relieved
+                        # (SIGTERM -> /healthz "draining"); hand its live
+                        # stream off NOW instead of waiting for either
+                        # completion or the socket to die
+                        sess.severed = True
+                        sess.drain_handoff = True
+                        sess.sever_pod = pod.url
+                        router.metrics.count("drain_handoffs_total")
+                        break
+                    sess.feed(chunk)
+            except requests.exceptions.ReadTimeout:
+                # alive-but-slow: the deadline is gone; no continuation
+                # could finish in time, and no quarantine (the pod keeps
+                # its warm caches)
+                sess.deadline_hit = True
+            except requests.RequestException as e:
+                router.pod_died(pod.url, f"mid-stream: {e}")
+                sess.severed = True
+                sess.sever_pod = pod.url
+            return True
+
+        def _finish_stream(self, model: str, keys, sess, raw: bytes,
+                           deadline: float, budget: float,
+                           priority: str) -> None:
+            """Endgame of a committed continuable stream: run the
+            continuation loop if the upstream severed, then write
+            whatever typed payload is still owed and the ONE chunked
+            terminator."""
+            if sess.severed and not sess.done:
+                self._continue_stream(model, keys, sess, raw, deadline,
+                                      priority)
+            if sess.done:
+                if sess.continued:
+                    router.metrics.count("streams_continued_total")
+            elif sess.deadline_hit and not sess.client_gone:
+                err = DeadlineExceededError("streaming", budget)
+                sess.write(_stream_error_payload(
+                    sess.content_type, self.path, err))
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except OSError:
+                pass
+
+        def _continue_stream(self, model: str, keys, sess, raw: bytes,
+                             deadline: float, priority: str) -> None:
+            """The stream severed with bytes committed: re-plan within
+            the REMAINING deadline and the shared retry budget (a
+            continuation IS a failover attempt — it spends the budget,
+            never bypasses it), re-issue the ORIGINAL body with the
+            resume block set to the tokens already on the client's wire,
+            and let the session splice the continuation line-for-line.
+            Loops on repeated severs until the stream completes or
+            continuation is exhausted — only then does the client see
+            the typed severed payload (or the pod's own held in-stream
+            error, which is the more honest story when the pod reported
+            one before dying)."""
+            reason = "exhausted"
+            while sess.severed and not sess.done and not sess.client_gone:
+                if deadline - time.monotonic() <= 0:
+                    reason = "deadline expired"
+                    break
+                if not router.retry_budget.allow_retry():
+                    router.metrics.count("retry_budget_exhausted_total")
+                    reason = "retry budget exhausted"
+                    break
+                plan = plan_route(model, router.registry.candidates(model),
+                                  router.sticky, keys, router.inflight())
+                hdrs = sess.resume_block()
+                outcome = "none"
+                for pod in plan:
+                    if not router.breakers.allow(pod.url):
+                        router.metrics.count("breaker_skipped_total")
+                        continue
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    router.enter(pod.url)
+                    try:
+                        outcome = self._try_continue(pod, raw, sess,
+                                                     remaining, priority,
+                                                     hdrs)
+                    finally:
+                        router.exit(pod.url)
+                    if outcome == "complete":
+                        # resume refused with 422: the original stream
+                        # already emitted its LAST token — every byte the
+                        # client is owed is on its wire; finish it
+                        sess.write(b'{"done": true}\n')
+                        sess.done = True
+                        sess.continued = True
+                        router.metrics.routed(pod.url, model)
+                        break
+                    if outcome == "relayed":
+                        sess.continued = True
+                        router.metrics.routed(pod.url, model)
+                        if not sess.severed:
+                            live = router.registry.pod(pod.url)
+                            if live is not None and live.healthy:
+                                # the continuation pod holds the warm
+                                # prefix now; pin the conversation there
+                                router.sticky.assign(keys, pod.url)
+                        break
+                    if outcome == "refused":
+                        break
+                    # "next": this candidate shed/died before relaying
+                    # anything; the sess is untouched — try another
+                if outcome == "refused":
+                    # a 400 on the resume block is deterministic: every
+                    # other pod speaks the same contract, retrying would
+                    # just burn the budget
+                    reason = "resume refused"
+                    break
+                if outcome == "none":
+                    reason = "no candidate"
+                    break
+            if sess.done or sess.client_gone:
+                return
+            if sess.deadline_hit:
+                return  # _finish_stream writes the typed 504
+            router.metrics.count("continuation_failed_total")
+            router.metrics.count("severed_streams_total")
+            if sess.pod_error is not None:
+                sess.write(sess.pod_error)
+                return
+            err = UpstreamSeveredError(sess.sever_pod or "fleet",
+                                       f"continuation {reason}")
+            logger.warning("stream severed: %s", err)
+            sess.write(_stream_error_payload(
+                sess.content_type, self.path, err))
+
+        def _try_continue(self, pod, raw: bytes, sess, remaining: float,
+                          priority: str, hdrs: dict) -> str:
+            """One continuation dispatch. Returns ``"relayed"`` (the
+            attempt fed the stream — the sess says how it ended),
+            ``"complete"`` (422: the original stream was already done),
+            ``"refused"`` (400: the resume block itself is rejected —
+            deterministic, stop), or ``"next"`` (shed/died before
+            relaying anything; another candidate may serve)."""
+            router.metrics.count("upstream_attempts_total")
+            router.metrics.count("continuation_attempts_total")
+            try:
+                resp = router.http().request(
+                    "POST", pod.url + self.path, data=raw,
+                    headers={
+                        "Content-Type": "application/json",
+                        DEADLINE_HEADER: str(max(1, int(remaining * 1000))),
+                        PRIORITY_HEADER: priority,
+                        **hdrs,
+                    },
+                    stream=True,
+                    timeout=(router.connect_timeout_s, remaining),
+                )
+            except requests.exceptions.ReadTimeout:
+                return "next"  # slow, not dead: the loop's deadline
+                # check settles it; no quarantine
+            except requests.RequestException as e:
+                router.pod_died(pod.url, f"continuation dispatch: {e}")
+                return "next"
+            try:
+                if resp.status_code in _BACKPRESSURE:
+                    router.breakers.record(pod.url, True)
+                    return "next"
+                if resp.status_code == 422:
+                    router.breakers.record(pod.url, True)
+                    return "complete"
+                if resp.status_code != 200:
+                    # 400 malformed resume — or any other deterministic
+                    # refusal: the contract is broken, not the pod
+                    router.breakers.record(pod.url, resp.status_code < 500)
+                    return "refused"
+                return ("relayed"
+                        if self._relay_continuable(pod, resp, sess)
+                        else "next")
+            finally:
+                resp.close()
 
     host, _, port = listen.rpartition(":")
     httpd = ThreadingHTTPServer((host or "0.0.0.0", int(port)), Handler)

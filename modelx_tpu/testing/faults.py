@@ -237,7 +237,8 @@ class FaultyFSProvider:
 
 
 class PodKillSwitch:
-    """Abrupt pod death for fleet-router drills (PR 8).
+    """Abrupt pod death for fleet-router drills (PR 8) — and, with a
+    ``sset``, its opposite: coordinated drain (ISSUE 12).
 
     A clean ``httpd.shutdown()`` lets in-flight handlers FINISH — the
     opposite of a crash. This switch models the real thing: every accepted
@@ -248,14 +249,23 @@ class PodKillSwitch:
     TCP stream — truncated chunked body, no terminator — not a graceful
     error event, and new connections are refused.
 
+    ``drain()`` is the coordinated path the same drills must also cover:
+    what SIGTERM does to a real pod (serve_main's handler), done to an
+    in-process pod — ``sset.draining`` flips, ``/healthz`` answers 503
+    ``{"status": "draining"}``, admission stops, live streams keep
+    flowing so the fleet router can hand them off token-exactly.
+
     Seeded scheduling composes with :class:`FaultPlan`: drive the kill
-    from an exact call index by firing an op per relayed chunk and calling
-    ``kill()`` when the scheduled error lands (see ``fire_kills``); the
-    drill replays byte-identically.
+    (or drain) from an exact call index by firing an op per relayed
+    chunk and calling ``kill()``/``drain()`` when the scheduled error
+    lands (see ``fire_kills``/``fire_drain``); the drill replays
+    byte-identically.
     """
 
-    def __init__(self, httpd) -> None:
+    def __init__(self, httpd, sset=None) -> None:
         self._httpd = httpd
+        self._sset = sset
+        self.draining = False
         self._conns: list = []
         self._lock = threading.Lock()
         self.killed = False
@@ -304,6 +314,31 @@ class PodKillSwitch:
                 time.sleep(act.latency_s)
             if act.error is not None:
                 self.kill()
+                return True
+            return False
+
+        return hook
+
+    def drain(self) -> None:
+        """Coordinated drain: what serve_main's SIGTERM handler does,
+        applied to an in-process pod. Idempotent; requires the switch to
+        have been built with the pod's ServerSet."""
+        if self._sset is None:
+            raise RuntimeError("PodKillSwitch needs sset= to drain")
+        self.draining = True
+        self._sset.draining = True
+
+    def fire_drain(self, plan: FaultPlan, op: str = "pod.drain"):
+        """Like ``fire_kills`` but the scheduled event DRAINS the pod
+        instead of killing it — drills cover both the crash and the
+        coordinated hand-off path. Returns True when the drain fired."""
+
+        def hook() -> bool:
+            act = plan.fire(op)
+            if act.latency_s:
+                time.sleep(act.latency_s)
+            if act.error is not None:
+                self.drain()
                 return True
             return False
 

@@ -1,0 +1,363 @@
+"""Live request continuation (ISSUE 12), pod-side layers: the engine's
+token-exact resume (``resume_step`` rejoins the original (seed, step)
+sample stream), the wire contract (``resume`` block / X-ModelX-Resume-*
+headers, typed 400/422 refusals, one-token-per-line NDJSON framing), the
+boundary-hang watchdog, and the coordinated-drain in-flight accounting.
+
+The oracle throughout: a continuation spliced after k tokens is
+BYTE-IDENTICAL to the uninterrupted stream — greedy and sampled, dense
+and paged KV. Router-side splicing lives in test_router.py."""
+
+import dataclasses
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+import jax
+import jax.numpy as jnp
+
+from modelx_tpu.dl import safetensors as st
+from modelx_tpu.dl.continuous import ContinuousBatcher
+from modelx_tpu.dl.serve import ModelServer, ServerSet, serve
+from modelx_tpu.dl.serving_errors import (
+    EngineBrokenError,
+    RESUME_EMITTED_HEADER,
+    RESUME_SEED_HEADER,
+    resume_headers,
+)
+from modelx_tpu.registry.server import free_port
+from modelx_tpu.testing import faults
+from modelx_tpu.testing.faults import PodKillSwitch
+
+
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    from modelx_tpu.models import llama
+
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64),
+                              dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    d = tmp_path_factory.mktemp("continuation")
+    st.write_safetensors(
+        str(d / "model.safetensors"),
+        {k: np.asarray(v) for k, v in params.items()},
+    )
+    srv = ModelServer(str(d), mesh_spec="dp=1", dtype="float32",
+                      max_seq_len=96, name="m")
+    srv.load()
+    return srv
+
+
+PROMPT = [5, 9, 2, 7, 1]
+N = 17
+GREEDY = dict(temperature=0.0, seed=0)
+SAMPLED = dict(temperature=0.9, top_k=8, top_p=0.95, seed=1234)
+
+
+def _stream_ids(cb, ids, n, samp, resume_step=0):
+    kw = dict(samp)
+    if resume_step:
+        kw["resume_step"] = resume_step
+    out = list(cb.stream(np.asarray([ids], np.int32), max_new_tokens=n, **kw))
+    return np.concatenate(out, axis=1)[0].tolist()
+
+
+class TestEngineResume:
+    """Schedule-invariance at the engine: per-row sample streams depend
+    only on (seed, decode step), so a re-admitted row whose prompt is
+    ``original prompt + k emitted tokens`` and whose first sample runs at
+    step k continues the EXACT stream the severed row was producing."""
+
+    # the whole engine-level matrix rides the slow set (`make continuation` /
+    # `make slow`): tier-1 keeps the splice tests below, which assert the same
+    # byte-equality contract end-to-end through the HTTP front end, and the
+    # tier-1 wall has no room for a second compile-heavy replay
+    @pytest.mark.slow
+    @pytest.mark.parametrize(
+        "page_size,prefill_chunk",
+        [(0, 0), (16, 0), (0, 16)],
+        ids=["dense", "paged", "chunked-prefill"],
+    )
+    def test_resume_is_token_exact(self, server, page_size, prefill_chunk):
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4,
+                               page_size=page_size,
+                               prefill_chunk=prefill_chunk)
+        try:
+            for name, samp in (("greedy", GREEDY), ("sampled", SAMPLED)):
+                full = _stream_ids(cb, PROMPT, N, samp)
+                assert len(full) == N
+                # k=1 (everything replays) and k=12 (deep into decode)
+                # bracket the contract; mid-stream ks add no new path
+                for k in (1, 12):
+                    cont = _stream_ids(cb, PROMPT + full[:k], N - k, samp,
+                                       resume_step=k)
+                    assert cont == full[k:], (
+                        f"{name} k={k}: full tail {full[k:]} != cont {cont}")
+        finally:
+            cb.close()
+
+    def test_resume_step_validation(self, server):
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4)
+        try:
+            with pytest.raises(ValueError, match="resume_step"):
+                next(iter(cb.stream(np.asarray([PROMPT], np.int32),
+                                    max_new_tokens=4, resume_step=-1)))
+            # ids = prompt + emitted, so a resume_step >= the row length
+            # claims more emitted tokens than the row carries
+            with pytest.raises(ValueError, match="resume_step"):
+                next(iter(cb.stream(np.asarray([PROMPT], np.int32),
+                                    max_new_tokens=4,
+                                    resume_step=len(PROMPT))))
+        finally:
+            cb.close()
+
+
+class TestBoundaryWatchdog:
+    # ~6 s of deliberate wedge + restart: rides the slow set with the
+    # other supervised-restart drills (`make continuation` runs it)
+    @pytest.mark.slow
+    def test_wedged_dispatch_fails_waiters_and_restarts(self, server):
+        """A dispatch that never returns (wedged device call) must not
+        hold every waiter forever: the watchdog fails the active rows
+        with the typed error within its window, readiness drains, and
+        the loop feeds the ordinary restart path once the dispatch
+        finally returns — after which the engine serves byte-identical
+        output again."""
+        # the generous ctor window absorbs first-touch compiles; the test
+        # tightens it only once the programs are warm
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4,
+                               restart_backoff_s=0.05,
+                               boundary_watchdog_s=30.0)
+        try:
+            tokens = np.asarray([PROMPT], np.int32)
+            expected = server.generate(tokens, max_new_tokens=11)
+            np.testing.assert_array_equal(
+                cb.generate(tokens, max_new_tokens=11), expected)
+            cb.boundary_watchdog_s = 0.2
+            plan = faults.FaultPlan()
+            plan.add("engine.dispatch", latency_at=[0], latency_s=2.0)
+            cb._chunk = faults.wrap_dispatch(cb._chunk, plan)
+            t0 = time.monotonic()
+            with pytest.raises(EngineBrokenError, match="watchdog"):
+                cb.generate(tokens, max_new_tokens=11)
+            # the waiter was failed BY THE WATCHDOG, mid-wedge — not by
+            # the dispatch eventually returning
+            assert time.monotonic() - t0 < 1.9
+            assert cb.stats["watchdog_stalls"] == 1
+            # once the wedged call returns, the supervisor rebuilds and
+            # the engine serves again, byte-identical
+            deadline = time.monotonic() + 30
+            while (cb.snapshot()["engine_restarts"] < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+            assert cb.snapshot()["engine_restarts"] >= 1
+            np.testing.assert_array_equal(
+                cb.generate(tokens, max_new_tokens=11), expected)
+        finally:
+            cb.close()
+
+
+@pytest.fixture(scope="module")
+def cont_front(server):
+    """The module server behind a continuous-engine pod over HTTP."""
+    sset = ServerSet({"m": server}, continuous_batch=True, max_slots=2,
+                     stream_chunk_size=4)
+    sset.pool.mark_ready("m")
+    httpd = serve(sset, listen=f"127.0.0.1:{free_port()}")
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", sset
+    httpd.shutdown()
+
+
+BODY = {"tokens": [[5, 9, 2, 7, 1]], "max_new_tokens": N, "stream": True,
+        **{k: v for k, v in SAMPLED.items()}}
+
+
+def _lines_to_ids(body: bytes):
+    lines = body.decode().strip().split("\n")
+    assert lines[-1] == '{"done": true}', lines[-1]
+    ids = []
+    for ln in lines[:-1]:
+        obj = json.loads(ln)
+        assert len(obj["tokens"]) == 1 and len(obj["tokens"][0]) == 1, ln
+        ids.append(obj["tokens"][0][0])
+    return ids
+
+
+class TestWireContract:
+    # the splice tests below depend on (and therefore re-assert) the
+    # line framing every run; the explicit framing audit rides slow
+    @pytest.mark.slow
+    def test_single_row_stream_is_one_token_per_line(self, cont_front):
+        """Position-independent framing: wherever a stream is severed,
+        the client's bytes end at a token boundary, so a spliced
+        continuation can be byte-identical."""
+        base, _ = cont_front
+        r = requests.post(base + "/v1/generate", json=BODY, stream=True)
+        assert r.status_code == 200, r.text
+        assert len(_lines_to_ids(r.raw.read())) == N
+
+    @pytest.mark.parametrize("samp", [GREEDY, SAMPLED],
+                             ids=["greedy", "sampled"])
+    def test_resume_headers_splice_byte_exactly(self, cont_front, samp):
+        base, _ = cont_front
+        body = dict(BODY, **samp)
+        full = requests.post(base + "/v1/generate", json=body,
+                             stream=True).raw.read()
+        ids = _lines_to_ids(full)
+        for k in (1, 6, 14):
+            r = requests.post(base + "/v1/generate", json=body,
+                              headers=resume_headers(ids[:k], samp["seed"]),
+                              stream=True)
+            assert r.status_code == 200, r.text
+            prefix = "".join(json.dumps({"tokens": [[t]]}) + "\n"
+                             for t in ids[:k]).encode()
+            assert prefix + r.raw.read() == full
+
+    def test_resume_native_field_splices_and_headers_win(self, cont_front):
+        base, _ = cont_front
+        full = requests.post(base + "/v1/generate", json=BODY,
+                             stream=True).raw.read()
+        ids = _lines_to_ids(full)
+        prefix = lambda k: "".join(json.dumps({"tokens": [[t]]}) + "\n"
+                                   for t in ids[:k]).encode()
+        body = dict(BODY, resume={"emitted": ids[:6], "seed": SAMPLED["seed"]})
+        r = requests.post(base + "/v1/generate", json=body, stream=True)
+        assert r.status_code == 200, r.text
+        assert prefix(6) + r.raw.read() == full
+        # headers WIN over the native field: a router continuing a stream
+        # that was ITSELF a continuation carries the longer emitted list
+        r = requests.post(base + "/v1/generate", json=body,
+                          headers=resume_headers(ids[:9], SAMPLED["seed"]),
+                          stream=True)
+        assert prefix(9) + r.raw.read() == full
+
+    def test_malformed_resume_is_400(self, cont_front, server):
+        base, _ = cont_front
+        cases = [
+            # seed header without emitted (both-or-neither)
+            (BODY, {RESUME_SEED_HEADER: "1234"}),
+            # non-integer emitted tokens
+            (BODY, {RESUME_EMITTED_HEADER: "a,b", RESUME_SEED_HEADER: "1"}),
+            # resume on a non-streaming request
+            (dict(BODY, stream=False,
+                  resume={"emitted": [1], "seed": 0}), {}),
+            # emitted token outside the model's vocab
+            (BODY, resume_headers([10 ** 6], 1)),
+            # native resume block of the wrong shape
+            (dict(BODY, resume=[1, 2]), {}),
+        ]
+        for body, hdrs in cases:
+            r = requests.post(base + "/v1/generate", json=body, headers=hdrs)
+            assert r.status_code == 400, (r.status_code, r.text, body, hdrs)
+            assert "resume" in r.json()["error"], r.text
+        # resume needs per-step sample streams to rejoin: the plain
+        # engine path types the same refusal
+        plain = ServerSet({"m": server})
+        httpd = serve(plain, listen=f"127.0.0.1:{free_port()}")
+        try:
+            pbase = f"http://127.0.0.1:{httpd.server_address[1]}"
+            r = requests.post(pbase + "/v1/generate", json=BODY,
+                              headers=resume_headers([1, 2], 1234))
+            assert r.status_code == 400, (r.status_code, r.text)
+            assert "resume" in r.json()["error"], r.text
+        finally:
+            httpd.shutdown()
+
+    def test_exhausted_resume_is_422(self, cont_front):
+        """422 = the ORIGINAL stream already finished (every owed byte is
+        on some client's wire): the router turns this into the final
+        {"done": true} line, never an error."""
+        base, _ = cont_front
+        full = requests.post(base + "/v1/generate", json=BODY,
+                             stream=True).raw.read()
+        ids = _lines_to_ids(full)
+        # all n tokens already emitted
+        r = requests.post(base + "/v1/generate", json=BODY,
+                          headers=resume_headers(ids, SAMPLED["seed"]))
+        assert r.status_code == 422, (r.status_code, r.text)
+        # a stop token inside the emitted list: the stream ENDED there
+        r = requests.post(base + "/v1/generate",
+                          json=dict(BODY, stop_token_ids=[ids[2]]),
+                          headers=resume_headers(ids[:6], SAMPLED["seed"]))
+        assert r.status_code == 422, (r.status_code, r.text)
+
+
+class TestCoordinatedDrain:
+    def test_inflight_counter_tracks_streams_to_last_byte(self, server):
+        """--drain-grace waits on ``ServerSet.inflight``: the counter must
+        cover a streaming response until its final byte, not just the
+        handler dispatch."""
+        sset = ServerSet({"m": server})
+        httpd = serve(sset, listen=f"127.0.0.1:{free_port()}")
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        release = threading.Event()
+        orig = sset.stream_source
+
+        def slow_source(srv, tokens, n, samp, stop_token_ids=None, **kw):
+            def run():
+                yield np.asarray([[7]], np.int32)
+                release.wait(timeout=10)
+                yield np.asarray([[8]], np.int32)
+
+            return run()
+
+        sset.stream_source = slow_source
+        try:
+            assert sset.inflight == 0
+            got = {}
+
+            def client():
+                r = requests.post(base + "/v1/generate",
+                                  json={"tokens": [[1, 2]],
+                                        "max_new_tokens": 2, "stream": True},
+                                  stream=True)
+                got["body"] = r.raw.read()
+
+            t = threading.Thread(target=client, daemon=True)
+            t.start()
+            deadline = time.monotonic() + 5
+            while sset.inflight != 1 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert sset.inflight == 1  # held open mid-stream
+            release.set()
+            t.join(timeout=10)
+            assert got["body"].endswith(b'{"done": true}\n')
+            deadline = time.monotonic() + 5
+            while sset.inflight != 0 and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert sset.inflight == 0
+        finally:
+            sset.stream_source = orig
+            httpd.shutdown()
+
+    def test_killswitch_drain_flips_healthz(self, server):
+        """PodKillSwitch.drain() is serve_main's SIGTERM path for
+        in-process pods: /healthz flips to the draining 503 the router's
+        registry keys the proactive hand-off on."""
+        sset = ServerSet({"m": server})
+        sset.pool.mark_ready("m")
+        httpd = serve(sset, listen=f"127.0.0.1:{free_port()}")
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            ks = PodKillSwitch(httpd, sset=sset)
+            assert requests.get(base + "/healthz").status_code == 200
+            ks.drain()
+            r = requests.get(base + "/healthz")
+            assert r.status_code == 503
+            assert r.json()["status"] == "draining"
+            assert not sset.ready
+        finally:
+            httpd.shutdown()
+
+    def test_killswitch_drain_requires_sset(self, server):
+        sset = ServerSet({"m": server})
+        httpd = serve(sset, listen=f"127.0.0.1:{free_port()}")
+        try:
+            with pytest.raises(RuntimeError, match="sset"):
+                PodKillSwitch(httpd).drain()
+        finally:
+            httpd.shutdown()
