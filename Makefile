@@ -5,7 +5,7 @@ PY ?= python
 IMAGE ?= modelx-tpu
 TAG ?= $(shell git describe --tags --always 2>/dev/null || echo dev)
 
-.PHONY: all native test chaos slow lifecycle fleet overload programs continuation lint wheel image image-dl compose-up compose-down clean
+.PHONY: all native test chaos slow lifecycle fleet overload programs continuation obs lint wheel image image-dl compose-up compose-down clean
 
 all: native lint test wheel
 
@@ -74,6 +74,15 @@ continuation:
 programs:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_program_store.py -q -m "not slow"
 	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_program_store.py -q -m slow
+
+# observability drills (ISSUE 13): exposition-format round-trips, trace
+# summary/decorator units, request-id propagation over HTTP — then the
+# pod-kill chaos soak under runtime lockdep, where the failed-over
+# streams must keep their end-to-end request ids across the splice
+obs:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_promexp.py -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_router.py -q -k "RequestId or Observability"
+	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_router.py -q -m chaos
 
 # two layers: the project-native concurrency/purity gate (always — it is
 # stdlib-only and baseline-governed, see docs/analysis.md), then generic

@@ -1628,6 +1628,86 @@ def measure_continuation(model_dir: str, *, pods: int = 2, clients: int = 8,
     return out
 
 
+def measure_latency_breakdown(model_dir: str, *, requests_n: int = 8,
+                              new_tokens: int = 8,
+                              max_seq_len: int = 128) -> dict:
+    """Per-request latency breakdown micro-leg (ISSUE 13): fire
+    ``requests_n`` non-streaming requests at one continuous-batching pod
+    and read the ``X-ModelX-Timing-*`` headers back. Two checks ride it:
+    the phase spans must ACCOUNT for the request (the engine-reported
+    ``total_ms`` covers >= 90% of the client-observed wall time — a
+    breakdown that loses a tenth of the latency is lying), and the
+    TTFT split (``ttft_queue_ms_*`` = admission wait vs
+    ``ttft_compute_ms_*`` = prefill-to-first-token) is the capacity
+    signal: queue-dominated TTFT means add pods, compute-dominated
+    means the model/batching is the floor."""
+    import requests as _requests
+
+    from modelx_tpu.dl.serve import ModelServer, ServerSet, serve
+    from modelx_tpu.dl.serving_errors import TIMING_HEADER_PREFIX
+    from modelx_tpu.registry.server import free_port
+
+    server = ModelServer(model_dir, name="default", max_seq_len=max_seq_len)
+    server.load()
+    vocab = int(getattr(server.cfg, "vocab_size", 0) or 256)
+    sset = ServerSet({"default": server}, continuous_batch=True,
+                     max_slots=2, stream_chunk_size=4)
+    sset.pool.mark_ready("default")
+    httpd = serve(sset, listen=f"127.0.0.1:{free_port()}")
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def hdr_ms(resp, key: str) -> float:
+        name = TIMING_HEADER_PREFIX + "-".join(
+            p.capitalize() for p in key.split("_"))
+        return float(resp.headers.get(name, 0) or 0)
+
+    rng = np.random.RandomState(31)
+    queue_ms, compute_ms, coverage = [], [], []
+    try:
+        for i in range(requests_n):
+            prompt = rng.randint(1, vocab, (6,)).tolist()
+            t0 = time.monotonic()
+            r = _requests.post(base + "/v1/generate",
+                               json={"tokens": [prompt],
+                                     "max_new_tokens": new_tokens},
+                               timeout=120)
+            wall_ms = (time.monotonic() - t0) * 1e3
+            if r.status_code != 200:
+                raise RuntimeError(f"request {i}: {r.text[:200]}")
+            q, ttft = hdr_ms(r, "queue_ms"), hdr_ms(r, "ttft_ms")
+            total = hdr_ms(r, "total_ms")
+            if not total or not ttft:
+                raise RuntimeError(
+                    f"request {i}: timing headers missing: "
+                    f"{dict(r.headers)}")
+            queue_ms.append(q)
+            compute_ms.append(max(0.0, ttft - q))
+            coverage.append(total / wall_ms if wall_ms else 0.0)
+    finally:
+        httpd.shutdown()
+        for cb in sset.cbatchers.values():
+            cb.close()
+            cb.release_device_state()
+
+    worst = min(coverage)
+    if worst < 0.9:
+        raise RuntimeError(
+            f"phase spans cover only {worst:.1%} of wall time "
+            f"(coverage per request: {[round(c, 3) for c in coverage]})")
+
+    def pct(vals, p) -> float:
+        return round(float(np.percentile(vals, p)), 3)
+
+    return {
+        "breakdown_requests": requests_n,
+        "breakdown_coverage_min": round(worst, 3),
+        "ttft_queue_ms_p50": pct(queue_ms, 50),
+        "ttft_queue_ms_p99": pct(queue_ms, 99),
+        "ttft_compute_ms_p50": pct(compute_ms, 50),
+        "ttft_compute_ms_p99": pct(compute_ms, 99),
+    }
+
+
 class _Budget:
     """Soft wall-clock budget for the whole capture (BENCH_r05 post-mortem:
     the run exceeded the driver's hard timeout and recorded NOTHING, rc
@@ -2231,6 +2311,12 @@ def tiny_main() -> int:
         # kill behind the router; tokens_lost must read 0
         out.update(measure_continuation(workdir, new_tokens=12,
                                         max_seq_len=128))
+
+        # per-request latency breakdown (ISSUE 13): the engine's phase
+        # timeline must account for >= 90% of client wall time, and the
+        # TTFT queue-vs-compute split is the scaling signal
+        out.update(measure_latency_breakdown(workdir, new_tokens=8,
+                                             max_seq_len=128))
 
         # --- compiled-program registry (ISSUE 11), CPU proxy ---
         # bench-shaped small checkpoint, not LlamaConfig.tiny: the ratio

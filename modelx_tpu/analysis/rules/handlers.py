@@ -61,6 +61,9 @@ _SERVER_PATH_FILES = (
     "modelx_tpu/router/registry.py",
     "modelx_tpu/router/rebalance.py",
     "modelx_tpu/router/admission.py",
+    "modelx_tpu/utils/promexp.py",
+    "modelx_tpu/utils/trace.py",
+    "modelx_tpu/utils/accesslog.py",
 )
 
 _HANDLER_MODULES = (

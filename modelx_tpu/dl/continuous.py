@@ -105,7 +105,7 @@ from modelx_tpu.dl.serving_errors import (
 )
 from modelx_tpu.models.decode import SEQ_BUCKET, pad_seq_len
 from modelx_tpu.testing import faults as _faults
-from modelx_tpu.utils import trace
+from modelx_tpu.utils import promexp, trace
 from modelx_tpu.utils.jax_compat import copy_to_host_async
 
 _DONE = object()  # end-of-stream sentinel on per-request output queues
@@ -133,7 +133,9 @@ class _Ticket:
     state it is in; ``timeout_s`` records the effective budget so the 504
     names the number that actually applied."""
 
-    __slots__ = ("out", "cancelled", "deadline", "timeout_s", "restart")
+    __slots__ = ("out", "cancelled", "deadline", "timeout_s", "restart",
+                 "request_id", "t_submit", "t_admit", "t_first",
+                 "prefill_pieces", "preempts", "resume_step")
 
     def __init__(self) -> None:
         self.out: "queue.Queue" = queue.Queue()
@@ -144,9 +146,42 @@ class _Ticket:
         # restart goes ahead of newer arrivals (re-grab livelock guard),
         # so priority-aware inserts must never cut in front of it
         self.restart = False
+        # per-request phase timeline (ISSUE 13): monotonic stamps written
+        # by the one thread that owns each transition — submit() on the
+        # caller's thread, slot claim + first-token delivery on the engine
+        # thread — so no stamp needs a lock. t_admit/t_first stick at
+        # their FIRST write: a preempted fill's restart re-claims a slot
+        # but the request queued only once.
+        self.request_id = ""
+        self.t_submit = 0.0
+        self.t_admit = 0.0
+        self.t_first = 0.0
+        self.prefill_pieces = 0
+        self.preempts = 0
+        self.resume_step = 0
 
     def cancel(self) -> None:
         self.cancelled = True
+
+    def timing(self) -> dict:
+        """The phase breakdown this ticket observed (ms, monotonic-clock
+        deltas); phases that never happened (no slot claimed, no first
+        token) are simply absent, so a shed/expired request still reports
+        what it DID spend."""
+        t: dict = {}
+        if self.t_submit and self.t_admit:
+            t["queue_ms"] = round((self.t_admit - self.t_submit) * 1e3, 3)
+        if self.t_admit and self.t_first:
+            t["prefill_ms"] = round((self.t_first - self.t_admit) * 1e3, 3)
+        if self.t_submit and self.t_first:
+            t["ttft_ms"] = round((self.t_first - self.t_submit) * 1e3, 3)
+        if self.prefill_pieces:
+            t["prefill_pieces"] = self.prefill_pieces
+        if self.preempts:
+            t["preempts"] = self.preempts
+        if self.resume_step:
+            t["resume_step"] = self.resume_step
+        return t
 
 
 class _Row:
@@ -546,6 +581,12 @@ class ContinuousBatcher:
                       "dispatches": 0, "dispatch_depth_max": 1,
                       "host_syncs_per_boundary": 0,
                       "tokens_in_flight_peak": 0, "sync_lag_chunks_max": 0}
+        # per-request latency histograms (ISSUE 13): fed at first-token
+        # delivery from the ticket's phase stamps; snapshot() exposes them
+        # once populated and the Prometheus exposition renders them as
+        # explicit-bucket histogram families
+        self.hist_queue_ms = promexp.Histogram()
+        self.hist_ttft_ms = promexp.Histogram()
         # env-gated chaos drills (default off): MODELX_FAULT_PLAN schedules
         # deterministic dispatch faults against the running engine
         env_plan = _faults.from_env()
@@ -1229,6 +1270,8 @@ class ContinuousBatcher:
             ticket.out.put(self._deadline_error(ticket, "waiting for a slot"))
             return None
         slot = self._free.pop()
+        if not ticket.t_admit:  # first claim only: restarts re-enter here
+            ticket.t_admit = time.monotonic()
         s = len(ids)
         hit = None
         if self.prefix_cache is not None:
@@ -1626,8 +1669,13 @@ class ContinuousBatcher:
             )
             page_start = jnp.int32(start_pg * ps)
         self.stats["prefill_pieces"] += 1
+        fill.ticket.prefill_pieces += 1
         if not last:
-            with trace.span("continuous.prefill_piece", tokens=take):
+            # the fill's spans run on the ENGINE thread where the
+            # transport's request context isn't set: re-bind the ticket's
+            # id so the piece timeline joins the request's trace
+            with trace.request_context(fill.ticket.request_id), \
+                    trace.span("continuous.prefill_piece", tokens=take):
                 if self.page_size > 0:
                     self._cache = self._piece_prog(
                         self.server.params, piece, self._cache,
@@ -1651,7 +1699,8 @@ class ContinuousBatcher:
         seed = np.asarray([samp.get("seed", 0)], np.int32)
         first_step = np.asarray([samp.get("resume_step", 0)], np.int32)
         last_idx = jnp.asarray([take - 1], jnp.int32)
-        with trace.span("continuous.prefill_flip", tokens=take):
+        with trace.request_context(fill.ticket.request_id), \
+                trace.span("continuous.prefill_flip", tokens=take):
             if self.page_size > 0:
                 self._cache, self._tok, first = self._piece_flip_prog(
                     self.server.params, piece, self._cache, self._tok,
@@ -1736,6 +1785,7 @@ class ContinuousBatcher:
         self._release_slot(slot)
         self.stats["fill_preempts"] += 1
         fill.ticket.restart = True  # head-of-backlog pin: see _Ticket
+        fill.ticket.preempts += 1
         self._preempted.append((fill.ids, fill.n, fill.samp, fill.ticket))
         self._backlog_add(1)  # back in the not-yet-admitted set
 
@@ -1929,6 +1979,17 @@ class ContinuousBatcher:
             first_np = first_ref()
             # device-wait, not host work: keep it out of boundary_host_ms
             self._sync_wait_s += time.monotonic() - t0
+            ticket = row.ticket
+            if not ticket.t_first:
+                ticket.t_first = time.monotonic()
+                # the histograms feed HERE, once per request, from the
+                # same stamps the client's timing block reports
+                if ticket.t_submit:
+                    if ticket.t_admit:
+                        self.hist_queue_ms.observe(
+                            (ticket.t_admit - ticket.t_submit) * 1e3)
+                    self.hist_ttft_ms.observe(
+                        (ticket.t_first - ticket.t_submit) * 1e3)
             if row.seq is not None:
                 row.seq.append(int(first_np[0, 0]))
             row.out.put(first_np)
@@ -2496,6 +2557,15 @@ class ContinuousBatcher:
             snap["boundary_host_ms_p50"] = round(float(np.percentile(hist, 50)), 3)
             snap["boundary_host_ms_p99"] = round(float(np.percentile(hist, 99)), 3)
             snap["boundary_host_ms_count"] = int(hist.size)
+        # per-request latency histograms (ISSUE 13): present once a first
+        # token delivered — the gate mirrors boundary_host_ms_*, so an
+        # idle engine's snapshot keeps its pre-PR shape
+        qh = self.hist_queue_ms.snapshot()
+        if qh["count"]:
+            snap["queue_ms_hist"] = qh
+        th = self.hist_ttft_ms.snapshot()
+        if th["count"]:
+            snap["ttft_ms_hist"] = th
         # supervision + bounded-admission surface: the operator's view of
         # the self-healing layer (engine_restarts rides in from stats)
         snap["engine_state"] = self._state
@@ -2582,15 +2652,22 @@ class ContinuousBatcher:
         )
 
     def submit(self, ids: list[int], max_new_tokens: int, samp: dict,
-               timeout_s: float | None = None) -> _Ticket:
+               timeout_s: float | None = None,
+               request_id: str = "") -> _Ticket:
         """Enqueue one prompt row; the returned ticket carries the output
         queue and a ``cancel()`` the transport calls when its client goes
         away (the engine then frees the slot at the next chunk boundary).
         ``timeout_s`` clamps the engine deadline to a propagated
-        per-request remainder (deadline propagation, ISSUE 9)."""
+        per-request remainder (deadline propagation, ISSUE 9);
+        ``request_id`` threads the transport's end-to-end id into the
+        ticket so the engine's per-request timeline is joinable with the
+        router's and pod's view of the same request (ISSUE 13)."""
         self._validate(ids, max_new_tokens)
         self._check_quarantine(ids, max_new_tokens)
         ticket = _Ticket()
+        ticket.request_id = str(request_id or "")
+        ticket.resume_step = int(samp.get("resume_step", 0) or 0)
+        ticket.t_submit = time.monotonic()
         self._stamp_deadline(ticket, timeout_s)
         self._enqueue((list(ids), int(max_new_tokens), dict(samp), ticket), 1)
         return ticket
@@ -2605,7 +2682,10 @@ class ContinuousBatcher:
             self._validate(ids, n)
             self._check_quarantine(ids, n)
         tickets = [_Ticket() for _ in rows]
-        for t in tickets:
+        now = time.monotonic()
+        for t, (_ids, _n, samp) in zip(tickets, rows):
+            t.t_submit = now
+            t.resume_step = int(samp.get("resume_step", 0) or 0)
             self._stamp_deadline(t, timeout_s)
         self._enqueue([
             (list(ids), int(n), dict(samp), t)
@@ -2636,7 +2716,8 @@ class ContinuousBatcher:
                  temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
                  seed: int = 0, stop_token_ids=None,
                  timeout_s: float | None = None,
-                 priority: str = "interactive") -> np.ndarray:
+                 priority: str = "interactive",
+                 timing: dict | None = None) -> np.ndarray:
         """[B, S + m], matching ModelServer.generate: rows of a multi-row
         request become independent slots with seeds seed+i (the same
         per-row streams the ragged path derives). With ``stop_token_ids``,
@@ -2658,11 +2739,20 @@ class ContinuousBatcher:
         outs = [t.out for t in tickets]
         rows = []
         emitted = 0
-        for out in outs:
-            pieces = list(self._drain_row(out))
-            row = np.concatenate(pieces, axis=1)
-            emitted += int(row.size)
-            rows.append(row)
+        try:
+            for out in outs:
+                pieces = list(self._drain_row(out))
+                row = np.concatenate(pieces, axis=1)
+                emitted += int(row.size)
+                rows.append(row)
+        finally:
+            if timing is not None and tickets:
+                # a multi-row request reports the WORST row per phase:
+                # the client-visible latency is bounded by the slowest
+                for t in tickets:
+                    for k, v in t.timing().items():
+                        timing[k] = max(timing.get(k, 0), v) \
+                            if isinstance(v, (int, float)) else v
         width = max(r.shape[1] for r in rows)
         rows = [
             r if r.shape[1] == width else np.pad(
@@ -2679,7 +2769,8 @@ class ContinuousBatcher:
                seed: int = 0, chunk_size: int = 0,
                stop_token_ids=None, timeout_s: float | None = None,
                priority: str = "interactive",
-               resume_step: int = 0) -> Iterator[np.ndarray]:
+               resume_step: int = 0, request_id: str = "",
+               timing: dict | None = None) -> Iterator[np.ndarray]:
         """Single-row streaming: yields [1, k] arrays of new tokens as the
         engine decodes them (k == 1 for the prefill token, then up to the
         ENGINE's chunk size — the per-request chunk_size arg is accepted for
@@ -2714,6 +2805,7 @@ class ContinuousBatcher:
             samp["resume_step"] = resume_step
         ticket = self.submit(
             tokens[0].tolist(), max_new_tokens, samp, timeout_s=timeout_s,
+            request_id=request_id,
         )
         try:
             for piece in self._drain_row(ticket.out):
@@ -2724,6 +2816,11 @@ class ContinuousBatcher:
             # generator) cancels the row so its slot frees at the next
             # chunk boundary; after a full drain this is a no-op
             ticket.cancel()
+            if timing is not None:
+                # the caller's out-param: filled HERE (generator close or
+                # exhaustion) so the transport reads a complete breakdown
+                # exactly when the stream ends
+                timing.update(ticket.timing())
 
     def close(self) -> None:
         with self._close_lock:

@@ -427,12 +427,16 @@ def eos_for(tok, req: dict) -> tuple[int, ...]:
 
 def run_completion(sset, req: dict, chat: bool,
                    timeout_s: float | None = None,
-                   priority: str = "interactive") -> dict:
+                   priority: str = "interactive",
+                   request_id: str = "",
+                   timing: dict | None = None) -> dict:
     """Non-streaming completions/chat: returns the OpenAI response body.
     ``timeout_s``/``priority`` are the transport's propagated deadline
     remainder and priority class — honored by the continuous engine
     (clamping its per-request expiry), ignored by engines without
-    deadline machinery."""
+    deadline machinery. ``timing`` (ISSUE 13) is the transport's
+    out-param: the continuous engine fills it with the per-request phase
+    breakdown, which the handler returns as X-ModelX-Timing-* headers."""
     server = resolve_model(sset, req)
     tok = tokenizer_for(server)
     prompts = parse_prompts(req, chat, server)
@@ -466,6 +470,7 @@ def run_completion(sset, req: dict, chat: bool,
     # engine (per-request expiry clamp, interactive-first backlog); other
     # engines have no deadline machinery to honor them with
     deadline_kw = deadline_kwargs(timeout_s, priority) if continuous else {}
+    timing_kw = {"timing": timing} if continuous and timing is not None else {}
 
     def _one(ids: list[int]) -> list[list[int]]:
         # n samples of one prompt = n rows of the same ids in ONE engine
@@ -473,7 +478,7 @@ def run_completion(sset, req: dict, chat: bool,
         # multi-row requests, which is exactly OpenAI's n semantics
         batch = np.asarray([ids] * n_samples, np.int32)
         out = engine.generate(batch, max_new_tokens=n_tokens,
-                              **stops_kw, **deadline_kw, **samp)
+                              **stops_kw, **deadline_kw, **timing_kw, **samp)
         return [row[len(ids):].tolist() for row in out]
 
     if len(id_rows) > 1 and engine is not server:
@@ -553,7 +558,8 @@ def run_completion(sset, req: dict, chat: bool,
 def stream_completion(sset, req: dict, chat: bool,
                       timeout_s: float | None = None,
                       priority: str = "interactive",
-                      resume=None) -> Iterator[dict]:
+                      resume=None, request_id: str = "",
+                      timing: dict | None = None) -> Iterator[dict]:
     """SSE event bodies for stream=true (single prompt only). The first
     ``next()`` performs all validation — callers pull one event before
     committing a 200 so bad requests still fail with their real status.
@@ -634,7 +640,8 @@ def stream_completion(sset, req: dict, chat: bool,
         if resume_step:
             kw["resume_step"] = resume_step
         gen = sset.stream_source(server, np.asarray([ids], np.int32), n_tokens,
-                                 samp, stop_token_ids=list(eos) or None, **kw)
+                                 samp, stop_token_ids=list(eos) or None,
+                                 request_id=request_id, timing=timing, **kw)
         # prime generation BEFORE yielding anything: the transport commits
         # its 200 after the first event, and a compile/decode failure must
         # surface as a real status even for chat (whose first event is the
@@ -731,7 +738,7 @@ def stream_completion(sset, req: dict, chat: bool,
         }
         if include_usage:  # stream_options.include_usage (OpenAI contract:
             # a final chunk with empty choices carrying the usage)
-            yield {
+            usage_event = {
                 **envelope,
                 "choices": [],
                 "usage": {
@@ -740,6 +747,16 @@ def stream_completion(sset, req: dict, chat: bool,
                     "total_tokens": len(ids) + len(new_ids) + eos_count,
                 },
             }
+            if timing is not None:
+                # the per-request phase breakdown rides the SAME opt-in
+                # final chunk (ISSUE 13): close the source first so the
+                # engine's finally has filled the out-param even when a
+                # stop token ended the loop early. Engines without phase
+                # machinery leave it empty — the chunk stays unchanged.
+                gen.close()
+                if timing:
+                    usage_event["timing"] = dict(timing)
+            yield usage_event
 
     return events()
 

@@ -57,8 +57,10 @@ import numpy as np
 
 from modelx_tpu.dl import families as fam
 from modelx_tpu.dl.serving_errors import (
+    ATTEMPT_HEADER,
     DEADLINE_HEADER,
     PRIORITY_HEADER,
+    REQUEST_ID_HEADER,
     RESUME_EMITTED_HEADER,
     RESUME_SEED_HEADER,
     DeadlineExceededError,
@@ -66,13 +68,18 @@ from modelx_tpu.dl.serving_errors import (
     ModelLoadingError,
     ResumeExhaustedError,
     ServingError,
+    client_identity as _client_hash,
     deadline_kwargs,
+    mint_request_id,
+    parse_attempt,
     parse_deadline_ms,
     parse_priority,
+    parse_request_id,
     parse_resume,
+    timing_headers,
 )
 from modelx_tpu.parallel.mesh import make_mesh
-from modelx_tpu.utils import trace
+from modelx_tpu.utils import accesslog, promexp, trace
 
 logger = logging.getLogger("modelx.serve")
 
@@ -1359,7 +1366,8 @@ class ServerSet:
 
     def stream_source(self, server: ModelServer, tokens, n: int, samp: dict,
                       stop_token_ids=None, timeout_s: float | None = None,
-                      priority: str = "interactive", resume_step: int = 0):
+                      priority: str = "interactive", resume_step: int = 0,
+                      request_id: str = "", timing: dict | None = None):
         """Streaming analogue of engine_for: a token-chunk iterator.
         Single-row streams join the continuous engine when enabled; all
         paths honor the operator's --stream-chunk-size and end early on a
@@ -1371,13 +1379,18 @@ class ServerSet:
         row is ``prompt + emitted`` and sampling restarts at step k) —
         continuous-engine only; the plain path has no per-step sample
         streams to rejoin, so the handler refuses resume before we get
-        here (MalformedResumeError, 400)."""
+        here (MalformedResumeError, 400).
+        ``request_id``/``timing`` (ISSUE 13) thread the end-to-end id
+        into the engine ticket and return its phase breakdown via the
+        caller's out-param — continuous-engine only; the plain path has
+        no per-request phases to report."""
         cb = self.continuous_for(server)
         if cb is not None and tokens.shape[0] == 1:
             return cb.stream(tokens, max_new_tokens=n,
                              stop_token_ids=stop_token_ids,
                              timeout_s=timeout_s, priority=priority,
-                             resume_step=resume_step, **samp)
+                             resume_step=resume_step,
+                             request_id=request_id, timing=timing, **samp)
         if resume_step:
             raise MalformedResumeError(
                 "resume requires the continuous engine (single-row stream)"
@@ -1473,6 +1486,14 @@ class ServerSet:
         return m.group("model") if m else None
 
 
+def _query_param(path: str, name: str) -> str:
+    """One query-string value from a raw request path ("" when absent)."""
+    from urllib.parse import parse_qs, urlparse
+
+    vals = parse_qs(urlparse(path).query).get(name)
+    return vals[0] if vals else ""
+
+
 def propagated_timeout(headers) -> float | None:
     """The caller's remaining budget from ``X-ModelX-Deadline-Ms``
     (stamped by the fleet router per upstream attempt; the header name
@@ -1492,8 +1513,10 @@ def request_priority(headers) -> str:
     return parse_priority(headers.get(PRIORITY_HEADER))
 
 
-def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingHTTPServer:
+def serve(servers: ModelServer | ServerSet, listen: str = ":8000",
+          access_log: str = "") -> ThreadingHTTPServer:
     sset = servers if isinstance(servers, ServerSet) else ServerSet({servers.name: servers})
+    access = accesslog.open_log(access_log)
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -1501,13 +1524,47 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
         def log_message(self, *a):
             pass
 
+        def send_response(self, code, message=None):
+            # remember the committed status for the access-log line (one
+            # capture point covers _json AND the streaming 200)
+            self._resp_status = code
+            super().send_response(code, message)
+
+        def _obs_headers(self) -> None:
+            """Echo the request id + attempt on EVERY response (JSON and
+            streamed): the client joins its response to the fleet's logs
+            and traces by this one header. No-op on paths that never
+            bound an id (GETs)."""
+            rid = getattr(self, "_rid", "")
+            if rid:
+                self.send_header(REQUEST_ID_HEADER, rid)
+                self.send_header(ATTEMPT_HEADER, str(self._attempt))
+
         def _json(self, status: int, obj, headers: dict | None = None) -> None:
             body = json.dumps(obj).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            self._obs_headers()
+            if getattr(self, "_rid", ""):
+                # the non-streaming timing contract: whatever phases this
+                # request reached ride as X-ModelX-Timing-* headers — a
+                # 504 still reports the queue time it burned
+                timing = dict(self._timing)
+                timing["total_ms"] = round(
+                    (time.monotonic() - self._t0) * 1e3, 3)
+                for k, v in timing_headers(timing).items():
+                    self.send_header(k, v)
             for k, v in (headers or {}).items():  # e.g. Retry-After on 429
                 self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _text(self, status: int, text: str, content_type: str) -> None:
+            body = text.encode("utf-8")
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
 
@@ -1520,6 +1577,7 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
             self.send_header("Content-Type", content_type)
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Transfer-Encoding", "chunked")
+            self._obs_headers()
             self.end_headers()
 
             def write_chunk(payload: bytes) -> None:
@@ -1543,7 +1601,7 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
 
         def _stream_generate(self, server, tokens, n, samp, stop_ids=None,
                              timeout_s=None, priority="interactive",
-                             resume_step=0) -> None:
+                             resume_step=0, include_timing=False) -> None:
             """NDJSON token stream, then {"done": true}; concatenates to
             the non-streaming result. Single-row streams emit ONE token
             per line ({"tokens": [[t]]}): position-independent framing, so
@@ -1557,8 +1615,11 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
             kw = deadline_kwargs(timeout_s, priority)
             if resume_step:
                 kw["resume_step"] = resume_step
+            timing: dict = self._timing
             gen = sset.stream_source(server, tokens, n, samp,
-                                     stop_token_ids=stop_ids, **kw)
+                                     stop_token_ids=stop_ids,
+                                     request_id=getattr(self, "_rid", ""),
+                                     timing=timing, **kw)
             try:
                 # pull the first chunk BEFORE committing a 200: an
                 # unsupported family / bad request must still be a 4xx
@@ -1573,22 +1634,50 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                                   headers=e.headers())
 
             def payloads():
+                emitted = 0
                 if first is not None:
                     for piece in itertools.chain([first], gen):
                         rows = piece.tolist()
                         if len(rows) == 1:
                             for t in rows[0]:
+                                emitted += 1
                                 yield (json.dumps({"tokens": [[t]]}).encode()
                                        + b"\n")
                         else:
+                            emitted += sum(len(r) for r in rows)
                             yield (json.dumps({"tokens": rows}).encode()
                                    + b"\n")
+                if include_timing:
+                    # OPT-IN final timing line, BEFORE the done line. The
+                    # default stream is byte-unchanged — the router's
+                    # continuation splice and the byte-equality contract
+                    # it tests depend on that. gen.close() runs the
+                    # engine-side finally, so the breakdown is complete.
+                    gen.close()
+                    yield (json.dumps(
+                        {"timing": self._finish_timing(timing, emitted)}
+                    ).encode() + b"\n")
                 yield b'{"done": true}\n'
 
             self._stream_chunks(
                 "application/x-ndjson", payloads(),
                 lambda e: json.dumps({"error": str(e)}).encode() + b"\n",
             )
+
+        def _finish_timing(self, timing: dict, emitted: int) -> dict:
+            """Complete a phase breakdown with the handler-side view:
+            wall total, emitted count, and the decode rate (tokens after
+            the first over the post-TTFT wall time)."""
+            t = dict(timing)
+            total_ms = round((time.monotonic() - self._t0) * 1e3, 3)
+            t["total_ms"] = total_ms
+            t["tokens"] = emitted
+            ttft = t.get("ttft_ms")
+            if ttft is not None and emitted > 1 and total_ms > ttft:
+                t["decode_tps"] = round(
+                    (emitted - 1) / ((total_ms - ttft) / 1e3), 2)
+            self._timing.update(t)  # the access-log line sees it too
+            return t
 
         def _openai(self, req: dict, chat: bool) -> None:
             """/v1/completions + /v1/chat/completions (openai_api.py). SSE
@@ -1599,6 +1688,7 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
             # 503 + Retry-After, DRAINING 409, FAILED 503 + reason — the
             # SAME typed errors the native surface maps
             name = str(req.get("model") or sset.default)
+            self._log_model = name
             if sset.pool is not None:
                 try:
                     sset.pool.check_admission(name)
@@ -1643,7 +1733,9 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                     events = oai.stream_completion(sset, req, chat,
                                                    timeout_s=timeout_s,
                                                    priority=priority,
-                                                   resume=resume)
+                                                   resume=resume,
+                                                   request_id=self._rid,
+                                                   timing=self._timing)
                     try:
                         # validation + compile errors must surface as a real
                         # status, so pull the first event before the 200
@@ -1673,7 +1765,8 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                         ),
                     )
                 return self._json(200, oai.run_completion(
-                    sset, req, chat, timeout_s=timeout_s, priority=priority))
+                    sset, req, chat, timeout_s=timeout_s, priority=priority,
+                    request_id=self._rid, timing=self._timing))
             except oai.APIError as e:
                 # typed lifecycle 503s raised inside the API layer carry
                 # Retry-After like the native surface's (satellite:
@@ -1712,6 +1805,10 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
             return False
 
         def do_GET(self):
+            # GETs share keep-alive connections with POSTs: clear the
+            # per-request observability state a previous POST bound
+            self._rid = ""
+            self._resp_status = 0
             if self.path == "/healthz":
                 engine = sset.engine_health()
                 failed = sset.pool.failed() if sset.pool is not None else {}
@@ -1750,7 +1847,7 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                     self._json(503, {"status": "engine-broken"})
                 else:
                     self._json(200, {"status": "ok"})
-            elif self.path == "/metrics":
+            elif self.path.split("?", 1)[0] == "/metrics":
                 payload = {}
                 lifecycle = sset.pool.states() if sset.pool is not None else {}
                 for n, s in list(sset.servers.items()):
@@ -1774,7 +1871,16 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                         payload[n] = {"lifecycle": st}
                 if sset.pool is not None and "pool" not in payload:
                     payload["pool"] = sset.pool.pool_snapshot()
-                self._json(200, payload)
+                # content negotiation (ISSUE 13): the SAME tree renders
+                # as Prometheus text on Accept: text/plain or
+                # ?format=prometheus; the default JSON is byte-unchanged
+                fmt = _query_param(self.path, "format")
+                if promexp.wants_prometheus(self.headers.get("Accept"), fmt):
+                    self._text(200, promexp.render(
+                        payload, label_levels={("*",): "model"}),
+                        promexp.CONTENT_TYPE)
+                else:
+                    self._json(200, payload)
             elif self.path == "/admin/models":
                 if not self._admin_auth():
                     return
@@ -1793,8 +1899,13 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                 # one body, two contracts: the native {default, models} keys
                 # plus OpenAI's {object: "list", data: [...]}
                 self._json(200, oai.models_payload(sset))
-            elif self.path == "/v1/trace":
-                self._json(200, trace.tracer().summary())
+            elif self.path.split("?", 1)[0] == "/v1/trace":
+                # ?request_id= filters the summary to one request's
+                # timeline; ?prefix= narrows by span path (both optional)
+                self._json(200, trace.tracer().summary(
+                    prefix=_query_param(self.path, "prefix"),
+                    request_id=_query_param(self.path, "request_id"),
+                ))
             else:
                 self._json(404, {"error": "not found"})
 
@@ -1805,10 +1916,37 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
             # to reach zero before closing engines (serve_main's
             # --drain-grace loop), instead of sleeping a fixed interval
             sset.request_began()
+            # end-to-end request identity (ISSUE 13): honor the router's
+            # (or client's) id, mint one for direct traffic; the id binds
+            # every span this handler thread closes, echoes on the
+            # response, and threads into the engine ticket
+            self._rid = (parse_request_id(self.headers.get(REQUEST_ID_HEADER))
+                         or mint_request_id())
+            self._attempt = parse_attempt(self.headers.get(ATTEMPT_HEADER))
+            self._timing = {}
+            self._resp_status = 0
+            self._log_model = ""
+            self._t0 = time.monotonic()
+            path = self.path.split("?", 1)[0]
             try:
-                self._do_POST()
+                with trace.request_context(self._rid), \
+                        trace.span("serve.request", http_path=path,
+                                   attempt=self._attempt):
+                    self._do_POST()
             finally:
                 sset.request_ended()
+                if access is not None:
+                    access.write(
+                        request_id=self._rid,
+                        attempt=self._attempt,
+                        client=_client_hash(self.headers,
+                                            self.client_address),
+                        path=path,
+                        model=self._log_model,
+                        status=self._resp_status,
+                        ms=round((time.monotonic() - self._t0) * 1e3, 3),
+                        timing=self._timing,
+                    )
 
         def _do_POST(self):
             length = int(self.headers.get("Content-Length", 0) or 0)
@@ -1864,6 +2002,8 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                 return self._openai(req, chat=self.path.endswith("chat/completions"))
 
             server, verb = sset.resolve(self.path)
+            if server is not None:
+                self._log_model = server.name
             if server is None:
                 # a name the routing set doesn't know may still be a
                 # lifecycle entry: PULLING/LOADING answers 503 +
@@ -2108,7 +2248,9 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                         return self._stream_generate(
                             server, tokens, n, samp, stop_ids,
                             timeout_s=timeout_s, priority=priority,
-                            resume_step=resume_step)
+                            resume_step=resume_step,
+                            include_timing=bool(
+                                req.get("include_timing", False)))
                     engine = sset.engine_for(
                         server, tokens.shape[0], samp["temperature"]
                     )
@@ -2122,7 +2264,8 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000") -> ThreadingH
                         out = engine.generate(tokens, max_new_tokens=n,
                                               stop_token_ids=stop_ids,
                                               timeout_s=timeout_s,
-                                              priority=priority, **samp)
+                                              priority=priority,
+                                              timing=self._timing, **samp)
                     else:
                         out = engine.generate(tokens, max_new_tokens=n, **samp)
                     rows = out.tolist()

@@ -177,6 +177,10 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
                    "forever (a wedged TPU dispatch is otherwise silent; "
                    "0 = off). Size it well above the worst legitimate "
                    "boundary: first-request compiles run minutes on TPU")
+@click.option("--access-log", default="",
+              help="append one JSON line per request (request id, hashed "
+                   "client identity, model, status, per-phase timing) to "
+                   "this path; empty = off")
 def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen: str,
          max_seq_len: int, compile_cache: bool,
          blob_cache_dir: str, blob_cache_max_bytes: int,
@@ -193,7 +197,8 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
          publish_programs: bool,
          admin_tokens: tuple[str, ...], staging_dir: str,
          loras: tuple[str, ...], drain_seconds: float,
-         drain_grace: float, boundary_watchdog_s: float) -> None:
+         drain_grace: float, boundary_watchdog_s: float,
+         access_log: str) -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     from modelx_tpu.parallel.distributed import initialize
 
@@ -309,7 +314,8 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
             "--evict-idle is inert without --hbm-budget-bytes "
             "(eviction only runs to fit a load under the budget)"
         )
-    httpd = serve(sset, listen=listen)  # starts serving 503s while loading
+    httpd = serve(sset, listen=listen,  # starts serving 503s while loading
+                  access_log=access_log)
     stats = sset.load_all(concurrent=concurrent_load)
     logging.getLogger("modelx.serve").info("models loaded: %s", stats)
     stop = threading.Event()

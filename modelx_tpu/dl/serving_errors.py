@@ -69,8 +69,94 @@ CLIENT_HEADER = "X-ModelX-Client"
 RESUME_EMITTED_HEADER = "X-ModelX-Resume-Emitted"
 RESUME_SEED_HEADER = "X-ModelX-Resume-Seed"
 
+# End-to-end request identity (ISSUE 13): the router mints ONE id per
+# client request (honoring a client-supplied one) and stamps it on every
+# upstream attempt; pods echo it on the response and thread it through
+# spans, access-log lines, and the engine ticket. A failover or stream
+# continuation re-uses the SAME id with the attempt counter bumped, so
+# one grep joins the whole request across processes. Timing headers share
+# the prefix: ``X-ModelX-Timing-Queue-Ms`` etc. on non-streaming replies.
+REQUEST_ID_HEADER = "X-ModelX-Request-Id"
+ATTEMPT_HEADER = "X-ModelX-Attempt"
+TIMING_HEADER_PREFIX = "X-ModelX-Timing-"
+
 PRIORITY_INTERACTIVE = "interactive"
 PRIORITY_BATCH = "batch"
+
+# the id alphabet is CLOSED (it rides in headers and JSON log lines, so
+# a hostile client-supplied id must not inject header/log structure)
+_REQUEST_ID_CHARS = frozenset(
+    "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789-_.:")
+_REQUEST_ID_MAX = 128
+
+
+def mint_request_id() -> str:
+    """A fresh request id: 16 hex chars of OS entropy under a fixed
+    prefix. Minted by the FIRST hop that sees the request without one
+    (normally the router; a direct-to-pod request gets one from the pod)."""
+    import secrets
+
+    return "req-" + secrets.token_hex(8)
+
+
+def parse_request_id(value) -> str | None:
+    """Client-supplied ``X-ModelX-Request-Id`` -> the id to honor, or
+    None when absent/unusable (the receiver mints instead). Validation is
+    strict — a closed alphabet and a length cap — because the id is
+    reflected verbatim into response headers and access logs."""
+    if not value:
+        return None
+    rid = str(value).strip()
+    if not rid or len(rid) > _REQUEST_ID_MAX:
+        return None
+    if not all(c in _REQUEST_ID_CHARS for c in rid):
+        return None
+    return rid
+
+
+def parse_attempt(value) -> int:
+    """``X-ModelX-Attempt`` header value -> attempt ordinal (>= 1);
+    absent/malformed reads as attempt 1 — the first try."""
+    try:
+        return max(1, int(str(value).strip()))
+    except (TypeError, ValueError):
+        return 1
+
+
+def client_identity(headers, client_address) -> str:
+    """The hashed client identity of a request: API token, else the
+    explicit ``X-ModelX-Client`` header, else source IP — first
+    available. Tokens are hashed before they become a metrics or
+    access-log key: neither surface may leak a bearer credential. ONE
+    function for the router's fairness queues and both access logs, so
+    the same caller aggregates under the same key fleet-wide."""
+    import hashlib
+
+    auth = str(headers.get("Authorization", "") or "")
+    if auth.startswith("Bearer ") and auth[len("Bearer "):].strip():
+        digest = hashlib.sha256(
+            auth[len("Bearer "):].strip().encode()).hexdigest()
+        return "tok:" + digest[:12]
+    explicit = str(headers.get(CLIENT_HEADER, "") or "").strip()
+    if explicit:
+        return "hdr:" + explicit[:64]
+    host = client_address[0] if client_address else ""
+    return "ip:" + (host or "unknown")
+
+
+def timing_headers(timing: dict) -> dict[str, str]:
+    """A timing breakdown dict -> ``X-ModelX-Timing-*`` response headers.
+    ``{"queue_ms": 1.25}`` becomes ``X-ModelX-Timing-Queue-Ms: 1.25``;
+    non-numeric values are skipped so a partially-filled breakdown never
+    breaks the response."""
+    out: dict[str, str] = {}
+    for key, val in (timing or {}).items():
+        if not isinstance(val, (int, float)) or isinstance(val, bool):
+            continue
+        name = TIMING_HEADER_PREFIX + "-".join(
+            p.capitalize() for p in str(key).split("_") if p)
+        out[name] = f"{val:g}" if isinstance(val, float) else str(val)
+    return out
 
 
 def parse_priority(value) -> str:

@@ -121,9 +121,14 @@ class Metrics:
             self.counters[name] = self.counters.get(name, 0.0) + value
 
     def render(self) -> str:
+        # full exposition-format families (ISSUE 13): # HELP/# TYPE per
+        # metric, promtool-parseable — shared renderer with the serving
+        # pods' and router's /metrics
+        from modelx_tpu.utils import promexp
+
         with self._lock:
-            lines = [f"modelx_{k} {v}" for k, v in sorted(self.counters.items())]
-        return "\n".join(lines) + "\n"
+            counters = dict(sorted(self.counters.items()))
+        return promexp.render(counters)
 
 
 class Registry:
@@ -164,7 +169,10 @@ class Registry:
         return Response(200, body=b"ok")
 
     def get_metrics(self, req: "Request") -> "Response":
-        return Response(200, body=self.metrics.render().encode(), content_type="text/plain; version=0.0.4")
+        from modelx_tpu.utils import promexp
+
+        return Response(200, body=self.metrics.render().encode(),
+                        content_type=promexp.CONTENT_TYPE)
 
     def get_global_index(self, req: "Request") -> "Response":
         idx = self.store.get_global_index(req.query_one("search"))

@@ -34,7 +34,6 @@ until an operator turns a knob.
 
 from __future__ import annotations
 
-import hashlib
 import math
 import threading
 import time
@@ -50,6 +49,7 @@ from modelx_tpu.dl.serving_errors import (  # noqa: F401  (re-exports)
     PRIORITY_INTERACTIVE,
     DeadlineExceededError,
     QueueFullError,
+    client_identity,
     parse_deadline_ms,
     parse_priority,
 )
@@ -65,16 +65,10 @@ def client_key(headers, client_address) -> str:
     """The fairness identity of a request: API token, else the explicit
     ``X-ModelX-Client`` header, else source IP — first available. Tokens
     are hashed before they become a metrics key: /metrics must never leak
-    a bearer credential."""
-    auth = str(headers.get("Authorization", "") or "")
-    if auth.startswith("Bearer ") and auth[len("Bearer "):].strip():
-        digest = hashlib.sha256(auth[len("Bearer "):].strip().encode()).hexdigest()
-        return "tok:" + digest[:12]
-    explicit = str(headers.get(CLIENT_HEADER, "") or "").strip()
-    if explicit:
-        return "hdr:" + explicit[:64]
-    host = client_address[0] if client_address else ""
-    return "ip:" + (host or "unknown")
+    a bearer credential. The canonical implementation lives in
+    serving_errors (``client_identity``) since ISSUE 13 — both access
+    logs and this fairness key must bucket a caller identically."""
+    return client_identity(headers, client_address)
 
 
 def jain_index(values) -> float | None:

@@ -106,6 +106,10 @@ from modelx_tpu.router.server import FleetRouter, route_serve
 @click.option("--breaker-cooldown", default=10.0, type=float,
               help="seconds an OPEN breaker waits before letting one "
                    "half-open probe request through")
+@click.option("--access-log", default="",
+              help="append one JSON line per routed request (request id, "
+                   "hashed client identity, model, status, latency, route "
+                   "decision) to this path; empty = off")
 def main(pods: tuple[str, ...], listen: str, default_model: str,
          poll_interval: float, poll_timeout: float, request_timeout: float,
          connect_timeout: float, sticky_entries: int, sticky_window: int,
@@ -113,7 +117,8 @@ def main(pods: tuple[str, ...], listen: str, default_model: str,
          rebalance_queue_high: int, rebalance_interval: float,
          rebalance_cooldown: float, fair_share: int, client_rate: float,
          max_router_backlog: int, retry_budget: float,
-         breaker_threshold: int, breaker_cooldown: float) -> None:
+         breaker_threshold: int, breaker_cooldown: float,
+         access_log: str) -> None:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
     registry = PodRegistry(
@@ -137,6 +142,7 @@ def main(pods: tuple[str, ...], listen: str, default_model: str,
         retry_budget=RetryBudget(ratio=retry_budget),
         breakers=BreakerBoard(threshold=breaker_threshold,
                               cooldown_s=breaker_cooldown),
+        access_log=access_log,
     )
     router.start()
     httpd = route_serve(router, listen=listen)

@@ -50,6 +50,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from modelx_tpu.dl.serving_errors import (
+    ATTEMPT_HEADER,
+    REQUEST_ID_HEADER,
     DeadlineExceededError,
     ModelDrainingError,
     ModelUnloadedError,
@@ -57,6 +59,9 @@ from modelx_tpu.dl.serving_errors import (
     QueueFullError,
     ServingError,
     UpstreamSeveredError,
+    mint_request_id,
+    parse_attempt,
+    parse_request_id,
     parse_resume,
     resume_headers,
 )
@@ -73,6 +78,7 @@ from modelx_tpu.router.admission import (
 from modelx_tpu.router.http import LazySession
 from modelx_tpu.router.policy import StickyTable, plan_route, sticky_keys
 from modelx_tpu.router.registry import PodRegistry
+from modelx_tpu.utils import accesslog, promexp, trace
 
 logger = logging.getLogger("modelx.router")
 
@@ -149,7 +155,7 @@ class FleetRouter:
                  admission: AdmissionController | None = None,
                  retry_budget: RetryBudget | None = None,
                  breakers: BreakerBoard | None = None,
-                 session=None) -> None:
+                 session=None, access_log: str = "") -> None:
         from modelx_tpu.router.policy import DEFAULT_WINDOW_TOKENS
 
         self.registry = registry
@@ -167,6 +173,9 @@ class FleetRouter:
         self.retry_budget = retry_budget or RetryBudget()
         self.breakers = breakers or BreakerBoard()
         self.metrics = RouterMetrics()
+        # opt-in JSON-lines access log (ISSUE 13): one line per routed
+        # request, request id as the join key against the pod's log
+        self.access = accesslog.open_log(access_log)
         self._session = LazySession(session)
         self._inflight: dict[str, int] = {}
         self._inflight_lock = threading.Lock()
@@ -188,6 +197,8 @@ class FleetRouter:
         self.registry.stop()
         if self._maint is not None:
             self._maint.join(timeout=2.0)
+        if self.access is not None:
+            self.access.close()
 
     def _maintenance(self) -> None:
         while not self._stop.wait(self.registry.poll_interval_s):
@@ -279,6 +290,26 @@ def _stream_error_payload(content_type: str, path: str, e: ServingError) -> byte
     return body + b"\n"
 
 
+def _query_param(path: str, name: str) -> str:
+    """One query parameter from a request path ("" when absent)."""
+    from urllib.parse import parse_qs, urlparse
+
+    vals = parse_qs(urlparse(path).query).get(name)
+    return vals[0] if vals else ""
+
+
+# which snapshot-tree levels become Prometheus labels on GET /metrics
+# (everything else flattens into the metric name)
+_METRIC_LABELS = {
+    ("router", "routes", "*"): "pod",
+    ("router", "model_routes", "*"): "model",
+    ("pods", "*"): "pod",
+    ("inflight", "*"): "pod",
+    ("breakers", "pods", "*"): "pod",
+    ("admission", "clients", "*"): "client",
+}
+
+
 class _StreamSession:
     """Client side of ONE committed continuable stream, shared by every
     upstream attempt that feeds it (the original dispatch and any
@@ -317,7 +348,7 @@ class _StreamSession:
         self.emitted: list[int] = []   # token ids on the client's wire
         self._buf = b""
 
-    def commit(self, content_type: str) -> None:
+    def commit(self, content_type: str, extra_headers=()) -> None:
         if self.committed:
             return
         self.committed = True
@@ -327,6 +358,14 @@ class _StreamSession:
         h.send_header("Content-Type", content_type)
         h.send_header("Cache-Control", "no-cache")
         h.send_header("Transfer-Encoding", "chunked")
+        # the router's observability echo: the end-to-end request id and
+        # the attempt number of the upstream actually feeding the client
+        rid = getattr(h, "_rid", "")
+        if rid:
+            h.send_header(REQUEST_ID_HEADER, rid)
+            h.send_header(ATTEMPT_HEADER, str(getattr(h, "_attempt_sent", 1)))
+        for k, v in extra_headers:
+            h.send_header(k, v)
         h.end_headers()
 
     def write(self, payload: bytes) -> None:
@@ -388,11 +427,27 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
         def log_message(self, *a):
             pass
 
+        def send_response(self, code, message=None):
+            # captured for the access log: whatever status last went on
+            # the wire is what the client saw
+            self._resp_status = code
+            super().send_response(code, message)
+
+        def _obs_headers(self) -> None:
+            """Echo the request id + attempt on router-authored responses
+            (relayed pod responses carry the pod's own echo instead)."""
+            rid = getattr(self, "_rid", "")
+            if rid:
+                self.send_header(REQUEST_ID_HEADER, rid)
+                self.send_header(ATTEMPT_HEADER,
+                                 str(getattr(self, "_attempt_sent", 1)))
+
         def _json(self, status: int, obj, headers: dict | None = None) -> None:
             body = json.dumps(obj).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            self._obs_headers()
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
@@ -401,11 +456,23 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
             except OSError:
                 pass  # client went away; nothing to salvage
 
+        def _text(self, status: int, text: str, content_type: str) -> None:
+            body = text.encode()
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            try:
+                self.wfile.write(body)
+            except OSError:
+                pass
+
         def _serving_error(self, path: str, e: ServingError) -> None:
             body = _error_body(path, e)
             self.send_response(e.http_status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            self._obs_headers()
             for k, v in e.headers().items():
                 self.send_header(k, v)
             self.end_headers()
@@ -417,6 +484,10 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
         # -- reads ------------------------------------------------------------
 
         def do_GET(self):
+            # keep-alive hygiene: a GET after a routed POST on the same
+            # connection must not inherit that request's identity
+            self._rid = ""
+            self._resp_status = 0
             if self.path == "/healthz":
                 ready = [p for p in router.registry.pods()
                          if p.healthy and p.ready_models()]
@@ -431,8 +502,25 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
                 # the router holds no device state and self-heals by
                 # polling: alive as long as the process answers
                 self._json(200, {"status": "ok"})
-            elif self.path == "/metrics":
-                self._json(200, router.snapshot())
+            elif self.path.split("?", 1)[0] == "/metrics":
+                # content negotiation (ISSUE 13): Prometheus text format
+                # on Accept: text/plain or ?format=prometheus, the JSON
+                # snapshot — byte-identical to pre-PR — otherwise
+                payload = router.snapshot()
+                fmt = _query_param(self.path, "format")
+                if promexp.wants_prometheus(self.headers.get("Accept"), fmt):
+                    self._text(200,
+                               promexp.render(payload,
+                                              label_levels=_METRIC_LABELS),
+                               promexp.CONTENT_TYPE)
+                else:
+                    self._json(200, payload)
+            elif self.path.split("?", 1)[0] == "/v1/trace":
+                # span summary, pod-parity: ?prefix= narrows by span name,
+                # ?request_id= narrows to one request's timeline
+                self._json(200, trace.tracer().summary(
+                    prefix=_query_param(self.path, "prefix"),
+                    request_id=_query_param(self.path, "request_id")))
             elif self.path == "/v1/models":
                 fleet = router.registry.models()
                 self._json(200, {
@@ -449,6 +537,39 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
 
         def do_POST(self):
             router.metrics.count("requests_total")
+            # end-to-end request identity (ISSUE 13): honor a well-formed
+            # client-supplied id (a chained router, a client correlating
+            # its own logs), mint otherwise; every upstream dispatch for
+            # this request carries the SAME id with an incrementing
+            # attempt counter
+            self._rid = (parse_request_id(self.headers.get(REQUEST_ID_HEADER))
+                         or mint_request_id())
+            self._attempt_next = parse_attempt(self.headers.get(ATTEMPT_HEADER))
+            self._attempt_sent = self._attempt_next
+            self._resp_status = 0
+            self._decision = ""
+            self._pod_url = ""
+            self._log_model = ""
+            t0 = time.monotonic()
+            try:
+                with trace.request_context(self._rid), \
+                        trace.span("router.request", http_path=self.path):
+                    self._do_POST()
+            finally:
+                if router.access is not None:
+                    router.access.write(
+                        request_id=self._rid,
+                        attempt=self._attempt_sent,
+                        client=client_key(self.headers, self.client_address),
+                        path=self.path,
+                        model=self._log_model,
+                        status=self._resp_status,
+                        ms=round((time.monotonic() - t0) * 1e3, 3),
+                        route=self._decision or "unrouted",
+                        pod=self._pod_url,
+                    )
+
+        def _do_POST(self):
             length = int(self.headers.get("Content-Length", 0) or 0)
             raw = self.rfile.read(length) if length else b""
             try:
@@ -460,6 +581,7 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
             model = router.resolve_model(self.path, req)
             if model is None:
                 return self._json(404, {"error": "not found"})
+            self._log_model = model
             # the overload-protection front gate: fairness identity +
             # priority class feed the admission controller BEFORE any pod
             # sees the request; the deadline clamps to an incoming
@@ -523,6 +645,9 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
                                           base_emitted=base)
             plan = plan_route(model, router.registry.candidates(model),
                               router.sticky, keys, router.inflight())
+            # for the access log's route decision: was the served pod the
+            # sticky assignment, a load-balanced pick, or a failover?
+            sticky_url = router.sticky.lookup(keys, [p.url for p in plan])
             if not plan:
                 # mirror the single-pod routing contract (PR 5): a name no
                 # pod has ever heard of 404s; DRAINING everywhere is 409;
@@ -551,6 +676,7 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
                     # which an incoming deadline header may have clamped
                     # below the router's own --request-timeout
                     raise DeadlineExceededError("routing", budget)
+                was_first = not attempted
                 if not attempted:
                     router.retry_budget.record_attempt()
                 elif not router.retry_budget.allow_retry():
@@ -567,6 +693,13 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
                 finally:
                     router.exit(pod.url)
                 if status is not None:
+                    self._pod_url = pod.url
+                    if not was_first:
+                        self._decision = "failover"
+                    elif pod.url == sticky_url:
+                        self._decision = "sticky"
+                    else:
+                        self._decision = "balanced"
                     router.metrics.routed(pod.url, model)
                     live = router.registry.pod(pod.url)
                     if status == 200 and live is not None and live.healthy:
@@ -620,6 +753,9 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
             timeout, and the pod's engine stops decoding for callers
             whose budget is gone (dl/serve.py honors the header)."""
             router.metrics.count("upstream_attempts_total")
+            attempt = self._attempt_next
+            self._attempt_next += 1
+            self._attempt_sent = attempt
             try:
                 resp = router.http().request(
                     "POST", pod.url + self.path, data=raw,
@@ -627,6 +763,8 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
                         "Content-Type": "application/json",
                         DEADLINE_HEADER: str(max(1, int(remaining * 1000))),
                         PRIORITY_HEADER: priority,
+                        REQUEST_ID_HEADER: self._rid,
+                        ATTEMPT_HEADER: str(attempt),
                     },
                     stream=True,
                     timeout=(router.connect_timeout_s, remaining),
@@ -698,9 +836,17 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
                 router.pod_died(pod.url, f"body read: {e}")
                 return False
             self.send_response(resp.status_code)
+            relayed = set()
             for k, v in resp.headers.items():
-                if k.lower() in _HOP_HEADERS:
+                kl = k.lower()
+                # x-modelx-* responses carry the pod's observability echo
+                # (request id, attempt, per-phase timing): the router is
+                # transparent to it, like the body
+                if kl in _HOP_HEADERS or kl.startswith("x-modelx-"):
                     self.send_header(k, v)
+                    relayed.add(kl)
+            if REQUEST_ID_HEADER.lower() not in relayed:
+                self._obs_headers()  # pod predates the echo: router's own
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             try:
@@ -726,6 +872,13 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
             self.send_header("Content-Type", content_type)
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Transfer-Encoding", "chunked")
+            relayed = set()
+            for k, v in resp.headers.items():
+                if k.lower().startswith("x-modelx-"):
+                    self.send_header(k, v)
+                    relayed.add(k.lower())
+            if REQUEST_ID_HEADER.lower() not in relayed:
+                self._obs_headers()
             self.end_headers()
 
             def write_chunk(payload: bytes) -> None:
@@ -783,7 +936,11 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
             except requests.RequestException as e:
                 router.pod_died(pod.url, f"stream open: {e}")
                 return False
-            sess.commit(content_type)
+            skip = (REQUEST_ID_HEADER.lower(), ATTEMPT_HEADER.lower())
+            sess.commit(content_type, extra_headers=[
+                (k, v) for k, v in resp.headers.items()
+                if k.lower().startswith("x-modelx-")
+                and k.lower() not in skip])
             sess.reset_for_attempt()
             try:
                 sess.feed(first)
@@ -824,6 +981,8 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
             if sess.severed and not sess.done:
                 self._continue_stream(model, keys, sess, raw, deadline,
                                       priority)
+            if sess.continued:
+                self._decision = "continuation"
             if sess.done:
                 if sess.continued:
                     router.metrics.count("streams_continued_total")
@@ -933,6 +1092,12 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
             relaying anything; another candidate may serve)."""
             router.metrics.count("upstream_attempts_total")
             router.metrics.count("continuation_attempts_total")
+            # a continuation is a failover attempt of the SAME request:
+            # same id, next attempt number — the pods' logs and span
+            # timelines join on the id across the splice
+            attempt = self._attempt_next
+            self._attempt_next += 1
+            self._attempt_sent = attempt
             try:
                 resp = router.http().request(
                     "POST", pod.url + self.path, data=raw,
@@ -940,6 +1105,8 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
                         "Content-Type": "application/json",
                         DEADLINE_HEADER: str(max(1, int(remaining * 1000))),
                         PRIORITY_HEADER: priority,
+                        REQUEST_ID_HEADER: self._rid,
+                        ATTEMPT_HEADER: str(attempt),
                         **hdrs,
                     },
                     stream=True,

@@ -1,0 +1,70 @@
+"""Opt-in structured access logs (ISSUE 13): one JSON object per line.
+
+Both HTTP front doors — the fleet router and the serving pod — take an
+``--access-log PATH`` flag and write one line per completed request with
+the end-to-end request id, the hashed client identity, the model, the
+final status, the per-phase timing breakdown, and (router-side) the
+route decision. JSON-lines because the consumers are ``jq``/log
+shippers, not humans tailing a terminal; the request id is the join key
+across the router's line, the pod's line, and the engine span timeline.
+
+The writer is deliberately small: append-mode, line-buffered, one lock
+around the write so concurrent handler threads never interleave bytes
+mid-line. A write failure (disk full, path yanked) disables the log and
+logs ONE warning — observability must never take the serving path down.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Any
+
+logger = logging.getLogger("modelx.accesslog")
+
+
+class AccessLog:
+    """Thread-safe JSON-lines access log writer."""
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh = open(path, "a", buffering=1, encoding="utf-8")
+        self._broken = False
+
+    def write(self, **fields: Any) -> None:
+        """Append one log line; ``ts`` (unix seconds) is stamped here so
+        every producer's lines sort on the same clock."""
+        rec = {"ts": round(time.time(), 3)}
+        rec.update(fields)
+        try:
+            line = json.dumps(rec, default=str) + "\n"
+        except (TypeError, ValueError) as e:
+            logger.warning("unserializable access-log record dropped: %s", e)
+            return
+        with self._lock:
+            if self._broken:
+                return
+            try:
+                self._fh.write(line)
+            except OSError as e:
+                # one warning, then silence: a full disk must not turn
+                # every request into a logging error
+                self._broken = True
+                logger.warning("access log %s failed, disabling: %s",
+                               self.path, e)
+
+    def close(self) -> None:
+        with self._lock:
+            self._broken = True
+            try:
+                self._fh.close()
+            except OSError as e:
+                logger.warning("access log close failed: %s", e)
+
+
+def open_log(path: str | None) -> AccessLog | None:
+    """``--access-log`` plumbing: None/"" disables (the default)."""
+    return AccessLog(path) if path else None

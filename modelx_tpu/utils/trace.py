@@ -22,6 +22,8 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
+import functools
+import inspect
 import json
 import logging
 import os
@@ -34,6 +36,28 @@ logger = logging.getLogger("modelx.trace")
 MAX_SPANS = 8192
 
 _current_path: contextvars.ContextVar[str] = contextvars.ContextVar("modelx_span_path", default="")
+
+# the request id (ISSUE 13) rides a contextvar parallel to the span path:
+# every span closed while a request context is active carries the id, so
+# /v1/trace can filter one request's timeline out of the ring
+_current_request: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "modelx_request_id", default="")
+
+
+def current_request_id() -> str:
+    """The request id bound to this thread/task context ("" when none)."""
+    return _current_request.get()
+
+
+@contextlib.contextmanager
+def request_context(request_id: str) -> Iterator[None]:
+    """Bind a request id for the duration of a block: every span closed
+    inside (across nested calls, same thread/task) is stamped with it."""
+    token = _current_request.set(str(request_id or ""))
+    try:
+        yield
+    finally:
+        _current_request.reset(token)
 
 
 class Tracer:
@@ -62,11 +86,16 @@ class Tracer:
                 {k: v for k, v in span.items() if k not in ("path", "start_s", "duration_s")},
             )
 
-    def spans(self, prefix: str = "") -> list[dict[str, Any]]:
+    def spans(self, prefix: str = "",
+              request_id: str = "") -> list[dict[str, Any]]:
+        # one O(n) copy under the lock, filtering OUTSIDE it: concurrent
+        # record() calls never wait on a caller's aggregation
         with self._lock:
             out = list(self._spans)
         if prefix:
             out = [s for s in out if s["path"].startswith(prefix)]
+        if request_id:
+            out = [s for s in out if s.get("request_id") == request_id]
         return out
 
     def clear(self) -> None:
@@ -83,10 +112,15 @@ class Tracer:
         with open(path, "w") as f:
             json.dump(self.spans(), f, indent=1)
 
-    def summary(self) -> dict[str, dict[str, float]]:
-        """Per-path aggregate: count / total_s / max_s (for /metrics)."""
+    def summary(self, prefix: str = "",
+                request_id: str = "") -> dict[str, dict[str, float]]:
+        """Per-path aggregate: count / total_s / max_s (for /metrics and
+        /v1/trace, optionally filtered to one request id). Aggregates
+        over a lock-snapshot copy — the tracer lock is held only for the
+        ring copy inside :meth:`spans`, never across the whole walk, so
+        concurrent ``record()`` calls proceed unblocked."""
         agg: dict[str, dict[str, float]] = {}
-        for s in self.spans():
+        for s in self.spans(prefix, request_id):
             a = agg.setdefault(s["path"], {"count": 0, "total_s": 0.0, "max_s": 0.0})
             a["count"] += 1
             a["total_s"] += s["duration_s"]
@@ -127,19 +161,37 @@ def span(name: str, **attrs: Any) -> Iterator[dict[str, Any]]:
         rec["path"] = path
         rec["start_s"] = start
         rec["duration_s"] = time.monotonic() - start
+        rid = _current_request.get()
+        if rid:
+            rec["request_id"] = rid
         _tracer.record(rec)
 
 
 def traced(name: str):
-    """Decorator form of :func:`span`."""
+    """Decorator form of :func:`span`.
+
+    ``functools.wraps`` preserves the wrapped function's signature,
+    annotations, and qualname (the old manual ``__name__``/``__doc__``
+    copy dropped everything ``inspect.signature`` reads). Generator
+    functions get their own path: wrapping one in a plain ``with span``
+    closed the span at the FIRST yield — before any work ran — so the
+    generator variant keeps the span open across the whole iteration."""
 
     def deco(fn):
+        if inspect.isgeneratorfunction(fn):
+
+            @functools.wraps(fn)
+            def genwrapper(*args, **kwargs):
+                with span(name):
+                    yield from fn(*args, **kwargs)
+
+            return genwrapper
+
+        @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             with span(name):
                 return fn(*args, **kwargs)
 
-        wrapper.__name__ = getattr(fn, "__name__", name)
-        wrapper.__doc__ = fn.__doc__
         return wrapper
 
     return deco

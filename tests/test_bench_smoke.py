@@ -410,6 +410,47 @@ class TestContinuationLeg:
         assert out["continuation_gap_ms"] >= 300
 
 
+class TestLatencyBreakdownLeg:
+    # real continuous pod + compiles: rides the slow set like the other
+    # serving-pod bench legs
+    @pytest.mark.slow
+    def test_measure_latency_breakdown_schema(self, tmp_path):
+        """The per-request latency-breakdown micro-leg (ISSUE 13) on a
+        tiny model: schema-checks the TTFT split keys and the leg's own
+        accounting contract (phase spans cover >= 90% of wall time — the
+        leg RAISES below that, so a passing run is the assertion)."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        import bench
+        from modelx_tpu.dl import safetensors as st
+        from modelx_tpu.models import llama
+
+        cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64),
+                                  dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        st.write_safetensors(
+            str(tmp_path / "model.safetensors"),
+            {k: np.asarray(v) for k, v in params.items()},
+        )
+        out = bench.measure_latency_breakdown(str(tmp_path), requests_n=4,
+                                              new_tokens=6, max_seq_len=96)
+        for key in ("breakdown_requests", "breakdown_coverage_min",
+                    "ttft_queue_ms_p50", "ttft_queue_ms_p99",
+                    "ttft_compute_ms_p50", "ttft_compute_ms_p99"):
+            assert key in out, key
+        assert out["breakdown_requests"] == 4
+        assert out["breakdown_coverage_min"] >= 0.9
+        # compute-side TTFT is real work on every request; queue time may
+        # be ~0 on an idle pod but never negative
+        assert out["ttft_compute_ms_p50"] > 0
+        assert out["ttft_queue_ms_p50"] >= 0
+        assert out["ttft_queue_ms_p99"] >= out["ttft_queue_ms_p50"]
+        assert out["ttft_compute_ms_p99"] >= out["ttft_compute_ms_p50"]
+
+
 class TestBenchBudget:
     """The r05-timeout fix (rc 124, nothing recorded): the soft budget
     skips stages that no longer fit — NAMED in timed_out_legs — records

@@ -137,12 +137,20 @@ class TestIncrementalPush:
         )
         base = srv.serve_background()
         try:
+            def blob_put_total() -> float:
+                # sample line only: the exposition format also carries
+                # "# HELP/# TYPE modelx_blob_put_total ..." comment lines
+                for line in requests.get(base + "/metrics").text.splitlines():
+                    if line.startswith("modelx_blob_put_total "):
+                        return float(line.split()[1])
+                return 0.0
+
             client = Client(base, quiet=True)
             d = str(tmp_path / "ck")
             ckpt = Checkpointer(d)
             ckpt.save(params, None, step=1)
             client.push("library/train", "v1", d)
-            puts_v1 = float(requests.get(base + "/metrics").text.split("blob_put_total")[1].split()[0])
+            puts_v1 = blob_put_total()
 
             # touch exactly one layer
             params2 = dict(params)
@@ -150,7 +158,7 @@ class TestIncrementalPush:
             params2[name] = np.asarray(params2[name]) + 1
             ckpt.save(params2, None, step=2)
             client.push("library/train", "v2", d)
-            puts_v2 = float(requests.get(base + "/metrics").text.split("blob_put_total")[1].split()[0])
+            puts_v2 = blob_put_total()
             # layer-0 shard + checkpoint.json changed; everything else deduped
             assert puts_v2 - puts_v1 == 2, (puts_v1, puts_v2)
         finally:

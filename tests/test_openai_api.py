@@ -485,6 +485,42 @@ class TestStreaming:
         assert r.status_code == 400
         assert "stream" in r.json()["error"]["message"]
 
+    def test_stream_usage_carries_timing_block(self, front):
+        """ISSUE 13: on an engine with phase machinery (the continuous
+        batcher), the opt-in final usage chunk also carries the
+        per-request timing breakdown; the default stream (no
+        include_usage) stays byte-unchanged."""
+        _, server = front
+        sset = ServerSet({"m": server}, continuous_batch=True, max_slots=2,
+                         stream_chunk_size=4)
+        sset.pool.mark_ready("m")
+        httpd = serve(sset, listen=f"127.0.0.1:{free_port()}")
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            req = {"prompt": "hello world tpu", "max_tokens": 6,
+                   "temperature": 0, "stream": True,
+                   "stream_options": {"include_usage": True}}
+            r = requests.post(base + "/v1/completions", json=req)
+            assert r.status_code == 200, r.text
+            events = self._events(r)
+            assert "usage" in events[-1]
+            timing = events[-1].get("timing")
+            assert timing, events[-1]
+            assert timing["ttft_ms"] > 0
+            assert timing.get("queue_ms", 0) >= 0
+            # without include_usage no timing (or usage) chunk appears
+            r = requests.post(base + "/v1/completions",
+                              json={"prompt": "hello world tpu",
+                                    "max_tokens": 6, "temperature": 0,
+                                    "stream": True})
+            assert not any("timing" in e or "usage" in e
+                           for e in self._events(r))
+        finally:
+            httpd.shutdown()
+            for cb in sset.cbatchers.values():
+                cb.close()
+                cb.release_device_state()
+
     def test_stream_validation_is_pre_status(self, front):
         base, _ = front
         r = requests.post(base + "/v1/completions",

@@ -1,0 +1,323 @@
+"""Prometheus text exposition (utils/promexp.py, ISSUE 13): the histogram
+instrument, the content-negotiation rule, the generic snapshot-tree
+renderer — and the HTTP surfaces that serve it (registry, router; the pod
+surface is parse-tested in test_router.py next to its fixtures).
+
+The parser below is the test's own strict promtool stand-in: every line
+must be a ``# HELP``/``# TYPE`` comment or a well-formed sample, label
+escaping must round-trip, histogram buckets must be cumulative with the
+``+Inf`` bucket equal to ``_count``."""
+
+import json
+import re
+
+import pytest
+import requests
+
+from modelx_tpu.utils import promexp
+from modelx_tpu.utils.promexp import (
+    CONTENT_TYPE,
+    Histogram,
+    render,
+    wants_prometheus,
+)
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>\S+)$")
+_ESCAPES = {"\\": "\\", "n": "\n", '"': '"'}
+
+
+def parse_labels(raw):
+    """Decode one ``{...}`` label block, honoring ``\\\\``/``\\n``/``\\"``."""
+    labels = {}
+    i = 0
+    while i < len(raw):
+        eq = raw.index("=", i)
+        key = raw[i:eq]
+        assert raw[eq + 1] == '"', raw
+        j = eq + 2
+        val = []
+        while raw[j] != '"':
+            if raw[j] == "\\":
+                val.append(_ESCAPES[raw[j + 1]])
+                j += 2
+            else:
+                val.append(raw[j])
+                j += 1
+        labels[key] = "".join(val)
+        i = j + 1
+        if i < len(raw):
+            assert raw[i] == ",", raw
+            i += 1
+    return labels
+
+
+def parse_exposition(text):
+    """Strict parse of text format 0.0.4. Returns ``{family_name:
+    {"type": ..., "help": ..., "samples": [(sample_name, labels, value)]}}``
+    and asserts the invariants a real scraper needs: every non-comment
+    line is a sample, every sample belongs to a declared family, and
+    histogram bucket counts are cumulative with ``+Inf`` == ``_count``."""
+    families = {}
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            name, _, help_text = line[len("# HELP "):].partition(" ")
+            families.setdefault(name, {"samples": []})["help"] = help_text
+        elif line.startswith("# TYPE "):
+            name, _, kind = line[len("# TYPE "):].partition(" ")
+            assert kind in ("counter", "gauge", "histogram"), line
+            families.setdefault(name, {"samples": []})["type"] = kind
+        else:
+            m = _SAMPLE_RE.match(line)
+            assert m, f"unparseable sample line: {line!r}"
+            base = m.group("name")
+            fam = base
+            for suffix in ("_bucket", "_sum", "_count"):
+                if base.endswith(suffix):
+                    trimmed = base[: -len(suffix)]
+                    if families.get(trimmed, {}).get("type") == "histogram":
+                        fam = trimmed
+                        break
+            assert fam in families, f"sample before its family: {line!r}"
+            assert "type" in families[fam], f"sample before # TYPE: {line!r}"
+            families[fam]["samples"].append((
+                base,
+                parse_labels(m.group("labels") or ""),
+                float(m.group("value")),
+            ))
+    for name, fam in families.items():
+        if fam.get("type") != "histogram":
+            continue
+        series = {}
+        for sample, labels, value in fam["samples"]:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            s = series.setdefault(key, {"buckets": [], "count": None})
+            if sample == name + "_bucket":
+                bound = labels["le"]
+                s["buckets"].append(
+                    (float("inf") if bound == "+Inf" else float(bound),
+                     value))
+            elif sample == name + "_count":
+                s["count"] = value
+        for s in series.values():
+            s["buckets"].sort()
+            counts = [c for _, c in s["buckets"]]
+            assert counts == sorted(counts), (name, counts)
+            assert s["buckets"][-1][0] == float("inf"), name
+            assert s["buckets"][-1][1] == s["count"], name
+    return families
+
+
+class TestHistogram:
+    def test_snapshot_is_cumulative(self):
+        h = Histogram(buckets=(1, 5, 10))
+        for v in (0.5, 0.7, 3, 7):
+            h.observe(v)
+        snap = h.snapshot()
+        assert snap["buckets"] == {"1": 2, "5": 3, "10": 4, "+Inf": 4}
+        assert snap["count"] == 4
+        assert abs(snap["sum"] - 11.2) < 1e-9
+
+    def test_overflow_lands_only_in_inf(self):
+        h = Histogram(buckets=(1, 5))
+        h.observe(100)
+        snap = h.snapshot()
+        assert snap["buckets"] == {"1": 0, "5": 0, "+Inf": 1}
+        assert snap["count"] == 1
+
+    def test_nan_is_dropped(self):
+        h = Histogram(buckets=(1,))
+        h.observe(float("nan"))
+        assert h.snapshot()["count"] == 0
+
+    def test_bounds_sorted_and_required(self):
+        h = Histogram(buckets=(10, 1))
+        h.observe(2)
+        assert h.snapshot()["buckets"] == {"1": 0, "10": 1, "+Inf": 1}
+        with pytest.raises(ValueError):
+            Histogram(buckets=())
+
+    def test_shape_detector(self):
+        assert promexp.is_histogram_snapshot(Histogram().snapshot())
+        assert not promexp.is_histogram_snapshot({"buckets": {}})
+        assert not promexp.is_histogram_snapshot(3)
+
+
+class TestWantsPrometheus:
+    def test_format_param_wins(self):
+        assert wants_prometheus("application/json", "prometheus")
+        assert wants_prometheus(None, "text")
+        assert not wants_prometheus("text/plain", "json")
+
+    def test_accept_header_fallback(self):
+        assert wants_prometheus("text/plain; version=0.0.4", "")
+        assert not wants_prometheus("application/json", "")
+        assert not wants_prometheus(None, None)
+        assert not wants_prometheus("*/*", "")
+
+
+class TestRender:
+    def test_counters_and_gauges(self):
+        text = render({"requests_total": 3, "depth": 2.5})
+        fams = parse_exposition(text)
+        assert fams["modelx_requests_total"]["type"] == "counter"
+        assert fams["modelx_requests_total"]["samples"][0][2] == 3
+        assert fams["modelx_depth"]["type"] == "gauge"
+        assert fams["modelx_depth"]["samples"][0][2] == 2.5
+
+    def test_label_levels_capture_dynamic_keys(self):
+        tree = {"pods": {"http://a:8000": {"inflight": 1},
+                         "http://b:8000": {"inflight": 2}}}
+        fams = parse_exposition(
+            render(tree, label_levels={("pods", "*"): "pod"}))
+        samples = fams["modelx_pods_inflight"]["samples"]
+        assert {(s[1]["pod"], s[2]) for s in samples} == {
+            ("http://a:8000", 1.0), ("http://b:8000", 2.0)}
+
+    def test_label_level_never_rematches_children(self):
+        # the consumed level's CHILD dicts are structure, not labels:
+        # "queue" below must stay a metric-name fragment
+        tree = {"pods": {"http://a": {"queue": {"depth": 4}}}}
+        fams = parse_exposition(
+            render(tree, label_levels={("pods", "*"): "pod"}))
+        (sample,) = fams["modelx_pods_queue_depth"]["samples"]
+        assert sample[1] == {"pod": "http://a"}
+        assert sample[2] == 4
+
+    def test_label_escaping_round_trips(self):
+        ugly = 'u"rl\\with\nnewline'
+        tree = {"pods": {ugly: {"inflight": 1}}}
+        text = render(tree, label_levels={("pods", "*"): "pod"})
+        (sample,) = parse_exposition(text)["modelx_pods_inflight"]["samples"]
+        assert sample[1]["pod"] == ugly
+
+    def test_name_sanitization(self):
+        fams = parse_exposition(render({"a-b.c": 1, "1xx": 2}))
+        assert "modelx_a_b_c" in fams
+        assert "modelx_1xx" in fams  # digit is fine mid-name
+
+    def test_histogram_family(self):
+        h = Histogram(buckets=(1, 10))
+        h.observe(0.5)
+        h.observe(5)
+        tree = {"m": {"queue_ms_hist": h.snapshot()}}
+        fams = parse_exposition(render(tree,
+                                       label_levels={("*",): "model"}))
+        fam = fams["modelx_queue_ms_hist"]
+        assert fam["type"] == "histogram"
+        by_name = {}
+        for name, labels, value in fam["samples"]:
+            assert labels.get("model", labels.get("le")) is not None
+            by_name.setdefault(name, []).append((labels, value))
+        assert [v for _, v in sorted(
+            by_name["modelx_queue_ms_hist_bucket"][0:3],
+            key=lambda s: float("inf") if s[0]["le"] == "+Inf"
+            else float(s[0]["le"]))] == [1, 2, 2]
+        ((_, total),) = by_name["modelx_queue_ms_hist_count"]
+        assert total == 2
+
+    def test_kind_clash_demotes_to_gauge(self):
+        # the same family name arriving as both gauge and histogram must
+        # still render something every scraper can parse
+        h = Histogram(buckets=(1,))
+        h.observe(0.5)
+        tree = {"a": {"lat": 3}, "b": {"lat": h.snapshot()}}
+        fams = parse_exposition(render(tree, label_levels={("*",): "m"}))
+        fam = fams["modelx_lat"]
+        assert fam["type"] == "gauge"
+        assert {(s[1]["m"], s[2]) for s in fam["samples"]} == {
+            ("a", 3.0), ("b", 1.0)}  # histogram surfaces its count
+
+    def test_non_numeric_leaves_skipped(self):
+        text = render({"state": "READY", "urls": ["a"], "none": None,
+                       "up": True, "n": 1})
+        fams = parse_exposition(text)
+        assert set(fams) == {"modelx_up", "modelx_n"}
+        assert fams["modelx_up"]["samples"][0][2] == 1.0
+
+    def test_empty_tree_renders_empty(self):
+        assert render({}) == ""
+
+    def test_every_line_is_comment_or_sample(self):
+        # a busy, deep, labeled tree: the whole render must satisfy the
+        # strict parser line-for-line
+        h = Histogram()
+        h.observe(12.5)
+        tree = {
+            "requests_total": 10,
+            "router": {"sticky_entries": 5, "routes": {"p1": 7}},
+            "pods": {"http://p:1": {"inflight": 0,
+                                    "ttft_ms_hist": h.snapshot()}},
+        }
+        text = render(tree, label_levels={("router", "routes", "*"): "pod",
+                                          ("pods", "*"): "pod"})
+        fams = parse_exposition(text)
+        assert fams["modelx_requests_total"]["type"] == "counter"
+        assert fams["modelx_pods_ttft_ms_hist"]["type"] == "histogram"
+
+
+class TestRegistrySurface:
+    def test_registry_metrics_parse_and_count(self):
+        from modelx_tpu.registry.fs import MemoryFSProvider
+        from modelx_tpu.registry.server import (
+            Options,
+            RegistryServer,
+            free_port,
+        )
+        from modelx_tpu.registry.store_fs import FSRegistryStore
+        from modelx_tpu.types import Digest
+
+        srv = RegistryServer(
+            Options(listen=f"127.0.0.1:{free_port()}"),
+            store=FSRegistryStore(MemoryFSProvider()))
+        base = srv.serve_background()
+        try:
+            data = b"weights"
+            digest = str(Digest.from_bytes(data))
+            assert requests.put(f"{base}/r/demo/blobs/{digest}",
+                                data=data).status_code == 201
+            r = requests.get(f"{base}/metrics")
+            assert r.headers["Content-Type"] == CONTENT_TYPE
+            fams = parse_exposition(r.text)
+            assert fams["modelx_blob_put_total"]["samples"][0][2] == 1
+            assert fams["modelx_blob_put_total"]["type"] == "counter"
+        finally:
+            srv.shutdown()
+
+
+class TestRouterSurface:
+    def test_router_metrics_negotiation_and_json_compat(self):
+        from modelx_tpu.registry.server import free_port
+        from modelx_tpu.router.registry import PodRegistry
+        from modelx_tpu.router.server import FleetRouter, route_serve
+
+        # one never-polled placeholder pod: the surface under test is the
+        # router's own /metrics, no upstream traffic happens
+        router = FleetRouter(PodRegistry(["http://127.0.0.1:9"],
+                                         poll_interval_s=60.0))
+        httpd = route_serve(router, listen=f"127.0.0.1:{free_port()}")
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        try:
+            before = requests.get(base + "/metrics")
+            assert before.headers["Content-Type"] == "application/json"
+            prom = requests.get(base + "/metrics?format=prometheus")
+            assert prom.headers["Content-Type"] == CONTENT_TYPE
+            fams = parse_exposition(prom.text)
+            assert "modelx_router_requests_total" in fams
+            via_accept = requests.get(base + "/metrics",
+                                      headers={"Accept": "text/plain"})
+            assert parse_exposition(via_accept.text)
+            # the JSON surface is byte-compatible around the side door:
+            # same schema, same serialization
+            after = requests.get(base + "/metrics")
+            assert json.dumps(json.loads(before.content)) == \
+                json.dumps(json.loads(after.content))
+            assert set(after.json()) == set(router.snapshot())
+        finally:
+            httpd.shutdown()
+            router.close()

@@ -31,7 +31,11 @@ import jax
 
 from modelx_tpu.dl import safetensors as st
 from modelx_tpu.dl.serve import ModelServer, ServerSet, serve
-from modelx_tpu.dl.serving_errors import DEADLINE_HEADER
+from modelx_tpu.dl.serving_errors import (
+    ATTEMPT_HEADER,
+    DEADLINE_HEADER,
+    REQUEST_ID_HEADER,
+)
 from modelx_tpu.models import llama
 from modelx_tpu.registry.server import free_port
 from modelx_tpu.router.admission import RetryBudget
@@ -899,13 +903,14 @@ def new_pod(tiny_server):
         url=f"http://127.0.0.1:{httpd.server_address[1]}")
 
 
-def new_cont_pod(tiny_server):
+def new_cont_pod(tiny_server, access_log=""):
     """A real pod whose single-row streams ride the continuous engine —
     the resume contract (ISSUE 12) needs per-step sample streams."""
     sset = ServerSet({"default": tiny_server}, continuous_batch=True,
                      max_slots=2, stream_chunk_size=4)
     sset.pool.mark_ready("default")
-    httpd = serve(sset, listen=f"127.0.0.1:{free_port()}")
+    httpd = serve(sset, listen=f"127.0.0.1:{free_port()}",
+                  access_log=access_log)
     return SimpleNamespace(
         sset=sset, httpd=httpd,
         kill=PodKillSwitch(httpd, sset=sset),
@@ -998,6 +1003,7 @@ class TestPodServingStats:
 
 
 class TestFleetAcceptance:
+    @pytest.mark.slow
     def test_sticky_hit_ratio_above_point_nine(self, fleet):
         """Repeated-prefix conversations: after each conversation's first
         turn every request sticky-hits, and each conversation pins to one
@@ -1494,3 +1500,347 @@ class TestFleetSoak:
             router.close()
             for p in pods:
                 p.httpd.shutdown()
+
+
+# -- observability (ISSUE 13): request identity, metrics, access logs ----------
+
+
+def _read_log(path):
+    """Parsed JSON-lines access-log records ([] while the file is empty —
+    the writers are line-buffered, so a complete record is one read away)."""
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+class TestRequestIdPropagation:
+    """One request id threads client -> router -> every upstream attempt
+    -> response echo: minted at the front door when absent, honored when
+    supplied, replaced when malformed, and carried with an incrementing
+    attempt counter across failovers and continuations."""
+
+    def test_minted_id_and_attempt_echoed(self):
+        pod = FakePod()
+        f = make_router([pod.url])
+        try:
+            r = requests.post(f.base + "/v1/generate",
+                              json={"tokens": [[1, 2]]})
+            assert r.status_code == 200
+            rid = r.headers[REQUEST_ID_HEADER]
+            assert rid.startswith("req-")
+            assert r.headers[ATTEMPT_HEADER] == "1"
+            # the upstream dispatch carried the same identity
+            assert pod.seen_headers[0]["x-modelx-request-id"] == rid
+            assert pod.seen_headers[0]["x-modelx-attempt"] == "1"
+        finally:
+            f.httpd.shutdown()
+            pod.close()
+
+    def test_client_supplied_id_honored(self):
+        pod = FakePod()
+        f = make_router([pod.url])
+        try:
+            r = requests.post(f.base + "/v1/generate",
+                              json={"tokens": [[1, 2]]},
+                              headers={REQUEST_ID_HEADER: "my-trace.7"})
+            assert r.headers[REQUEST_ID_HEADER] == "my-trace.7"
+            assert pod.seen_headers[0]["x-modelx-request-id"] == "my-trace.7"
+        finally:
+            f.httpd.shutdown()
+            pod.close()
+
+    def test_malformed_id_replaced_with_a_mint(self):
+        """Ids outside the closed alphabet never reach a pod, a log line,
+        or a response header — injection via the id header is dead on
+        arrival; the request still gets a usable minted id."""
+        pod = FakePod()
+        f = make_router([pod.url])
+        try:
+            r = requests.post(f.base + "/v1/generate",
+                              json={"tokens": [[1, 2]]},
+                              headers={REQUEST_ID_HEADER: "bad id!{}"})
+            rid = r.headers[REQUEST_ID_HEADER]
+            assert rid.startswith("req-")
+            assert rid != "bad id!{}"
+            assert pod.seen_headers[0]["x-modelx-request-id"] == rid
+        finally:
+            f.httpd.shutdown()
+            pod.close()
+
+    def test_incoming_attempt_seeds_the_counter(self):
+        """A chained router (router behind router) keeps ONE attempt
+        sequence for the whole request: hop two starts counting where
+        hop one stopped instead of restarting at 1."""
+        pod = FakePod()
+        f = make_router([pod.url])
+        try:
+            r = requests.post(f.base + "/v1/generate",
+                              json={"tokens": [[1, 2]]},
+                              headers={REQUEST_ID_HEADER: "chained-1",
+                                       ATTEMPT_HEADER: "5"})
+            assert pod.seen_headers[0]["x-modelx-attempt"] == "5"
+            assert r.headers[ATTEMPT_HEADER] == "5"
+        finally:
+            f.httpd.shutdown()
+            pod.close()
+
+    def test_nonstreaming_failover_same_id_next_attempt(self):
+        """A shed first attempt and the winning failover carry the SAME
+        request id with attempt 1 then 2 — the two pods' logs join on
+        the id, and the client's echo names the attempt that actually
+        answered."""
+        pods = [FakePod(), FakePod()]
+        f = make_router([p.url for p in pods])
+        try:
+            body = {"tokens": [[7, 8, 9, 10]]}
+            # warm the sticky table so the retry hits a KNOWN first pod
+            assert requests.post(f.base + "/v1/generate",
+                                 json=body).status_code == 200
+            first = next(p for p in pods if p.seen_headers)
+            other = next(p for p in pods if p is not first)
+            first.status_script = [503]
+            r = requests.post(f.base + "/v1/generate", json=body,
+                              headers={REQUEST_ID_HEADER: "retry-me"})
+            assert r.status_code == 200
+            assert r.headers[REQUEST_ID_HEADER] == "retry-me"
+            assert r.headers[ATTEMPT_HEADER] == "2"
+            assert first.seen_headers[1]["x-modelx-request-id"] == "retry-me"
+            assert first.seen_headers[1]["x-modelx-attempt"] == "1"
+            assert other.seen_headers[0]["x-modelx-request-id"] == "retry-me"
+            assert other.seen_headers[0]["x-modelx-attempt"] == "2"
+        finally:
+            f.httpd.shutdown()
+            for p in pods:
+                p.close()
+
+    def test_continuation_keeps_the_id_and_increments_attempt(self):
+        """A mid-stream failover is the SAME request: the continuation
+        dispatch reuses the id with the next attempt number, so both
+        pods' span timelines and access logs join across the splice."""
+        pods = _sever_pods()
+        f = make_router([p.url for p in pods])
+        try:
+            r = requests.post(f.base + "/v1/generate", json=_CONT_BODY,
+                              stream=True,
+                              headers={REQUEST_ID_HEADER: "trace-abc"})
+            assert r.headers[REQUEST_ID_HEADER] == "trace-abc"
+            assert r.raw.read() == _SPLICED
+            first = next(p for p in pods if p.seen_headers
+                         and "x-modelx-resume-emitted"
+                         not in p.seen_headers[0])
+            cont = next(p for p in pods if p.seen_headers
+                        and "x-modelx-resume-emitted" in p.seen_headers[0])
+            assert first.seen_headers[0]["x-modelx-request-id"] == "trace-abc"
+            assert cont.seen_headers[0]["x-modelx-request-id"] == "trace-abc"
+            assert first.seen_headers[0]["x-modelx-attempt"] == "1"
+            assert cont.seen_headers[0]["x-modelx-attempt"] == "2"
+        finally:
+            f.httpd.shutdown()
+            for p in pods:
+                p.close()
+
+
+class TestObservabilitySurface:
+    """The router's scrape + trace + access-log surfaces: JSON /metrics
+    byte-compatible with pre-ISSUE-13 consumers, Prometheus text on
+    explicit negotiation, /v1/trace filterable to one request, and the
+    structured access log naming the route decision per request."""
+
+    def test_metrics_json_default_unchanged(self):
+        pod = FakePod()
+        f = make_router([pod.url])
+        try:
+            r = requests.get(f.base + "/metrics")
+            assert r.headers["Content-Type"] == "application/json"
+            snap = r.json()
+            assert set(snap) >= {"router", "pods", "inflight"}
+            assert snap == f.router.snapshot()
+        finally:
+            f.httpd.shutdown()
+            pod.close()
+
+    def test_metrics_prometheus_negotiation(self):
+        pod = FakePod()
+        f = make_router([pod.url])
+        try:
+            assert requests.post(f.base + "/v1/generate",
+                                 json={"tokens": [[1]]}).status_code == 200
+            r = requests.get(f.base + "/metrics?format=prometheus")
+            assert r.headers["Content-Type"].startswith("text/plain")
+            samples = {}
+            for line in r.text.splitlines():
+                if not line or line.startswith("#"):
+                    continue
+                name, val = line.rsplit(" ", 1)
+                samples[name] = float(val)  # every sample line parses
+            assert samples["modelx_router_requests_total"] >= 1
+            # Accept negotiation reaches the same surface
+            r2 = requests.get(f.base + "/metrics",
+                              headers={"Accept": "text/plain"})
+            assert r2.headers["Content-Type"] == r.headers["Content-Type"]
+            # and the JSON snapshot is untouched by the side door
+            assert "router" in requests.get(f.base + "/metrics").json()
+        finally:
+            f.httpd.shutdown()
+            pod.close()
+
+    def test_trace_endpoint_filters_by_request_id(self):
+        pod = FakePod()
+        f = make_router([pod.url])
+        try:
+            assert requests.post(
+                f.base + "/v1/generate", json={"tokens": [[1]]},
+                headers={REQUEST_ID_HEADER: "trace-filter-xyzzy"},
+            ).status_code == 200
+            summary = requests.get(f.base + "/v1/trace").json()
+            assert any(p.startswith("router.request") for p in summary)
+            mine = requests.get(
+                f.base + "/v1/trace?request_id=trace-filter-xyzzy").json()
+            assert any(p.startswith("router.request") for p in mine)
+            assert requests.get(
+                f.base + "/v1/trace?request_id=no-such-id-ever").json() == {}
+        finally:
+            f.httpd.shutdown()
+            pod.close()
+
+    def test_pod_metrics_prometheus_scrape(self, fleet):
+        """The pod-side scrape surface through a REAL pod: every sample
+        line parses, and the JSON default still carries the per-model
+        tree the router's poller and dashboards read."""
+        pod = fleet.pods[0]
+        r = requests.get(pod.url + "/metrics?format=prometheus")
+        assert r.headers["Content-Type"].startswith("text/plain")
+        assert r.text  # a loaded pod always has at least pool gauges
+        for line in r.text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            name, val = line.rsplit(" ", 1)
+            assert name
+            float(val)
+        j = requests.get(pod.url + "/metrics").json()
+        assert "default" in j
+
+    def test_access_log_records_route_decision(self, tmp_path):
+        pods = [FakePod(), FakePod()]
+        log = tmp_path / "router-access.log"
+        f = make_router([p.url for p in pods], access_log=str(log))
+        try:
+            r = requests.post(f.base + "/v1/generate",
+                              json={"tokens": [[5, 6]]},
+                              headers={REQUEST_ID_HEADER: "logged-1"})
+            assert r.status_code == 200
+            rec = wait_for(lambda: _read_log(log))[-1]
+            assert rec["request_id"] == "logged-1"
+            assert rec["status"] == 200
+            assert rec["attempt"] == 1
+            assert rec["route"] in ("sticky", "balanced")
+            assert rec["model"] == "default"
+            assert rec["pod"] in [p.url for p in pods]
+            assert rec["ms"] >= 0
+            assert rec["client"]
+            assert rec["ts"] > 0
+        finally:
+            f.httpd.shutdown()
+            for p in pods:
+                p.close()
+
+    def test_access_log_names_the_continuation(self, tmp_path):
+        pods = _sever_pods()
+        log = tmp_path / "router-access.log"
+        f = make_router([p.url for p in pods], access_log=str(log))
+        try:
+            r = requests.post(f.base + "/v1/generate", json=_CONT_BODY,
+                              stream=True,
+                              headers={REQUEST_ID_HEADER: "spliced-1"})
+            assert r.raw.read() == _SPLICED
+            rec = wait_for(lambda: _read_log(log))[-1]
+            assert rec["request_id"] == "spliced-1"
+            assert rec["route"] == "continuation"
+            assert rec["attempt"] == 2
+            assert rec["status"] == 200
+        finally:
+            f.httpd.shutdown()
+            for p in pods:
+                p.close()
+
+
+# real continuous pods + compiles: rides the slow/chaos set (`make obs`)
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestObservabilityE2E:
+    def test_one_id_threads_logs_spans_and_timing_across_a_kill(
+            self, tiny_server, tmp_path):
+        """The ISSUE 13 acceptance drill: ONE request id visibly threads
+        the router access log, BOTH pods' access logs (original attempt
+        and continuation), the span timeline, and the in-stream timing
+        block — for a single streamed request that failed over
+        mid-stream after a seeded pod kill."""
+        pods = [new_cont_pod(tiny_server,
+                             access_log=str(tmp_path / f"pod{i}.log"))
+                for i in range(2)]
+        router_log = tmp_path / "router.log"
+        httpd = None
+        router = None
+        try:
+            registry = PodRegistry([p.url for p in pods],
+                                   poll_interval_s=60.0)
+            registry.poll_once()
+            router = FleetRouter(registry, request_timeout_s=30.0,
+                                 access_log=str(router_log))
+            httpd = route_serve(router, listen=f"127.0.0.1:{free_port()}")
+            base = f"http://127.0.0.1:{httpd.server_address[1]}"
+            fired = threading.Event()
+            for p in pods:
+                arm_kill(p, fired)
+            body = {"tokens": [[2, 4, 6, 8]], "max_new_tokens": 12,
+                    "stream": True, "temperature": 0.9, "top_k": 8,
+                    "top_p": 0.95, "seed": 1234, "include_timing": True}
+            r = requests.post(base + "/v1/generate", json=body, stream=True,
+                              headers={REQUEST_ID_HEADER: "e2e-trace-1"})
+            assert r.status_code == 200
+            assert r.headers[REQUEST_ID_HEADER] == "e2e-trace-1"
+            lines = [json.loads(ln) for ln in r.raw.read().splitlines()
+                     if ln]
+            assert fired.is_set(), "the kill never fired"
+            assert lines[-1] == {"done": True}
+            # the opt-in timing block rode through the splice
+            (timing,) = [ln["timing"] for ln in lines if "timing" in ln]
+            assert timing["total_ms"] > 0
+            assert timing["ttft_ms"] > 0
+            assert timing["tokens"] >= 1
+            # the router access log names the continuation, same id
+            rrec = wait_for(lambda: [
+                rec for rec in _read_log(router_log)
+                if rec["request_id"] == "e2e-trace-1"])[-1]
+            assert rrec["route"] == "continuation"
+            assert rrec["attempt"] == 2
+            assert rrec["status"] == 200
+            # both pods logged the SAME id: the original attempt (1) on
+            # the killed pod, the continuation (2) on the survivor
+            attempts = set()
+            for i in range(2):
+                recs = wait_for(lambda i=i: [
+                    rec for rec in _read_log(tmp_path / f"pod{i}.log")
+                    if rec["request_id"] == "e2e-trace-1"])
+                attempts.update(rec["attempt"] for rec in recs)
+            assert attempts == {1, 2}
+            # the span timeline joins on the id too (pods answer /v1/trace;
+            # the killed pod's listener is gone — any survivor will do)
+            paths: set = set()
+            for url in [base] + [p.url for p in pods]:
+                try:
+                    paths.update(requests.get(
+                        url + "/v1/trace?request_id=e2e-trace-1",
+                        timeout=5).json())
+                except requests.RequestException:
+                    continue
+            assert any(p.startswith("serve.request") for p in paths)
+            assert any(p.startswith("router.request") for p in paths)
+        finally:
+            if httpd is not None:
+                httpd.shutdown()
+            if router is not None:
+                router.close()
+            for p in pods:
+                close_cont_pod(p)

@@ -115,3 +115,104 @@ class TestIntegration:
         # an unwritable dir must not raise out of the context manager
         with jax_profile(str(tmp_path / "trace")):
             pass
+
+
+class TestTracedWraps:
+    """ISSUE 13: ``traced()`` must be a transparent wrapper — signature,
+    qualname, and docstring survive — and must keep a GENERATOR's span
+    open across the whole iteration instead of closing at first yield."""
+
+    def test_signature_and_metadata_preserved(self):
+        import inspect
+
+        @traced("fn.sig")
+        def f(a, b=2, *, c):
+            """docs"""
+            return a + b + c
+
+        assert f.__name__ == "f"
+        assert f.__doc__ == "docs"
+        assert list(inspect.signature(f).parameters) == ["a", "b", "c"]
+        assert f(1, c=3) == 6
+
+    def test_generator_span_covers_the_whole_iteration(self):
+        import time as _time
+
+        @traced("gen.op")
+        def g():
+            yield 1
+            _time.sleep(0.02)  # work AFTER the first yield
+            yield 2
+
+        it = g()
+        assert next(it) == 1
+        # span still open: first yield must not close it
+        assert not tracer().spans("gen.op")
+        assert list(it) == [2]
+        (s,) = tracer().spans("gen.op")
+        assert s["duration_s"] >= 0.02
+
+    def test_generator_identity_preserved(self):
+        import inspect
+
+        @traced("gen.id")
+        def g(n):
+            yield from range(n)
+
+        assert inspect.isgeneratorfunction(g)
+        assert g.__name__ == "g"
+        assert list(g(3)) == [0, 1, 2]
+
+
+class TestRequestContext:
+    """The request id rides a contextvar parallel to the span path: every
+    span closed inside ``request_context`` carries it, and the /v1/trace
+    filters slice one request's timeline out of the ring."""
+
+    def test_spans_stamped_and_filterable(self):
+        from modelx_tpu.utils.trace import current_request_id, request_context
+
+        assert current_request_id() == ""
+        with request_context("req-42"):
+            assert current_request_id() == "req-42"
+            with span("inside"):
+                pass
+        assert current_request_id() == ""
+        with span("outside"):
+            pass
+        (s,) = tracer().spans(request_id="req-42")
+        assert s["path"] == "inside"
+        out = tracer().spans("outside")
+        assert "request_id" not in out[0]
+
+    def test_summary_filters_by_request_id(self):
+        from modelx_tpu.utils.trace import request_context
+
+        for rid in ("req-a", "req-a", "req-b"):
+            with request_context(rid):
+                with span("op"):
+                    pass
+        assert tracer().summary(request_id="req-a")["op"]["count"] == 2
+        assert tracer().summary(request_id="req-b")["op"]["count"] == 1
+        assert tracer().summary(request_id="req-zzz") == {}
+
+    def test_context_isolated_per_thread(self):
+        import threading
+
+        from modelx_tpu.utils.trace import request_context
+
+        seen = []
+
+        def worker():
+            with span("w.op"):
+                pass
+            seen.append(True)
+
+        with request_context("req-main"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert seen
+        # the worker thread's span never inherits the main thread's id
+        (w,) = tracer().spans("w.op")
+        assert "request_id" not in w
