@@ -75,13 +75,17 @@ programs:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_program_store.py -q -m "not slow"
 	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_program_store.py -q -m slow
 
-# observability drills (ISSUE 13): exposition-format round-trips, trace
-# summary/decorator units, request-id propagation over HTTP — then the
-# pod-kill chaos soak under runtime lockdep, where the failed-over
-# streams must keep their end-to-end request ids across the splice
+# observability drills (ISSUE 13 + 15): exposition-format round-trips,
+# trace summary/decorator units, request-id propagation over HTTP; the
+# flight-recorder / rate-wheel / devmem / access-log-rotation units and
+# the engine crash-dump + /debug/flightrec + /admin/profile drills —
+# then the pod-kill chaos soak under runtime lockdep, where the
+# failed-over streams must keep their end-to-end request ids across the
+# splice
 obs:
-	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_promexp.py -q
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_promexp.py tests/test_flightrec.py -q
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_router.py -q -k "RequestId or Observability"
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_engine_faults.py -q -k "FlightRecorder or Observability"
 	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_router.py -q -m chaos
 
 # two layers: the project-native concurrency/purity gate (always — it is

@@ -1708,6 +1708,98 @@ def measure_latency_breakdown(model_dir: str, *, requests_n: int = 8,
     }
 
 
+def measure_obs_overhead(model_dir: str, *, clients_n: int = 8,
+                         requests_per_client: int = 3, new_tokens: int = 8,
+                         rounds: int = 3, max_seq_len: int = 128) -> dict:
+    """Observability-overhead micro-leg (ISSUE 15): the flight recorder
+    and device telemetry are always-on by default, so their cost must be
+    measured, not asserted. Runs the SAME 8-client generate workload
+    against two pods that differ only in the recorder+telemetry knobs
+    and compares best-of-``rounds`` wall time (min-of-rounds because CPU
+    scheduling noise dwarfs the dict stores being measured — the bar is
+    ``flightrec_overhead_pct`` < 2%). Also reads the measured-vs-
+    reserved HBM accounting off the instrumented pod
+    (``hbm_measured_vs_reserved_ratio``)."""
+    import requests as _requests
+
+    from modelx_tpu.dl.serve import ModelServer, ServerSet, serve
+    from modelx_tpu.registry.server import free_port
+
+    server = ModelServer(model_dir, name="default", max_seq_len=max_seq_len)
+    server.load()
+    vocab = int(getattr(server.cfg, "vocab_size", 0) or 256)
+    out: dict = {"obs_overhead_clients": clients_n}
+
+    def run_leg(obs_on: bool) -> float:
+        sset = ServerSet({"default": server}, continuous_batch=True,
+                         max_slots=4, stream_chunk_size=4,
+                         flight_recorder=obs_on, device_telemetry=obs_on)
+        sset.pool.mark_ready("default")
+        httpd = serve(sset, listen=f"127.0.0.1:{free_port()}")
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        rng = np.random.RandomState(47)
+        prompts = [rng.randint(1, vocab, (6,)).tolist()
+                   for _ in range(clients_n)]
+        errors: list = []
+
+        def client(idx: int) -> None:
+            try:
+                for _ in range(requests_per_client):
+                    r = _requests.post(
+                        base + "/v1/generate",
+                        json={"tokens": [prompts[idx]],
+                              "max_new_tokens": new_tokens},
+                        timeout=120)
+                    if r.status_code != 200:
+                        raise RuntimeError(f"client {idx}: {r.text[:200]}")
+            except Exception as e:  # surfaced after join
+                errors.append(e)
+
+        def one_round() -> float:
+            threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                       for i in range(clients_n)]
+            t0 = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            if errors:
+                raise RuntimeError(f"obs-overhead leg failed: {errors[0]}")
+            return time.monotonic() - t0
+
+        try:
+            one_round()  # warmup: compiles + first-admission costs
+            best = min(one_round() for _ in range(rounds))
+            if obs_on:
+                # the instrumented leg also proves the telemetry surface:
+                # measured occupancy lands next to the estimate
+                snap = sset.pool.pool_snapshot()
+                measured = int(snap.get("hbm_bytes_measured", 0))
+                reserved = int(snap.get("hbm_reserved_bytes", 0))
+                out["hbm_measured_vs_reserved_ratio"] = (
+                    round(measured / reserved, 3) if reserved else None)
+                out["hbm_measured_source"] = snap.get(
+                    "hbm_measured_source", "none")
+                cb = sset.cbatchers.get("default")
+                out["flightrec_events"] = (
+                    cb.flightrec.total if cb is not None
+                    and cb.flightrec is not None else 0)
+        finally:
+            httpd.shutdown()
+            for cb in sset.cbatchers.values():
+                cb.close()
+                cb.release_device_state()
+        return best
+
+    on_s = run_leg(True)
+    off_s = run_leg(False)
+    out["obs_on_wall_s"] = round(on_s, 4)
+    out["obs_off_wall_s"] = round(off_s, 4)
+    out["flightrec_overhead_pct"] = (
+        round((on_s - off_s) / off_s * 100.0, 2) if off_s else None)
+    return out
+
+
 class _Budget:
     """Soft wall-clock budget for the whole capture (BENCH_r05 post-mortem:
     the run exceeded the driver's hard timeout and recorded NOTHING, rc
@@ -2317,6 +2409,12 @@ def tiny_main() -> int:
         # TTFT queue-vs-compute split is the scaling signal
         out.update(measure_latency_breakdown(workdir, new_tokens=8,
                                              max_seq_len=128))
+
+        # observability overhead (ISSUE 15): the always-on flight
+        # recorder + device telemetry must cost < 2% of wall time, and
+        # the measured-vs-reserved HBM accounting must be present
+        out.update(measure_obs_overhead(workdir, new_tokens=8,
+                                        max_seq_len=128))
 
         # --- compiled-program registry (ISSUE 11), CPU proxy ---
         # bench-shaped small checkpoint, not LlamaConfig.tiny: the ratio

@@ -64,6 +64,9 @@ _SERVER_PATH_FILES = (
     "modelx_tpu/utils/promexp.py",
     "modelx_tpu/utils/trace.py",
     "modelx_tpu/utils/accesslog.py",
+    "modelx_tpu/utils/flightrec.py",
+    "modelx_tpu/utils/devmem.py",
+    "modelx_tpu/utils/tswheel.py",
 )
 
 _HANDLER_MODULES = (

@@ -105,8 +105,8 @@ from modelx_tpu.dl.serving_errors import (
 )
 from modelx_tpu.models.decode import SEQ_BUCKET, pad_seq_len
 from modelx_tpu.testing import faults as _faults
-from modelx_tpu.utils import promexp, trace
-from modelx_tpu.utils.jax_compat import copy_to_host_async
+from modelx_tpu.utils import devmem, flightrec, promexp, trace, tswheel
+from modelx_tpu.utils.jax_compat import copy_to_host_async, step_trace_annotation
 
 _DONE = object()  # end-of-stream sentinel on per-request output queues
 _NO_HIT = object()  # "no memoized prefix-cache lookup" sentinel (None = a miss)
@@ -270,7 +270,11 @@ class ContinuousBatcher:
                  restart_backoff_s: float = 0.25,
                  max_crashes: int = 5,
                  crash_window_s: float = 60.0,
-                 boundary_watchdog_s: float = 0.0) -> None:
+                 boundary_watchdog_s: float = 0.0,
+                 flight_recorder: bool = True,
+                 flightrec_capacity: int = 0,
+                 flight_dump_dir: str = "",
+                 device_telemetry: bool = True) -> None:
         if server.family.decode_fns is None:
             raise ValueError(f"family {server.family.name} has no cached decode")
         self.server = server
@@ -569,6 +573,26 @@ class ContinuousBatcher:
         self.boundary_watchdog_s = float(boundary_watchdog_s)
         self._watch_stall: BaseException | None = None
         self._progress_t: float | None = None
+        # -- flight recorder (ISSUE 15) -------------------------------------
+        # bounded ring of boundary-granularity engine events (admission,
+        # fill piece, dispatch, readback, preemption, EOS, expiry, stall,
+        # crash) — the black box the supervisor dumps on crash/watchdog/
+        # circuit-break so healing stops destroying the evidence. On by
+        # default: the per-boundary cost is a few dict stores (the bench's
+        # flightrec_overhead_pct leg holds the tax under 2%).
+        self.flight_dump_dir = str(flight_dump_dir or "")
+        self.flightrec = (
+            flightrec.FlightRecorder(
+                int(flightrec_capacity) or flightrec.DEFAULT_CAPACITY)
+            if flight_recorder else None
+        )
+        # the request whose admission/fill dispatch is in flight, for crash
+        # attribution in the dump (the id twin of _suspect_fp)
+        self._suspect_rid = ""
+        # measured device telemetry (utils/devmem) sampled into snapshot()
+        self.device_telemetry = bool(device_telemetry)
+        # windowed token rate (tokens/s over 1m/5m) fed at delivery time
+        self.rate_tokens = tswheel.Wheel()
         self.stats = {"chunks": 0, "admitted": 0, "active_peak": 0,
                       "prefill_pieces": 0, "stall_ms_max": 0.0,
                       "engine_restarts": 0, "shed": 0, "expired": 0,
@@ -622,6 +646,54 @@ class ContinuousBatcher:
     # streaming client's flush cadence (delivery still splits into
     # chunk_size pieces) and the stop-detection lag stay bounded
     AUTO_DISPATCH_DEPTH = 4
+
+    # -- flight recorder ------------------------------------------------------
+
+    def _rec(self, event: str, slot: int = -1, request_id: str = "",
+             **fields) -> None:
+        """Record one engine event into the flight ring (no-op when the
+        recorder is disabled)."""
+        fr = self.flightrec
+        if fr is not None:
+            fr.record(event, slot=slot, request_id=request_id, **fields)
+
+    def _slot_states(self) -> list[dict]:
+        """Per-slot occupancy for the black-box dump: who held which slot
+        (and how far along) when the engine died."""
+        out = []
+        for slot, row in list(self._rows.items()):
+            out.append({"slot": slot, "state": "decoding",
+                        "request_id": row.ticket.request_id,
+                        "emitted": row.emitted, "budget": row.budget})
+        for slot, fill in list(self._filling.items()):
+            out.append({"slot": slot, "state": "filling",
+                        "request_id": fill.ticket.request_id,
+                        "filled": fill.filled,
+                        "prompt_len": len(fill.ids)})
+        return out
+
+    def _flight_dump(self, reason: str, err: BaseException | None) -> str:
+        """Write the black-box file (crash / watchdog / circuit-break).
+        Best-effort by design: the engine is already dying, and the dump
+        path must never add a failure mode of its own."""
+        if self.flightrec is None or not self.flight_dump_dir:
+            return ""
+        meta = {
+            "model": str(getattr(self.server, "name", "") or ""),
+            "engine_state": self._state,
+            "restarts": self._restarts,
+        }
+        if err is not None:
+            meta["error"] = repr(err)[:300]
+        path = self.flightrec.dump(
+            self.flight_dump_dir, reason, meta=meta,
+            slots=self._slot_states(),
+        )
+        if path:
+            logging.getLogger("modelx.serve").warning(
+                "flight recorder dumped %s black box to %s", reason, path
+            )
+        return path
 
     # -- compiled programs ----------------------------------------------------
 
@@ -1236,6 +1308,7 @@ class ContinuousBatcher:
         memo = self._prep_memo.pop(item[3], None)
         fp = memo[0] if memo is not None else _fingerprint(item[0], item[1])
         self._suspect_fp = fp
+        self._suspect_rid = item[3].request_id
         try:
             prep = self._prepare_admit(
                 item, memo_hit=memo[1] if memo is not None else _NO_HIT
@@ -1246,6 +1319,7 @@ class ContinuousBatcher:
                 p["ticket"].out.put(e)
             raise
         self._suspect_fp = None
+        self._suspect_rid = ""
         if prep is not None:
             prep["fp"] = fp  # reused by the admit/fill dispatch attribution
             to_admit.append(prep)
@@ -1267,6 +1341,8 @@ class ContinuousBatcher:
         if ticket.deadline is not None and time.monotonic() > ticket.deadline:
             # expired while queued: 504 BEFORE occupying a slot
             self.stats["expired"] += 1
+            self._rec("deadline", request_id=ticket.request_id,
+                      state="queued")
             ticket.out.put(self._deadline_error(ticket, "waiting for a slot"))
             return None
         slot = self._free.pop()
@@ -1356,6 +1432,8 @@ class ContinuousBatcher:
         self._steady = False  # an admission boundary, not steady decode
         self.stats["admitted"] += 1
         self.stats["active_peak"] = max(self.stats["active_peak"], len(self._rows))
+        self._rec("admit", slot=slot, request_id=prep["ticket"].request_id,
+                  prompt_len=s, budget=prep["n"])
 
     def _admit_all(self, preps: list) -> None:
         """Dispatch a boundary's worth of prepared admissions: same-bucket
@@ -1458,6 +1536,10 @@ class ContinuousBatcher:
         # this dispatch is attributable to ONE request: a loop death here
         # counts against its poison-quarantine budget
         self._suspect_fp = prep["fp"]
+        self._suspect_rid = prep["ticket"].request_id
+        self._rec("dispatch_admit", slot=slot,
+                  request_id=prep["ticket"].request_id,
+                  prompt_len=s, cached=prep["hit"] is not None)
         hit = prep["hit"]
         prompt_pages = (
             jnp.asarray(prep["prompt_pages"])
@@ -1521,6 +1603,7 @@ class ContinuousBatcher:
             prep, lambda first=first: np.asarray(first).reshape(1, 1)
         )
         self._suspect_fp = None
+        self._suspect_rid = ""
 
     # -- chunked prefill scheduling -------------------------------------------
 
@@ -1648,6 +1731,7 @@ class ContinuousBatcher:
         # quarantine): a prompt that crashes the loop mid-fill must not be
         # re-admitted forever
         self._suspect_fp = fill.fp
+        self._suspect_rid = fill.ticket.request_id
         self._steady = False  # a fill boundary, not steady decode
         if last:
             self._tok_host = None  # the flip program advances the device tok
@@ -1670,6 +1754,8 @@ class ContinuousBatcher:
             page_start = jnp.int32(start_pg * ps)
         self.stats["prefill_pieces"] += 1
         fill.ticket.prefill_pieces += 1
+        self._rec("fill_piece", slot=slot, request_id=fill.ticket.request_id,
+                  tokens=take, last=last)
         if not last:
             # the fill's spans run on the ENGINE thread where the
             # transport's request context isn't set: re-bind the ticket's
@@ -1689,6 +1775,7 @@ class ContinuousBatcher:
             fill.filled += take
             self._offsets[slot] = fill.filled
             self._suspect_fp = None
+            self._suspect_rid = ""
             return
         samp = fill.samp
         # filters ride as arrays (0 / 1.0 = off): a one-shot program has
@@ -1733,6 +1820,7 @@ class ContinuousBatcher:
             prep, lambda first=first: np.asarray(first).reshape(1, 1)
         )
         self._suspect_fp = None
+        self._suspect_rid = ""
         self._requeue_preempted()
 
     def _requeue_preempted(self) -> None:
@@ -1784,6 +1872,8 @@ class ContinuousBatcher:
         self._fill_order.remove(slot)
         self._release_slot(slot)
         self.stats["fill_preempts"] += 1
+        self._rec("preempt", slot=slot, request_id=fill.ticket.request_id,
+                  filled=fill.filled)
         fill.ticket.restart = True  # head-of-backlog pin: see _Ticket
         fill.ticket.preempts += 1
         self._preempted.append((fill.ids, fill.n, fill.samp, fill.ticket))
@@ -1880,7 +1970,15 @@ class ContinuousBatcher:
         # rows whose tokens are discarded anyway)
         active = list(self._rows)
         filtered = bool(self._use_filters[active].any())
-        with trace.span("continuous.chunk", active=len(self._rows), depth=depth):
+        self._rec("dispatch", depth=depth, n_steps=n_steps,
+                  active=len(self._rows))
+        # the step annotation names this dispatch in an on-demand profiler
+        # capture (POST /admin/profile) with the SAME ordinal the flight
+        # ring records, so XLA timeline steps join engine events 1:1
+        with trace.span("continuous.chunk", active=len(self._rows),
+                        depth=depth), \
+                step_trace_annotation("continuous.chunk",
+                                      step_num=self.stats["dispatches"]):
             # .copy() is load-bearing: jax zero-copy-aliases host numpy
             # buffers (CPU backend) and transfers lazily, while this loop
             # mutates the originals (retirement resets, next admissions)
@@ -1993,11 +2091,18 @@ class ContinuousBatcher:
             if row.seq is not None:
                 row.seq.append(int(first_np[0, 0]))
             row.out.put(first_np)
+            self.rate_tokens.add(1)
             if row.stops and int(first_np[0, 0]) in row.stops and not done:
                 row.out.put(_DONE)
                 row.closed = True  # plan retires the slot next dispatch
+                self._rec("eos", slot=row.slot,
+                          request_id=ticket.request_id,
+                          reason="stop", emitted=row.emitted)
             elif done:
                 row.out.put(_DONE)
+                self._rec("eos", slot=row.slot,
+                          request_id=ticket.request_id,
+                          reason="budget", emitted=row.emitted)
 
     def _put_pieces(self, row: _Row, arr: np.ndarray) -> None:
         """Hand a row its tokens in flush-cadence pieces: a depth-D
@@ -2024,9 +2129,13 @@ class ContinuousBatcher:
         toks_dev, plan, depth = pending
         t0 = time.monotonic()
         toks = np.asarray(toks_dev)
-        self._sync_wait_s += time.monotonic() - t0
+        wait_s = time.monotonic() - t0
+        self._sync_wait_s += wait_s
         self._boundary_syncs += 1
         self._inflight_chunks = max(0, self._inflight_chunks - depth)
+        self._rec("readback", depth=depth, rows=len(plan),
+                  wait_ms=round(wait_s * 1e3, 3))
+        self.rate_tokens.add(sum(max(take, 0) for _, _, _, take, _ in plan))
         # valid until the next dispatch/admission advances the device tok
         # (the dispatch path resets it to None first)
         self._tok_host = toks[:, -1].copy()
@@ -2051,11 +2160,16 @@ class ContinuousBatcher:
                     self._put_pieces(row, piece[:, :cut])  # include the stop
                     row.out.put(_DONE)
                     row.closed = True
+                    self._rec("eos", slot=slot,
+                              request_id=row.ticket.request_id,
+                              reason="stop", emitted=row.emitted)
                     continue
             if piece is not None:
                 self._put_pieces(row, piece)
             if done:
                 row.out.put(_DONE)
+                self._rec("eos", slot=slot, request_id=row.ticket.request_id,
+                          reason="budget", emitted=row.emitted)
 
     @staticmethod
     def _deadline_passed(ticket: _Ticket, now: float) -> bool:
@@ -2080,6 +2194,8 @@ class ContinuousBatcher:
                     ticket.out.put(_DONE)
                 elif self._deadline_passed(ticket, now):
                     self.stats["expired"] += 1
+                    self._rec("deadline", request_id=ticket.request_id,
+                              state=state)
                     self._backlog_sub(1)
                     self._prep_memo.pop(ticket, None)
                     ticket.out.put(self._deadline_error(ticket, state))
@@ -2097,12 +2213,18 @@ class ContinuousBatcher:
         for slot, fill in list(self._filling.items()):
             if self._deadline_passed(fill.ticket, now):
                 self.stats["expired"] += 1
+                self._rec("deadline", slot=slot,
+                          request_id=fill.ticket.request_id,
+                          state="prefilling")
                 self._drop_fill(
                     slot, self._deadline_error(fill.ticket, "prefilling")
                 )
         for row in self._rows.values():
             if not row.closed and self._deadline_passed(row.ticket, now):
                 self.stats["expired"] += 1
+                self._rec("deadline", slot=row.slot,
+                          request_id=row.ticket.request_id,
+                          state="decoding")
                 row.out.put(self._deadline_error(row.ticket, "decoding"))
                 row.closed = True  # the sweep below frees the slot
 
@@ -2191,7 +2313,13 @@ class ContinuousBatcher:
             )
             self._watch_stall = err
             self.stats["watchdog_stalls"] += 1
+            self._rec("watchdog_stall", stalled_s=round(stalled_s, 3),
+                      window_s=self.boundary_watchdog_s)
             self._state = "restarting"  # readiness drains while wedged
+            # the wedged loop cannot dump for itself (it is inside a device
+            # call): the watchdog writes the black box NOW, while the
+            # evidence — ring + per-slot state — still describes the stall
+            self._flight_dump("watchdog", err)
             logging.getLogger("modelx.serve").error(
                 "continuous engine stalled: no boundary progress in %.2fs "
                 "(watchdog %.2fs) — failing %d active row(s)",
@@ -2241,11 +2369,17 @@ class ContinuousBatcher:
         self._fill_order = []
         self._preempted = []
         self._suspect_fp = None
+        self._suspect_rid = ""
         self._last_chunk_t = None
         self._prep_memo = {}
         self._tok_host = None
         self._watch_stall = None
         self._progress_t = None
+        if self.flightrec is not None:
+            # fresh flight: the rebuilt engine must not replay the dead
+            # engine's timeline into its next black box
+            self.flightrec.reset()
+            self._rec("rebuild", restarts=self._restarts + 1)
         self._sync_wait_s = 0.0
         self._boundary_syncs = 0
         self._steady = False
@@ -2432,6 +2566,14 @@ class ContinuousBatcher:
                     self._poison.get(self._suspect_fp, 0) + 1
                 )
                 self._suspect_fp = None
+            self._rec("crash", request_id=self._suspect_rid,
+                      error=repr(e)[:200],
+                      verdict="broken" if broken else "crashed")
+            if e is not self._watch_stall:
+                # a watchdog stall already dumped mid-wedge, with the
+                # pre-unwind slot state; don't overwrite that evidence
+                self._flight_dump("circuit-break" if broken else "crash", err)
+            self._suspect_rid = ""
             self._deliver_failsafe(pending, err)
             self._fail_active(err, drain_queue=broken)
             return "broken" if broken else "crashed"
@@ -2577,6 +2719,20 @@ class ContinuousBatcher:
             snap["max_queue_depth"] = self.max_queue_depth
         if self.request_timeout_s > 0:
             snap["request_timeout_s"] = self.request_timeout_s
+        # windowed rates (ISSUE 15): recent-rate truth without a scraper —
+        # tokens delivered per second over the 1m/5m trailing windows
+        snap["tokens_per_s_1m"] = round(self.rate_tokens.rate(60), 4)
+        snap["tokens_per_s_5m"] = round(self.rate_tokens.rate(300), 4)
+        if self.flightrec is not None:
+            snap["flightrec_events"] = self.flightrec.total
+        if self.device_telemetry:
+            # measured device occupancy (utils/devmem): accountant truth
+            # (or the live-buffer census on backends without one) next to
+            # the engine's own estimates; `source` says which it was
+            dm = devmem.sample()
+            snap["hbm_bytes_in_use"] = dm["hbm_bytes_in_use"]
+            snap["hbm_bytes_reservable"] = dm["hbm_bytes_reservable"]
+            snap["hbm_source"] = dm["source"]
         return snap
 
     @property

@@ -51,6 +51,8 @@ import shutil
 import threading
 import time
 
+from modelx_tpu.utils import devmem
+
 logger = logging.getLogger("modelx.lifecycle")
 
 # -- lifecycle states ---------------------------------------------------------
@@ -346,6 +348,14 @@ class ModelPool:
         if self.hbm_budget_bytes:
             snap["hbm_budget_bytes"] = self.hbm_budget_bytes
         snap["evict_idle"] = self.evict_idle
+        # measured occupancy next to the estimate (ISSUE 15): the
+        # reservations above are FILE-SIZE guesses; this is the device's
+        # own accounting, and the delta is the estimator's running error
+        dm = devmem.sample()
+        snap["hbm_bytes_measured"] = dm["hbm_bytes_in_use"]
+        snap["hbm_measured_vs_reserved_delta"] = (
+            dm["hbm_bytes_in_use"] - snap["hbm_reserved_bytes"])
+        snap["hbm_measured_source"] = dm["source"]
         return snap
 
     def failed(self) -> dict[str, str]:
@@ -447,9 +457,17 @@ class ModelPool:
                     # same stance as request_unload: never empty the node —
                     # if the incoming load then FAILED, nothing would serve
                     break
+                # the eviction decision ran on ESTIMATES; log the
+                # measured occupancy alongside so an operator can see
+                # how far off the estimator was when it mattered
+                dm = devmem.sample()
                 logger.info(
-                    "evicting idle model %s (%d bytes) for the HBM budget",
+                    "evicting idle model %s (%d bytes reserved) for the "
+                    "HBM budget; device measures %d bytes in use "
+                    "(source=%s, delta=%+d vs %d reserved pool-wide)",
                     victim.name, victim.hbm_reserved_bytes,
+                    dm["hbm_bytes_in_use"], dm["source"],
+                    dm["hbm_bytes_in_use"] - reserved, reserved,
                 )
                 art = self._free_entry_locked(victim, evicted=True)
                 if frees is not None:

@@ -79,7 +79,7 @@ from modelx_tpu.dl.serving_errors import (
     timing_headers,
 )
 from modelx_tpu.parallel.mesh import make_mesh
-from modelx_tpu.utils import accesslog, promexp, trace
+from modelx_tpu.utils import accesslog, devmem, promexp, trace, tswheel
 
 logger = logging.getLogger("modelx.serve")
 
@@ -89,6 +89,9 @@ logger = logging.getLogger("modelx.serve")
 DEFAULT_MAX_NEW_TOKENS_LIMIT = 1024
 # /v1/profile holds the handler thread and the profiler for this long at most
 MAX_PROFILE_SECONDS = 60
+# /admin/profile captures kept on disk; older ones are pruned after each
+# capture so the on-demand profiler can never fill the pod's disk
+MAX_PROFILE_CAPTURES = 4
 
 _UNSET = object()  # tokenizer not probed yet (absent is cached as None)
 
@@ -1079,7 +1082,11 @@ class ServerSet:
                  evict_idle: bool = False,
                  allow_admin_load: bool = False,
                  admin_tokens: tuple[str, ...] = (),
-                 staging_root: str = "") -> None:
+                 staging_root: str = "",
+                 flight_recorder: bool = True,
+                 flightrec_capacity: int = 0,
+                 flight_dump_dir: str = "",
+                 device_telemetry: bool = True) -> None:
         if not servers:
             raise ValueError("no models")
         self.max_new_tokens_limit = max_new_tokens_limit
@@ -1108,6 +1115,23 @@ class ServerSet:
         self.admin_tokens = tuple(admin_tokens)
         self.trace_dir = trace_dir or os.path.join(os.getcwd(), "jax-trace")
         self._profiling = threading.Lock()
+        # on-demand profiler captures (POST /admin/profile) land in
+        # numbered subdirs under trace_dir; only the newest
+        # MAX_PROFILE_CAPTURES survive (the capture dir is CAPPED — an
+        # operator probing a live incident must not fill the disk)
+        self._capture_seq = 0
+        self._capture_lock = threading.Lock()
+        # engine flight recorder + black-box dump dir (ISSUE 15), threaded
+        # into every ContinuousBatcher this set creates
+        self.flight_recorder = bool(flight_recorder)
+        self.flightrec_capacity = int(flightrec_capacity)
+        self.flight_dump_dir = str(flight_dump_dir or "")
+        # measured device telemetry (utils/devmem) in engine snapshots and
+        # the /metrics device family
+        self.device_telemetry = bool(device_telemetry)
+        # windowed pod rates (utils/tswheel): requests/s, 5xx/s, sheds/s
+        # over 1m/5m, marked once per completed POST in the handler
+        self.rates = tswheel.RateSet(("requests", "http_5xx", "sheds"))
         self._dynamic_batch = dynamic_batch
         self._continuous_batch = continuous_batch
         self.max_slots = max_slots
@@ -1184,6 +1208,34 @@ class ServerSet:
     def inflight(self) -> int:
         with self._inflight_lock:
             return self._inflight
+
+    def next_capture_dir(self) -> str:
+        """A fresh numbered capture dir under ``trace_dir/captures`` for
+        one on-demand profiler run; prunes all but the newest
+        ``MAX_PROFILE_CAPTURES - 1`` existing captures first (the new one
+        brings the total back to the cap)."""
+        import shutil
+
+        root = os.path.join(self.trace_dir, "captures")
+        # only the sequence bump needs the lock; the filesystem work runs
+        # outside it (callers are already serialized by _profiling — this
+        # lock just keeps the counter coherent for any future caller)
+        with self._capture_lock:
+            self._capture_seq += 1
+            seq = self._capture_seq
+        os.makedirs(root, exist_ok=True)
+        keep = MAX_PROFILE_CAPTURES - 1
+        old = sorted(
+            (d for d in os.listdir(root)
+             if d.startswith("cap-")
+             and os.path.isdir(os.path.join(root, d))),
+            key=lambda d: os.path.getmtime(os.path.join(root, d)),
+        )
+        for name in old[:max(0, len(old) - keep)]:
+            shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+        path = os.path.join(root, "cap-%d-%d" % (int(time.time()), seq))
+        os.makedirs(path, exist_ok=True)
+        return path
 
     def add_server(self, name: str, server: ModelServer) -> None:
         """Insert a runtime-loaded model into the routing set (the pool's
@@ -1296,6 +1348,10 @@ class ServerSet:
                     max_queue_depth=self.max_queue_depth,
                     request_timeout_s=self.request_timeout_s,
                     boundary_watchdog_s=self.boundary_watchdog_s,
+                    flight_recorder=self.flight_recorder,
+                    flightrec_capacity=self.flightrec_capacity,
+                    flight_dump_dir=self.flight_dump_dir,
+                    device_telemetry=self.device_telemetry,
                 )
                 self.cbatchers[server.name] = cb
         return cb
@@ -1514,9 +1570,9 @@ def request_priority(headers) -> str:
 
 
 def serve(servers: ModelServer | ServerSet, listen: str = ":8000",
-          access_log: str = "") -> ThreadingHTTPServer:
+          access_log: str = "", access_log_max_bytes: int = 0) -> ThreadingHTTPServer:
     sset = servers if isinstance(servers, ServerSet) else ServerSet({servers.name: servers})
-    access = accesslog.open_log(access_log)
+    access = accesslog.open_log(access_log, max_bytes=access_log_max_bytes)
 
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -1871,6 +1927,16 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000",
                         payload[n] = {"lifecycle": st}
                 if sset.pool is not None and "pool" not in payload:
                     payload["pool"] = sset.pool.pool_snapshot()
+                # pod-level windowed rates (ISSUE 15): requests/s,
+                # 5xx/s, sheds/s over 1m and 5m — floats, so they
+                # render as gauges in the Prometheus view for free
+                payload["rates"] = sset.rates.snapshot()
+                if sset.device_telemetry:
+                    # measured device memory next to the lifecycle
+                    # ESTIMATES (hbm_reserved_bytes): the source key is
+                    # a string, skipped by the text renderer, kept in
+                    # JSON so a reader knows how it was measured
+                    payload["device"] = devmem.sample()
                 # content negotiation (ISSUE 13): the SAME tree renders
                 # as Prometheus text on Accept: text/plain or
                 # ?format=prometheus; the default JSON is byte-unchanged
@@ -1906,6 +1972,19 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000",
                     prefix=_query_param(self.path, "prefix"),
                     request_id=_query_param(self.path, "request_id"),
                 ))
+            elif self.path.split("?", 1)[0] == "/debug/flightrec":
+                # the live flight-recorder ring (ISSUE 15): the same
+                # timeline the black-box dump freezes, served while the
+                # engine is still flying. Admin-gated — events carry
+                # request ids — with /v1/trace's ?request_id= slicing.
+                if not self._admin_auth():
+                    return
+                rid = _query_param(self.path, "request_id") or None
+                body = {}
+                for n, cb in list(sset.cbatchers.items()):
+                    if cb.flightrec is not None:
+                        body[n] = cb.flightrec.summary(rid)
+                self._json(200, body)
             else:
                 self._json(404, {"error": "not found"})
 
@@ -1935,6 +2014,14 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000",
                     self._do_POST()
             finally:
                 sset.request_ended()
+                # windowed fleet rates (ISSUE 15): one mark per request
+                # plus outcome classes, bucketed into 1-s wheels the
+                # /metrics snapshot reads as *_per_s_{1m,5m}
+                sset.rates.mark("requests")
+                if self._resp_status >= 500:
+                    sset.rates.mark("http_5xx")
+                elif self._resp_status == 429:
+                    sset.rates.mark("sheds")
                 if access is not None:
                     access.write(
                         request_id=self._rid,
@@ -1978,6 +2065,35 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000",
                 finally:
                     sset._profiling.release()
                 return self._json(200, {"trace_dir": sset.trace_dir})
+
+            if self.path == "/admin/profile":
+                # on-demand XLA profiler capture (ISSUE 15): same
+                # one-at-a-time lock as /v1/profile, but admin-gated and
+                # writing into a fresh CAPPED capture dir (the oldest
+                # captures age out) so repeated captures on a live pod
+                # never grow the disk without bound
+                if not self._admin_auth():
+                    return
+                try:
+                    seconds = float(req.get("duration_s", 3))
+                except (TypeError, ValueError):
+                    seconds = -1.0
+                if not (0 < seconds <= MAX_PROFILE_SECONDS):
+                    return self._json(
+                        400,
+                        {"error": "duration_s must be a number in "
+                                  f"(0, {MAX_PROFILE_SECONDS}]"},
+                    )
+                if not sset._profiling.acquire(blocking=False):
+                    return self._json(409, {"error": "profile already running"})
+                try:
+                    capture_dir = sset.next_capture_dir()
+                    with trace.jax_profile(capture_dir):
+                        time.sleep(seconds)
+                finally:
+                    sset._profiling.release()
+                return self._json(200, {"capture_dir": capture_dir,
+                                        "duration_s": seconds})
 
             if self.path == "/admin/models":
                 # runtime load: pull a registry ref (or point at a local

@@ -181,6 +181,25 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
               help="append one JSON line per request (request id, hashed "
                    "client identity, model, status, per-phase timing) to "
                    "this path; empty = off")
+@click.option("--access-log-max-bytes", default=0, type=int,
+              help="rotate the access log once it exceeds this many bytes "
+                   "(renamed to <path>.1, one generation kept; 0 = never)")
+@click.option("--flight-dump-dir", default="",
+              help="continuous batching: on an engine crash, watchdog "
+                   "fire, or circuit-break, write the flight recorder's "
+                   "last events + per-slot state as a JSON-lines black-box "
+                   "file here (the live ring is GET /debug/flightrec; "
+                   "empty = no dump files)")
+@click.option("--flightrec-capacity", default=0, type=int,
+              help="flight recorder ring size in events (0 = default 512)")
+@click.option("--flight-recorder/--no-flight-recorder", default=True,
+              help="record structured engine events (admission, dispatch, "
+                   "readback, preemption, EOS, deadline, crash) into a "
+                   "bounded in-memory ring")
+@click.option("--device-telemetry/--no-device-telemetry", default=True,
+              help="sample measured device memory (jax memory_stats, "
+                   "live-buffer census fallback) into /metrics and "
+                   "/admin/models next to the lifecycle estimates")
 def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen: str,
          max_seq_len: int, compile_cache: bool,
          blob_cache_dir: str, blob_cache_max_bytes: int,
@@ -198,7 +217,9 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
          admin_tokens: tuple[str, ...], staging_dir: str,
          loras: tuple[str, ...], drain_seconds: float,
          drain_grace: float, boundary_watchdog_s: float,
-         access_log: str) -> None:
+         access_log: str, access_log_max_bytes: int,
+         flight_dump_dir: str, flightrec_capacity: int,
+         flight_recorder: bool, device_telemetry: bool) -> None:
     logging.basicConfig(level=logging.INFO, format="%(asctime)s %(name)s %(message)s")
     from modelx_tpu.parallel.distributed import initialize
 
@@ -294,7 +315,11 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
                      evict_idle=evict_idle,
                      allow_admin_load=allow_admin_load,
                      admin_tokens=admin_tokens,
-                     staging_root=staging_dir)
+                     staging_root=staging_dir,
+                     flight_recorder=flight_recorder,
+                     flightrec_capacity=flightrec_capacity,
+                     flight_dump_dir=flight_dump_dir,
+                     device_telemetry=device_telemetry)
     # runtime-loaded models get the same cache knobs the boot set got
     sset.server_defaults.update(
         prefix_cache_size=prefix_cache,
@@ -315,7 +340,8 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
             "(eviction only runs to fit a load under the budget)"
         )
     httpd = serve(sset, listen=listen,  # starts serving 503s while loading
-                  access_log=access_log)
+                  access_log=access_log,
+                  access_log_max_bytes=access_log_max_bytes)
     stats = sset.load_all(concurrent=concurrent_load)
     logging.getLogger("modelx.serve").info("models loaded: %s", stats)
     stop = threading.Event()

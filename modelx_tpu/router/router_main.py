@@ -110,6 +110,9 @@ from modelx_tpu.router.server import FleetRouter, route_serve
               help="append one JSON line per routed request (request id, "
                    "hashed client identity, model, status, latency, route "
                    "decision) to this path; empty = off")
+@click.option("--access-log-max-bytes", default=0, type=int,
+              help="rotate the access log once it exceeds this many bytes "
+                   "(renamed to <path>.1, one generation kept; 0 = never)")
 def main(pods: tuple[str, ...], listen: str, default_model: str,
          poll_interval: float, poll_timeout: float, request_timeout: float,
          connect_timeout: float, sticky_entries: int, sticky_window: int,
@@ -118,7 +121,7 @@ def main(pods: tuple[str, ...], listen: str, default_model: str,
          rebalance_cooldown: float, fair_share: int, client_rate: float,
          max_router_backlog: int, retry_budget: float,
          breaker_threshold: int, breaker_cooldown: float,
-         access_log: str) -> None:
+         access_log: str, access_log_max_bytes: int) -> None:
     logging.basicConfig(level=logging.INFO,
                         format="%(asctime)s %(name)s %(message)s")
     registry = PodRegistry(
@@ -143,6 +146,7 @@ def main(pods: tuple[str, ...], listen: str, default_model: str,
         breakers=BreakerBoard(threshold=breaker_threshold,
                               cooldown_s=breaker_cooldown),
         access_log=access_log,
+        access_log_max_bytes=access_log_max_bytes,
     )
     router.start()
     httpd = route_serve(router, listen=listen)

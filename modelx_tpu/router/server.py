@@ -78,7 +78,7 @@ from modelx_tpu.router.admission import (
 from modelx_tpu.router.http import LazySession
 from modelx_tpu.router.policy import StickyTable, plan_route, sticky_keys
 from modelx_tpu.router.registry import PodRegistry
-from modelx_tpu.utils import accesslog, promexp, trace
+from modelx_tpu.utils import accesslog, promexp, trace, tswheel
 
 logger = logging.getLogger("modelx.router")
 
@@ -155,7 +155,8 @@ class FleetRouter:
                  admission: AdmissionController | None = None,
                  retry_budget: RetryBudget | None = None,
                  breakers: BreakerBoard | None = None,
-                 session=None, access_log: str = "") -> None:
+                 session=None, access_log: str = "",
+                 access_log_max_bytes: int = 0) -> None:
         from modelx_tpu.router.policy import DEFAULT_WINDOW_TOKENS
 
         self.registry = registry
@@ -173,9 +174,13 @@ class FleetRouter:
         self.retry_budget = retry_budget or RetryBudget()
         self.breakers = breakers or BreakerBoard()
         self.metrics = RouterMetrics()
+        # windowed fleet rates (ISSUE 15): the counters above only ever
+        # grow; these 1-s wheels answer "how fast RIGHT NOW" over 1m/5m
+        self.rates = tswheel.RateSet(("requests", "http_5xx", "sheds"))
         # opt-in JSON-lines access log (ISSUE 13): one line per routed
         # request, request id as the join key against the pod's log
-        self.access = accesslog.open_log(access_log)
+        self.access = accesslog.open_log(access_log,
+                                         max_bytes=access_log_max_bytes)
         self._session = LazySession(session)
         self._inflight: dict[str, int] = {}
         self._inflight_lock = threading.Lock()
@@ -266,6 +271,7 @@ class FleetRouter:
             "admission": self.admission.snapshot(),
             "retry_budget": self.retry_budget.snapshot(),
             "breakers": self.breakers.snapshot(),
+            "rates": self.rates.snapshot(),
         }
         if self.rebalancer is not None:
             out["rebalance"] = self.rebalancer.snapshot()
@@ -556,6 +562,13 @@ def route_serve(router: FleetRouter, listen: str = ":8100") -> ThreadingHTTPServ
                         trace.span("router.request", http_path=self.path):
                     self._do_POST()
             finally:
+                # windowed rates (ISSUE 15): outcome classes off the
+                # committed status, same capture point as the access log
+                router.rates.mark("requests")
+                if self._resp_status >= 500:
+                    router.rates.mark("http_5xx")
+                elif self._resp_status == 429:
+                    router.rates.mark("sheds")
                 if router.access is not None:
                     router.access.write(
                         request_id=self._rid,

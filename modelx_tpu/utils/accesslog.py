@@ -12,12 +12,19 @@ The writer is deliberately small: append-mode, line-buffered, one lock
 around the write so concurrent handler threads never interleave bytes
 mid-line. A write failure (disk full, path yanked) disables the log and
 logs ONE warning — observability must never take the serving path down.
+
+Rotation (ISSUE 15): ``--access-log-max-bytes`` caps the file. When a
+write pushes the size past the cap the file renames to ``<path>.1``
+(one generation — the previous ``.1`` is overwritten) and a fresh file
+reopens, all under the same write lock so no line is torn across the
+swap. 0 (the default) keeps the historical append-forever behavior.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 from typing import Any
@@ -28,11 +35,21 @@ logger = logging.getLogger("modelx.accesslog")
 class AccessLog:
     """Thread-safe JSON-lines access log writer."""
 
-    def __init__(self, path: str) -> None:
+    def __init__(self, path: str, max_bytes: int = 0) -> None:
         self.path = path
+        self.max_bytes = int(max_bytes)
         self._lock = threading.Lock()
         self._fh = open(path, "a", buffering=1, encoding="utf-8")
+        self._size = self._fh.tell()  # append mode: tell() is the size
         self._broken = False
+
+    def _rotate_locked(self) -> None:
+        """Rename to ``.1`` and reopen; caller holds the lock. A rotation
+        failure disables the log like any other write failure."""
+        self._fh.close()
+        os.replace(self.path, self.path + ".1")
+        self._fh = open(self.path, "a", buffering=1, encoding="utf-8")
+        self._size = 0
 
     def write(self, **fields: Any) -> None:
         """Append one log line; ``ts`` (unix seconds) is stamped here so
@@ -49,6 +66,9 @@ class AccessLog:
                 return
             try:
                 self._fh.write(line)
+                self._size += len(line.encode("utf-8"))
+                if 0 < self.max_bytes <= self._size:
+                    self._rotate_locked()
             except OSError as e:
                 # one warning, then silence: a full disk must not turn
                 # every request into a logging error
@@ -65,6 +85,7 @@ class AccessLog:
                 logger.warning("access log close failed: %s", e)
 
 
-def open_log(path: str | None) -> AccessLog | None:
-    """``--access-log`` plumbing: None/"" disables (the default)."""
-    return AccessLog(path) if path else None
+def open_log(path: str | None, max_bytes: int = 0) -> AccessLog | None:
+    """``--access-log`` plumbing: None/"" disables (the default);
+    ``max_bytes`` > 0 enables size-capped rotation."""
+    return AccessLog(path, max_bytes=max_bytes) if path else None

@@ -1,0 +1,130 @@
+"""Measured device memory telemetry (ISSUE 15).
+
+The lifecycle manager's ``--hbm-budget-bytes`` admits models against
+FILE-SIZE estimates (safetensors bytes at reservation, tightened to
+loaded bytes at READY) — never against what the device actually holds.
+ServerlessLLM's argument (PAPERS.md) applies: memory state must be
+*accounted*, not estimated, before a scheduler can trust it. This module
+samples the accelerator's own accounting — ``Device.memory_stats()``
+where the backend provides it (TPU/GPU), the live-buffer census as the
+fallback (CPU backend, older jax) — into one small dict the engine
+snapshot, ``pool_snapshot()``, and ``/admin/models`` all share.
+
+Shim rules follow ``jax_compat``: jax is imported lazily (the module
+stays importable in jax-free contexts), every backend probe degrades
+gracefully, and the sample says HOW it measured (``source`` =
+``memory_stats`` | ``live_buffers`` | ``none``) so a reader never
+mistakes a fallback census for device truth.
+
+Sampling is cached for ``max_age_s`` (default 1 s): ``/metrics`` is
+polled per scrape and ``live_buffers`` walks every allocation — the
+cache keeps telemetry off the request path's critical section.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+__all__ = ["sample", "raw_sample"]
+
+logger = logging.getLogger("modelx.devmem")
+
+_cache_lock = threading.Lock()
+_cached: dict | None = None
+_cached_t = 0.0
+
+
+def _device_stats(dev) -> dict | None:
+    """One device's accountant-reported stats, or None when the backend
+    has no accountant (CPU) or the probe fails."""
+    ms = getattr(dev, "memory_stats", None)
+    if ms is None:
+        return None
+    try:
+        stats = ms()
+    except Exception:  # backend-dependent: NotImplementedError, RuntimeError
+        logger.debug("memory_stats() failed on %s", dev, exc_info=True)
+        return None
+    if not stats:
+        return None
+    in_use = int(stats.get("bytes_in_use", 0))
+    limit = int(stats.get("bytes_limit",
+                          stats.get("bytes_reservable_limit", 0)))
+    return {
+        "hbm_bytes_in_use": in_use,
+        "hbm_bytes_limit": limit,
+        "hbm_bytes_reservable": max(0, limit - in_use),
+    }
+
+
+def _live_buffer_bytes(jax_mod) -> int | None:
+    """Fallback census: sum the bytes of every live jax array. Modern
+    jax exposes ``live_arrays()``; fall back through per-device
+    ``live_buffers()`` on older versions."""
+    live = getattr(jax_mod, "live_arrays", None)
+    try:
+        if live is not None:
+            return sum(int(a.nbytes) for a in live())
+        total = 0
+        for dev in jax_mod.local_devices():
+            bufs = getattr(dev, "live_buffers", None)
+            if bufs is None:
+                return None
+            total += sum(int(b.nbytes) for b in bufs())
+        return total
+    except Exception:
+        logger.debug("live-buffer census failed", exc_info=True)
+        return None
+
+
+def raw_sample() -> dict:
+    """One uncached sample across local devices. Keys are numeric (they
+    render as promexp gauges) except ``source``, which the renderer
+    skips and the JSON keeps."""
+    out = {
+        "hbm_bytes_in_use": 0,
+        "hbm_bytes_reservable": 0,
+        "device_count": 0,
+        "source": "none",
+    }
+    try:
+        import jax
+    except Exception:  # jax-free context (registry tooling, docs builds)
+        logger.debug("jax unavailable for device telemetry", exc_info=True)
+        return out
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        logger.debug("jax.local_devices() failed", exc_info=True)
+        return out
+    out["device_count"] = len(devices)
+    per = [_device_stats(d) for d in devices]
+    if any(p is not None for p in per):
+        out["source"] = "memory_stats"
+        for p in per:
+            if p is None:
+                continue
+            out["hbm_bytes_in_use"] += p["hbm_bytes_in_use"]
+            out["hbm_bytes_reservable"] += p["hbm_bytes_reservable"]
+        return out
+    census = _live_buffer_bytes(jax)
+    if census is not None:
+        out["source"] = "live_buffers"
+        out["hbm_bytes_in_use"] = census
+    return out
+
+
+def sample(max_age_s: float = 1.0) -> dict:
+    """The cached sample every surface shares. A copy is returned —
+    callers merge it into snapshot trees they then mutate."""
+    global _cached, _cached_t
+    now = time.monotonic()
+    with _cache_lock:
+        if _cached is not None and now - _cached_t < max_age_s:
+            return dict(_cached)
+    fresh = raw_sample()  # outside the lock: live_buffers can be slow
+    with _cache_lock:
+        _cached, _cached_t = fresh, time.monotonic()
+        return dict(fresh)

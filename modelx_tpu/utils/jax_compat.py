@@ -31,4 +31,24 @@ def copy_to_host_async(x):
     return x
 
 
-__all__ = ["shard_map", "copy_to_host_async"]
+def step_trace_annotation(name: str, **kwargs):
+    """``jax.profiler.StepTraceAnnotation`` where the installed jax has
+    it, an inert context manager otherwise.
+
+    The continuous engine wraps each decode dispatch in one of these so
+    an on-demand profiler capture (``POST /admin/profile``) shows named
+    step boundaries that line up with the flight recorder's ``dispatch``
+    events — same ``step_num``, two views of one boundary. Profiling is
+    observability, never load-bearing: any missing API degrades to
+    running the dispatch unannotated.
+    """
+    try:
+        from jax.profiler import StepTraceAnnotation
+    except ImportError:
+        import contextlib
+
+        return contextlib.nullcontext()
+    return StepTraceAnnotation(name, **kwargs)
+
+
+__all__ = ["shard_map", "copy_to_host_async", "step_trace_annotation"]

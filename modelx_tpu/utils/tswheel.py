@@ -1,0 +1,106 @@
+"""Fixed-wheel per-second rate counters (ISSUE 15), stdlib-only.
+
+Every `/metrics` number before this module was a counter-since-boot:
+"tokens/s over the last minute" needed an external scraper doing the
+rate() math. A :class:`Wheel` keeps one integer bucket per second in a
+fixed ring (no allocation per event, no unbounded history) so the pod
+and the router can report recent-rate truth — tokens/s, requests/s,
+5xx/s, sheds/s over 1m/5m windows — from a bare ``curl``.
+
+Semantics: ``add(n)`` charges ``n`` to the current wall second's bucket;
+``rate(window_s)`` sums the last ``window_s`` COMPLETED-or-current
+buckets and divides by ``window_s``. A bucket older than the wheel span
+is lazily zeroed when its ring slot is reused, so an idle wheel decays
+to 0.0 without a background thread. The clock is ``time.monotonic()``
+(rates must not jump on wall-clock steps).
+
+Thread safety: one small lock per wheel. Callers are HTTP handler
+threads and the engine loop; the critical section is a few integer ops,
+far below the cost of the request that triggered it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["Wheel", "RateSet", "WINDOWS"]
+
+# the exported windows: (suffix, seconds) — 1m and 5m, the two spans a
+# human watching a deploy (or the router's placement logic) acts on
+WINDOWS = (("1m", 60), ("5m", 300))
+
+
+class Wheel:
+    """One counter's fixed wheel of 1-second buckets."""
+
+    def __init__(self, span_s: int = 300, _clock=time.monotonic) -> None:
+        if span_s < 1:
+            raise ValueError("span_s must be >= 1")
+        # +1 guard slot: the current (partial) second never aliases the
+        # oldest full bucket a max-window rate() is summing
+        self.span_s = int(span_s)
+        self._size = self.span_s + 1
+        self._counts = [0] * self._size
+        self._stamps = [-1] * self._size  # the epoch-second each slot holds
+        self._lock = threading.Lock()
+        self._clock = _clock
+
+    def add(self, n: int = 1) -> None:
+        now_s = int(self._clock())
+        i = now_s % self._size
+        with self._lock:
+            if self._stamps[i] != now_s:  # slot held an expired second
+                self._stamps[i] = now_s
+                self._counts[i] = 0
+            self._counts[i] += n
+
+    def rate(self, window_s: int) -> float:
+        """Events per second over the trailing ``window_s`` seconds."""
+        window_s = min(int(window_s), self.span_s)
+        if window_s < 1:
+            raise ValueError("window_s must be >= 1")
+        now_s = int(self._clock())
+        lo = now_s - window_s  # buckets in (lo, now_s] count
+        total = 0
+        with self._lock:
+            for i in range(self._size):
+                if lo < self._stamps[i] <= now_s:
+                    total += self._counts[i]
+        return total / float(window_s)
+
+    def total(self) -> int:
+        """Sum of every live bucket (whole-span total, for tests)."""
+        now_s = int(self._clock())
+        lo = now_s - self.span_s
+        with self._lock:
+            return sum(
+                c for c, s in zip(self._counts, self._stamps)
+                if lo < s <= now_s
+            )
+
+
+class RateSet:
+    """A named family of wheels with one snapshot shape.
+
+    ``snapshot()`` renders ``{"<name>_per_s_1m": x, "<name>_per_s_5m": y}``
+    — plain float leaves, so the tree rides the existing promexp path as
+    gauges with no renderer changes.
+    """
+
+    def __init__(self, names: tuple[str, ...], span_s: int = 300,
+                 _clock=time.monotonic) -> None:
+        self._wheels = {n: Wheel(span_s, _clock=_clock) for n in names}
+
+    def mark(self, name: str, n: int = 1) -> None:
+        self._wheels[name].add(n)
+
+    def wheel(self, name: str) -> Wheel:
+        return self._wheels[name]
+
+    def snapshot(self) -> dict:
+        out: dict = {}
+        for name, wheel in self._wheels.items():
+            for suffix, secs in WINDOWS:
+                out[f"{name}_per_s_{suffix}"] = round(wheel.rate(secs), 4)
+        return out

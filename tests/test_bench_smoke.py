@@ -451,6 +451,51 @@ class TestLatencyBreakdownLeg:
         assert out["ttft_compute_ms_p99"] >= out["ttft_compute_ms_p50"]
 
 
+class TestObsOverheadLeg:
+    # two real continuous pods + compiles: slow set, like the other
+    # serving-pod bench legs
+    @pytest.mark.slow
+    def test_measure_obs_overhead_schema(self, tmp_path):
+        """The observability-overhead micro-leg (ISSUE 15) on a tiny
+        model: schema-checks the on/off wall times, the overhead
+        percentage, and the measured-vs-reserved HBM accounting the
+        instrumented leg reads off the pod."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        import bench
+        from modelx_tpu.dl import safetensors as st
+        from modelx_tpu.models import llama
+
+        cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64),
+                                  dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        st.write_safetensors(
+            str(tmp_path / "model.safetensors"),
+            {k: np.asarray(v) for k, v in params.items()},
+        )
+        out = bench.measure_obs_overhead(str(tmp_path), clients_n=4,
+                                         requests_per_client=2,
+                                         new_tokens=4, rounds=2,
+                                         max_seq_len=96)
+        for key in ("obs_overhead_clients", "obs_on_wall_s",
+                    "obs_off_wall_s", "flightrec_overhead_pct",
+                    "hbm_measured_vs_reserved_ratio",
+                    "hbm_measured_source", "flightrec_events"):
+            assert key in out, key
+        assert out["obs_overhead_clients"] == 4
+        assert out["obs_on_wall_s"] > 0
+        assert out["obs_off_wall_s"] > 0
+        assert out["flightrec_overhead_pct"] is not None
+        # the instrumented leg really recorded engine events
+        assert out["flightrec_events"] > 0
+        # CPU backend: the census fallback still measures SOMETHING
+        assert out["hbm_measured_source"] in ("memory_stats",
+                                              "live_buffers")
+
+
 class TestBenchBudget:
     """The r05-timeout fix (rc 124, nothing recorded): the soft budget
     skips stages that no longer fit — NAMED in timed_out_legs — records

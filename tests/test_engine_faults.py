@@ -9,6 +9,9 @@ with tokens, _DONE, or a typed ServingError.
 """
 
 import dataclasses
+import glob
+import json
+import os
 import queue
 import threading
 import time
@@ -486,6 +489,170 @@ class TestServingSurface:
         r = requests.get(base + "/livez")
         assert r.status_code == 503
         assert r.json() == {"status": "engine-broken"}
+
+
+class TestFlightRecorderDrills:
+    """The flight recorder against a REAL engine death (ISSUE 15): the
+    black-box dump must carry the poisoned request's id on its final
+    dispatch event, and the supervised restart must start a fresh ring."""
+
+    def test_crash_dump_carries_rid_and_restart_resets_ring(
+            self, server, tmp_path):
+        cb = ContinuousBatcher(server, max_slots=2, chunk_size=4,
+                               restart_backoff_s=0.02,
+                               flight_dump_dir=str(tmp_path))
+        try:
+            # healthy traffic first: the dump should show the flight's
+            # history, not just the fatal boundary
+            cb.generate(np.array([[1, 2, 3]], np.int32), max_new_tokens=4)
+            plan = faults.FaultPlan()
+            plan.add("engine.admit", errors_at=[0],
+                     error=RuntimeError("injected-admit"))
+            cb._admit_prog = faults.wrap_dispatch(
+                cb._admit_prog, plan, op="engine.admit")
+            t = cb.submit([7, 7, 7, 7], 5, {}, request_id="rid-poison")
+            item = t.out.get(timeout=60)
+            while isinstance(item, np.ndarray):
+                item = t.out.get(timeout=60)
+            # the crashing request's waiter gets an error (the callsite
+            # failsafe delivers the ORIGINAL exception), never a hang
+            assert isinstance(item, BaseException), item
+            _wait_restarts(cb, 1)
+
+            (path,) = glob.glob(str(tmp_path / "flightrec-*-crash.jsonl"))
+            lines = [json.loads(s) for s in
+                     open(path, encoding="utf-8").read().splitlines()]
+            header = lines[0]
+            assert header["kind"] == "flightrec"
+            assert header["reason"] == "crash"
+            assert "injected-admit" in header["error"]
+            events = [ln for ln in lines if ln["kind"] == "event"]
+            # the flight's history made it in, not just the death
+            assert any(e["event"] == "dispatch" for e in events)
+            # the final event is the crash, attributed to the poisoned
+            # request; the last dispatch-family event is its fatal
+            # admission dispatch, same id
+            assert events[-1]["event"] == "crash"
+            assert events[-1]["request_id"] == "rid-poison"
+            last_dispatch = [e for e in events
+                             if e["event"].startswith("dispatch")][-1]
+            assert last_dispatch["event"] == "dispatch_admit"
+            assert last_dispatch["request_id"] == "rid-poison"
+
+            # the rebuilt engine flies a FRESH ring: no dead-flight
+            # events, and the first event is the rebuild marker
+            live = cb.flightrec.events()
+            assert live and live[0]["event"] == "rebuild"
+            assert all(e.get("request_id") != "rid-poison" for e in live)
+            # ... and it still records: serve one request, see its events
+            cb.generate(np.array([[4, 5]], np.int32), max_new_tokens=4)
+            assert cb.flightrec.events(request_id="") is not None
+            assert any(e["event"] == "eos" for e in cb.flightrec.events())
+        finally:
+            cb.close()
+
+    @pytest.mark.slow  # a full extra engine build for a negative check
+    def test_recorder_off_means_no_ring_and_no_dump(self, server, tmp_path):
+        cb = ContinuousBatcher(server, max_slots=1, chunk_size=4,
+                               restart_backoff_s=0.02,
+                               flight_recorder=False,
+                               flight_dump_dir=str(tmp_path))
+        try:
+            assert cb.flightrec is None
+            _crash_next_chunk(cb)
+            with pytest.raises(EngineBrokenError):
+                cb.generate(np.array([[5, 9, 2]], np.int32),
+                            max_new_tokens=8)
+            _wait_restarts(cb, 1)
+            assert glob.glob(str(tmp_path / "flightrec-*")) == []
+        finally:
+            cb.close()
+
+
+class TestObservabilitySurface:
+    """/debug/flightrec and POST /admin/profile over a real ServerSet
+    (ISSUE 15): admin gating, request-id slicing, one-capture-at-a-time,
+    and the capped capture dir."""
+
+    @pytest.fixture(scope="class")
+    def front(self, server, tmp_path_factory):
+        d = tmp_path_factory.mktemp("obs_front")
+        sset = ServerSet({"m": server}, continuous_batch=True, max_slots=2,
+                         stream_chunk_size=4, admin_tokens=("sekrit",),
+                         trace_dir=str(d / "traces"))
+        port = free_port()
+        httpd = serve(sset, listen=f"127.0.0.1:{port}")
+        yield sset, f"http://127.0.0.1:{port}"
+        for cb in list(sset.cbatchers.values()):
+            cb.close()
+        httpd.shutdown()
+
+    def test_debug_flightrec_is_gated_and_slices_by_rid(self, front):
+        sset, base = front
+        hdr = {"Authorization": "Bearer sekrit"}
+        # a STREAM: the single-row stream path is what threads the
+        # transport's end-to-end id into the engine ticket (ISSUE 13)
+        r = requests.post(
+            base + "/v1/m/generate",
+            json={"tokens": [[5, 9, 2]], "max_new_tokens": 6,
+                  "stream": True},
+            headers={"X-ModelX-Request-Id": "rid-live-1"})
+        assert r.status_code == 200
+        assert r.content  # stream fully consumed
+        # the ring holds request-attributed events, so the endpoint is
+        # admin surface: no token, no timeline
+        assert requests.get(base + "/debug/flightrec").status_code in (
+            401, 403)
+        body = requests.get(base + "/debug/flightrec", headers=hdr).json()
+        assert body["m"]["recorded_total"] > 0
+        assert body["m"]["capacity"] > 0
+        kinds = {e["event"] for e in body["m"]["events"]}
+        assert {"admit", "dispatch", "readback"} <= kinds
+        # ?request_id= slicing, the /v1/trace convention
+        mine = requests.get(
+            base + "/debug/flightrec?request_id=rid-live-1",
+            headers=hdr).json()["m"]["events"]
+        assert mine and all(
+            e["request_id"] == "rid-live-1" for e in mine)
+        assert any(e["event"] == "admit" for e in mine)
+        none = requests.get(
+            base + "/debug/flightrec?request_id=rid-nope",
+            headers=hdr).json()["m"]["events"]
+        assert none == []
+
+    def test_admin_profile_capture_roundtrip(self, front):
+        sset, base = front
+        hdr = {"Authorization": "Bearer sekrit"}
+        url = base + "/admin/profile"
+        assert requests.post(url, json={"duration_s": 0.2}).status_code in (
+            401, 403)
+        for bad in ("x", 0, -1, 10_000):
+            r = requests.post(url, json={"duration_s": bad}, headers=hdr)
+            assert r.status_code == 400, bad
+        # one capture at a time: hold the profiling lock and collide
+        assert sset._profiling.acquire(blocking=False)
+        try:
+            r = requests.post(url, json={"duration_s": 0.2}, headers=hdr)
+            assert r.status_code == 409
+        finally:
+            sset._profiling.release()
+        r = requests.post(url, json={"duration_s": 0.2}, headers=hdr,
+                          timeout=60)
+        assert r.status_code == 200
+        cap = r.json()["capture_dir"]
+        assert os.path.isdir(cap)
+        assert cap.startswith(sset.trace_dir)
+        # the CPU-backend capture really wrote profile artifacts
+        found = [os.path.join(root, f)
+                 for root, _, files in os.walk(cap) for f in files]
+        assert found, f"empty capture dir {cap}"
+        # captures are capped: another round must not grow past the cap
+        r2 = requests.post(url, json={"duration_s": 0.1}, headers=hdr,
+                           timeout=60)
+        assert r2.status_code == 200
+        root = os.path.join(sset.trace_dir, "captures")
+        from modelx_tpu.dl.serve import MAX_PROFILE_CAPTURES
+        assert len(os.listdir(root)) <= MAX_PROFILE_CAPTURES
 
 
 @pytest.mark.slow

@@ -1,0 +1,248 @@
+"""Flight recorder, windowed rate wheels, measured device telemetry, and
+access-log rotation (ISSUE 15) — the fast unit tier.
+
+Everything here is jax-free or CPU-trivial: the ring and the wheels are
+pure data structures (the wheels take an injected clock so window edges
+are exact), the devmem sampler is probed only for its fallback shape,
+and the access-log rotation drill writes a few hundred bytes to tmp.
+The engine-integrated drills (crash dump with the poisoned request's id,
+/debug/flightrec, /admin/profile) live in test_engine_faults.py next to
+the fault-injection fixtures they need.
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from modelx_tpu.utils import accesslog, devmem, flightrec, tswheel
+
+
+class TestFlightRecorderRing:
+    def test_records_in_order_with_seq(self):
+        fr = flightrec.FlightRecorder(capacity=8)
+        fr.record("admit", slot=0, request_id="r-1", prompt_len=6)
+        fr.record("dispatch", depth=2, n_steps=8)
+        evs = fr.events()
+        assert [e["event"] for e in evs] == ["admit", "dispatch"]
+        assert [e["seq"] for e in evs] == [0, 1]
+        assert evs[0]["slot"] == 0
+        assert evs[0]["request_id"] == "r-1"
+        assert evs[0]["prompt_len"] == 6
+        assert "slot" not in evs[1]  # slot=-1 means "not slot-bound"
+        assert evs[0]["t"] <= evs[1]["t"]
+
+    def test_ring_is_bounded_and_keeps_the_newest(self):
+        fr = flightrec.FlightRecorder(capacity=4)
+        for i in range(10):
+            fr.record("e", n=i)
+        evs = fr.events()
+        assert len(evs) == 4
+        assert [e["n"] for e in evs] == [6, 7, 8, 9]
+        assert fr.total == 10
+        assert fr.summary()["dropped"] == 6
+
+    def test_request_id_slicing(self):
+        fr = flightrec.FlightRecorder(capacity=16)
+        fr.record("admit", request_id="a")
+        fr.record("dispatch")
+        fr.record("eos", request_id="a", reason="stop")
+        fr.record("admit", request_id="b")
+        mine = fr.events(request_id="a")
+        assert [e["event"] for e in mine] == ["admit", "eos"]
+        assert fr.summary("a")["events"] == mine
+        assert fr.events(request_id="nope") == []
+
+    def test_reset_starts_a_fresh_flight(self):
+        fr = flightrec.FlightRecorder(capacity=4)
+        fr.record("crash")
+        fr.reset()
+        assert fr.events() == []
+        assert fr.total == 0
+        fr.record("rebuild")
+        assert fr.events()[0]["seq"] == 0
+
+    def test_events_returns_copies(self):
+        fr = flightrec.FlightRecorder(capacity=4)
+        fr.record("admit", request_id="a")
+        fr.events()[0]["event"] = "tampered"
+        assert fr.events()[0]["event"] == "admit"
+
+    def test_capacity_validated(self):
+        with pytest.raises(ValueError):
+            flightrec.FlightRecorder(capacity=0)
+
+    def test_concurrent_appends_never_tear(self):
+        fr = flightrec.FlightRecorder(capacity=64)
+
+        def spin(tag):
+            for i in range(200):
+                fr.record("e", tag=tag, i=i)
+
+        threads = [threading.Thread(target=spin, args=(t,))
+                   for t in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert fr.total == 800
+        evs = fr.events()
+        assert len(evs) == 64
+        assert [e["seq"] for e in evs] == list(range(736, 800))
+
+
+class TestFlightRecorderDump:
+    def test_dump_format(self, tmp_path):
+        fr = flightrec.FlightRecorder(capacity=8)
+        fr.record("admit", slot=0, request_id="r-1")
+        fr.record("crash", request_id="r-1", error="RuntimeError('x')")
+        path = fr.dump(str(tmp_path), "crash",
+                       meta={"model": "default", "restarts": 1},
+                       slots=[{"slot": 0, "state": "decoding",
+                               "request_id": "r-1"}])
+        assert os.path.dirname(path) == str(tmp_path)
+        assert os.path.basename(path).startswith("flightrec-")
+        assert path.endswith("-crash.jsonl")
+        lines = [json.loads(s) for s in
+                 open(path, encoding="utf-8").read().splitlines()]
+        header, slot, *events = lines
+        assert header["kind"] == "flightrec"
+        assert header["reason"] == "crash"
+        assert header["model"] == "default"
+        assert header["recorded_total"] == 2
+        assert header["dropped"] == 0
+        assert slot == {"kind": "slot", "slot": 0, "state": "decoding",
+                        "request_id": "r-1"}
+        assert [e["event"] for e in events] == ["admit", "crash"]
+        assert all(e["kind"] == "event" for e in events)
+
+    def test_dump_creates_dir_and_reason_is_slugged(self, tmp_path):
+        fr = flightrec.FlightRecorder(capacity=2)
+        fr.record("watchdog_stall")
+        path = fr.dump(str(tmp_path / "deep" / "dir"), "circuit break")
+        assert os.path.exists(path)
+        assert path.endswith("-circuit-break.jsonl")
+
+    def test_dump_failure_returns_empty_not_raises(self, tmp_path):
+        target = tmp_path / "not-a-dir"
+        target.write_text("file in the way")
+        fr = flightrec.FlightRecorder(capacity=2)
+        fr.record("crash")
+        assert fr.dump(str(target), "crash") == ""
+
+
+class TestWheel:
+    def test_rate_over_windows(self):
+        t = [1000.0]
+        w = tswheel.Wheel(span_s=300, _clock=lambda: t[0])
+        for _ in range(60):
+            w.add()
+            t[0] += 1.0
+        t[0] -= 1.0  # stand on the last bucket's second
+        # 60 events over the last 60 s -> 1/s; over 300 s -> 0.2/s
+        assert w.rate(60) == pytest.approx(1.0)
+        assert w.rate(300) == pytest.approx(0.2)
+
+    def test_old_buckets_age_out(self):
+        t = [1000.0]
+        w = tswheel.Wheel(span_s=60, _clock=lambda: t[0])
+        w.add(30)
+        assert w.rate(60) == pytest.approx(0.5)
+        t[0] += 61
+        assert w.rate(60) == 0.0
+        assert w.total() == 0
+
+    def test_slot_reuse_resets_stale_count(self):
+        t = [1000.0]
+        w = tswheel.Wheel(span_s=5, _clock=lambda: t[0])
+        w.add(10)
+        t[0] += 6  # same ring slot, new second
+        w.add(1)
+        assert w.total() == 1
+
+    def test_window_clamped_to_span(self):
+        t = [1000.0]
+        w = tswheel.Wheel(span_s=60, _clock=lambda: t[0])
+        w.add(60)
+        assert w.rate(3600) == pytest.approx(1.0)  # clamped to 60
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            tswheel.Wheel(span_s=0)
+        with pytest.raises(ValueError):
+            tswheel.Wheel().rate(0)
+
+
+class TestRateSet:
+    def test_snapshot_shape(self):
+        t = [1000.0]
+        rs = tswheel.RateSet(("requests", "http_5xx"),
+                             _clock=lambda: t[0])
+        rs.mark("requests", 120)
+        t[0] += 10
+        snap = rs.snapshot()
+        assert set(snap) == {"requests_per_s_1m", "requests_per_s_5m",
+                             "http_5xx_per_s_1m", "http_5xx_per_s_5m"}
+        assert snap["requests_per_s_1m"] == pytest.approx(2.0)
+        assert snap["requests_per_s_5m"] == pytest.approx(0.4)
+        assert snap["http_5xx_per_s_1m"] == 0.0
+        assert all(isinstance(v, float) for v in snap.values())
+
+    def test_unknown_name_raises(self):
+        rs = tswheel.RateSet(("requests",))
+        with pytest.raises(KeyError):
+            rs.mark("nope")
+
+
+class TestDevmem:
+    def test_sample_shape_and_fallback(self):
+        dm = devmem.raw_sample()
+        assert set(dm) >= {"hbm_bytes_in_use", "hbm_bytes_reservable",
+                           "device_count", "source"}
+        assert dm["source"] in ("memory_stats", "live_buffers", "none")
+        assert isinstance(dm["hbm_bytes_in_use"], int)
+        assert dm["hbm_bytes_in_use"] >= 0
+        # CPU test env: jax is importable, so devices were found
+        assert dm["device_count"] >= 1
+
+    def test_cached_sample_is_a_copy(self):
+        a = devmem.sample(max_age_s=60)
+        a["hbm_bytes_in_use"] = -777
+        assert devmem.sample(max_age_s=60)["hbm_bytes_in_use"] != -777
+
+
+class TestAccessLogRotation:
+    def read_lines(self, path):
+        with open(path, encoding="utf-8") as f:
+            return [json.loads(s) for s in f.read().splitlines()]
+
+    def test_rotates_past_cap_and_keeps_one_generation(self, tmp_path):
+        path = str(tmp_path / "access.log")
+        log = accesslog.AccessLog(path, max_bytes=200)
+        for i in range(20):
+            log.write(request_id=f"r-{i}", status=200)
+        log.close()
+        assert os.path.exists(path + ".1")
+        current = self.read_lines(path)
+        rotated = self.read_lines(path + ".1")
+        # one generation kept: the survivors are a contiguous, untorn
+        # SUFFIX of the stream ending at the last write
+        ids = [r["request_id"] for r in rotated + current]
+        assert ids == [f"r-{i}" for i in range(20 - len(ids), 20)]
+        assert os.path.getsize(path) < 400  # kept near the cap, not 20 lines
+
+    def test_zero_cap_never_rotates(self, tmp_path):
+        path = str(tmp_path / "access.log")
+        log = accesslog.AccessLog(path)
+        for i in range(50):
+            log.write(i=i)
+        log.close()
+        assert not os.path.exists(path + ".1")
+        assert len(self.read_lines(path)) == 50
+
+    def test_open_log_plumbs_max_bytes(self, tmp_path):
+        log = accesslog.open_log(str(tmp_path / "a.log"), max_bytes=7)
+        assert log.max_bytes == 7
+        log.close()
+        assert accesslog.open_log("", max_bytes=7) is None

@@ -261,6 +261,34 @@ class TestRender:
         assert fams["modelx_pods_ttft_ms_hist"]["type"] == "histogram"
 
 
+class TestWindowedRateFamilies:
+    """ISSUE 15: the tswheel/devmem snapshot leaves must survive the
+    strict parser unchanged — floats as gauges, the ``source`` string
+    silently absent from the text view (the JSON keeps it)."""
+
+    def test_rateset_snapshot_renders_as_gauges(self):
+        from modelx_tpu.utils import tswheel
+
+        t = [1000.0]
+        rs = tswheel.RateSet(("requests", "sheds"), _clock=lambda: t[0])
+        for _ in range(30):
+            rs.mark("requests")
+        t[0] += 10
+        fams = parse_exposition(render({"rates": rs.snapshot()}))
+        fam = fams["modelx_rates_requests_per_s_1m"]
+        assert fam["type"] == "gauge"
+        assert fam["samples"][0][2] == 0.5  # 30 events / 60 s
+        assert fams["modelx_rates_sheds_per_s_5m"]["samples"][0][2] == 0.0
+
+    def test_devmem_family_skips_source_string(self):
+        from modelx_tpu.utils import devmem
+
+        fams = parse_exposition(render({"device": devmem.raw_sample()}))
+        assert fams["modelx_device_hbm_bytes_in_use"]["type"] == "gauge"
+        assert "modelx_device_device_count" in fams
+        assert not any("source" in name for name in fams)
+
+
 class TestRegistrySurface:
     def test_registry_metrics_parse_and_count(self):
         from modelx_tpu.registry.fs import MemoryFSProvider
@@ -309,6 +337,9 @@ class TestRouterSurface:
             assert prom.headers["Content-Type"] == CONTENT_TYPE
             fams = parse_exposition(prom.text)
             assert "modelx_router_requests_total" in fams
+            # windowed rates (ISSUE 15) ride the same renderer as gauges
+            assert fams["modelx_rates_requests_per_s_1m"]["type"] == "gauge"
+            assert fams["modelx_rates_http_5xx_per_s_5m"]["type"] == "gauge"
             via_accept = requests.get(base + "/metrics",
                                       headers={"Accept": "text/plain"})
             assert parse_exposition(via_accept.text)
