@@ -5,7 +5,7 @@ PY ?= python
 IMAGE ?= modelx-tpu
 TAG ?= $(shell git describe --tags --always 2>/dev/null || echo dev)
 
-.PHONY: all native test chaos slow lifecycle fleet overload programs continuation obs lint wheel image image-dl compose-up compose-down clean
+.PHONY: all native test chaos slow lifecycle fleet overload programs continuation obs mesh lint wheel image image-dl compose-up compose-down clean
 
 all: native lint test wheel
 
@@ -87,6 +87,19 @@ obs:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_router.py -q -k "RequestId or Observability"
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_engine_faults.py -q -k "FlightRecorder or Observability"
 	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_router.py -q -m chaos
+
+# mesh-serving drills (ISSUE 16): family shard rules -> NamedSharding,
+# sharded byte-range fetch math, bundle mesh-skew, per-device HBM
+# budgeting + telemetry, and the multi-device continuous-decode matrix
+# (tier-1 keeps one dp=1 byte-equality representative; the heavy
+# mesh-shape sweeps live in the slow set) — then the engine chaos sweep
+# under runtime lockdep, since the sharded engine reuses the dispatch/
+# supervisor lock order the fault drills validate
+mesh:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_loader.py tests/test_sharding_mesh.py -q -m "not slow"
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_program_store.py -q -k mesh
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_sharding_mesh.py -q -m slow
+	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_engine_faults.py -q -m chaos
 
 # two layers: the project-native concurrency/purity gate (always — it is
 # stdlib-only and baseline-governed, see docs/analysis.md), then generic

@@ -776,6 +776,111 @@ def measure_continuous(params: dict, mesh, decode_tps: float | None) -> dict:
         cb.close()
 
 
+def sharded_child_main(ckpt_dir: str) -> int:
+    """``bench.py --sharded-child``: the forced-host multi-device half of
+    ``measure_sharded_serving``, in a FRESH process so
+    ``--xla_force_host_platform_device_count=8`` is set before jax
+    initializes (the parent's backend is already up with its own device
+    count). Boots the same checkpoint twice — a dp=1 single-device server
+    and a dp=2,tp=2 four-device server — runs the continuous engine under
+    concurrent clients on each, and prints one JSON line of aggregate
+    rates plus the dp=1 engine-vs-legacy byte-equality verdict."""
+    import threading as _t
+    from concurrent.futures import ThreadPoolExecutor
+
+    from modelx_tpu.dl.continuous import ContinuousBatcher
+    from modelx_tpu.dl.serve import ModelServer
+
+    clients, new_tokens = 4, 64
+    rng = np.random.RandomState(7)
+    out: dict = {}
+    for tag, spec in (("dp1", "dp=1"), ("mesh", "dp=2,tp=2")):
+        srv = ModelServer(ckpt_dir, mesh_spec=spec, dtype="float32",
+                          max_seq_len=256)
+        srv.load()
+        cb = ContinuousBatcher(srv, max_slots=4, chunk_size=16, max_len=256)
+        try:
+            prompts = [
+                rng.randint(1, srv.cfg.vocab_size, (1, 32)).astype(np.int32)
+                for _ in range(clients)
+            ]
+            # warm: single + batched admission programs, then one repeat
+            cb.generate(prompts[0], max_new_tokens=8)
+            cb.generate(np.concatenate([prompts[0], prompts[0]]),
+                        max_new_tokens=8)
+            cb.generate(prompts[0], max_new_tokens=8)
+            if tag == "dp1":
+                # the byte-equality acceptance: the mesh-aware engine on a
+                # single-device mesh must reproduce the legacy serving
+                # path's tokens exactly (greedy AND sampled)
+                toks = prompts[0][:, :16]
+                greedy_eq = np.array_equal(
+                    cb.generate(toks, max_new_tokens=12),
+                    srv.generate(toks, max_new_tokens=12))
+                sampled_eq = np.array_equal(
+                    cb.generate(toks, max_new_tokens=12, temperature=0.8,
+                                top_k=12, seed=7),
+                    srv.generate(toks, max_new_tokens=12, temperature=0.8,
+                                 top_k=12, seed=7))
+                out["sharded_dp1_byte_equal"] = bool(greedy_eq and sampled_eq)
+            start = _t.Barrier(clients)
+
+            def client(i: int) -> int:
+                start.wait()
+                got = cb.generate(prompts[i], max_new_tokens=new_tokens)
+                return got.shape[1] - prompts[i].shape[1]
+
+            t0 = time.monotonic()
+            with ThreadPoolExecutor(clients) as pool:
+                totals = list(pool.map(client, range(clients)))
+            dt = time.monotonic() - t0
+            snap = cb.snapshot()
+            out[f"{tag}_tokens_per_s"] = round(sum(totals) / dt, 1)
+            out[f"{tag}_mesh"] = snap["mesh"]
+            out[f"{tag}_devices"] = snap["mesh_devices"]
+        finally:
+            cb.close()
+    print(json.dumps(out))
+    return 0
+
+
+def measure_sharded_serving(ckpt_dir: str, env=None,
+                            timeout_s: float = 900.0) -> dict:
+    """Tensor-parallel continuous decode on a real (forced-host) multi-
+    device mesh — the ISSUE 16 acceptance leg. A child process pins
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=8`` BEFORE jax
+    imports, serves one checkpoint on dp=1 and on dp=2,tp=2, and this
+    parent reports the aggregate rates, the per-device throughput ratio
+    (tp devices all work on every token, so the mesh aggregate IS the
+    per-device rate; pass >= 0.7x the single-device baseline), and the
+    dp=1 byte-equality verdict."""
+    child_env = dict(env or os.environ)
+    child_env["JAX_PLATFORMS"] = "cpu"
+    flags = child_env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        child_env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    p = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--sharded-child",
+         ckpt_dir],
+        capture_output=True, text=True, env=child_env, timeout=timeout_s)
+    if p.returncode != 0:
+        raise RuntimeError(f"sharded child failed: {p.stderr[-2000:]}")
+    child = json.loads(p.stdout.strip().splitlines()[-1])
+    dp1 = child.get("dp1_tokens_per_s") or 0.0
+    mesh_tps = child.get("mesh_tokens_per_s") or 0.0
+    return {
+        "sharded_mesh": child.get("mesh_mesh"),
+        "sharded_devices": child.get("mesh_devices"),
+        "sharded_tokens_per_s": mesh_tps,
+        "sharded_dp1_tokens_per_s": dp1,
+        "sharded_per_device_ratio": (
+            round(mesh_tps / dp1, 3) if dp1 else None
+        ),
+        "sharded_dp1_byte_equal": child.get("sharded_dp1_byte_equal"),
+    }
+
+
 def measure_decode_pipelined(params, mesh, decode_tps: float | None, *,
                              clients: int = 8, chunk: int = 16,
                              new_tokens: int = 192, prompt_len: int = 64,
@@ -2416,6 +2521,11 @@ def tiny_main() -> int:
         out.update(measure_obs_overhead(workdir, new_tokens=8,
                                         max_seq_len=128))
 
+        # tensor-parallel serving (ISSUE 16): continuous decode on a
+        # forced-host dp=2,tp=2 mesh vs the dp=1 baseline — per-device
+        # ratio passes >= 0.7, and the dp=1 engine must stay byte-exact
+        out.update(measure_sharded_serving(workdir))
+
         # --- compiled-program registry (ISSUE 11), CPU proxy ---
         # bench-shaped small checkpoint, not LlamaConfig.tiny: the ratio
         # should be measured on a model whose trace+compile is non-trivial
@@ -2495,4 +2605,6 @@ if __name__ == "__main__":
         sys.exit(leg_main(sys.argv[2], sys.argv[3], sys.argv[4], sys.argv[5]))
     if len(sys.argv) > 1 and sys.argv[1] == "--tiny":
         sys.exit(tiny_main())
+    if len(sys.argv) > 1 and sys.argv[1] == "--sharded-child":
+        sys.exit(sharded_child_main(sys.argv[2]))
     sys.exit(main())

@@ -53,6 +53,9 @@ _SERVER_PATH_FILES = (
     "modelx_tpu/dl/continuous.py",
     "modelx_tpu/dl/lifecycle.py",
     "modelx_tpu/dl/program_store.py",
+    "modelx_tpu/dl/loader.py",
+    "modelx_tpu/dl/sharding.py",
+    "modelx_tpu/parallel/mesh.py",
     "modelx_tpu/registry/server.py",
     "modelx_tpu/registry/store_fs.py",
     "modelx_tpu/registry/gc.py",

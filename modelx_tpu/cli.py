@@ -499,7 +499,7 @@ def cmd_programs_push(ref: str, quantize: str | None, cache_dir: str) -> None:
                 client, r.repository, manifest, quantize=quantize
             )
             keys = program_store.export_surface(family, cfg, sds, mesh, out_dir)
-            data = program_store.build_bundle(out_dir, keys=keys)
+            data = program_store.build_bundle(out_dir, keys=keys, mesh=mesh)
             if data is None:
                 raise ValueError("no programs exported; nothing to push")
             desc = program_store.publish(client.remote, r.repository, r.version, data)

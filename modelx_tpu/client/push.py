@@ -11,9 +11,13 @@ Reference parity: pkg/client/push.go:29-207. Semantics preserved:
   the reference forgot (push.go:196-207 nil-deref);
 - manifest PUT last = commit point.
 
-TPU-native addition: safetensors blobs are annotated with their tensor index
-(``modelx.tensor.index``) at push time, so the deploy-time loader can plan
-per-shard ranged reads from the manifest alone — no header round-trip.
+TPU-native addition: safetensors blobs are annotated at push time with their
+tensor index (``modelx.tensor.index``) AND their shard layout
+(``modelx.shard.spec``, the family's tensor-name -> PartitionSpec rules), so
+the deploy-time loader can plan per-shard ranged reads — which byte ranges
+each device needs — from the manifest alone, before fetching a byte. A
+``modelx.yaml`` that pins ``serving.mesh`` additionally stamps the manifest
+with ``modelx.shard.mesh`` so a puller knows the intended topology too.
 """
 
 from __future__ import annotations
@@ -28,6 +32,8 @@ from modelx_tpu.client.extension import get_extension
 from modelx_tpu.client.progress import MultiBar
 from modelx_tpu.client.remote import RegistryClient
 from modelx_tpu.types import (
+    AnnotationShardMesh,
+    AnnotationShardSpec,
     AnnotationTensorIndex,
     BlobLocationPurposeUpload,
     Descriptor,
@@ -69,12 +75,34 @@ def parse_manifest_from_dir(directory: str, cache_dir: str | None = None):
                 _annotate_safetensors(entry.path, desc)
                 blobs.append(desc)
     manifest = Manifest(config=config or Descriptor(), blobs=blobs)
+    _annotate_mesh(directory, manifest)
     return manifest, tgz_paths
 
 
+def _annotate_mesh(directory: str, manifest) -> None:
+    """Stamp the manifest with the checkpoint's pinned serving mesh
+    (``modelx.yaml`` serving.mesh), when one exists: a puller then knows
+    the intended topology — and can budget per-device HBM — before any
+    blob byte moves."""
+    path = os.path.join(directory, MODEL_CONFIG_FILENAME)
+    if not os.path.isfile(path):
+        return
+    try:
+        from modelx_tpu.client.model_config import ModelConfig
+
+        with open(path, "r", encoding="utf-8") as f:
+            config = ModelConfig.from_yaml(f.read())
+    except Exception:
+        return  # an invalid sidecar fails later with a real diagnostic
+    if config.serving.mesh:
+        manifest.annotations[AnnotationShardMesh] = config.serving.mesh
+
+
 def _annotate_safetensors(path: str, desc: Descriptor) -> None:
-    """Attach the safetensors tensor index as a manifest annotation so the
-    TPU loader can plan ranged reads without fetching the header first."""
+    """Attach the safetensors tensor index and the family's shard-layout
+    rules as manifest annotations so the TPU loader can plan PLACED ranged
+    reads — which byte ranges land on which device — without fetching the
+    header first."""
     if not path.endswith(".safetensors"):
         return
     try:
@@ -92,6 +120,17 @@ def _annotate_safetensors(path: str, desc: Descriptor) -> None:
     # models with enormous tensor counts rather than break the push
     if len(payload) <= 256 * 1024:
         desc.annotations[AnnotationTensorIndex] = payload
+    # per-tensor PartitionSpec layout (dl/sharding.py family rule sets):
+    # the rules are plain JSON (no jax import) and a few hundred bytes, so
+    # they always fit. An unrecognized layout annotates nothing and the
+    # puller falls back to its own inference, exactly as before.
+    from modelx_tpu.dl.sharding import encode_rules, infer_family, rules_for_family
+
+    family = infer_family(list(header))
+    if family:
+        desc.annotations[AnnotationShardSpec] = encode_rules(
+            rules_for_family(family)
+        )
 
 
 class Pusher:

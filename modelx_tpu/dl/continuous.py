@@ -385,6 +385,18 @@ class ContinuousBatcher:
             # engine-owned device state: the big cache (donated through
             # every program so HBM holds exactly one copy)
             self._cache = self._init_cache(self.max_slots, self.max_len)
+        # -- mesh placement (tensor-parallel continuous decode) -------------
+        # On a >1-device mesh the engine's KV state gets an explicit GSPMD
+        # layout before the first program closes over it: dense caches
+        # shard slots over dp and kv heads over tp; the paged pool shards
+        # kv heads over tp only (its leading dim is a global page index no
+        # axis may split). Every program the engine compiles then inherits
+        # these input layouts, so decode math runs tensor-parallel instead
+        # of congealing on device 0. A single-device mesh skips this block
+        # entirely — the dp=1 engine stays byte-identical to before.
+        self.mesh = server.mesh
+        self.mesh_devices = int(self.mesh.size)
+        self._cache = self._place_cache(self._cache)
         self._tok = jnp.zeros((self.max_slots, 1), jnp.int32)
         # host-side per-slot state (tiny vectors, traced as inputs)
         self._offsets = np.zeros(self.max_slots, np.int32)
@@ -646,6 +658,30 @@ class ContinuousBatcher:
     # streaming client's flush cadence (delivery still splits into
     # chunk_size pieces) and the stop-detection lag stay bounded
     AUTO_DISPATCH_DEPTH = 4
+
+    def _place_cache(self, cache):
+        """Lay the engine's KV state out on the serving mesh (no-op on a
+        single device — the dp=1 engine stays byte-identical to before).
+        Dense caches shard slots over dp and kv heads over tp; the paged
+        pool shards kv heads over tp only, because its leading dim is a
+        global page index no axis may split. Every program the engine
+        compiles inherits these input layouts, so decode math runs
+        tensor-parallel instead of congealing on device 0."""
+        if self.mesh_devices <= 1:
+            return cache
+        from modelx_tpu.dl.sharding import cache_sharding
+
+        pool_batch_dim = -1 if self.page_size > 0 else 0
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(
+                leaf,
+                cache_sharding(
+                    self.mesh, leaf.shape, batch_dim=pool_batch_dim,
+                    head_dim=len(leaf.shape) - 2,
+                ),
+            ),
+            cache,
+        )
 
     # -- flight recorder ------------------------------------------------------
 
@@ -1971,7 +2007,7 @@ class ContinuousBatcher:
         active = list(self._rows)
         filtered = bool(self._use_filters[active].any())
         self._rec("dispatch", depth=depth, n_steps=n_steps,
-                  active=len(self._rows))
+                  active=len(self._rows), devices=self.mesh_devices)
         # the step annotation names this dispatch in an on-demand profiler
         # capture (POST /admin/profile) with the SAME ordinal the flight
         # ring records, so XLA timeline steps join engine events 1:1
@@ -2354,6 +2390,7 @@ class ContinuousBatcher:
             self.stats["pages_free"] = len(self._free_pages)
         else:
             self._cache = self._init_cache(self.max_slots, self.max_len)
+        self._cache = self._place_cache(self._cache)
         self._tok = jnp.zeros((self.max_slots, 1), jnp.int32)
         self._offsets[:] = 0
         self._steps[:] = 0
@@ -2711,6 +2748,13 @@ class ContinuousBatcher:
         # supervision + bounded-admission surface: the operator's view of
         # the self-healing layer (engine_restarts rides in from stats)
         snap["engine_state"] = self._state
+        # serving topology: the mesh the engine's programs compiled under
+        # and the device count its chunk work spreads over — the labels a
+        # fleet dashboard joins per-device throughput against
+        from modelx_tpu.parallel.mesh import mesh_str
+
+        snap["mesh"] = mesh_str(self.mesh)
+        snap["mesh_devices"] = self.mesh_devices
         snap["quarantined"] = sum(
             1 for c in self._poison.values() if c >= self.POISON_CRASHES
         )

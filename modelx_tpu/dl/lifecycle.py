@@ -164,9 +164,21 @@ class ModelPool:
     def __init__(self, sset, hbm_budget_bytes: int = 0, evict_idle: bool = False,
                  allow_admin_load: bool = False, staging_root: str = "",
                  drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S,
-                 blob_cache=None) -> None:
+                 blob_cache=None, mesh=None) -> None:
         self.sset = sset
         self.hbm_budget_bytes = int(hbm_budget_bytes)
+        # the serving mesh (ServerSet's shared mesh): --hbm-budget-bytes is
+        # PER-DEVICE HBM, and on a weight-sharding mesh (tp/ep/pp/fsdp)
+        # each device holds only 1/factor of a model's bytes — checkpoint
+        # file sizes and load_bytes are divided by this before they meet
+        # the budget. dp/sp replicate weights, so a dp-only mesh keeps
+        # factor 1 and every pre-mesh deployment budgets exactly as before.
+        self.mesh = mesh
+        self.weight_shard_factor = 1
+        if mesh is not None:
+            from modelx_tpu.parallel.mesh import weight_shard_factor
+
+            self.weight_shard_factor = max(1, weight_shard_factor(mesh))
         self.evict_idle = bool(evict_idle)
         self.allow_admin_load = bool(allow_admin_load)
         self.staging_root = staging_root
@@ -189,6 +201,13 @@ class ModelPool:
             e.model_dir = server.model_dir
             self.entries[name] = e
 
+    def _per_device(self, total_bytes: int) -> int:
+        """Per-device footprint of ``total_bytes`` of weights on this
+        pool's mesh (ceiling division — budgets must never round a
+        footprint down to a free lunch)."""
+        f = self.weight_shard_factor
+        return int(total_bytes) if f <= 1 else -(-int(total_bytes) // f)
+
     # -- state transitions driven by ServerSet.load_all -----------------------
 
     def mark_loading(self, name: str) -> None:
@@ -206,7 +225,7 @@ class ModelPool:
             e.loads_total += 1
             self.stats["loads_total"] += 1
             if e.server is not None:
-                e.hbm_reserved_bytes = int(
+                e.hbm_reserved_bytes = self._per_device(
                     e.server.stats.get("load_bytes", 0) or 0
                 ) or e.hbm_reserved_bytes
             e.last_used = time.monotonic()
@@ -284,7 +303,7 @@ class ModelPool:
         if e.state == LOADING and e.server is not None and e.server.ready:
             e.to(READY)
             if not e.hbm_reserved_bytes:
-                e.hbm_reserved_bytes = int(
+                e.hbm_reserved_bytes = self._per_device(
                     e.server.stats.get("load_bytes", 0) or 0
                 )
         return e.state
@@ -348,6 +367,12 @@ class ModelPool:
         if self.hbm_budget_bytes:
             snap["hbm_budget_bytes"] = self.hbm_budget_bytes
         snap["evict_idle"] = self.evict_idle
+        if self.mesh is not None:
+            from modelx_tpu.parallel.mesh import mesh_str
+
+            snap["mesh"] = mesh_str(self.mesh)
+            snap["mesh_devices"] = int(self.mesh.size)
+            snap["weight_shard_factor"] = self.weight_shard_factor
         # measured occupancy next to the estimate (ISSUE 15): the
         # reservations above are FILE-SIZE guesses; this is the device's
         # own accounting, and the delta is the estimator's running error
@@ -393,6 +418,9 @@ class ModelPool:
                                  f"{ref or model_dir!r}: {e}")
         if est <= 0:
             raise PoolError(400, f"no safetensors found under {ref or model_dir!r}")
+        # checkpoint file sizes are TOTAL weight bytes; the budget admits
+        # what one device will actually hold on this pool's mesh
+        est = self._per_device(est)
 
         frees: list = []
         try:

@@ -56,6 +56,7 @@ from modelx_tpu.types import (
     AnnotationProgramCode,
     AnnotationProgramCount,
     AnnotationProgramJax,
+    AnnotationProgramMesh,
     Descriptor,
     Digest,
     Manifest,
@@ -78,34 +79,54 @@ def _member_name_ok(name: str) -> bool:
     return bool(_ARTIFACT_RE.match(name) or _XLA_RE.match(name))
 
 
-def _env() -> tuple[str, str, str]:
+def _mesh_str(mesh=None) -> str:
+    """Normalize a mesh argument to the canonical ``"dp=2,tp=4"`` string.
+    ``None`` derives the default serving topology (dp over all local
+    devices — the same default ModelServer and plan_from_manifest use), a
+    live Mesh renders its shape, a string passes through."""
+    if isinstance(mesh, str):
+        return mesh
+    if mesh is not None and getattr(mesh, "shape", None) is not None:
+        from modelx_tpu.parallel.mesh import mesh_str
+
+        return mesh_str(mesh)
+    import jax
+
+    return f"dp={len(jax.devices())}"
+
+
+def _env(mesh=None) -> tuple[str, str, str, str]:
     import jax
 
     from modelx_tpu.dl import aot_cache
 
-    return jax.__version__, jax.default_backend(), aot_cache.code_version()
+    return (jax.__version__, jax.default_backend(), aot_cache.code_version(),
+            _mesh_str(mesh))
 
 
-def env_key() -> str:
-    """Digest of (jax version, backend, package-source digest) — the bundle
-    compatibility domain. One bundle per environment coexists in a
-    manifest; republishing from the same environment replaces it."""
-    jx, backend, code = _env()
-    h = hashlib.sha256(f"{jx}\x00{backend}\x00{code}".encode())
+def env_key(mesh=None) -> str:
+    """Digest of (jax version, backend, package-source digest, mesh shape)
+    — the bundle compatibility domain. Mesh is load-bearing: exported
+    programs bake their GSPMD partitioning in, so a dp=1 surface must
+    never warm-install (and mis-warm) a tp=4 pod. One bundle per
+    environment coexists in a manifest; republishing from the same
+    environment replaces it."""
+    jx, backend, code, mesh_s = _env(mesh)
+    h = hashlib.sha256(f"{jx}\x00{backend}\x00{code}\x00{mesh_s}".encode())
     return h.hexdigest()[:12]
 
 
-def bundle_name() -> str:
+def bundle_name(mesh=None) -> str:
     """Dotfile on purpose: push.parse_manifest_from_dir skips dotfiles, so
     a model dir holding a pulled bundle re-pushes cleanly — programs only
     ever attach to a manifest through :func:`publish`."""
-    return f".programs-{env_key()}.tar"
+    return f".programs-{env_key(mesh)}.tar"
 
 
 # --- bundle build -------------------------------------------------------------
 
 
-def build_bundle(cache_dir: str, keys=None) -> bytes | None:
+def build_bundle(cache_dir: str, keys=None, mesh=None) -> bytes | None:
     """Pack serialized exports from ``cache_dir`` into a deterministic tar
     (sorted members, zeroed mtimes/owners): same artifacts => same bytes
     => same content address, so republishing an unchanged surface is a
@@ -143,12 +164,13 @@ def build_bundle(cache_dir: str, keys=None) -> bytes | None:
         members.append((name, data))
     if not members:
         return None
-    jx, backend, code = _env()
+    jx, backend, code, mesh_s = _env(mesh)
     meta = {
         "formatVersion": BUNDLE_FORMAT,
         "jax": jx,
         "backend": backend,
         "codeVersion": code,
+        "mesh": mesh_s,
         "artifacts": artifacts,
     }
     meta_bytes = json.dumps(meta, sort_keys=True, separators=(",", ":")).encode()
@@ -168,7 +190,7 @@ def build_bundle(cache_dir: str, keys=None) -> bytes | None:
 # --- bundle install -----------------------------------------------------------
 
 
-def install_bundle(data: bytes, cache_dir: str) -> dict:
+def install_bundle(data: bytes, cache_dir: str, mesh=None) -> dict:
     """Install a bundle's artifacts into the local AOT cache dir.
 
     Never raises: every failure mode — undecodable tar, missing/invalid
@@ -197,7 +219,7 @@ def install_bundle(data: bytes, cache_dir: str) -> dict:
         if not isinstance(meta, dict) or meta.get("formatVersion") != BUNDLE_FORMAT:
             return _skip(f"unsupported bundle format {meta.get('formatVersion')!r}"
                          if isinstance(meta, dict) else "bundle meta is not an object")
-        jx, backend, code = _env()
+        jx, backend, code, mesh_s = _env(mesh)
         got = (meta.get("jax"), meta.get("backend"), meta.get("codeVersion"))
         if got != (jx, backend, code):
             # the whole bundle is for another world: programs exported by
@@ -205,6 +227,17 @@ def install_bundle(data: bytes, cache_dir: str) -> dict:
             return _skip(
                 "version skew: bundle built for jax=%s backend=%s code=%s, "
                 "local jax=%s backend=%s code=%s" % (*got, jx, backend, code),
+                n=len(meta.get("artifacts") or ()),
+            )
+        got_mesh = meta.get("mesh")
+        if got_mesh is not None and got_mesh != mesh_s:
+            # the exports bake their GSPMD partitioning in: a bundle
+            # compiled for another mesh shape would deserialize fine and
+            # then mis-warm (or fail at execute) on this topology.
+            # Pre-mesh bundles carry no key and install as before.
+            return _skip(
+                f"mesh skew: bundle built for mesh={got_mesh}, "
+                f"local mesh={mesh_s}",
                 n=len(meta.get("artifacts") or ()),
             )
         os.makedirs(cache_dir, exist_ok=True)
@@ -243,7 +276,7 @@ def install_bundle(data: bytes, cache_dir: str) -> dict:
     return stats
 
 
-def install_from_dir(model_dir: str, cache_dir: str) -> dict:
+def install_from_dir(model_dir: str, cache_dir: str, mesh=None) -> dict:
     """Install every pulled program bundle found in a model dir (the
     lifecycle/boot path: pull_model drops ``.programs-*.tar`` next to the
     weights). Aggregated stats; never raises."""
@@ -257,7 +290,7 @@ def install_from_dir(model_dir: str, cache_dir: str) -> dict:
             logger.warning("program install: cannot read %s: %s", path, e)
             continue
         total["bundles"] += 1
-        stats = install_bundle(data, cache_dir)
+        stats = install_bundle(data, cache_dir, mesh=mesh)
         for k in ("installed", "present", "skipped"):
             total[k] += stats[k]
         total["reasons"].extend(stats["reasons"])
@@ -283,7 +316,10 @@ def publish(remote, repository: str, version: str, data: bytes) -> Descriptor:
     from modelx_tpu.client.push import commit_delta_digests
 
     meta = _bundle_meta(data)
-    name = bundle_name()
+    # name (and thereby replace-vs-coexist identity) follows the bundle's
+    # OWN stamped environment: publish may run in a different process than
+    # the export (modelx programs push), so never re-derive it locally
+    name = bundle_name(meta.get("mesh"))
     desc = Descriptor(
         name=name,
         media_type=MediaTypeModelProgram,
@@ -293,6 +329,7 @@ def publish(remote, repository: str, version: str, data: bytes) -> Descriptor:
             AnnotationProgramJax: meta["jax"],
             AnnotationProgramBackend: meta["backend"],
             AnnotationProgramCode: meta["codeVersion"],
+            AnnotationProgramMesh: meta.get("mesh") or _mesh_str(None),
             # programs, not members: the XLA executables are support acts
             AnnotationProgramCount: str(_program_count(meta)),
         },
@@ -336,21 +373,27 @@ def bundle_program_count(data: bytes) -> int:
 
 
 def pull_and_install(client, repository: str, manifest: Manifest,
-                     cache_dir: str, cache=None) -> dict:
+                     cache_dir: str, cache=None, mesh=None) -> dict:
     """Fetch the manifest's program bundles (blob cache first — re-swaps
     are disk-warm) and install them into the local AOT cache. Corrupt
     bytes (digest mismatch) are logged and skipped, never installed;
     transport errors likewise — the caller's compile path just stays
     cold. Never raises."""
     total = {"bundles": 0, "installed": 0, "present": 0, "skipped": 0, "reasons": []}
+    env = _env(mesh)
     for desc in program_descriptors(manifest):
         # a bundle stamped for another environment is skew by construction;
         # don't spend bytes on it (install_bundle re-checks via meta.json
         # anyway, for bundles with absent/wrong annotations)
         code = desc.annotations.get(AnnotationProgramCode)
-        if code is not None and code != _env()[2]:
+        if code is not None and code != env[2]:
             total["skipped"] += 1
             total["reasons"].append(f"{desc.name}: version skew (annotation)")
+            continue
+        bundle_mesh = desc.annotations.get(AnnotationProgramMesh)
+        if bundle_mesh is not None and bundle_mesh != env[3]:
+            total["skipped"] += 1
+            total["reasons"].append(f"{desc.name}: mesh skew (annotation)")
             continue
         try:
             data = _read_blob(client, repository, desc, cache=cache)
@@ -362,7 +405,7 @@ def pull_and_install(client, repository: str, manifest: Manifest,
             total["reasons"].append(f"{desc.name}: digest mismatch")
             continue
         total["bundles"] += 1
-        stats = install_bundle(data, cache_dir)
+        stats = install_bundle(data, cache_dir, mesh=mesh)
         for k in ("installed", "present", "skipped"):
             total[k] += stats[k]
         total["reasons"].extend(stats["reasons"])
@@ -494,7 +537,22 @@ def plan_from_manifest(client, repository: str, manifest: Manifest,
     family = fam.detect(list(infos))
     infos = fuse_expert_tensors(infos, family.rules)
     cfg = family.infer_config(fam.abstract_params(infos))
-    mesh = make_mesh(f"dp={len(jax.devices())}")
+    # a checkpoint that pins its serving topology (modelx.shard.mesh) gets
+    # its programs exported for THAT mesh — the shape a puller will serve
+    # under; otherwise the local default (dp over all devices)
+    from modelx_tpu.types import AnnotationShardMesh
+
+    mesh = None
+    pinned = manifest.annotations.get(AnnotationShardMesh, "")
+    if pinned:
+        try:
+            mesh = make_mesh(pinned)
+        except ValueError as e:
+            logger.warning(
+                "manifest pins mesh %r but it does not fit this host (%s); "
+                "exporting for the local default mesh instead", pinned, e)
+    if mesh is None:
+        mesh = make_mesh(f"dp={len(jax.devices())}")
     sds = fam.abstract_params(infos, family.rules, mesh, quantize=quantize)
     return family, cfg, sds, mesh
 
@@ -525,7 +583,7 @@ def publish_for_server(ref: str, server, cache_dir: str) -> Descriptor | None:
     from modelx_tpu.dl import aot_cache
 
     keys = [k for k in keys if os.path.isfile(aot_cache.artifact_path(cache_dir, k))]
-    data = build_bundle(cache_dir, keys=keys)
+    data = build_bundle(cache_dir, keys=keys, mesh=server.mesh)
     if data is None:
         return None
     parsed = parse_reference(ref)

@@ -321,7 +321,9 @@ class ModelServer:
                 from modelx_tpu.dl import program_store
 
                 try:
-                    pstats = program_store.install_from_dir(self.model_dir, cache_dir)
+                    pstats = program_store.install_from_dir(
+                        self.model_dir, cache_dir, mesh=self.mesh
+                    )
                     if pstats["bundles"] or pstats["skipped"]:
                         self.stats["programs"] = {
                             k: pstats[k]
@@ -388,6 +390,13 @@ class ModelServer:
                     self.params = lora.merge_adapter(self.params, self.lora_dir)
                 self.stats["lora_dir"] = self.lora_dir
             seconds = time.monotonic() - t0
+            from modelx_tpu.parallel.mesh import mesh_str, weight_shard_factor
+
+            self.stats["mesh"] = mesh_str(self.mesh)
+            self.stats["mesh_devices"] = int(self.mesh.size)
+            # how many ways the weight bytes divide across devices — what
+            # load_bytes must be divided by to get the per-device footprint
+            self.stats["weight_shard_factor"] = weight_shard_factor(self.mesh)
             self.stats["family"] = self.family.name
             self.stats["load_seconds"] = round(seconds, 3)
             self.stats["load_bytes"] = total
@@ -1191,6 +1200,10 @@ class ServerSet:
         self.pool = ModelPool(
             self, hbm_budget_bytes=hbm_budget_bytes, evict_idle=evict_idle,
             allow_admin_load=allow_admin_load, staging_root=staging_root,
+            # the shared serving mesh: --hbm-budget-bytes is per-device
+            # HBM, and on a weight-sharding mesh the pool divides each
+            # model's footprint by the mesh's weight-shard factor
+            mesh=first.mesh,
         )
 
     def request_began(self) -> None:
@@ -1942,8 +1955,14 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000",
                 # ?format=prometheus; the default JSON is byte-unchanged
                 fmt = _query_param(self.path, "format")
                 if promexp.wants_prometheus(self.headers.get("Accept"), fmt):
+                    # the second rule labels the per-device HBM breakdown
+                    # (payload["device"]["devices"][i]) with device="<i>"
+                    # instead of minting one metric name per device index
                     self._text(200, promexp.render(
-                        payload, label_levels={("*",): "model"}),
+                        payload, label_levels={
+                            ("*",): "model",
+                            ("*", "devices", "*"): "device",
+                        }),
                         promexp.CONTENT_TYPE)
                 else:
                     self._json(200, payload)

@@ -132,11 +132,12 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
               help="merge a PEFT-style LoRA adapter into model NAME at load "
                    "('default' for --model-dir); repeatable")
 @click.option("--hbm-budget-bytes", default=0, type=int,
-              help="model lifecycle pool: device-memory budget — a runtime "
-                   "load whose estimated footprint (manifest/safetensors "
-                   "sizes) does not fit is refused with 507, or makes room "
-                   "by LRU-evicting idle models under --evict-idle "
-                   "(0 = unbudgeted)")
+              help="model lifecycle pool: PER-DEVICE memory budget — a "
+                   "runtime load whose estimated per-device footprint "
+                   "(manifest/safetensors sizes divided by the mesh's "
+                   "weight-shard factor: tp*ep*pp*fsdp) does not fit is "
+                   "refused with 507, or makes room by LRU-evicting idle "
+                   "models under --evict-idle (0 = unbudgeted)")
 @click.option("--evict-idle", is_flag=True,
               help="with --hbm-budget-bytes: LRU-evict READY models that "
                    "have no in-flight requests to make room for a new load "
@@ -264,6 +265,13 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
     from modelx_tpu.parallel.mesh import make_mesh
 
     shared_mesh = make_mesh(mesh) if mesh else make_mesh(f"dp={len(jax.devices())}")
+    from modelx_tpu.parallel.mesh import mesh_str, weight_shard_factor
+
+    logging.getLogger("modelx.serve").info(
+        "serving mesh %s (%d device(s), weight shard factor %d)",
+        mesh_str(shared_mesh), shared_mesh.size,
+        weight_shard_factor(shared_mesh),
+    )
     servers = {
         name: ModelServer(path, dtype=dtype, max_seq_len=max_seq_len,
                           name=name, mesh=shared_mesh, quantize=quantize,

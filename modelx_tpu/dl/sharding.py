@@ -16,9 +16,13 @@ from __future__ import annotations
 import json
 import logging
 import re
-from typing import Sequence
+from typing import TYPE_CHECKING, Sequence
 
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
+if TYPE_CHECKING:  # jax is imported lazily: the rule tables and the
+    # encode/decode/infer_family half of this module must stay importable
+    # from jax-free contexts (the client-side push annotates manifests
+    # with these rules without ever touching a device)
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 Rules = list[tuple[str, list]]
 
@@ -33,6 +37,8 @@ def decode_rules(payload: str) -> Rules:
 
 def spec_for(name: str, rules: Rules) -> PartitionSpec:
     """First-match-wins lookup of a tensor's PartitionSpec."""
+    from jax.sharding import PartitionSpec
+
     for pattern, spec in rules:
         if re.search(pattern, name):
             return PartitionSpec(*[tuple(s) if isinstance(s, list) else s for s in spec])
@@ -41,6 +47,8 @@ def spec_for(name: str, rules: Rules) -> PartitionSpec:
 
 def clean_spec(spec: PartitionSpec, mesh: Mesh) -> PartitionSpec:
     """Drop axis names the mesh doesn't have (e.g. tp rules on a dp-only mesh)."""
+    from jax.sharding import PartitionSpec
+
     cleaned = []
     for entry in spec:
         if entry is None:
@@ -54,7 +62,31 @@ def clean_spec(spec: PartitionSpec, mesh: Mesh) -> PartitionSpec:
 
 
 def sharding_for(name: str, rules: Rules, mesh: Mesh) -> NamedSharding:
+    from jax.sharding import NamedSharding
+
     return NamedSharding(mesh, clean_spec(spec_for(name, rules), mesh))
+
+
+def cache_sharding(mesh: Mesh, shape: Sequence[int], batch_dim: int = 0,
+                   head_dim: int = 2) -> NamedSharding:
+    """NamedSharding for one KV-cache leaf: slots over dp, kv heads over
+    tp — each axis applied only when the mesh has it AND its size divides
+    the dimension (GQA head counts and tiny test models routinely don't
+    divide; an indivisible dim replicates rather than erroring). Pass
+    ``batch_dim=-1`` for pooled/paged leaves whose leading dim is a global
+    page index no axis may split."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    spec: list = [None] * len(shape)
+
+    def _assign(axis: str, dim: int) -> None:
+        size = dict(mesh.shape).get(axis, 1)
+        if 0 <= dim < len(shape) and size > 1 and shape[dim] % size == 0:
+            spec[dim] = axis
+
+    _assign("dp", batch_dim)
+    _assign("tp", head_dim)
+    return NamedSharding(mesh, PartitionSpec(*spec))
 
 
 # -- default rule sets --------------------------------------------------------

@@ -80,7 +80,7 @@ def measure_once(base: str, repo: str, cache_dir: str = "",
         from modelx_tpu.dl import program_store
 
         pstats = program_store.pull_and_install(
-            client, repo, manifest, cache_dir, cache=blob_cache
+            client, repo, manifest, cache_dir, cache=blob_cache, mesh=mesh
         )
         programs_installed = pstats["installed"] + pstats["present"]
     infos: dict = {}
@@ -160,7 +160,7 @@ def measure_once(base: str, repo: str, cache_dir: str = "",
         from modelx_tpu.dl import program_store
 
         try:
-            data = program_store.build_bundle(cache_dir)
+            data = program_store.build_bundle(cache_dir, mesh=mesh)
             if data is not None:
                 program_store.publish(client.remote, repo, version, data)
                 programs_published = program_store.bundle_program_count(data)

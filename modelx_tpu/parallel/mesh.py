@@ -96,3 +96,26 @@ def make_mesh(spec: str | MeshSpec, devices=None) -> Mesh:
 def single_device_mesh() -> Mesh:
     """A 1×... mesh over one device (CPU tests / single-chip serve)."""
     return Mesh(np.array(jax.devices()[:1]).reshape((1,)), axis_names=(AXIS_BATCH,))
+
+
+def mesh_str(mesh: Mesh) -> str:
+    """Canonical ``"dp=2,tp=4"`` form of a live Mesh — the annotation /
+    env-key spelling, round-trippable through :func:`parse_mesh_spec`."""
+    return ",".join(f"{k}={v}" for k, v in dict(mesh.shape).items())
+
+
+# axes whose size divides each device's WEIGHT bytes: tensor/expert/stage
+# parallelism and ZeRO-3 all shard the parameters themselves. dp and sp
+# replicate parameters (they shard batch/sequence), so they never reduce
+# the per-device footprint.
+WEIGHT_SHARDING_AXES = (AXIS_FSDP, AXIS_STAGE, AXIS_EXPERT, AXIS_MODEL)
+
+
+def weight_shard_factor(mesh: Mesh) -> int:
+    """How many ways the mesh divides a model's weight bytes — the
+    per-device footprint divisor the HBM budget uses. A dp-only mesh
+    returns 1: every device holds the full replica."""
+    return math.prod(
+        int(size) for name, size in dict(mesh.shape).items()
+        if name in WEIGHT_SHARDING_AXES
+    )

@@ -50,6 +50,10 @@ AnnotationProgramJax = "modelx.program.jax"
 AnnotationProgramBackend = "modelx.program.backend"
 AnnotationProgramCode = "modelx.program.code"
 AnnotationProgramCount = "modelx.program.artifacts"
+# the mesh shape ("dp=2,tp=4") the bundle's programs were compiled under:
+# part of the bundle compatibility domain — a dp=1 surface must never
+# warm-install on a tp=4 pod
+AnnotationProgramMesh = "modelx.program.mesh"
 
 # --- blob location purposes (types.go:16-19) ---------------------------------
 

@@ -103,11 +103,22 @@ def raw_sample() -> dict:
     per = [_device_stats(d) for d in devices]
     if any(p is not None for p in per):
         out["source"] = "memory_stats"
-        for p in per:
+        # per-device breakdown (keyed by local device index as a string):
+        # on a sharded mesh the AGGREGATE hides exactly the failure that
+        # matters — one device's HBM filling while its peers idle — so the
+        # accountant's per-device truth rides along. promexp renders the
+        # dict as one gauge per device via a ``device`` label; the JSON
+        # surfaces keep it nested.
+        out["devices"] = {}
+        for i, p in enumerate(per):
             if p is None:
                 continue
             out["hbm_bytes_in_use"] += p["hbm_bytes_in_use"]
             out["hbm_bytes_reservable"] += p["hbm_bytes_reservable"]
+            out["devices"][str(i)] = {
+                "hbm_bytes_in_use": p["hbm_bytes_in_use"],
+                "hbm_bytes_reservable": p["hbm_bytes_reservable"],
+            }
         return out
     census = _live_buffer_bytes(jax)
     if census is not None:
@@ -123,8 +134,17 @@ def sample(max_age_s: float = 1.0) -> dict:
     now = time.monotonic()
     with _cache_lock:
         if _cached is not None and now - _cached_t < max_age_s:
-            return dict(_cached)
+            return _copy(_cached)
     fresh = raw_sample()  # outside the lock: live_buffers can be slow
     with _cache_lock:
         _cached, _cached_t = fresh, time.monotonic()
-        return dict(fresh)
+        return _copy(fresh)
+
+
+def _copy(sample_dict: dict) -> dict:
+    """Copy deep enough that a caller mutating the nested per-device dicts
+    cannot corrupt the shared cache entry."""
+    out = dict(sample_dict)
+    if isinstance(out.get("devices"), dict):
+        out["devices"] = {k: dict(v) for k, v in out["devices"].items()}
+    return out

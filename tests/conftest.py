@@ -19,6 +19,24 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: the suite compiles the same tiny-model
+# programs over and over across modules (every ModelServer fixture re-jits
+# the identical HLO), which dominates the tier-1 wall on a 1-cpu box. The
+# cache is keyed by HLO + jax version + backend, so hits are exact; set via
+# jax.config (not env) because a sitecustomize may pre-import jax before
+# this file runs. MODELX_TEST_NO_COMPILE_CACHE=1 opts out.
+if not os.environ.get("MODELX_TEST_NO_COMPILE_CACHE"):
+    import tempfile
+
+    _cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(tempfile.gettempdir(), "modelx-jax-test-cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    except Exception:  # older jax without the knobs: run uncached
+        pass
+
 # lockdep rides every run as a plugin but only instruments when
 # MODELX_LOCKDEP=1 (make chaos) — see modelx_tpu/analysis/lockdep.py
 pytest_plugins = ["modelx_tpu.analysis.pytest_lockdep"]

@@ -412,6 +412,8 @@ class TestContinuousPrefixCache:
 
         assert lens == {n: pad_seq_len(n) for n in lens}
 
+    # tier-1 wall (ISSUE 16): second_turn_matches_plain keeps the prefix-cache oracle tier-1
+    @pytest.mark.slow
     def test_oversize_prefix_falls_back_to_full_prefill(self, server):
         """A stored bucket + suffix bucket that exceeds max_len must
         full-prefill (correctness over reuse) and count as a MISS."""
@@ -483,6 +485,8 @@ class TestBatchedAdmission:
         finally:
             cb.close()
 
+    # tier-1 wall (ISSUE 16): mixed_buckets + multirow keep batched admission tier-1
+    @pytest.mark.slow
     def test_small_burst_pads_to_pow2_not_max_slots(self, server):
         """A 2-row burst on a max_slots=8 engine must prefill a [2, Sb]
         block, not [8, Sb] — the batched-admit program pads to the next
@@ -669,6 +673,8 @@ class TestChunkedPrefill:
         np.testing.assert_array_equal(got, expected)
         assert engine.stats["prefill_pieces"] - before == 3
 
+    # tier-1 wall (ISSUE 16): long_greedy keeps chunked prefill tier-1
+    @pytest.mark.slow
     def test_long_sampled_matches_ragged(self, server, engine):
         """Same (seed, step) streams: the flip piece's first token is
         step 0 of the row's stream, like single-program admission."""

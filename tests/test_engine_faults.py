@@ -620,6 +620,8 @@ class TestObservabilitySurface:
             headers=hdr).json()["m"]["events"]
         assert none == []
 
+    # tier-1 wall (ISSUE 16): `make obs` runs this class unfiltered
+    @pytest.mark.slow
     def test_admin_profile_capture_roundtrip(self, front):
         sset, base = front
         hdr = {"Authorization": "Bearer sekrit"}

@@ -93,6 +93,8 @@ class TestPipelineForward:
 
 
 class TestPipelineTrain:
+    # tier-1 wall (ISSUE 16): test_model::TestTrainStep keeps the loss-decreases oracle tier-1
+    @pytest.mark.slow
     def test_train_step_decreases_loss(self):
         cfg = _tiny_fp32(num_layers=2)
         params = llama.init_params(cfg, jax.random.PRNGKey(2))

@@ -82,6 +82,8 @@ class TestPipelinedExactness:
         assert pipe_engine.stats["dispatch_depth_max"] > 1
         assert pipe_engine.stats["dispatches"] < pipe_engine.stats["chunks"]
 
+    # tier-1 wall (ISSUE 16): greedy keeps pipelined exactness tier-1
+    @pytest.mark.slow
     def test_sampled_matches_serial_and_plain(self, server, serial_engine,
                                               pipe_engine):
         """(seed, step) streams are dispatch-schedule-invariant: the same

@@ -150,6 +150,8 @@ class TestSuffixPrefill:
         assert max(prefill_widths[:1]) == 48
         assert prefill_widths[-1] == 16
 
+    # tier-1 wall (ISSUE 16): second_turn_matches_uncached_exactly keeps suffix prefill tier-1
+    @pytest.mark.slow
     def test_suffix_write_span_never_overflows_cache(self, model):
         """Regression: plen 31 + suffix bucket 16 = 47 > the naive
         cache_len of 32+8+1 = 41 — an undersized cache would make the
@@ -166,6 +168,8 @@ class TestSuffixPrefill:
         assert warm.prefix_cache.hits == 1
         assert got == expect
 
+    # tier-1 wall (ISSUE 16): second_turn_matches_uncached_exactly keeps suffix prefill tier-1
+    @pytest.mark.slow
     def test_growing_conversation_keeps_hitting(self, model):
         params, cfg, fwd, init = model
         dec = ChunkedDecoder(fwd, init, 4, prefix_cache=PrefixKVCache(4))

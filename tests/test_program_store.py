@@ -206,6 +206,64 @@ class TestBundleHardening:
         assert total["reasons"]  # the junk one logged, not raised
 
 
+class TestBundleMeshSkew:
+    """ISSUE 16: exported programs bake their GSPMD partitioning in, so
+    the bundle compatibility domain includes the mesh shape — a dp=1
+    surface must never warm-install a tp=4 pod's programs."""
+
+    def test_env_key_includes_mesh(self):
+        assert ps.env_key("dp=1") != ps.env_key("dp=2,tp=4")
+        assert ps.env_key("dp=2,tp=4") == ps.env_key("dp=2,tp=4")
+        assert ps.bundle_name("dp=2,tp=4") == \
+            f".programs-{ps.env_key('dp=2,tp=4')}.tar"
+        assert ps.bundle_name("dp=2,tp=4") != ps.bundle_name("dp=1")
+
+    def test_env_key_accepts_live_mesh(self):
+        from modelx_tpu.parallel.mesh import make_mesh
+
+        assert ps.env_key(make_mesh("dp=2,tp=2")) == ps.env_key("dp=2,tp=2")
+
+    def test_mesh_skew_skips_wholesale(self, tmp_path):
+        src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+        fill_cache(src)
+        data = ps.build_bundle(src, mesh="dp=2,tp=4")
+        stats = ps.install_bundle(data, dst, mesh="dp=1")
+        assert stats["installed"] == 0 and stats["skipped"] == 2
+        assert any("mesh skew" in r for r in stats["reasons"])
+        assert not os.path.exists(dst) or not os.listdir(dst)
+
+    def test_same_mesh_installs(self, tmp_path):
+        src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+        fill_cache(src)
+        data = ps.build_bundle(src, mesh="dp=2,tp=4")
+        stats = ps.install_bundle(data, dst, mesh="dp=2,tp=4")
+        assert stats["installed"] == 2 and stats["skipped"] == 0
+
+    def test_legacy_bundle_without_mesh_key_installs(self, tmp_path):
+        """A pre-mesh bundle (no "mesh" in meta.json) carries no claim
+        about topology and installs exactly as before the upgrade."""
+        src, dst = str(tmp_path / "src"), str(tmp_path / "dst")
+        fill_cache(src)
+        data = ps.build_bundle(src)
+        out = io.BytesIO()
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r:") as tar, \
+                tarfile.open(fileobj=out, mode="w",
+                             format=tarfile.USTAR_FORMAT) as rewrit:
+            for m in tar.getmembers():
+                blob = tar.extractfile(m).read()
+                if m.name == ps.META_MEMBER:
+                    meta = json.loads(blob)
+                    meta.pop("mesh", None)
+                    blob = json.dumps(meta, sort_keys=True,
+                                      separators=(",", ":")).encode()
+                info = tarfile.TarInfo(m.name)
+                info.size = len(blob)
+                rewrit.addfile(info, io.BytesIO(blob))
+        stats = ps.install_bundle(out.getvalue(), dst, mesh="dp=2,tp=4")
+        assert stats["installed"] == 2
+        assert not any("mesh" in r for r in stats["reasons"])
+
+
 # --- registry round-trip ------------------------------------------------------
 
 
@@ -298,6 +356,40 @@ class TestRegistry:
         assert stats["installed"] == 0
         assert any("version skew" in r for r in stats["reasons"])
         assert not fetches  # no bytes spent on a bundle we cannot use
+
+    def test_mesh_annotation_skips_without_fetching(self, pushed, tmp_path,
+                                                    monkeypatch):
+        """The manifest annotation alone decides mesh skew — no blob bytes
+        move for a bundle built for another topology."""
+        base, store, client = pushed
+        d = str(tmp_path / "meshcache")
+        fill_cache(d)
+        desc = ps.publish(client.remote, REPO, "v1",
+                          ps.build_bundle(d, mesh="dp=2,tp=4"))
+        assert desc.name == ps.bundle_name("dp=2,tp=4")
+        assert desc.annotations["modelx.program.mesh"] == "dp=2,tp=4"
+        manifest = client.get_manifest(REPO, "v1")
+        fetches = []
+        monkeypatch.setattr(
+            client.remote, "get_blob_content",
+            lambda *a, **k: fetches.append(a) or iter(()),
+        )
+        stats = ps.pull_and_install(client, REPO, manifest, "/nonexistent",
+                                    mesh="dp=1")
+        assert stats["installed"] == 0
+        assert any("mesh skew" in r for r in stats["reasons"])
+        assert not fetches
+
+    def test_pull_same_mesh_installs(self, pushed, tmp_path):
+        base, store, client = pushed
+        d = str(tmp_path / "meshcache")
+        fill_cache(d)
+        ps.publish(client.remote, REPO, "v1",
+                   ps.build_bundle(d, mesh="dp=2,tp=4"))
+        manifest = client.get_manifest(REPO, "v1")
+        stats = ps.pull_and_install(client, REPO, manifest,
+                                    str(tmp_path / "c1"), mesh="dp=2,tp=4")
+        assert stats["installed"] == 2 and stats["bundles"] == 1
 
     def test_gc_keeps_referenced_collects_pruned(self, pushed, bundle):
         from modelx_tpu.registry.gc import gc_blobs
@@ -455,11 +547,13 @@ def make_warm_model_dir(tiny_dir: str, tmp_path) -> tuple[str, str]:
     pub_cache = str(tmp_path / "pub-cache")
     keys = export_warmup_surface(tiny_dir, pub_cache)
     assert keys, "warmup export produced no programs"
-    data = ps.build_bundle(pub_cache)
+    # Label the bundle with the mesh the programs were exported on
+    # (dp=1), not the process default (dp=8 under the forced backend).
+    data = ps.build_bundle(pub_cache, mesh="dp=1")
     model_dir = str(tmp_path / "warm-model")
     os.makedirs(model_dir)
     shutil.copy(os.path.join(tiny_dir, "model.safetensors"), model_dir)
-    with open(os.path.join(model_dir, ps.bundle_name()), "wb") as f:
+    with open(os.path.join(model_dir, ps.bundle_name("dp=1")), "wb") as f:
         f.write(data)
     return model_dir, pub_cache
 

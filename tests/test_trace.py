@@ -111,6 +111,8 @@ class TestIntegration:
         (s,) = tracer().spans("dl.load")
         assert s["tensors"] == 1 and s["bytes_to_device"] == 16
 
+    # tier-1 wall (ISSUE 16): failure-path profile drill; `make slow` is the home
+    @pytest.mark.slow
     def test_jax_profile_noop_on_failure(self, tmp_path):
         # an unwritable dir must not raise out of the context manager
         with jax_profile(str(tmp_path / "trace")):

@@ -37,6 +37,8 @@ class TestTrainCLI:
                     "--log-every", "1")
         assert out2["steps"] == 7
 
+    # tier-1 wall (ISSUE 16): the jsonl leg keeps the train CLI tier-1
+    @pytest.mark.slow
     def test_npy_data_and_push(self, tmp_path):
         srv = RegistryServer(
             Options(listen=f"127.0.0.1:{free_port()}"),
