@@ -5,7 +5,7 @@ PY ?= python
 IMAGE ?= modelx-tpu
 TAG ?= $(shell git describe --tags --always 2>/dev/null || echo dev)
 
-.PHONY: all native test chaos slow lifecycle fleet overload programs continuation obs mesh lint wheel image image-dl compose-up compose-down clean
+.PHONY: all native test chaos slow lifecycle fleet overload programs continuation obs mesh decode lint wheel image image-dl compose-up compose-down clean
 
 all: native lint test wheel
 
@@ -100,6 +100,16 @@ mesh:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_program_store.py -q -k mesh
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_sharding_mesh.py -q -m slow
 	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_engine_faults.py -q -m chaos
+
+# decode-path drills (ISSUE 17): fused-sampler byte-identity + ragged
+# paged-sweep exactness (tier-1 grid and the wider slow resume matrix),
+# the paged-KV op suite, the pipelined/bench legs that carry the
+# sampled-client mix — under runtime lockdep, since the engine's
+# dispatch loop owns the lock order the sampled traffic exercises
+decode:
+	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_sampling_fused.py tests/test_paged_kv.py -q -m "not slow"
+	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_sampling_fused.py tests/test_pipelined.py -q -m slow
+	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest "tests/test_bench_smoke.py::TestPipelinedLeg" -q -m slow
 
 # two layers: the project-native concurrency/purity gate (always — it is
 # stdlib-only and baseline-governed, see docs/analysis.md), then generic

@@ -881,6 +881,61 @@ def measure_sharded_serving(ckpt_dir: str, env=None,
     }
 
 
+def _sampling_microbench(rows: int, vocab: int, reps: int = 40) -> dict:
+    """Per-step sampling cost at the engine's [rows, vocab] logits shape:
+    the fused top-k prefix path (``sampling_ms_*``) vs the same filters
+    forced through the full-vocab sort (``sampling_sort_ms_p50``,
+    ``k_cap=None``) — the direct price ISSUE 17's tentpole removes from
+    every sampled decode step."""
+    import jax
+    import jax.numpy as jnp
+
+    from modelx_tpu.ops import sampling as sampling_ops
+
+    key = jax.random.PRNGKey(0)
+    temp = jnp.full((rows,), 0.8, jnp.float32)
+    tk = jnp.full((rows,), 40, jnp.int32)
+    tp = jnp.full((rows,), 0.95, jnp.float32)
+    seeds = jnp.arange(rows, dtype=jnp.int32)
+
+    def _fused(lg, step):
+        return sampling_ops.sample(lg, key, temp, tk, tp,
+                                   seeds=seeds, step=step)
+
+    def _sorted(lg, step):
+        filt = sampling_ops.scale_and_filter_reference(
+            lg, temp, tk, tp, k_cap=None)
+        steps = jnp.broadcast_to(jnp.asarray(step, jnp.int32), (rows,))
+        keys = jax.vmap(lambda s, st: jax.random.fold_in(
+            jax.random.fold_in(key, s), st))(seeds, steps)
+        return jax.vmap(jax.random.categorical)(keys, filt)
+
+    fused = jax.jit(_fused)
+    sortp = jax.jit(_sorted)
+    logits = [
+        jax.random.normal(jax.random.fold_in(key, i), (rows, vocab),
+                          jnp.float32) * 3.0
+        for i in range(4)
+    ]
+
+    def timed(fn) -> list[float]:
+        jax.block_until_ready(fn(logits[0], 0))  # compile outside the clock
+        ms = []
+        for i in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(logits[i % len(logits)], i))
+            ms.append((time.perf_counter() - t0) * 1e3)
+        return ms
+
+    f_ms = np.asarray(timed(fused))
+    s_ms = np.asarray(timed(sortp))
+    return {
+        "sampling_ms_p50": round(float(np.percentile(f_ms, 50)), 4),
+        "sampling_ms_p99": round(float(np.percentile(f_ms, 99)), 4),
+        "sampling_sort_ms_p50": round(float(np.percentile(s_ms, 50)), 4),
+    }
+
+
 def measure_decode_pipelined(params, mesh, decode_tps: float | None, *,
                              clients: int = 8, chunk: int = 16,
                              new_tokens: int = 192, prompt_len: int = 64,
@@ -899,7 +954,17 @@ def measure_decode_pipelined(params, mesh, decode_tps: float | None, *,
     one dispatch across D chunks, so the pipelined number should drop
     ~Dx (acceptance: >= 3x on the bench rig). ``dispatches_serial`` /
     ``dispatches_pipelined`` carry the structural evidence (fewer device
-    calls for the same tokens) independent of timing noise."""
+    calls for the same tokens) independent of timing noise.
+
+    ISSUE 17 adds a SAMPLED leg: the same dispatch-ahead engine under a
+    mixed client population (every other client samples at temperature
+    0.8 / top_k 40 / top_p 0.95 — cuts that resolve inside the fused
+    sampler's K_CAP prefix). Before the fused path, sampled rows paid a
+    full-vocab sort per token; ``sampled_vs_greedy_decode_ratio`` is the
+    acceptance signal (>= 0.9: sampling within 10% of greedy), with
+    ``sampling_ms_p50/p99`` (fused) vs ``sampling_sort_ms_p50`` (forced
+    sort path) microbenched at the engine's [clients, vocab] shape, and
+    ``pad_fraction`` read off the engine's dispatch accounting."""
     import threading as _t
     from concurrent.futures import ThreadPoolExecutor
 
@@ -912,8 +977,12 @@ def measure_decode_pipelined(params, mesh, decode_tps: float | None, *,
         rng.randint(1, cfg.vocab_size, (1, prompt_len)).astype(np.int32)
         for _ in range(clients + 1)
     ]
+    # the sampled leg's non-greedy client kwargs: cuts inside K_CAP, a
+    # per-client seed so streams are independent
+    samp_kw = {"temperature": 0.8, "top_k": 40, "top_p": 0.95}
 
-    def run(pipeline_depth: int, dispatch_depth: int) -> dict:
+    def run(pipeline_depth: int, dispatch_depth: int,
+            sampled: bool = False) -> dict:
         cb = ContinuousBatcher(shim, max_slots=clients, chunk_size=chunk,
                                max_len=max_len, burst_window_ms=5.0,
                                pipeline_depth=pipeline_depth,
@@ -938,7 +1007,16 @@ def measure_decode_pipelined(params, mesh, decode_tps: float | None, *,
             while d <= (dispatch_depth or cb.AUTO_DISPATCH_DEPTH):
                 cb.generate(prompts[-1],
                             max_new_tokens=(pipeline_depth + d) * chunk)
+                if sampled:
+                    # the filtered chunk-program variant compiles per
+                    # depth rung too — warm it so the measured phase's
+                    # mixed batches never compile
+                    cb.generate(prompts[-1], seed=9,
+                                max_new_tokens=(pipeline_depth + d) * chunk,
+                                **samp_kw)
                 d *= 2
+            if sampled:
+                cb.generate(prompts[-1], max_new_tokens=8, seed=9, **samp_kw)
             # the warmup's compiles landed in the boundary histogram and
             # the max/peak counters: reset so the reported observability
             # numbers describe the MEASURED phase only
@@ -952,7 +1030,8 @@ def measure_decode_pipelined(params, mesh, decode_tps: float | None, *,
 
             def client(i: int) -> int:
                 start.wait()
-                out = cb.generate(prompts[i], max_new_tokens=new_tokens)
+                kw = dict(seed=100 + i, **samp_kw) if sampled and i % 2 else {}
+                out = cb.generate(prompts[i], max_new_tokens=new_tokens, **kw)
                 return out.shape[1] - prompts[i].shape[1]
 
             t0 = time.monotonic()
@@ -968,6 +1047,7 @@ def measure_decode_pipelined(params, mesh, decode_tps: float | None, *,
 
     serial = run(1, 1)
     pipe = run(2, 0)
+    samp = run(2, 0, sampled=True)
 
     def overhead_ms(rec: dict) -> float | None:
         if not decode_tps:
@@ -979,6 +1059,7 @@ def measure_decode_pipelined(params, mesh, decode_tps: float | None, *,
 
     o_serial, o_pipe = overhead_ms(serial), overhead_ms(pipe)
     agg_pipe = pipe["tokens"] / pipe["wall"]
+    agg_samp = samp["tokens"] / samp["wall"]
     out = {
         "pipelined_clients": clients,
         "pipelined_chunk_size": chunk,
@@ -999,7 +1080,22 @@ def measure_decode_pipelined(params, mesh, decode_tps: float | None, *,
         "pipelined_tokens_in_flight_peak": pipe["snap"].get("tokens_in_flight_peak"),
         "pipelined_host_syncs_per_boundary": pipe["snap"].get("host_syncs_per_boundary"),
         "pipelined_sync_lag_chunks_max": pipe["snap"].get("sync_lag_chunks_max"),
+        # sampled leg (ISSUE 17): mixed greedy/sampled clients through the
+        # fused on-device sampler — the ratio to the all-greedy run is the
+        # acceptance signal (sampled rows used to pay a full-vocab sort)
+        "sampled_agg_tokens_per_s": round(agg_samp, 1),
+        "continuous_vs_batch_decode_sampled": (
+            round(agg_samp / decode_tps, 3) if decode_tps else None
+        ),
+        "sampled_vs_greedy_decode_ratio": (
+            round(agg_samp / agg_pipe, 3) if agg_pipe else None
+        ),
+        # padding tax, read off the engine's dispatch accounting (the
+        # sampled run's snapshot — identical traffic shape to pipe)
+        "pad_fraction": samp["snap"].get("pad_fraction"),
+        "pages_swept_fraction": samp["snap"].get("pages_swept_fraction"),
     }
+    out.update(_sampling_microbench(clients, int(cfg.vocab_size)))
     if o_serial is not None and o_pipe is not None:
         # o_pipe can legitimately clamp to 0.0 (pipelined wall under the
         # device-time estimate — the best possible outcome); floor + cap
@@ -2525,6 +2621,17 @@ def tiny_main() -> int:
         # forced-host dp=2,tp=2 mesh vs the dp=1 baseline — per-device
         # ratio passes >= 0.7, and the dp=1 engine must stay byte-exact
         out.update(measure_sharded_serving(workdir))
+
+        # fused-sampling decode leg (ISSUE 17): mixed sampled/greedy
+        # clients through the fused on-device sampler vs the all-greedy
+        # baseline (sampled_vs_greedy_decode_ratio), the sampling
+        # microbench at the engine's logits shape (sampling_ms_p50/p99
+        # vs sampling_sort_ms_p50), and the pad-fraction accounting
+        from modelx_tpu.parallel.mesh import make_mesh
+
+        out.update(measure_decode_pipelined(
+            params, make_mesh("dp=1"), None, clients=3, chunk=4,
+            new_tokens=24, prompt_len=8, max_len=96))
 
         # --- compiled-program registry (ISSUE 11), CPU proxy ---
         # bench-shaped small checkpoint, not LlamaConfig.tiny: the ratio

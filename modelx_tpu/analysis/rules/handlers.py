@@ -51,6 +51,8 @@ _SERVER_PATH_FILES = (
     "modelx_tpu/dl/serve_main.py",
     "modelx_tpu/dl/openai_api.py",
     "modelx_tpu/dl/continuous.py",
+    "modelx_tpu/ops/sampling.py",
+    "modelx_tpu/ops/paged_attention.py",
     "modelx_tpu/dl/lifecycle.py",
     "modelx_tpu/dl/program_store.py",
     "modelx_tpu/dl/loader.py",

@@ -616,7 +616,14 @@ class ContinuousBatcher:
                       # and the high-water planned-but-undelivered tokens
                       "dispatches": 0, "dispatch_depth_max": 1,
                       "host_syncs_per_boundary": 0,
-                      "tokens_in_flight_peak": 0, "sync_lag_chunks_max": 0}
+                      "tokens_in_flight_peak": 0, "sync_lag_chunks_max": 0,
+                      # pad accounting (ISSUE 17): every dispatched decode
+                      # program computes max_slots rows regardless of how
+                      # many are live — decode_pad_rows / decode_rows is
+                      # the row-padding tax snapshot() exposes as
+                      # pad_fraction (admit_pad_rows covers the admit-side
+                      # pow2 burst rounding separately)
+                      "decode_rows": 0, "decode_pad_rows": 0}
         # per-request latency histograms (ISSUE 13): fed at first-token
         # delivery from the ticket's phase stamps; snapshot() exposes them
         # once populated and the Prometheus exposition renders them as
@@ -2040,6 +2047,29 @@ class ContinuousBatcher:
         self._tok_host = None  # the in-flight program advances tok
         self.stats["chunks"] += depth
         self.stats["dispatches"] += 1
+        # pad accounting: live rows (decoding + filling) vs the program's
+        # static max_slots row dimension, weighted by chunk-equivalents
+        n_live = len(self._rows) + len(self._filling)
+        self.stats["decode_rows"] += self.max_slots * depth
+        self.stats["decode_pad_rows"] += (
+            max(self.max_slots - n_live, 0) * depth
+        )
+        if self.page_size > 0 and self._table is not None:
+            # ragged paged sweep: the in-place kernel stops at the batch's
+            # actual max page (ops/paged_attention), so the interesting
+            # number is how much of the static table width a dispatch
+            # really walks — pages_swept / pages_swept_possible
+            pps = int(self._table.shape[1])
+            blocks = int(
+                min(pps, (int(self._offsets.max()) + n_steps)
+                    // self.page_size + 1)
+            )
+            self.stats["pages_swept"] = (
+                self.stats.get("pages_swept", 0) + blocks
+            )
+            self.stats["pages_swept_possible"] = (
+                self.stats.get("pages_swept_possible", 0) + pps
+            )
         self._depth_last = depth
         if depth > self.stats["dispatch_depth_max"]:
             self.stats["dispatch_depth_max"] = depth
@@ -2736,6 +2766,19 @@ class ContinuousBatcher:
             snap["boundary_host_ms_p50"] = round(float(np.percentile(hist, 50)), 3)
             snap["boundary_host_ms_p99"] = round(float(np.percentile(hist, 99)), 3)
             snap["boundary_host_ms_count"] = int(hist.size)
+        # padding tax (ISSUE 17): fraction of dispatched decode row-chunks
+        # that carried no live request, plus — paged in-place mode — how
+        # much of the static page-table width the ragged sweep actually
+        # walked (1.0 would mean the pow2 bucket was always full)
+        if self.stats.get("decode_rows"):
+            snap["pad_fraction"] = round(
+                self.stats["decode_pad_rows"] / self.stats["decode_rows"], 4
+            )
+        if self.stats.get("pages_swept_possible"):
+            snap["pages_swept_fraction"] = round(
+                self.stats["pages_swept"]
+                / self.stats["pages_swept_possible"], 4
+            )
         # per-request latency histograms (ISSUE 13): present once a first
         # token delivered — the gate mirrors boundary_host_ms_*, so an
         # idle engine's snapshot keeps its pre-PR shape

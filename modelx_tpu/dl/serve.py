@@ -775,12 +775,17 @@ class ModelServer:
         padded[:, :s] = tokens_arr
         with trace.span("serve.generate_stream", model=self.name,
                         new_tokens=max_new_tokens):
+            # unfiltered requests (top_k 0, top_p off) pass None so the
+            # decoder's sampler variant compiles without any filter work —
+            # with filters off the mask is all-True, so tokens are
+            # byte-identical between the two variants
+            filtered = top_k > 0 or top_p < 1.0
             for piece in dec.stream(
                 self.params, jnp.asarray(padded), np.full((b,), s, np.int32),
                 max_new_tokens,
                 temperature=np.full((b,), temperature, np.float32),
-                top_k=np.full((b,), top_k, np.int32),
-                top_p=np.full((b,), top_p, np.float32),
+                top_k=np.full((b,), top_k, np.int32) if filtered else None,
+                top_p=np.full((b,), top_p, np.float32) if filtered else None,
                 seeds=((seed + np.arange(b)) % (2**31)).astype(np.int32),
                 stop_token_ids=stop_token_ids,
             ):

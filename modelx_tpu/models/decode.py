@@ -358,8 +358,12 @@ class ChunkedDecoder:
             jnp.zeros((b,), jnp.float32) if temperature is None
             else jnp.asarray(temperature, jnp.float32)
         )
-        top_k = jnp.zeros((b,), jnp.int32) if top_k is None else jnp.asarray(top_k, jnp.int32)
-        top_p = jnp.ones((b,), jnp.float32) if top_p is None else jnp.asarray(top_p, jnp.float32)
+        # None filters stay None (an empty pytree to jit, so the filtered
+        # and unfiltered streams are separate compiled variants): the
+        # sampler then skips ALL per-step filter work — before ISSUE 17's
+        # fused path that was a full [B, V] sort per greedy token
+        top_k = None if top_k is None else jnp.asarray(top_k, jnp.int32)
+        top_p = None if top_p is None else jnp.asarray(top_p, jnp.float32)
         seeds = jnp.zeros((b,), jnp.int32) if seeds is None else jnp.asarray(seeds, jnp.int32)
         # cache sized for whole chunks, rounded up to a power of two of them:
         # every distinct cache length compiles a fresh program pair, so the

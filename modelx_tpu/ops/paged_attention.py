@@ -9,10 +9,15 @@ attention reads the page pool DIRECTLY, one page block at a time, with the
 flash-attention accumulation (running max / normalizer), so the per-step
 transient is one [slots, page_size] block instead of [slots, max_len].
 
-Built on ``lax.scan`` + gathers rather than a hand-written pallas kernel:
-the loop body is three einsums over a page block — XLA schedules that fine
-on TPU and identically on CPU (where the engine's exactness tests run); a
-pallas kernel would add MXU-tile control, not a different memory story.
+Built on ``lax.fori_loop`` + gathers rather than a hand-written pallas
+kernel: the loop body is three einsums over a page block — XLA schedules
+that fine on TPU and identically on CPU (where the engine's exactness
+tests run); a pallas kernel would add MXU-tile control, not a different
+memory story. The loop bound is RAGGED (ISSUE 17): it stops at the
+batch's actual max page, ``ceil(max(lengths) / page_size)``, instead of
+the table's static pow2 width, so short batches stop paying attention
+work for pages nobody has reached — bit-exactly, since a fully-masked
+block's online-softmax update is the identity.
 
 Numerics: the blockwise accumulation is algebraically the softmax but not
 bit-identical to a full-width softmax (different reduction order) — same
@@ -74,9 +79,9 @@ def paged_attention(
         scale = 1.0 / math.sqrt(d)
     qg = (q.astype(jnp.float32) * jnp.float32(scale)).reshape(s, hkv, rep, d)
 
-    def body(carry, j):
+    def body(j, carry):
         m, l, acc = carry
-        pids = table[:, j]                       # [S]
+        pids = jax.lax.dynamic_index_in_dim(table, j, axis=1, keepdims=False)
         kb = pool_k[pids].astype(jnp.float32)    # [S, ps, Hkv, D]
         vb = pool_v[pids].astype(jnp.float32)
         scores = jnp.einsum("skrd,spkd->skrp", qg, kb)  # [S, Hkv, rep, ps]
@@ -94,14 +99,22 @@ def paged_attention(
         p = jnp.exp(scores - m_new[..., None]) * mask[:, None, None, :]
         l = l * corr + p.sum(axis=-1)
         acc = acc * corr[..., None] + jnp.einsum("skrp,spkd->skrd", p, vb)
-        return (m_new, l, acc), None
+        return (m_new, l, acc)
 
+    # Ragged block count: iterate to the batch's ACTUAL max page, not the
+    # table's static width (the pow2 bucket). Skipping trailing blocks is
+    # bit-exact, not approximate: every slot has >= 1 valid position in
+    # block 0, so a fully-masked block's update is the identity
+    # (m_new = m, corr = exp(0) = 1, p = exp(..) * 0 = 0).
     pages_per_slot = table.shape[1]
+    n_blocks = jnp.clip(
+        (jnp.max(lengths) + ps - 1) // ps, 1, pages_per_slot
+    ).astype(jnp.int32)
     init = (
         jnp.full((s, hkv, rep), NEG_INF, jnp.float32),
         jnp.zeros((s, hkv, rep), jnp.float32),
         jnp.zeros((s, hkv, rep, d), jnp.float32),
     )
-    (m, l, acc), _ = jax.lax.scan(body, init, jnp.arange(pages_per_slot))
+    m, l, acc = jax.lax.fori_loop(0, n_blocks, body, init)
     out = acc / l[..., None]
     return out.reshape(s, hq, d).astype(q.dtype)

@@ -10,7 +10,23 @@ row 2 uses top_k 40. Per-row semantics:
 - ``top_p >= 1``         -> no nucleus cut.
 
 Everything is ``vmap``/``lax``-friendly: no data-dependent shapes, the
-row's filters reduce to thresholds gathered from a sorted copy.
+row's filters reduce to thresholds gathered from a descending prefix.
+
+Fused path (ISSUE 17): production k / nucleus cuts almost always resolve
+inside a small static prefix, so the hot path computes thresholds from
+``jax.lax.top_k(scaled, K_CAP)`` — O(B·V) selection instead of the
+O(B·V·log V) full-vocab sort — and a whole-batch ``lax.cond`` falls back
+to the sort only when some row's cut overflows the cap. Bit-identity
+between the two branches is by construction, not luck: both read their
+thresholds off the SAME [B, K_CAP] prefix tensors (top_k values are
+bit-equal to a descending sort's first K_CAP columns — both are exact
+selections of the same multiset), the softmax max/denominator are
+computed once over the full unsorted row (one fixed reduction order), and
+the nucleus cumsum runs at width K_CAP in both branches for rows that fit
+(cumsum prefixes are NOT width-stable under XLA's log-depth scan, so the
+fallback may only use its full-width cumsum for rows that overflowed).
+``scale_and_filter_reference`` exposes the always-sort branch so property
+tests can assert byte-equality rather than hope for it.
 """
 
 from __future__ import annotations
@@ -18,7 +34,66 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+# Legacy sentinel (speculative sampling strikes proposed tokens out with
+# it). The filter masks themselves are dtype-aware — see mask_value().
 NEG_INF = -1e30
+
+# Static prefix width for the fused threshold path. Any row with
+# 0 < top_k <= K_CAP and a nucleus cut inside the first K_CAP sorted
+# probs resolves without sorting the vocab.
+K_CAP = 64
+
+
+def mask_value(dtype) -> jnp.ndarray:
+    """Most-negative FINITE value of ``dtype``, the fill for filtered-out
+    logits. A hard-coded -1e30 overflows fp16 (max 65504) to -inf, and
+    -inf logits turn downstream max/softmax arithmetic into NaN
+    factories; finfo-min stays finite in every float dtype."""
+    return jnp.asarray(jnp.finfo(jnp.dtype(dtype)).min, dtype)
+
+
+def _prefix_keep(scaled, prefix, top_k, top_p):
+    """Keep-mask for ``scaled`` [B, V] from a DESCENDING prefix [B, W] of
+    each row (W == V for the full-sort path). Returns ``(keep, fits)``
+    where ``fits[b]`` says row b's active filters resolved inside the
+    prefix — a prefix decision for a non-fitting row is garbage and the
+    caller must replace it with a full-width one."""
+    b, v = scaled.shape
+    w = prefix.shape[1]
+    keep = jnp.ones_like(scaled, bool)
+    fits = jnp.ones((b,), bool)
+    if top_k is not None:
+        # top-k: keep logits >= the k-th largest (per-row k)
+        k = jnp.clip(jnp.asarray(top_k, jnp.int32), 0, v)
+        k_idx = jnp.clip(k - 1, 0, w - 1)[:, None]
+        kth = jnp.take_along_axis(prefix, k_idx, axis=1)  # [B,1]
+        keep &= jnp.where(k[:, None] > 0, scaled >= kth, True)
+        fits &= (k == 0) | (k <= w)
+    if top_p is not None:
+        # top-p (nucleus): smallest prefix of the sorted distribution
+        # with cumulative probability >= p; keep logits >= its last
+        # member's value. Softmax stats come from the full unsorted row
+        # (max is an exact selection, the denominator has ONE reduction
+        # order) so every prefix width sees identical probs.
+        p = jnp.asarray(top_p, scaled.dtype)[:, None]
+        m = prefix[:, :1]  # row max — exact, width-independent
+        denom = jnp.sum(jnp.exp(scaled - m), axis=-1, keepdims=True)
+        probs = jnp.exp(prefix - m) / denom  # [B, W]
+        cum = jnp.cumsum(probs, axis=-1)
+        # prefix including the item that crosses p
+        in_nucleus = cum - probs < p
+        cut_idx = jnp.maximum(jnp.sum(in_nucleus, axis=-1) - 1, 0)[:, None]
+        pth = jnp.take_along_axis(prefix, cut_idx, axis=1)
+        keep &= jnp.where(p < 1.0, scaled >= pth, True)
+        # the cut lands inside the prefix iff the prefix holds >= p mass
+        fits &= (p[:, 0] >= 1.0) | (cum[:, -1] >= p[:, 0])
+    return keep, fits
+
+
+def _scaled(logits, temperature):
+    temperature = jnp.asarray(temperature, logits.dtype)
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    return logits / safe_t[:, None]
 
 
 def scale_and_filter(
@@ -26,40 +101,72 @@ def scale_and_filter(
     temperature: jax.Array,  # [B] float; <=0 rows pass through at scale 1
     top_k: jax.Array | None = None,  # [B] int32; 0 = off; None = skip filter
     top_p: jax.Array | None = None,  # [B] float; >=1 = off; None = skip filter
+    *,
+    k_cap: int | None = K_CAP,  # static fused-prefix width; None = always sort
 ) -> jax.Array:
     """Temperature-scaled, top-k/top-p-filtered logits — softmax of the
     result IS the distribution ``sample`` draws from. Exposed separately so
     speculative sampling's acceptance rule (models/speculative.py) verifies
-    against byte-identical target distributions."""
-    b, v = logits.shape
-    temperature = jnp.asarray(temperature, logits.dtype)
-    safe_t = jnp.where(temperature > 0, temperature, 1.0)
-    scaled = logits / safe_t[:, None]
+    against byte-identical target distributions.
 
+    When every row's cut fits inside ``k_cap`` the thresholds come from a
+    ``lax.top_k`` prefix and the full-vocab sort never runs; otherwise a
+    whole-batch ``lax.cond`` takes the sort branch, which is byte-identical
+    on fitting rows (see module docstring)."""
+    b, v = logits.shape
+    scaled = _scaled(logits, temperature)
     if top_k is None and top_p is None:
         return scaled
-    # one descending sort serves both filters
-    sorted_logits = -jnp.sort(-scaled, axis=-1)  # [B, V] desc
-    keep = jnp.ones_like(scaled, bool)
-    if top_k is not None:
-        # top-k: keep logits >= the k-th largest (per-row k)
-        k = jnp.clip(jnp.asarray(top_k, jnp.int32), 0, v)
-        k_idx = jnp.clip(k - 1, 0, v - 1)[:, None]
-        kth = jnp.take_along_axis(sorted_logits, k_idx, axis=1)  # [B,1]
-        keep &= jnp.where(k[:, None] > 0, scaled >= kth, True)
-    if top_p is not None:
-        # top-p (nucleus): smallest prefix of the sorted distribution
-        # with cumulative probability >= p; keep logits >= its last
-        # member's value
-        probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs_sorted, axis=-1)
-        p = jnp.asarray(top_p, logits.dtype)[:, None]
-        # prefix including the item that crosses p (cum[-1]=1 always)
-        in_nucleus = cum - probs_sorted < p
-        cut_idx = jnp.maximum(jnp.sum(in_nucleus, axis=-1) - 1, 0)[:, None]
-        pth = jnp.take_along_axis(sorted_logits, cut_idx, axis=1)
-        keep &= jnp.where(p < 1.0, scaled >= pth, True)
-    return jnp.where(keep, scaled, NEG_INF)
+    neg = mask_value(scaled.dtype)
+    if k_cap is None or v <= int(k_cap):
+        # cap disabled, or the vocab already fits inside it: the "prefix"
+        # is the whole sorted row and every cut fits by definition
+        full = -jnp.sort(-scaled, axis=-1)
+        keep, _ = _prefix_keep(scaled, full, top_k, top_p)
+        return jnp.where(keep, scaled, neg)
+
+    w = int(k_cap)
+    prefix = jax.lax.top_k(scaled, w)[0]  # [B, W] descending
+    keep_pre, fits = _prefix_keep(scaled, prefix, top_k, top_p)
+
+    def fused(_):
+        return keep_pre
+
+    def fallback(_):
+        full = -jnp.sort(-scaled, axis=-1)
+        keep_full, _ = _prefix_keep(scaled, full, top_k, top_p)
+        # fitting rows keep the prefix decision (bit-identical to the
+        # fused branch); only overflowing rows take the full-width answer
+        return jnp.where(fits[:, None], keep_pre, keep_full)
+
+    keep = jax.lax.cond(jnp.all(fits), fused, fallback, None)
+    return jnp.where(keep, scaled, neg)
+
+
+def scale_and_filter_reference(
+    logits: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array | None = None,
+    top_p: jax.Array | None = None,
+    *,
+    k_cap: int | None = K_CAP,
+) -> jax.Array:
+    """The always-sort branch of :func:`scale_and_filter`, exposed for the
+    property tests: for batches whose cuts fit inside ``k_cap`` this must
+    be byte-identical to the fused path."""
+    b, v = logits.shape
+    scaled = _scaled(logits, temperature)
+    if top_k is None and top_p is None:
+        return scaled
+    neg = mask_value(scaled.dtype)
+    full = -jnp.sort(-scaled, axis=-1)
+    if k_cap is None or v <= int(k_cap):
+        keep, _ = _prefix_keep(scaled, full, top_k, top_p)
+        return jnp.where(keep, scaled, neg)
+    keep_pre, fits = _prefix_keep(scaled, full[:, : int(k_cap)], top_k, top_p)
+    keep_full, _ = _prefix_keep(scaled, full, top_k, top_p)
+    keep = jnp.where(fits[:, None], keep_pre, keep_full)
+    return jnp.where(keep, scaled, neg)
 
 
 def sample(
@@ -72,10 +179,12 @@ def sample(
     step=0,  # int or [B] int32: decode step(s), folded in so steps differ
 ) -> jax.Array:
     """Next token per row, [B] int32. ``top_k``/``top_p`` as None (the
-    common temperature-only case) compiles without the O(B·V log V) sort
-    the filters need. ``step`` may be per-row: a continuous batch holds
-    rows at different decode depths, and each row's (seed, step) stream
-    must match what the same request would see decoded alone."""
+    common temperature-only case) compiles without any filter work; with
+    filters the fused top-k prefix path keeps the per-step cost at
+    O(B·V) unless a row's cut overflows ``K_CAP``. ``step`` may be
+    per-row: a continuous batch holds rows at different decode depths,
+    and each row's (seed, step) stream must match what the same request
+    would see decoded alone."""
     b, v = logits.shape
     greedy = jnp.argmax(logits, axis=-1)
     if seeds is None:

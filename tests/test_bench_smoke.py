@@ -629,8 +629,20 @@ class TestPipelinedLeg:
                     "boundary_host_ms_p99_pipelined",
                     "pipelined_tokens_in_flight_peak",
                     "pipelined_host_syncs_per_boundary",
-                    "pipelined_sync_lag_chunks_max"):
+                    "pipelined_sync_lag_chunks_max",
+                    # ISSUE 17: sampled-client mix + fused-sampler accounting
+                    "sampled_agg_tokens_per_s",
+                    "sampled_vs_greedy_decode_ratio",
+                    "pad_fraction",
+                    "sampling_ms_p50", "sampling_ms_p99",
+                    "sampling_sort_ms_p50"):
             assert key in out, key
+        # half the clients sample: the mixed run must still move tokens,
+        # and its throughput should land in the same decade as greedy
+        assert out["sampled_agg_tokens_per_s"] > 0
+        assert out["sampled_vs_greedy_decode_ratio"] > 0.1
+        assert 0.0 <= out["pad_fraction"] < 1.0
+        assert out["sampling_ms_p50"] > 0
         # the structural evidence, independent of timing noise: depth-D
         # programs mean FEWER device dispatches for the same token volume
         assert out["dispatches_pipelined"] < out["dispatches_serial"]

@@ -833,9 +833,13 @@ class TestChunkedPrefill:
         engine.generate(tokens, max_new_tokens=4)
         snap = engine.snapshot()
         for key in ("chunks", "admitted", "active_peak", "prefill_pieces",
-                    "stall_ms_max", "active", "filling", "waiting"):
+                    "stall_ms_max", "active", "filling", "waiting",
+                    "pad_fraction"):
             assert key in snap, key
         assert snap["prefill_pieces"] >= 3
+        # padded row-chunks / dispatched row-chunks; one live row in a
+        # multi-slot engine is mostly padding, and never more than all of it
+        assert 0.0 <= snap["pad_fraction"] < 1.0
 
 
 class TestChunkedPrefillPrefixCache:

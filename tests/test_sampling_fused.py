@@ -1,0 +1,336 @@
+"""Fused sampling + ragged paged decode (ISSUE 17).
+
+The sampling oracle: ``scale_and_filter`` (fused ``lax.top_k(K_CAP)``
+threshold path with whole-batch sort fallback) must be BYTE-IDENTICAL to
+``scale_and_filter_reference`` (the always-sort branch) — not close, not
+allclose: the engine's resumed-stream contract (test_continuation.py)
+rides on every replica and every replay drawing from bit-equal filtered
+logits. The ragged oracle: sweeping only the batch's live page blocks is
+an identity transform — fully-masked blocks must contribute exactly
+nothing, so short batches and full sweeps agree bit-for-bit.
+
+The engine-level leg proves the fused path carries the resume contract
+end-to-end at a vocab wide enough (128 > K_CAP) to actually engage it:
+tier-1 keeps one sampled resume per cache layout, the wider matrix rides
+the slow set.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from modelx_tpu.ops import sampling as S
+
+
+def _rand_logits(seed, b, v, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(b, v).astype(np.float32) * 3.0, dtype)
+
+
+def _pair(logits, temperature, top_k, top_p, k_cap=8):
+    """(fused, reference) filtered logits, both through jit so the test
+    exercises the compiled lax.cond, not an eager shortcut."""
+    fused = jax.jit(
+        lambda lg, t, k, p: S.scale_and_filter(lg, t, k, p, k_cap=k_cap)
+    )(logits, temperature, top_k, top_p)
+    ref = jax.jit(
+        lambda lg, t, k, p: S.scale_and_filter_reference(lg, t, k, p, k_cap=k_cap)
+    )(logits, temperature, top_k, top_p)
+    return np.asarray(fused), np.asarray(ref)
+
+
+class TestFusedBitIdentity:
+    """Property grid: every corner of the per-row parameter space must be
+    byte-equal between the fused prefix path and the sort reference."""
+
+    B, V, CAP = 6, 256, 8
+
+    def _check(self, logits, temperature, top_k, top_p):
+        fused, ref = _pair(logits, temperature, top_k, top_p, k_cap=self.CAP)
+        np.testing.assert_array_equal(fused, ref)
+        return fused
+
+    def test_top_k_zero_is_off(self):
+        lg = _rand_logits(0, self.B, self.V)
+        t = jnp.full((self.B,), 0.8)
+        k = jnp.zeros((self.B,), jnp.int32)
+        p = jnp.full((self.B,), 0.9)
+        out = self._check(lg, t, k, p)
+        # k=0 must not accidentally apply k=1: more than one survivor
+        assert (out[0] > S.mask_value(out.dtype) / 2).sum() > 1
+
+    def test_top_p_ge_one_is_off(self):
+        lg = _rand_logits(1, self.B, self.V)
+        t = jnp.full((self.B,), 1.0)
+        k = jnp.full((self.B,), 5, jnp.int32)
+        p = jnp.full((self.B,), 1.0)
+        out = self._check(lg, t, k, p)
+        # with p off, exactly k survive (random floats: no ties)
+        assert ((out[0] > S.mask_value(out.dtype) / 2).sum()) == 5
+
+    def test_ties_at_kth_logit(self):
+        # duplicate the k-th value several times: >= threshold keeps ALL
+        # tied entries in both branches
+        lg = np.array(_rand_logits(2, self.B, self.V))
+        order = np.argsort(-lg, axis=-1)
+        for b in range(self.B):
+            kth = lg[b, order[b, 3]]
+            lg[b, order[b, 3:7]] = kth  # 4-way tie across the k=4 boundary
+        t = jnp.ones((self.B,))
+        k = jnp.full((self.B,), 4, jnp.int32)
+        out = self._check(jnp.asarray(lg), t, k, jnp.full((self.B,), 1.0))
+        kept = (out[0] > S.mask_value(out.dtype) / 2).sum()
+        assert kept == 7  # 3 strictly-above + the 4-way tie
+
+    def test_all_rows_greedy_temperature_zero(self):
+        lg = _rand_logits(3, self.B, self.V)
+        t = jnp.zeros((self.B,))
+        k = jnp.full((self.B,), 3, jnp.int32)
+        p = jnp.full((self.B,), 0.9)
+        self._check(lg, t, k, p)
+        tok = S.sample(lg, jax.random.PRNGKey(0), t, k, p)
+        np.testing.assert_array_equal(
+            np.asarray(tok), np.asarray(jnp.argmax(lg, axis=-1)))
+
+    def test_k_over_cap_takes_fallback(self):
+        lg = _rand_logits(4, self.B, self.V)
+        t = jnp.ones((self.B,))
+        k = jnp.full((self.B,), 3, jnp.int32).at[2].set(100)  # > CAP
+        p = jnp.full((self.B,), 1.0)
+        out = self._check(lg, t, k, p)
+        # the overflow row really got its k=100 cut, not a clamped one
+        assert (out[2] > S.mask_value(out.dtype) / 2).sum() == 100
+
+    def test_nucleus_overflow_takes_fallback(self):
+        # near-flat logits: the p=0.99 nucleus needs far more than CAP=8
+        # entries, so fits is False and the sort branch must answer
+        rng = np.random.RandomState(5)
+        lg = jnp.asarray(rng.randn(self.B, self.V).astype(np.float32) * 1e-3)
+        t = jnp.ones((self.B,))
+        p = jnp.full((self.B,), 0.99)
+        out = self._check(lg, t, None, p)
+        assert (out[0] > S.mask_value(out.dtype) / 2).sum() > self.CAP
+
+    def test_per_row_mixed_params(self):
+        lg = _rand_logits(6, self.B, self.V)
+        t = jnp.asarray([0.0, 0.7, 1.0, 1.3, 0.9, 2.0])
+        k = jnp.asarray([0, 1, 5, 8, 200, 3], jnp.int32)
+        p = jnp.asarray([0.9, 1.0, 0.5, 1.5, 0.95, 0.1])
+        self._check(lg, t, k, p)
+
+    def test_k_only_and_p_only_none_filters(self):
+        lg = _rand_logits(7, self.B, self.V)
+        t = jnp.ones((self.B,))
+        self._check(lg, t, jnp.full((self.B,), 4, jnp.int32), None)
+        self._check(lg, t, None, jnp.full((self.B,), 0.7))
+        # both None: pure temperature scaling, no filter program at all
+        np.testing.assert_array_equal(
+            np.asarray(S.scale_and_filter(lg, t)),
+            np.asarray(S.scale_and_filter_reference(lg, t)))
+
+    def test_randomized_sweep(self):
+        # 20 random batches with per-row k in [0, CAP] and p in [0.3, 1.2]
+        for seed in range(20):
+            rng = np.random.RandomState(100 + seed)
+            lg = jnp.asarray(rng.randn(4, 128).astype(np.float32) * 2.5)
+            t = jnp.asarray(rng.uniform(0.5, 1.5, 4).astype(np.float32))
+            k = jnp.asarray(rng.randint(0, self.CAP + 1, 4), jnp.int32)
+            p = jnp.asarray(rng.uniform(0.3, 1.2, 4).astype(np.float32))
+            self._check(lg, t, k, p)
+
+    def test_sample_tokens_match_reference_distribution(self):
+        # same fold_in keys + byte-equal filtered logits => same tokens
+        lg = _rand_logits(8, self.B, 256)
+        key = jax.random.PRNGKey(42)
+        t = jnp.full((self.B,), 0.9)
+        k = jnp.full((self.B,), 12, jnp.int32)
+        p = jnp.full((self.B,), 0.95)
+        seeds = jnp.arange(self.B, dtype=jnp.int32)
+        tok = S.sample(lg, key, t, k, p, seeds=seeds, step=3)
+
+        ref = S.scale_and_filter_reference(lg, t, k, p, k_cap=None)
+        steps = jnp.full((self.B,), 3, jnp.int32)
+        keys = jax.vmap(
+            lambda s, st: jax.random.fold_in(jax.random.fold_in(key, s), st)
+        )(seeds, steps)
+        want = jax.vmap(jax.random.categorical)(keys, ref)
+        np.testing.assert_array_equal(np.asarray(tok), np.asarray(want))
+
+
+class TestMaskValueDtypes:
+    """dtype-aware masking: -1e30 overflows fp16 to -inf and -inf logits
+    are NaN factories downstream; finfo-min stays finite everywhere."""
+
+    def test_legacy_sentinel_overflows_fp16(self):
+        # the regression this guards against, stated as a fact
+        with np.errstate(over="ignore"):
+            assert np.isinf(np.float16(S.NEG_INF))
+        assert np.isfinite(np.asarray(S.mask_value(jnp.float16)))
+
+    @pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float16])
+    def test_filtered_softmax_has_no_nan(self, dtype):
+        lg = _rand_logits(9, 4, 128, dtype=dtype)
+        t = jnp.full((4,), 0.8, dtype)
+        k = jnp.full((4,), 5, jnp.int32)
+        p = jnp.full((4,), 0.9, dtype)
+        out = S.scale_and_filter(lg, t, k, p, k_cap=8)
+        assert np.isfinite(np.asarray(out, np.float32)).all()
+        probs = jax.nn.softmax(out, axis=-1)
+        assert not np.isnan(np.asarray(probs, np.float32)).any()
+        tok = S.sample(lg, jax.random.PRNGKey(0), t, k, p,
+                       seeds=jnp.arange(4, dtype=jnp.int32))
+        assert ((np.asarray(tok) >= 0) & (np.asarray(tok) < 128)).all()
+
+
+class TestRaggedSweepExactness:
+    """The fori_loop bound tracks max(lengths): blocks past a row's length
+    are fully masked, and a fully-masked block must be an IDENTITY update
+    (m unchanged, correction exp(0)=1, probability mass 0). Proof: a short
+    batch and the same rows forced through a full sweep agree bitwise."""
+
+    def _pool(self, lengths, ps=8, pps=6, seed=0):
+        rng = np.random.RandomState(seed)
+        s = len(lengths)
+        hq, hkv, d = 4, 2, 16
+        p_count = 1 + s * pps
+        pool_k = rng.randn(p_count, ps, hkv, d).astype(np.float32)
+        pool_v = rng.randn(p_count, ps, hkv, d).astype(np.float32)
+        table = np.arange(1, 1 + s * pps, dtype=np.int32).reshape(s, pps)
+        q = rng.randn(s, hq, d).astype(np.float32)
+        return q, pool_k, pool_v, table
+
+    def test_short_batch_matches_full_sweep_bitwise(self):
+        from modelx_tpu.ops.paged_attention import paged_attention
+
+        ps, pps = 8, 6
+        short = np.asarray([3, 9, 17], np.int32)  # max 17 -> 3 of 6 blocks
+        q, pk, pv, table = self._pool(short, ps, pps)
+        base = np.asarray(paged_attention(
+            jnp.asarray(q), jnp.asarray(pk), jnp.asarray(pv),
+            jnp.asarray(table), jnp.asarray(short)))
+
+        # append a full-length row: every original row now sweeps all
+        # pps blocks, and its extra blocks are fully masked
+        full = np.concatenate([short, [ps * pps]]).astype(np.int32)
+        q4, pk4, pv4, table4 = self._pool(full, ps, pps)
+        q4[:3], table4[:3] = q, table
+        pk4[1:1 + 3 * pps], pv4[1:1 + 3 * pps] = pk[1:], pv[1:]
+        got = np.asarray(paged_attention(
+            jnp.asarray(q4), jnp.asarray(pk4), jnp.asarray(pv4),
+            jnp.asarray(table4), jnp.asarray(full)))
+        np.testing.assert_array_equal(got[:3], base)
+
+    def test_length_one_batch_sweeps_one_block(self):
+        from modelx_tpu.ops.paged_attention import paged_attention
+        from modelx_tpu.ops.attention import attention_reference
+
+        lengths = np.asarray([1, 1], np.int32)
+        q, pk, pv, table = self._pool(lengths, seed=1)
+        got = np.asarray(paged_attention(
+            jnp.asarray(q), jnp.asarray(pk), jnp.asarray(pv),
+            jnp.asarray(table), jnp.asarray(lengths)))
+        # dense reference over just the first (and only live) token
+        dk = pk[table[:, 0]][:, :1]  # [S,1,Hkv,D]
+        dv = pv[table[:, 0]][:, :1]
+        ref = attention_reference(
+            jnp.asarray(q)[:, :, None, :],
+            jnp.asarray(dk).transpose(0, 2, 1, 3),
+            jnp.asarray(dv).transpose(0, 2, 1, 3),
+            causal=True, q_offset=jnp.asarray(lengths - 1),
+        )[:, :, 0, :]
+        np.testing.assert_allclose(got, np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Engine-level: the fused path carries the resumed-stream contract.
+# test_continuation.py proves resume byte-equality at vocab 64 == K_CAP,
+# which takes the static sort escape; this server's vocab 128 > K_CAP is
+# the smallest shape where the lax.cond fused path actually runs.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def wide_server(tmp_path_factory):
+    from modelx_tpu.dl import safetensors as st
+    from modelx_tpu.dl.serve import ModelServer
+    from modelx_tpu.models import llama
+
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=128),
+                              dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    d = tmp_path_factory.mktemp("fusedwide")
+    st.write_safetensors(
+        str(d / "model.safetensors"),
+        {k: np.asarray(v) for k, v in params.items()},
+    )
+    srv = ModelServer(str(d), mesh_spec="dp=1", dtype="float32",
+                      max_seq_len=96, name="m")
+    srv.load()
+    return srv
+
+
+PROMPT = [5, 9, 2, 7, 1]
+SAMPLED = dict(temperature=0.9, top_k=8, top_p=0.95, seed=77)
+
+
+def _stream_ids(cb, ids, n, samp, resume_step=0):
+    kw = dict(samp)
+    if resume_step:
+        kw["resume_step"] = resume_step
+    out = list(cb.stream(np.asarray([ids], np.int32), max_new_tokens=n, **kw))
+    return np.concatenate(out, axis=1)[0].tolist()
+
+
+class TestFusedEngineResume:
+    @pytest.mark.parametrize(
+        "page_size,prefill_chunk",
+        [(0, 0), (16, 0), (0, 16)],
+        ids=["dense", "paged", "chunked-prefill"],
+    )
+    def test_sampled_resume_is_token_exact(self, wide_server, page_size,
+                                           prefill_chunk):
+        from modelx_tpu.dl.continuous import ContinuousBatcher
+
+        cb = ContinuousBatcher(wide_server, max_slots=2, chunk_size=4,
+                               page_size=page_size,
+                               prefill_chunk=prefill_chunk)
+        try:
+            n = 10
+            full = _stream_ids(cb, PROMPT, n, SAMPLED)
+            assert len(full) == n
+            k = 4
+            cont = _stream_ids(cb, PROMPT + full[:k], n - k, SAMPLED,
+                               resume_step=k)
+            assert cont == full[k:]
+        finally:
+            cb.close()
+
+    # the wider replay (greedy + extra splice points) adds no new code
+    # path over the tier-1 representative; it rides the slow set
+    @pytest.mark.slow
+    @pytest.mark.parametrize(
+        "page_size,prefill_chunk",
+        [(0, 0), (16, 0), (0, 16)],
+        ids=["dense", "paged", "chunked-prefill"],
+    )
+    def test_resume_matrix(self, wide_server, page_size, prefill_chunk):
+        from modelx_tpu.dl.continuous import ContinuousBatcher
+
+        cb = ContinuousBatcher(wide_server, max_slots=2, chunk_size=4,
+                               page_size=page_size,
+                               prefill_chunk=prefill_chunk)
+        try:
+            n = 14
+            greedy = dict(temperature=0.0, seed=0)
+            for samp in (greedy, SAMPLED):
+                full = _stream_ids(cb, PROMPT, n, samp)
+                for k in (1, 9):
+                    cont = _stream_ids(cb, PROMPT + full[:k], n - k, samp,
+                                       resume_step=k)
+                    assert cont == full[k:]
+        finally:
+            cb.close()
