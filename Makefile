@@ -5,7 +5,7 @@ PY ?= python
 IMAGE ?= modelx-tpu
 TAG ?= $(shell git describe --tags --always 2>/dev/null || echo dev)
 
-.PHONY: all native test chaos slow lifecycle fleet overload programs continuation obs mesh decode lint wheel image image-dl compose-up compose-down clean
+.PHONY: all native test chaos slow lifecycle fleet overload programs continuation obs mesh decode tiers lint wheel image image-dl compose-up compose-down clean
 
 all: native lint test wheel
 
@@ -110,6 +110,17 @@ decode:
 	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_sampling_fused.py tests/test_paged_kv.py -q -m "not slow"
 	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_sampling_fused.py tests/test_pipelined.py -q -m slow
 	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest "tests/test_bench_smoke.py::TestPipelinedLeg" -q -m slow
+
+# multi-tier live-state drills (ISSUE 18): content keying + tier-store
+# units, pool demote-on-unload / promote-on-load, the injected
+# RESOURCE_EXHAUSTED recovery drill, the bench tier-swap leg, and the
+# eviction-race / seeded mid-demotion chaos matrix — everything under
+# runtime lockdep, since demotion adds the tier store's lock to the
+# pool's established free-outside-the-lock order
+tiers:
+	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tiers.py \
+		"tests/test_bench_smoke.py::TestTierSwapLeg" -q
+	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tiers.py -q -m chaos
 
 # two layers: the project-native concurrency/purity gate (always — it is
 # stdlib-only and baseline-governed, see docs/analysis.md), then generic

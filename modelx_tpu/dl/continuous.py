@@ -357,47 +357,16 @@ class ContinuousBatcher:
         # idle-row trick, relocated). One chunk program serves every mix of
         # lengths — the table is a traced input, never a shape.
         self.page_size = int(page_size)
-        if self.page_size > 0:
-            if self.max_len % self.page_size:
-                raise ValueError(
-                    f"max_len {self.max_len} must be a multiple of "
-                    f"page_size {self.page_size}"
-                )
-            budget = int(max_live_tokens) or max(
-                self.max_len + self.chunk_size + self.page_size,
-                self.max_slots * self.max_len // 4,
-            )
-            self.num_pages = 1 + -(-budget // self.page_size)  # +1: trash
-            self._pages_per_slot = self.max_len // self.page_size
-            self._free_pages = list(range(1, self.num_pages))
-            self._table = np.zeros(
-                (self.max_slots, self._pages_per_slot), np.int32
-            )
-            self._row_pages: dict[int, list[int]] = {}  # slot -> owned pages
-            self._cache = jax.tree_util.tree_map(
-                lambda leaf: jnp.zeros(
-                    (self.num_pages, self.page_size) + leaf.shape[2:], leaf.dtype
-                ),
-                self._init_cache(1, self.page_size),
-            )
-        else:
-            self.num_pages = 0
-            # engine-owned device state: the big cache (donated through
-            # every program so HBM holds exactly one copy)
-            self._cache = self._init_cache(self.max_slots, self.max_len)
-        # -- mesh placement (tensor-parallel continuous decode) -------------
-        # On a >1-device mesh the engine's KV state gets an explicit GSPMD
-        # layout before the first program closes over it: dense caches
-        # shard slots over dp and kv heads over tp; the paged pool shards
-        # kv heads over tp only (its leading dim is a global page index no
-        # axis may split). Every program the engine compiles then inherits
-        # these input layouts, so decode math runs tensor-parallel instead
-        # of congealing on device 0. A single-device mesh skips this block
-        # entirely — the dp=1 engine stays byte-identical to before.
-        self.mesh = server.mesh
-        self.mesh_devices = int(self.mesh.size)
-        self._cache = self._place_cache(self._cache)
-        self._tok = jnp.zeros((self.max_slots, 1), jnp.int32)
+        try:
+            self._alloc_device_state(max_live_tokens)
+        except BaseException:
+            # a RESOURCE_EXHAUSTED here may leave SOME per-layer pools
+            # already allocated: drop the partial tree before re-raising
+            # so the caller's demote-and-retry (ServerSet.continuous_for)
+            # sees those bytes actually returned to the device
+            self._cache = None
+            self._tok = None
+            raise
         # host-side per-slot state (tiny vectors, traced as inputs)
         self._offsets = np.zeros(self.max_slots, np.int32)
         self._steps = np.zeros(self.max_slots, np.int32)
@@ -665,6 +634,54 @@ class ContinuousBatcher:
     # streaming client's flush cadence (delivery still splits into
     # chunk_size pieces) and the stop-detection lag stay bounded
     AUTO_DISPATCH_DEPTH = 4
+
+    def _alloc_device_state(self, max_live_tokens: int) -> None:
+        """The engine's big device allocations — the KV page pool (or
+        dense cache), its mesh placement, and the sampled-token buffer —
+        split out of ``__init__`` so a mid-allocation RESOURCE_EXHAUSTED
+        has one cleanup point there (partial per-layer pools are dropped
+        before the error propagates to the demote-and-retry path)."""
+        if self.page_size > 0:
+            if self.max_len % self.page_size:
+                raise ValueError(
+                    f"max_len {self.max_len} must be a multiple of "
+                    f"page_size {self.page_size}"
+                )
+            budget = int(max_live_tokens) or max(
+                self.max_len + self.chunk_size + self.page_size,
+                self.max_slots * self.max_len // 4,
+            )
+            self.num_pages = 1 + -(-budget // self.page_size)  # +1: trash
+            self._pages_per_slot = self.max_len // self.page_size
+            self._free_pages = list(range(1, self.num_pages))
+            self._table = np.zeros(
+                (self.max_slots, self._pages_per_slot), np.int32
+            )
+            self._row_pages: dict[int, list[int]] = {}  # slot -> owned pages
+            self._cache = jax.tree_util.tree_map(
+                lambda leaf: jnp.zeros(
+                    (self.num_pages, self.page_size) + leaf.shape[2:], leaf.dtype
+                ),
+                self._init_cache(1, self.page_size),
+            )
+        else:
+            self.num_pages = 0
+            # engine-owned device state: the big cache (donated through
+            # every program so HBM holds exactly one copy)
+            self._cache = self._init_cache(self.max_slots, self.max_len)
+        # -- mesh placement (tensor-parallel continuous decode) -------------
+        # On a >1-device mesh the engine's KV state gets an explicit GSPMD
+        # layout before the first program closes over it: dense caches
+        # shard slots over dp and kv heads over tp; the paged pool shards
+        # kv heads over tp only (its leading dim is a global page index no
+        # axis may split). Every program the engine compiles then inherits
+        # these input layouts, so decode math runs tensor-parallel instead
+        # of congealing on device 0. A single-device mesh skips this block
+        # entirely — the dp=1 engine stays byte-identical to before.
+        self.mesh = self.server.mesh
+        self.mesh_devices = int(self.mesh.size)
+        self._cache = self._place_cache(self._cache)
+        self._tok = jnp.zeros((self.max_slots, 1), jnp.int32)
 
     def _place_cache(self, cache):
         """Lay the engine's KV state out on the serving mesh (no-op on a

@@ -70,11 +70,15 @@ _RESERVING = (PULLING, LOADING, READY, DRAINING)
 
 class PoolError(Exception):
     """An admin-surface refusal with its HTTP status (the serving layer
-    maps it 1:1 to a JSON error body)."""
+    maps it 1:1 to a JSON error body). ``headers`` ride onto the
+    response: a 507 whose pressure could clear carries ``Retry-After``
+    (demotion/drain could make room), a hard refusal carries none."""
 
-    def __init__(self, status: int, message: str) -> None:
+    def __init__(self, status: int, message: str,
+                 headers: dict | None = None) -> None:
         super().__init__(message)
         self.status = status
+        self.headers = dict(headers or {})
 
 
 class ModelEntry:
@@ -97,6 +101,7 @@ class ModelEntry:
         self.inflight = 0
         self.last_used = time.monotonic()
         self._staged = False        # model_dir is pool-owned (safe to rm)
+        self.tier_key = ""          # content digest into the tier store
 
     def to(self, state: str, error: str | None = None) -> None:
         self.state = state
@@ -164,7 +169,10 @@ class ModelPool:
     def __init__(self, sset, hbm_budget_bytes: int = 0, evict_idle: bool = False,
                  allow_admin_load: bool = False, staging_root: str = "",
                  drain_timeout_s: float = DEFAULT_DRAIN_TIMEOUT_S,
-                 blob_cache=None, mesh=None) -> None:
+                 blob_cache=None, mesh=None,
+                 host_state_budget_bytes: int = 0,
+                 disk_state_budget_bytes: int = 0,
+                 state_spool_dir: str = "") -> None:
         self.sset = sset
         self.hbm_budget_bytes = int(hbm_budget_bytes)
         # the serving mesh (ServerSet's shared mesh): --hbm-budget-bytes is
@@ -190,6 +198,29 @@ class ModelPool:
         # the next puller boots warm (dl/program_store.py)
         self.publish_programs = False
         self.drain_timeout_s = float(drain_timeout_s)
+        # pool-level flight recorder (ISSUE 18): tier promotions and
+        # demotions, OOM shed-and-retry — the lifecycle counterpart of the
+        # engines' rings, served under the same /debug/flightrec surface
+        from modelx_tpu.utils.flightrec import FlightRecorder
+
+        self.flightrec = FlightRecorder(capacity=256)
+        # multi-tier live state (dl/tiers.py): demoted models' params
+        # staged in bounded host RAM / local disk so a re-load is a tier
+        # promotion, not a re-pull. Both budgets 0 (the default) keeps
+        # the store inert and the pool byte-identical to before.
+        from modelx_tpu.dl import tiers as tiers_mod
+
+        mesh_key = ""
+        if mesh is not None:
+            from modelx_tpu.parallel.mesh import mesh_str
+
+            mesh_key = mesh_str(mesh)
+        self.tiers = tiers_mod.TierStore(
+            host_budget_bytes=host_state_budget_bytes,
+            disk_budget_bytes=disk_state_budget_bytes,
+            spool_root=state_spool_dir, mesh_key=mesh_key,
+            recorder=self.flightrec,
+        )
         self._lock = threading.RLock()
         self._idle = threading.Condition(self._lock)  # inflight hit zero
         self.entries: dict[str, ModelEntry] = {}
@@ -350,8 +381,13 @@ class ModelPool:
         with self._lock:
             out = {}
             for name, e in self.entries.items():
-                self._effective_state(e)
-                out[name] = e.snapshot()
+                st = self._effective_state(e)
+                snap = e.snapshot()
+                if self.tiers.enabled:
+                    snap["tier"] = ("hbm" if st in _RESERVING
+                                    else self.tiers.tier_of(e.tier_key)
+                                    or "none")
+                out[name] = snap
             return out
 
     def reserved_bytes(self) -> int:
@@ -381,6 +417,8 @@ class ModelPool:
         snap["hbm_measured_vs_reserved_delta"] = (
             dm["hbm_bytes_in_use"] - snap["hbm_reserved_bytes"])
         snap["hbm_measured_source"] = dm["source"]
+        if self.tiers.enabled:
+            snap["tiers"] = self.tiers.snapshot()
         return snap
 
     def failed(self) -> dict[str, str]:
@@ -410,14 +448,20 @@ class ModelPool:
             raise PoolError(400, "send exactly one of ref or model_dir")
 
         # estimate BEFORE mutating any state: an unreachable ref or empty
-        # dir must refuse cleanly, reserving nothing
+        # dir must refuse cleanly, reserving nothing. The same (name,
+        # size) pairs that sum to the estimate ARE the tier key material,
+        # so a tier-store hit is decided before any weight byte moves.
+        from modelx_tpu.dl import tiers as tiers_mod
+
         try:
-            est = estimate_ref_bytes(ref) if ref else estimate_dir_bytes(model_dir)
+            pairs = tiers_mod.ref_pairs(ref) if ref else tiers_mod.dir_pairs(model_dir)
         except Exception as e:
             raise PoolError(400, f"cannot estimate footprint for "
                                  f"{ref or model_dir!r}: {e}")
+        est = sum(p[1] for p in pairs)
         if est <= 0:
             raise PoolError(400, f"no safetensors found under {ref or model_dir!r}")
+        tier_key = self.tiers.key_for(pairs) if self.tiers.enabled else ""
         # checkpoint file sizes are TOTAL weight bytes; the budget admits
         # what one device will actually hold on this pool's mesh
         est = self._per_device(est)
@@ -438,6 +482,7 @@ class ModelPool:
                 e.model_dir = model_dir
                 e.hbm_reserved_bytes = est
                 e.drain_seconds = None
+                e.tier_key = tier_key
                 e.to(PULLING if ref else LOADING)
         finally:
             # evicted victims' engines/params/staging close OUTSIDE the
@@ -459,15 +504,33 @@ class ModelPool:
         with self._lock:
             return {name: e.snapshot()}
 
+    def _measured_shortfall(self, est: int) -> bool:
+        """Does the DEVICE's own accounting say ``est`` more bytes will
+        not fit — regardless of what the reservation ledger believes?
+        Only the accountant-backed source counts: the live-buffer census
+        (CPU fallback) reports usage but no limit, so it can never veto
+        a load the ledger admitted."""
+        dm = devmem.sample()
+        return (dm["source"] == "memory_stats"
+                and est > dm["hbm_bytes_reservable"])
+
+    def _fits(self, est: int, reserved: int) -> bool:
+        if self.hbm_budget_bytes and reserved + est > self.hbm_budget_bytes:
+            return False
+        return not self._measured_shortfall(est)
+
     def _ensure_budget(self, est: int, loading: str = "",
                        frees: list | None = None) -> None:
         """Caller holds the lock. Refuse (507) or LRU-evict until ``est``
-        fits under the budget; evicted victims' heavy artifacts land in
-        ``frees`` for the caller to close after releasing the lock."""
-        if not self.hbm_budget_bytes:
+        fits BOTH the reservation ledger and the device's measured free
+        HBM (utils/devmem — the ledger admits estimates; the accountant
+        vetoes loads a leak or estimator error would crash); evicted
+        victims' heavy artifacts land in ``frees`` for the caller to
+        close after releasing the lock."""
+        if not self.hbm_budget_bytes and not self._measured_shortfall(est):
             return
         reserved = self.reserved_bytes()  # RLock: safe under the lock
-        if reserved + est <= self.hbm_budget_bytes:
+        if self._fits(est, reserved):
             return
         if self.evict_idle:
             # LRU order over READY models with nothing in flight; never the
@@ -501,15 +564,48 @@ class ModelPool:
                 if frees is not None:
                     frees.append(art)
                 reserved = self.reserved_bytes()
-                if reserved + est <= self.hbm_budget_bytes:
+                if self._fits(est, reserved):
                     return
+        # the 507 contract (ISSUE 18): when pressure COULD clear — busy
+        # victims whose drain would free enough bytes — the refusal says
+        # so and carries Retry-After; otherwise it is a hard refusal
+        # (no combination of demotions makes the load fit).
+        could_free = sum(
+            e.hbm_reserved_bytes for e in self.entries.values()
+            if self._effective_state(e) in (READY, DRAINING)
+            and e.name != loading
+        )
+        budget = self.hbm_budget_bytes
+        free_now = (budget - reserved) if budget else 0
+        if budget and est <= free_now + could_free:
+            raise PoolError(
+                507,
+                f"load needs ~{est} bytes but only {free_now} of the "
+                f"{budget}-byte HBM budget is free; demoting busy models "
+                f"could free {could_free} more — retry after in-flight "
+                "work drains"
+                + ("" if self.evict_idle else
+                   " (--evict-idle is off; unload a model first)"),
+                headers={"Retry-After": "2"},
+            )
+        if budget:
+            raise PoolError(
+                507,
+                f"load needs ~{est} bytes but only {free_now} of the "
+                f"{budget}-byte HBM budget is free, and no demotion can "
+                "make room (hard refusal)"
+                + ("" if self.evict_idle else
+                   " (--evict-idle is off; unload a model first)"),
+            )
+        dm = devmem.sample()
         raise PoolError(
             507,
-            f"load needs ~{est} bytes but only "
-            f"{self.hbm_budget_bytes - reserved} of the "
-            f"{self.hbm_budget_bytes}-byte HBM budget is free"
-            + ("" if self.evict_idle else
-               " (and --evict-idle is off; unload a model first)"),
+            f"load needs ~{est} bytes but the device measures only "
+            f"{dm['hbm_bytes_reservable']} bytes reservable "
+            f"(source={dm['source']})"
+            + ("; demotion could make room — retry after in-flight work "
+               "drains" if could_free else " (hard refusal)"),
+            headers={"Retry-After": "2"} if could_free else None,
         )
 
     def _staging_dir(self, name: str) -> str:
@@ -524,7 +620,31 @@ class ModelPool:
     def _do_load(self, e: ModelEntry) -> None:
         name = e.name
         try:
-            if e.ref:
+            # tier promotion (ISSUE 18): a host/disk hit materializes the
+            # demoted state — device_put to each tensor's recorded
+            # sharding — skipping the registry pull AND the safetensors
+            # parse; misses fall through to the pull path unchanged
+            promo = self.tiers.promote(e.tier_key) if e.tier_key else None
+            if promo is not None:
+                dest = self._staging_dir(name)
+                if promo.sidecar_dir:
+                    # tokenizer/config sidecars preserved at demotion time
+                    shutil.copytree(promo.sidecar_dir, dest,
+                                    dirs_exist_ok=True)
+                else:
+                    os.makedirs(dest, exist_ok=True)
+                stale = False
+                with self._lock:
+                    if e.state not in (PULLING, LOADING):  # raced an unload
+                        stale = True
+                    else:
+                        e.model_dir = dest
+                        e._staged = True
+                        e.to(LOADING)
+                if stale:
+                    shutil.rmtree(dest, ignore_errors=True)
+                    return
+            elif e.ref:
                 dest = self._staging_dir(name)
                 from modelx_tpu.dl.initializer import pull_model
                 from modelx_tpu.utils import trace
@@ -548,10 +668,43 @@ class ModelPool:
             from modelx_tpu.dl.serve import ModelServer
 
             kwargs = dict(self.sset.server_defaults)
-            server = ModelServer(e.model_dir, name=name, **kwargs)
-            with self._lock:
-                e.server = server
-            server.load()
+
+            def attempt():
+                server = ModelServer(e.model_dir, name=name, **kwargs)
+                with self._lock:
+                    e.server = server
+                if promo is not None:
+                    server.load_from_tier(promo)
+                else:
+                    server.load()
+                return server
+
+            try:
+                server = attempt()
+            except Exception as exc:
+                from modelx_tpu.dl import tiers as tiers_mod
+
+                if not tiers_mod.is_resource_exhausted(exc):
+                    raise
+                # XLA RESOURCE_EXHAUSTED mid-load (the ledger admitted an
+                # estimate the device couldn't honor): free the partial
+                # shards, demote idle victims, retry ONCE — a second
+                # failure surfaces as FAILED through the normal path
+                partial = e.server
+                if partial is not None:
+                    self._free_server(name, partial)
+                freed = self.shed_idle_for_bytes(
+                    e.hbm_reserved_bytes, exclude=name
+                )
+                self.flightrec.record("pool.oom_retry", model=name,
+                                      freed_bytes=freed)
+                if freed <= 0:
+                    raise
+                logger.warning(
+                    "load of %s hit RESOURCE_EXHAUSTED; demoted %d reserved "
+                    "bytes of idle state, retrying once", name, freed,
+                )
+                server = attempt()
             aborted = False
             with self._lock:
                 if e.state != LOADING:  # raced an unload/retry mid-load
@@ -562,8 +715,9 @@ class ModelPool:
             if aborted:
                 self._free_server(name, server)  # outside the lock
                 return
-            logger.info("model %s loaded at runtime (%s)", name,
-                        e.ref or e.model_dir)
+            logger.info("model %s loaded at runtime (%s)%s", name,
+                        e.ref or e.model_dir,
+                        f" [promoted from {promo.tier} tier]" if promo else "")
             if self.publish_programs and e.ref:
                 # after READY, off the serving path: the model is already
                 # taking traffic — a publish failure only costs the next
@@ -613,7 +767,8 @@ class ModelPool:
                 server, batcher, cb = self.sset.remove_server(name, close=False)
                 staged = e.model_dir if e._staged else ""
                 del self.entries[name]
-                deleted_art = (name, server, batcher, cb, staged)
+                deleted_art = (name, server, batcher, cb, staged,
+                               e.tier_key, e.model_dir)
             elif state == DRAINING:
                 raise PoolError(409, f"model {name!r} is already draining")
             elif state in (PULLING, LOADING):
@@ -665,10 +820,16 @@ class ModelPool:
         """Caller holds the lock. The BOOKKEEPING half of freeing a model:
         pull it out of routing, flip the entry UNLOADED, release the HBM
         reservation. Returns the heavy artifacts (server, engines, staged
-        dir) for ``_finish_free`` — run it AFTER releasing the lock."""
+        dir, tier-demotion material) for ``_finish_free`` — run it AFTER
+        releasing the lock."""
         name = e.name
         server, batcher, cb = self.sset.remove_server(name, close=False)
         staged = e.model_dir if e._staged else ""
+        # demotion material: the key (computed at load admission, or
+        # lazily off-lock from the dir) and the dir whose sidecars —
+        # tokenizer.json, config sidecars — the tier entry preserves
+        sidecar_src = e.model_dir
+        tier_key = e.tier_key
         if e._staged:
             e.model_dir = ""
             e._staged = False
@@ -681,23 +842,83 @@ class ModelPool:
         else:
             self.stats["unloads_total"] += 1
         logger.info("model %s %s", name, "evicted" if evicted else "unloaded")
-        return name, server, batcher, cb, staged
+        return name, server, batcher, cb, staged, tier_key, sidecar_src
 
     def _finish_free(self, art: tuple) -> None:
         """The HEAVY half of freeing a model (engine thread join, device
-        state release, params drop, staging rmtree). Never called under
-        the pool lock: one tenant's teardown must not stall admission for
-        the others."""
-        name, server, batcher, cb, staged = art
+        state release, tier demotion, params drop, staging rmtree). Never
+        called under the pool lock: one tenant's teardown must not stall
+        admission for the others."""
+        name, server, batcher, cb, staged, tier_key, sidecar_src = art
         if batcher is not None:
             batcher.close()
         if cb is not None:
             cb.close()
             cb.release_device_state()
         if server is not None:
+            # demotion instead of discard (ISSUE 18): stage the params
+            # into host RAM/disk BEFORE _free_server drops them — a later
+            # load of the same content is then a tier promotion
+            self._demote_server(name, server, tier_key, sidecar_src)
             self._free_server(name, server)
         if staged:
             shutil.rmtree(staged, ignore_errors=True)
+
+    def _demote_server(self, name: str, server, tier_key: str,
+                       sidecar_src: str) -> None:
+        """Offer a freed server's live params to the tier store (no pool
+        lock held — the device->host copy is the heavy half of eviction).
+        Never raises: a failed demotion degrades to the old discard."""
+        if not self.tiers.enabled or server.params is None:
+            return
+        try:
+            if not tier_key and sidecar_src:
+                # boot-time entries never went through request_load: key
+                # them from the checkpoint dir at first demotion
+                from modelx_tpu.dl import tiers as tiers_mod
+
+                tier_key = self.tiers.key_for(tiers_mod.dir_pairs(sidecar_src))
+            if not tier_key:
+                return
+            self.tiers.offer(
+                tier_key, name, server.params, family=server.family,
+                cfg=server.cfg, param_sds=server._param_sds,
+                sidecar_src=sidecar_src,
+            )
+            with self._lock:
+                e = self.entries.get(name)
+                if e is not None and not e.tier_key:
+                    e.tier_key = tier_key  # a re-POST of the dir promotes
+        except Exception:
+            logger.exception("demotion of %s failed; state discarded", name)
+
+    def shed_idle_for_bytes(self, need: int, exclude: str = "") -> int:
+        """Demote idle READY victims (LRU-first) until ``need`` reserved
+        bytes are freed — the OOM-recovery path for loads and engine
+        allocations (``need <= 0`` frees one victim). Returns the
+        reserved bytes freed; 0 when nothing was sheddable. Victims are
+        idle by construction, so no in-flight request is ever dropped."""
+        frees: list = []
+        freed = 0
+        with self._lock:
+            victims = sorted(
+                (
+                    e for e in self.entries.values()
+                    if self._effective_state(e) == READY
+                    and e.inflight == 0 and e.name != exclude
+                ),
+                key=lambda e: e.last_used,
+            )
+            for victim in victims:
+                if len(self._serving_names()) <= 1:
+                    break  # never empty the node (request_unload's stance)
+                freed += victim.hbm_reserved_bytes
+                frees.append(self._free_entry_locked(victim, evicted=True))
+                if need <= 0 or freed >= need:
+                    break
+        for art in frees:
+            self._finish_free(art)
+        return freed
 
     @staticmethod
     def _free_server(name: str, server) -> None:

@@ -408,6 +408,52 @@ class ModelServer:
             self.ready = True
         return dict(self.stats)
 
+    def load_from_tier(self, promo) -> dict:
+        """Materialize a demoted model from a tier promotion
+        (dl/tiers.Promotion) instead of the checkpoint files: device_put
+        each host leaf straight to its recorded NamedSharding placement —
+        no fetch, no safetensors parse, no sharding-plan walk. The compile
+        overlap works exactly as in ``load`` (and usually hits the AOT
+        cache outright, since this content compiled here before)."""
+        with trace.span("serve.load_from_tier", model=self.name,
+                        tier=promo.tier):
+            t0 = time.monotonic()
+            self.family = promo.family
+            self.cfg = promo.cfg
+            self._param_sds = promo.param_sds
+            compile_thread = None
+            if promo.param_sds is not None:
+                compile_thread = threading.Thread(
+                    target=self._precompile_warmup, args=(promo.param_sds,),
+                    daemon=True,
+                )
+                compile_thread.start()
+            leaves = []
+            for arr, sharding in zip(promo.leaves, promo.shardings):
+                if sharding is not None:
+                    leaves.append(jax.device_put(arr, sharding))
+                else:
+                    leaves.append(jax.device_put(arr))
+            self.params = jax.tree_util.tree_unflatten(promo.treedef, leaves)
+            seconds = time.monotonic() - t0
+            from modelx_tpu.parallel.mesh import mesh_str, weight_shard_factor
+
+            self.stats["mesh"] = mesh_str(self.mesh)
+            self.stats["mesh_devices"] = int(self.mesh.size)
+            self.stats["weight_shard_factor"] = weight_shard_factor(self.mesh)
+            self.stats["family"] = self.family.name
+            self.stats["load_seconds"] = round(seconds, 3)
+            self.stats["load_bytes"] = promo.nbytes
+            self.stats["load_gbps"] = round(
+                promo.nbytes / max(seconds, 1e-9) / 1e9, 3)
+            self.stats["tier"] = promo.tier
+            self._compile()
+            if compile_thread is not None:
+                compile_thread.join()
+            self.stats["ready_seconds"] = round(time.monotonic() - t0, 3)
+            self.ready = True
+        return dict(self.stats)
+
     def _precompile_warmup(self, sds: dict) -> None:
         """AOT-compile the forward for the warmup token shapes (overlapped
         with the weight load). Failures only lose the warm start."""
@@ -1097,6 +1143,9 @@ class ServerSet:
                  allow_admin_load: bool = False,
                  admin_tokens: tuple[str, ...] = (),
                  staging_root: str = "",
+                 host_state_budget_bytes: int = 0,
+                 disk_state_budget_bytes: int = 0,
+                 state_spool_dir: str = "",
                  flight_recorder: bool = True,
                  flightrec_capacity: int = 0,
                  flight_dump_dir: str = "",
@@ -1209,6 +1258,12 @@ class ServerSet:
             # HBM, and on a weight-sharding mesh the pool divides each
             # model's footprint by the mesh's weight-shard factor
             mesh=first.mesh,
+            # tiered live state (dl/tiers.py): demoted models stage in
+            # host RAM/disk instead of being discarded, and a re-load of
+            # the same content is a tier promotion
+            host_state_budget_bytes=host_state_budget_bytes,
+            disk_state_budget_bytes=disk_state_budget_bytes,
+            state_spool_dir=state_spool_dir,
         )
 
     def request_began(self) -> None:
@@ -1346,31 +1401,66 @@ class ServerSet:
                             max_len, clamped, server.name, page_size,
                         )
                         max_len = clamped
-                cb = ContinuousBatcher(
-                    server, max_slots=self.max_slots,
-                    chunk_size=self.stream_chunk_size, max_len=max_len,
-                    prefix_cache=server._prefix_cache,
-                    page_size=page_size,
-                    max_live_tokens=self.kv_live_tokens,
-                    paged_attention=self.kv_attention,
-                    # --speculative-k composes with continuous batching:
-                    # the engine speculates whenever exactly one greedy row
-                    # is active (VERDICT r4: the flags must not be
-                    # mutually exclusive)
-                    speculative_k=server.speculative_k,
-                    pipeline_depth=self.pipeline_depth,
-                    dispatch_depth=self.dispatch_depth,
-                    burst_window_ms=self.burst_window_ms,
-                    prefill_chunk=self.prefill_chunk,
-                    prefill_budget=self.prefill_budget,
-                    max_queue_depth=self.max_queue_depth,
-                    request_timeout_s=self.request_timeout_s,
-                    boundary_watchdog_s=self.boundary_watchdog_s,
-                    flight_recorder=self.flight_recorder,
-                    flightrec_capacity=self.flightrec_capacity,
-                    flight_dump_dir=self.flight_dump_dir,
-                    device_telemetry=self.device_telemetry,
-                )
+                def build():
+                    return ContinuousBatcher(
+                        server, max_slots=self.max_slots,
+                        chunk_size=self.stream_chunk_size, max_len=max_len,
+                        prefix_cache=server._prefix_cache,
+                        page_size=page_size,
+                        max_live_tokens=self.kv_live_tokens,
+                        paged_attention=self.kv_attention,
+                        # --speculative-k composes with continuous batching:
+                        # the engine speculates whenever exactly one greedy
+                        # row is active (VERDICT r4: the flags must not be
+                        # mutually exclusive)
+                        speculative_k=server.speculative_k,
+                        pipeline_depth=self.pipeline_depth,
+                        dispatch_depth=self.dispatch_depth,
+                        burst_window_ms=self.burst_window_ms,
+                        prefill_chunk=self.prefill_chunk,
+                        prefill_budget=self.prefill_budget,
+                        max_queue_depth=self.max_queue_depth,
+                        request_timeout_s=self.request_timeout_s,
+                        boundary_watchdog_s=self.boundary_watchdog_s,
+                        flight_recorder=self.flight_recorder,
+                        flightrec_capacity=self.flightrec_capacity,
+                        flight_dump_dir=self.flight_dump_dir,
+                        device_telemetry=self.device_telemetry,
+                    )
+
+                try:
+                    cb = build()
+                except Exception as exc:
+                    # RESOURCE_EXHAUSTED allocating the KV/page pool: demote
+                    # idle tenants' state to the host tier and retry ONCE;
+                    # anything else (or a dry pool) is a typed 503 — the
+                    # request sheds instead of wedging the engine slot
+                    from modelx_tpu.dl import tiers as tiers_mod
+                    from modelx_tpu.dl.serving_errors import EngineBrokenError
+
+                    if not tiers_mod.is_resource_exhausted(exc):
+                        raise
+                    freed = self.pool.shed_idle_for_bytes(
+                        0, exclude=server.name)
+                    self.pool.flightrec.record(
+                        "engine.alloc_oom_retry", model=server.name,
+                        freed_bytes=freed)
+                    if freed <= 0:
+                        raise EngineBrokenError(
+                            f"KV allocation for {server.name} hit "
+                            "RESOURCE_EXHAUSTED and no idle model could be "
+                            "demoted") from exc
+                    logger.warning(
+                        "KV allocation for %s hit RESOURCE_EXHAUSTED; "
+                        "demoted %d reserved bytes of idle state, retrying "
+                        "once", server.name, freed,
+                    )
+                    try:
+                        cb = build()
+                    except Exception as exc2:
+                        raise EngineBrokenError(
+                            f"KV allocation for {server.name} failed after "
+                            "demoting idle state") from exc2
                 self.cbatchers[server.name] = cb
         return cb
 
@@ -2008,6 +2098,8 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000",
                 for n, cb in list(sset.cbatchers.items()):
                     if cb.flightrec is not None:
                         body[n] = cb.flightrec.summary(rid)
+                # pool-level ring: tier promotions/demotions, OOM retries
+                body["pool"] = sset.pool.flightrec.summary(rid)
                 self._json(200, body)
             else:
                 self._json(404, {"error": "not found"})
@@ -2135,7 +2227,10 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000",
                         wait=wait,
                     )
                 except PoolError as e:
-                    return self._json(e.status, {"error": str(e)})
+                    # a 507 that demotion could clear carries Retry-After
+                    # (ISSUE 18's 507 contract); hard refusals carry none
+                    return self._json(e.status, {"error": str(e)},
+                                      headers=e.headers or None)
                 return self._json(200 if wait else 202, snap)
 
             if self.path in ("/v1/completions", "/v1/chat/completions"):
@@ -2467,7 +2562,8 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000",
             try:
                 snap = sset.pool.request_unload(m.group("model"), wait=wait)
             except PoolError as e:
-                return self._json(e.status, {"error": str(e)})
+                return self._json(e.status, {"error": str(e)},
+                                  headers=e.headers or None)
             return self._json(200 if wait else 202, snap)
 
     host, _, port = listen.rpartition(":")

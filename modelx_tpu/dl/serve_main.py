@@ -142,6 +142,22 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
               help="with --hbm-budget-bytes: LRU-evict READY models that "
                    "have no in-flight requests to make room for a new load "
                    "instead of refusing it")
+@click.option("--host-state-budget-bytes", default=0, type=int,
+              help="tiered live state (dl/tiers.py): bound for the host-RAM "
+                   "tier that evicted/unloaded models' params demote into "
+                   "instead of being discarded — a later load of the same "
+                   "content is a tier promotion (device_put, no pull/parse). "
+                   "LRU within the tier; overflow spills to the disk tier "
+                   "(0 = host tier off)")
+@click.option("--disk-state-budget-bytes", default=0, type=int,
+              help="bound for the local-disk tier (decoded-tensor spool "
+                   "under --state-spool-dir) that host-tier overflow spills "
+                   "into; disk overflow drops oldest (0 = disk tier off; "
+                   "both 0 = tiering off, eviction discards as before)")
+@click.option("--state-spool-dir", default="",
+              help="where the disk tier spools decoded tensors — put it "
+                   "next to --blob-cache-dir (default: "
+                   "$TMPDIR/modelx-state-spool)")
 @click.option("--allow-admin-load", is_flag=True,
               help="enable the runtime lifecycle surface: POST "
                    "/admin/models pulls+loads a registry ref while traffic "
@@ -213,7 +229,9 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
          max_queue_depth: int, request_timeout: float,
          prefix_cache: int, prefix_cache_max_bytes: int,
          quantize: str | None, speculative_k: int,
-         hbm_budget_bytes: int, evict_idle: bool, allow_admin_load: bool,
+         hbm_budget_bytes: int, evict_idle: bool,
+         host_state_budget_bytes: int, disk_state_budget_bytes: int,
+         state_spool_dir: str, allow_admin_load: bool,
          publish_programs: bool,
          admin_tokens: tuple[str, ...], staging_dir: str,
          loras: tuple[str, ...], drain_seconds: float,
@@ -324,6 +342,9 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
                      allow_admin_load=allow_admin_load,
                      admin_tokens=admin_tokens,
                      staging_root=staging_dir,
+                     host_state_budget_bytes=host_state_budget_bytes,
+                     disk_state_budget_bytes=disk_state_budget_bytes,
+                     state_spool_dir=state_spool_dir,
                      flight_recorder=flight_recorder,
                      flightrec_capacity=flightrec_capacity,
                      flight_dump_dir=flight_dump_dir,
@@ -346,6 +367,11 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
         logging.getLogger("modelx.serve").warning(
             "--evict-idle is inert without --hbm-budget-bytes "
             "(eviction only runs to fit a load under the budget)"
+        )
+    if state_spool_dir and not disk_state_budget_bytes:
+        logging.getLogger("modelx.serve").warning(
+            "--state-spool-dir is inert without --disk-state-budget-bytes "
+            "(nothing spools to a 0-byte disk tier)"
         )
     httpd = serve(sset, listen=listen,  # starts serving 503s while loading
                   access_log=access_log,

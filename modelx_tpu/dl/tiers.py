@@ -1,0 +1,647 @@
+"""Multi-tier live model state (ISSUE 18): HBM -> host RAM -> local disk.
+
+The lifecycle pool's only answers to memory pressure used to be refusal
+(507) or full eviction — and an evicted model's state was discarded
+wholesale, so every swap-in re-paid pull + parse + placement
+(``ttft_swap_cold_ms`` ~ 479 ms best case, seconds on a cold blob
+cache). ServerlessLLM's blueprint (PAPERS.md) keeps evicted models'
+state STAGED instead: demotion copies the params off the device into a
+bounded host-RAM tier, host-tier overflow spools decoded tensors to a
+bounded local-disk tier (next to the blob cache — same disk, same
+operator budget mindset), and a later load of the same content is a
+tier PROMOTION — ``jax.device_put`` straight to each tensor's recorded
+``NamedSharding`` placement, no fetch, no safetensors parse.
+
+Keying: entries are addressed by a digest over the checkpoint's sorted
+``(safetensors name, size, salt)`` triples plus the pool's mesh env key
+(``parallel/mesh.mesh_str``). The salt is what makes the key CONTENT
+identity, not shape identity — two same-architecture models have
+identical names and sizes: a registry ref salts with each blob's
+manifest digest (exact content, known before any byte moves), a local
+dir salts with each file's mtime_ns (same unchanged dir == same key; a
+rewritten file misses, which is the safe direction). The key for a
+ref-loaded model is computed at admission and carried on its pool
+entry, so demote-after-ref-load -> promote-on-next-ref-load round-trips
+without touching the staged dir. A mesh change invalidates every entry
+(the recorded shardings belong to the old mesh).
+
+The store is process-local live state BY DESIGN: a restart falls back
+to the blob cache / registry (PR 1's fast-materialization path), which
+is the durable tier. Entries are kept on promotion (weights are
+immutable), so re-demoting an unchanged model is free — the next
+eviction finds its key already staged and only bumps the LRU clock.
+
+Concurrency: one small lock covers the maps and byte accounting; every
+heavy step — the device->host copy, the ``.npy`` spool write/read, the
+sidecar copy, directory removal — runs OUTSIDE it, guarded by per-entry
+busy marks (the concurrency lint's blocking-under-lock rule enforces
+the split, same as ``ModelPool._free_entry_locked``/``_finish_free``).
+A demotion that crashes mid-copy (the seeded ``FaultPlan`` drill, op
+``tiers.demote``) unregisters its half-built entry and deletes its
+partial spool: the model is either fully tiered or fully freed, never
+half.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+
+logger = logging.getLogger("modelx.tiers")
+
+__all__ = [
+    "TierStore", "Promotion", "content_key", "dir_pairs", "ref_pairs",
+    "is_resource_exhausted",
+]
+
+# tier names as they appear in snapshots, events, and /admin/models
+HOST = "host"
+DISK = "disk"
+
+# fault-plan ops (testing/faults.py): seeded crash/latency points for the
+# chaos demotion drills — from_env-gated, default off, like every seam
+OP_DEMOTE = "tiers.demote"
+OP_PROMOTE = "tiers.promote"
+OP_SPILL = "tiers.spill"
+
+
+def is_resource_exhausted(exc: BaseException | None) -> bool:
+    """Is this exception (or anything in its cause/context chain) an XLA
+    device-allocator failure? jax spells it differently across versions —
+    ``jaxlib.xla_extension.XlaRuntimeError`` with a ``RESOURCE_EXHAUSTED``
+    status string is the stable signal; match by type NAME so the check
+    never imports jaxlib internals (and so tests can fabricate one)."""
+    seen = 0
+    while exc is not None and seen < 8:
+        name = type(exc).__name__
+        text = str(exc)
+        if "RESOURCE_EXHAUSTED" in text:
+            return True
+        if name in ("XlaRuntimeError", "ResourceExhausted",
+                    "ResourceExhaustedError"):
+            low = text.lower()
+            if "out of memory" in low or "allocat" in low:
+                return True
+        exc = exc.__cause__ or exc.__context__
+        seen += 1
+    return False
+
+
+def _np_dtype(name: str):
+    """Resolve a dtype name to numpy, falling back to the ml_dtypes
+    extension types (bfloat16, float8_*) numpy itself can't spell."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def dir_pairs(model_dir: str) -> list[tuple[str, int, str]]:
+    """Sorted ``(basename, size, mtime_ns)`` of every ``*.safetensors``
+    under a local checkpoint dir — the content-key material for dir
+    loads. The mtime salt means an unchanged dir re-keys identically
+    while a rewritten checkpoint misses (never serve stale weights)."""
+    import glob
+
+    pairs = []
+    for path in glob.glob(os.path.join(model_dir, "*.safetensors")):
+        try:
+            st = os.stat(path)
+            pairs.append((os.path.basename(path), int(st.st_size),
+                          str(st.st_mtime_ns)))
+        except OSError:
+            logger.debug("stat %s failed for tier key", path, exc_info=True)
+    return sorted(pairs)
+
+
+def ref_pairs(uri: str) -> list[tuple[str, int, str]]:
+    """Sorted ``(blob name, size, digest)`` of a registry ref's
+    ``.safetensors`` blobs, read from the manifest — BEFORE any weight
+    byte moves, so a tier hit skips the pull entirely. The digest salt
+    is exact content identity: same-shaped models with different
+    weights key apart."""
+    from modelx_tpu.client.reference import parse_reference
+
+    ref = parse_reference(uri)
+    client = ref.client(quiet=True)
+    manifest = client.get_manifest(ref.repository, ref.version)
+    return sorted(
+        (b.name, int(b.size or 0), str(b.digest or ""))
+        for b in manifest.blobs if b.name.endswith(".safetensors")
+    )
+
+
+def content_key(pairs: list[tuple[str, int, str]], mesh_key: str = "") -> str:
+    """Digest of sorted ``(name, size, salt)`` triples + the mesh env
+    key. Empty when there is nothing to key (no safetensors)."""
+    if not pairs:
+        return ""
+    h = hashlib.sha256()
+    h.update(mesh_key.encode())
+    for name, size, salt in sorted(pairs):
+        h.update(b"\0")
+        h.update(name.encode())
+        h.update(str(int(size)).encode())
+        h.update(b"\0")
+        h.update(str(salt).encode())
+    return h.hexdigest()[:16]
+
+
+class Promotion:
+    """What ``TierStore.promote`` hands the load path: materialized host
+    leaves + everything needed to rebuild the server without touching
+    bytes — ``ModelServer.load_from_tier`` device_puts each leaf to its
+    recorded sharding and compiles as usual."""
+
+    __slots__ = ("key", "tier", "leaves", "treedef", "shardings", "family",
+                 "cfg", "param_sds", "sidecar_dir", "nbytes")
+
+    def __init__(self, key, tier, leaves, treedef, shardings, family, cfg,
+                 param_sds, sidecar_dir, nbytes) -> None:
+        self.key = key
+        self.tier = tier
+        self.leaves = leaves
+        self.treedef = treedef
+        self.shardings = shardings
+        self.family = family
+        self.cfg = cfg
+        self.param_sds = param_sds
+        self.sidecar_dir = sidecar_dir
+        self.nbytes = nbytes
+
+
+class _Entry:
+    __slots__ = ("key", "name", "state", "treedef", "shardings", "leaves",
+                 "spool_dir", "sidecar_dir", "nbytes", "family", "cfg",
+                 "param_sds", "last_used", "hits", "busy", "dropped")
+
+    def __init__(self, key: str, name: str) -> None:
+        self.key = key
+        self.name = name            # last model name staged under this key
+        self.state = "staging"      # staging -> host -> disk (or dropped)
+        self.treedef = None
+        self.shardings: list = []
+        self.leaves: list | None = None   # host-RAM numpy arrays
+        self.spool_dir = ""               # disk tier .npy spool
+        self.sidecar_dir = ""             # tokenizer/config sidecars
+        self.nbytes = 0
+        self.family = None
+        self.cfg = None
+        self.param_sds = None
+        self.last_used = time.monotonic()
+        self.hits = 0
+        self.busy = 0               # promotions/demotions in flight
+        self.dropped = False        # delete deferred until busy drains
+
+
+class TierStore:
+    """Bounded host-RAM + local-disk staging for demoted model state.
+
+    ``host_budget_bytes``/``disk_budget_bytes`` bound each tier (0
+    disables that tier; both 0 disables the store — ``offer`` and
+    ``promote`` become no-ops and the pool behaves exactly as before).
+    LRU within each tier: host overflow spills the least-recently-used
+    host entry to disk, disk overflow drops the oldest spool.
+    """
+
+    def __init__(self, host_budget_bytes: int = 0, disk_budget_bytes: int = 0,
+                 spool_root: str = "", mesh_key: str = "",
+                 recorder=None, fault_plan=None) -> None:
+        self.host_budget_bytes = int(host_budget_bytes)
+        self.disk_budget_bytes = int(disk_budget_bytes)
+        self.spool_root = spool_root or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), "modelx-state-spool"
+        )
+        self.mesh_key = mesh_key
+        self.recorder = recorder      # utils/flightrec.FlightRecorder or None
+        if fault_plan is None:
+            from modelx_tpu.testing import faults
+
+            fault_plan = faults.from_env()
+        self.fault_plan = fault_plan
+        self._lock = threading.Lock()
+        self._entries: dict[str, _Entry] = {}
+        self._order: list[str] = []   # LRU: oldest first (rebuilt on touch)
+        self.stats = {
+            "host_hits": 0, "disk_hits": 0, "misses": 0,
+            "demotions_host": 0, "demotions_disk": 0, "demotions_dropped": 0,
+            "demotion_failures": 0, "promotions_host": 0,
+            "promotions_disk": 0, "spills": 0,
+        }
+
+    @property
+    def enabled(self) -> bool:
+        return self.host_budget_bytes > 0 or self.disk_budget_bytes > 0
+
+    def key_for(self, pairs: list[tuple[str, int]]) -> str:
+        return content_key(pairs, self.mesh_key)
+
+    def _record(self, event: str, **fields) -> None:
+        if self.recorder is not None:
+            self.recorder.record(event, **fields)
+
+    def _fire(self, op: str) -> None:
+        if self.fault_plan is not None:
+            self.fault_plan.maybe_fail(op)
+
+    # -- accounting (caller holds the lock) -----------------------------------
+
+    def _tier_bytes(self, state: str) -> int:
+        return sum(e.nbytes for e in self._entries.values()
+                   if e.state == state)
+
+    def _touch(self, e: _Entry) -> None:
+        e.last_used = time.monotonic()
+
+    def _lru(self, state: str, exclude: str = "") -> "_Entry | None":
+        live = [e for e in self._entries.values()
+                if e.state == state and not e.busy and not e.dropped
+                and e.key != exclude]
+        return min(live, key=lambda e: e.last_used) if live else None
+
+    # -- demotion -------------------------------------------------------------
+
+    def offer(self, key: str, name: str, params, *, family=None, cfg=None,
+              param_sds=None, sidecar_src: str = "") -> bool:
+        """Stage one model's live params into the tier ladder; called by
+        the pool's free path OFF the pool lock. Returns True when the
+        state landed (or was already staged). Never raises: a demotion
+        failure degrades to the old discard behavior."""
+        if not self.enabled or not key or params is None:
+            return False
+        with self._lock:
+            cur = self._entries.get(key)
+            if cur is not None:
+                if cur.state in (HOST, DISK):
+                    # weights are immutable: same key == same bytes; the
+                    # existing entry just gets younger
+                    self._touch(cur)
+                    return True
+                return False  # a demotion for this key is already staging
+            e = self._entries[key] = _Entry(key, name)
+        try:
+            return self._demote(e, params, family, cfg, param_sds, sidecar_src)
+        except BaseException as exc:
+            # mid-demotion crash (injected or real): fully freed, never
+            # half — unregister the entry and delete any partial spool
+            self._discard_partial(e)
+            with self._lock:
+                self.stats["demotion_failures"] += 1
+            self._record("tier.demote.failed", model=name, error=str(exc))
+            logger.warning("demotion of %s to tiers failed: %s", name, exc)
+            return False
+
+    def _demote(self, e: _Entry, params, family, cfg, param_sds,
+                sidecar_src: str) -> bool:
+        """The heavy half of a demotion (no store lock held): fault point,
+        device->host copy, sidecar preservation, then finalize under the
+        lock and resolve any budget overflow."""
+        self._fire(OP_DEMOTE)
+        t0 = time.monotonic()
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        shardings = [getattr(leaf, "sharding", None) for leaf in leaves]
+        host = [np.asarray(leaf) for leaf in leaves]
+        nbytes = sum(int(a.nbytes) for a in host)
+        fits_host = 0 < nbytes <= self.host_budget_bytes
+        fits_disk = 0 < nbytes <= self.disk_budget_bytes
+        if not fits_host and not fits_disk:
+            self._discard_partial(e)
+            with self._lock:
+                self.stats["demotions_dropped"] += 1
+            self._record("tier.demote.dropped", model=e.name, bytes=nbytes)
+            return False
+        sidecar = self._preserve_sidecar(e.key, sidecar_src)
+        e.treedef = treedef
+        e.shardings = shardings
+        e.nbytes = nbytes
+        e.family = family
+        e.cfg = cfg
+        e.param_sds = param_sds
+        e.sidecar_dir = sidecar
+        if fits_host:
+            e.leaves = host
+            spill_victims = self._finalize(e, HOST, "demotions_host")
+        else:
+            # straight to disk: host tier too small (or disabled)
+            self._spool(e, host)
+            spill_victims = self._finalize(e, DISK, "demotions_disk")
+        self._resolve_spills(spill_victims)
+        self._record(
+            "tier.demote", model=e.name, tier=e.state, bytes=nbytes,
+            ms=round((time.monotonic() - t0) * 1e3, 1),
+        )
+        logger.info("model %s demoted to %s tier (%d bytes)",
+                    e.name, e.state, nbytes)
+        return True
+
+    def _finalize(self, e: _Entry, state: str, stat: str) -> list:
+        """Flip a staged entry live and collect LRU overflow victims
+        (returned for the caller to resolve OFF the lock)."""
+        with self._lock:
+            e.state = state
+            self._touch(e)
+            self.stats[stat] += 1
+            return self._overflow_locked(exclude=e.key)
+
+    def _overflow_locked(self, exclude: str = "") -> list:
+        """Caller holds the lock: pick (victim, action) pairs until both
+        tiers fit their budgets; victims are marked busy. Actions:
+        ``spill`` (host -> disk) or ``drop``."""
+        plan = []
+        guard = 0
+        while guard < 64:
+            guard += 1
+            host_bytes = self._tier_bytes(HOST)
+            if self.host_budget_bytes and host_bytes > self.host_budget_bytes:
+                v = self._lru(HOST, exclude=exclude)
+                if v is None:
+                    break
+                v.busy += 1
+                # spill when its bytes could ever fit the disk budget,
+                # else drop outright
+                act = "spill" if 0 < v.nbytes <= self.disk_budget_bytes else "drop"
+                if act == "spill":
+                    v.state = DISK  # counts against disk from now on
+                plan.append((v, act))
+                continue
+            disk_bytes = self._tier_bytes(DISK)
+            if self.disk_budget_bytes and disk_bytes > self.disk_budget_bytes:
+                v = self._lru(DISK, exclude=exclude)
+                if v is None:
+                    break
+                v.busy += 1
+                plan.append((v, "drop"))
+                continue
+            break
+        return plan
+
+    def _resolve_spills(self, plan: list) -> None:
+        """Perform overflow actions off the lock: spool host victims to
+        disk, delete dropped victims' artifacts."""
+        for victim, action in plan:
+            if action == "spill":
+                try:
+                    self._fire(OP_SPILL)
+                    self._spool(victim, victim.leaves or [])
+                    with self._lock:
+                        victim.leaves = None
+                        victim.busy -= 1
+                        self.stats["spills"] += 1
+                        more = self._overflow_locked()
+                    self._record("tier.spill", model=victim.name,
+                                 bytes=victim.nbytes)
+                except BaseException as exc:
+                    logger.warning("spill of %s to disk failed: %s",
+                                   victim.name, exc)
+                    with self._lock:
+                        victim.busy -= 1
+                        victim.dropped = True
+                        more = []
+                    self._reap(victim)
+                self._resolve_spills(more)
+            else:
+                with self._lock:
+                    victim.busy -= 1
+                    victim.dropped = True
+                    self.stats["demotions_dropped"] += 1
+                self._reap(victim)
+                self._record("tier.drop", model=victim.name,
+                             bytes=victim.nbytes)
+
+    def _spool(self, e: _Entry, host_leaves: list) -> None:
+        """Write leaves as ``.npy`` files under the spool root (decoded
+        tensors — a disk promote skips the safetensors parse AND the
+        sharding plan, it just device_puts what it reads). Extension
+        dtypes (bfloat16 etc.) don't survive ``np.save`` (they land as
+        void records), so those leaves spool as raw bytes and a
+        ``meta.json`` records the dtype + shape to view them back."""
+        import json
+
+        spool = os.path.join(self.spool_root, e.key, "leaves")
+        os.makedirs(spool, exist_ok=True)
+        meta = []
+        for i, arr in enumerate(host_leaves):
+            path = os.path.join(spool, f"{i:05d}.npy")
+            # isbuiltin == 1 for numpy's own scalar types; ml_dtypes'
+            # registered extension types report 2 and np.save mangles
+            # them into void records
+            raw = arr.dtype.isbuiltin != 1
+            if raw:
+                np.save(path, np.frombuffer(arr.tobytes(), np.uint8),
+                        allow_pickle=False)
+            else:
+                np.save(path, arr, allow_pickle=False)
+            meta.append({"dtype": arr.dtype.name, "shape": list(arr.shape),
+                         "raw": raw})
+        with open(os.path.join(spool, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        e.spool_dir = spool
+
+    def _preserve_sidecar(self, key: str, src: str) -> str:
+        """Copy the checkpoint dir's small non-safetensors files
+        (tokenizer.json, config sidecars) so a promotion can rebuild a
+        working ModelServer after the staged dir is rmtree'd. Weight
+        files are NOT copied — the tiers hold those as tensors."""
+        if not src or not os.path.isdir(src):
+            return ""
+        dest = os.path.join(self.spool_root, key, "sidecar")
+        try:
+            os.makedirs(dest, exist_ok=True)
+            for fn in os.listdir(src):
+                if fn.endswith(".safetensors"):
+                    continue
+                s = os.path.join(src, fn)
+                if os.path.isfile(s):
+                    shutil.copy2(s, os.path.join(dest, fn))
+            return dest
+        except OSError as exc:
+            logger.warning("sidecar preserve from %s failed: %s", src, exc)
+            return ""
+
+    def _discard_partial(self, e: _Entry) -> None:
+        """Unregister a half-built entry and remove anything it spooled
+        (crash-consistency: fully tiered or fully gone)."""
+        with self._lock:
+            self._entries.pop(e.key, None)
+        shutil.rmtree(os.path.join(self.spool_root, e.key),
+                      ignore_errors=True)
+
+    def _reap(self, e: _Entry) -> None:
+        """Delete a dropped entry's disk artifacts and unregister it
+        (leaves are freed by losing the reference)."""
+        with self._lock:
+            if e.busy > 0:
+                return  # the busy holder reaps on release
+            self._entries.pop(e.key, None)
+            e.leaves = None
+        if e.spool_dir or e.sidecar_dir:
+            shutil.rmtree(os.path.join(self.spool_root, e.key),
+                          ignore_errors=True)
+
+    # -- promotion ------------------------------------------------------------
+
+    def promote(self, key: str) -> Promotion | None:
+        """Materialize a staged entry for the load path (host leaves
+        ready for ``jax.device_put``); None on miss. The entry STAYS in
+        its tier — weights are immutable, so the next demotion of the
+        same content is free."""
+        if not self.enabled or not key:
+            return None
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.dropped or e.state not in (HOST, DISK):
+                self.stats["misses"] += 1
+                return None
+            e.busy += 1
+            self._touch(e)
+            tier = e.state
+        try:
+            self._fire(OP_PROMOTE)
+            t0 = time.monotonic()
+            if tier == HOST:
+                leaves = list(e.leaves or [])
+            else:
+                leaves = self._unspool(e)
+            promo = Promotion(
+                key, tier, leaves, e.treedef, list(e.shardings), e.family,
+                e.cfg, e.param_sds, e.sidecar_dir, e.nbytes,
+            )
+            with self._lock:
+                e.hits += 1
+                self.stats[f"{tier}_hits"] += 1
+                self.stats[f"promotions_{tier}"] += 1
+            self._record(
+                "tier.promote", model=e.name, tier=tier, bytes=e.nbytes,
+                ms=round((time.monotonic() - t0) * 1e3, 1),
+            )
+            return promo
+        except BaseException as exc:
+            logger.warning("promotion of %s from %s tier failed: %s",
+                           e.name, tier, exc)
+            return None
+        finally:
+            dropped = False
+            with self._lock:
+                e.busy -= 1
+                dropped = e.dropped and e.busy == 0
+            if dropped:
+                self._reap(e)
+
+    def _unspool(self, e: _Entry) -> list:
+        import json
+
+        with open(os.path.join(e.spool_dir, "meta.json")) as f:
+            meta = json.load(f)
+        leaves = []
+        for i, m in enumerate(meta):
+            arr = np.load(os.path.join(e.spool_dir, f"{i:05d}.npy"),
+                          allow_pickle=False)
+            if m["raw"]:
+                arr = arr.view(_np_dtype(m["dtype"])).reshape(m["shape"])
+            leaves.append(arr)
+        return leaves
+
+    # -- operational controls -------------------------------------------------
+
+    def spill_host(self) -> int:
+        """Push every host-tier entry to disk (bench's disk leg and a
+        pre-shutdown spill). Returns how many entries moved."""
+        moved = 0
+        while True:
+            with self._lock:
+                e = self._lru(HOST)
+                if e is None:
+                    return moved
+                e.busy += 1
+            try:
+                self._fire(OP_SPILL)
+                self._spool(e, e.leaves or [])
+                with self._lock:
+                    e.state = DISK
+                    e.leaves = None
+                    e.busy -= 1
+                    self.stats["spills"] += 1
+                    plan = self._overflow_locked()
+                moved += 1
+                self._resolve_spills(plan)
+            except BaseException as exc:
+                logger.warning("spill of %s failed: %s", e.name, exc)
+                with self._lock:
+                    e.busy -= 1
+                    e.dropped = True
+                self._reap(e)
+
+    def drop(self, key: str) -> bool:
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                return False
+            e.dropped = True
+            busy = e.busy > 0
+        if not busy:
+            self._reap(e)
+        return True
+
+    def tier_of(self, key: str) -> str | None:
+        if not key:
+            return None
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.dropped or e.state not in (HOST, DISK):
+                return None
+            return e.state
+
+    def close(self) -> None:
+        """Drop everything (tests + shutdown): host arrays by reference,
+        spools by rmtree of the whole root-owned keyspace."""
+        with self._lock:
+            entries = list(self._entries.values())
+            self._entries.clear()
+        for e in entries:
+            e.leaves = None
+            if e.spool_dir or e.sidecar_dir:
+                shutil.rmtree(os.path.join(self.spool_root, e.key),
+                              ignore_errors=True)
+
+    def snapshot(self) -> dict:
+        """Per-tier budgets/bytes/entries + hit/promotion/demotion
+        counters for ``pool_snapshot()`` / ``/admin/models`` /
+        ``/metrics`` (numbers only: promexp renders them as gauges)."""
+        with self._lock:
+            host_entries = [e for e in self._entries.values()
+                            if e.state == HOST]
+            disk_entries = [e for e in self._entries.values()
+                            if e.state == DISK]
+            snap = {
+                "host": {
+                    "budget_bytes": self.host_budget_bytes,
+                    "bytes": sum(e.nbytes for e in host_entries),
+                    "entries": len(host_entries),
+                    "hits": self.stats["host_hits"],
+                    "demotions": self.stats["demotions_host"],
+                    "promotions": self.stats["promotions_host"],
+                },
+                "disk": {
+                    "budget_bytes": self.disk_budget_bytes,
+                    "bytes": sum(e.nbytes for e in disk_entries),
+                    "entries": len(disk_entries),
+                    "hits": self.stats["disk_hits"],
+                    "demotions": self.stats["demotions_disk"],
+                    "promotions": self.stats["promotions_disk"],
+                },
+                "misses": self.stats["misses"],
+                "spills": self.stats["spills"],
+                "demotions_dropped": self.stats["demotions_dropped"],
+                "demotion_failures": self.stats["demotion_failures"],
+            }
+        return snap

@@ -313,6 +313,50 @@ class TestSwapLeg:
         assert out["swap_cache_hits"] >= 1
 
 
+class TestTierSwapLeg:
+    @pytest.mark.slow
+    def test_measure_tier_swap_schema(self, tmp_path):
+        """The tier-swap leg end to end on tiny models (ISSUE 18): cold
+        swap-in, host-tier promotion swap-in, forced spill, disk-tier
+        promotion swap-in — all under live traffic to C. Schema-checks
+        the JSON keys, that the host and disk legs actually hit their
+        tiers, and that traffic never failed."""
+        import bench
+        from modelx_tpu.registry.fs import MemoryFSProvider
+        from modelx_tpu.registry.server import (
+            Options, RegistryServer, free_port,
+        )
+        from modelx_tpu.registry.store_fs import FSRegistryStore
+
+        srv = RegistryServer(
+            Options(listen=f"127.0.0.1:{free_port()}"),
+            store=FSRegistryStore(MemoryFSProvider()),
+        )
+        base = srv.serve_background()
+        try:
+            out = bench.measure_tier_swap(
+                base, str(tmp_path), target_bytes=1,
+                hidden=64, inter=176, vocab=256, prompt_len=4, new_tokens=2,
+            )
+        finally:
+            srv.shutdown()
+        for key in ("ttft_swap_cold_ms", "ttft_swap_host_ms",
+                    "ttft_swap_disk_ms", "tier_traffic_served",
+                    "tier_traffic_errors", "tier_host_hits",
+                    "tier_disk_hits", "tier_spills"):
+            assert key in out, key
+        assert out["ttft_swap_cold_ms"] > 0
+        assert out["ttft_swap_host_ms"] > 0
+        assert out["ttft_swap_disk_ms"] > 0
+        # each promotion leg was served by its tier, not a re-pull
+        assert out["tier_host_hits"] == 1
+        assert out["tier_disk_hits"] == 1
+        assert out["tier_spills"] >= 1
+        # the uninterrupted-traffic contract: C kept serving throughout
+        assert out["tier_traffic_errors"] == 0
+        assert out["tier_traffic_served"] >= 1
+
+
 class TestFleetLeg:
     @pytest.mark.slow
     def test_measure_fleet_schema(self, tmp_path):

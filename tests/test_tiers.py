@@ -1,0 +1,484 @@
+"""Multi-tier live model state tests (ISSUE 18): the HBM -> host RAM ->
+local disk demotion ladder in ``dl/tiers.py`` and its lifecycle-pool
+integration — content keying, offer/promote round-trips, LRU overflow
+and spill, keep-on-promote, the pool's demote-on-unload / promote-on-load
+end-to-end path, the injected RESOURCE_EXHAUSTED load drill (recovery via
+demotion with zero dropped in-flight requests on survivors), and the
+eviction races (demote-while-loading, promote-while-draining, seeded
+crash mid-demotion proving fully-tiered-or-fully-freed).
+
+Tier-1 keeps the store units, one end-to-end promotion representative,
+and the OOM drill; the heavier race/chaos matrices carry ``slow``/
+``chaos`` markers and run under ``make tiers`` (MODELX_LOCKDEP=1)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from modelx_tpu.dl import tiers
+from modelx_tpu.dl.lifecycle import (
+    READY,
+    UNLOADED,
+    PoolError,
+    estimate_dir_bytes,
+)
+from modelx_tpu.dl.serve import ModelServer, ServerSet
+from modelx_tpu.dl.tiers import TierStore
+from modelx_tpu.testing.faults import FaultPlan, InjectedCrash
+from tests.test_lifecycle import make_server, write_tiny
+
+
+@pytest.fixture(scope="module")
+def model_dirs(tmp_path_factory):
+    root = tmp_path_factory.mktemp("tier-models")
+    dirs = {}
+    for name, seed in (("a", 0), ("b", 1), ("c", 2)):
+        d = root / name
+        write_tiny(str(d), seed)
+        dirs[name] = str(d)
+    return dirs
+
+
+def make_store(tmp_path, host=1 << 30, disk=1 << 30, fault_plan=None):
+    return TierStore(host_budget_bytes=host, disk_budget_bytes=disk,
+                     spool_root=str(tmp_path / "spool"),
+                     fault_plan=fault_plan)
+
+
+def tiny_params(seed=0, n=3, shape=(8, 4)):
+    rng = np.random.RandomState(seed)
+    return {f"w{i}": jnp.asarray(rng.rand(*shape).astype(np.float32))
+            for i in range(n)}
+
+
+def params_nbytes(params):
+    return sum(int(np.asarray(v).nbytes) for v in params.values())
+
+
+# -- keying -------------------------------------------------------------------
+
+
+class TestContentKey:
+    def test_deterministic_and_order_free(self):
+        pairs = [("b.safetensors", 10, "d1"), ("a.safetensors", 20, "d2")]
+        assert tiers.content_key(pairs) == tiers.content_key(pairs[::-1])
+        assert len(tiers.content_key(pairs)) == 16
+
+    def test_salt_and_mesh_change_the_key(self):
+        pairs = [("m.safetensors", 10, "d1")]
+        assert (tiers.content_key(pairs)
+                != tiers.content_key([("m.safetensors", 10, "d2")]))
+        assert (tiers.content_key(pairs, "dp=1")
+                != tiers.content_key(pairs, "dp=2"))
+
+    def test_empty_pairs_key_empty(self):
+        assert tiers.content_key([]) == ""
+
+    def test_dir_pairs_salts_with_mtime(self, tmp_path):
+        p = tmp_path / "model.safetensors"
+        p.write_bytes(b"x" * 64)
+        before = tiers.dir_pairs(str(tmp_path))
+        assert before[0][0] == "model.safetensors"
+        assert before[0][1] == 64
+        # a rewritten checkpoint must key DIFFERENTLY (same name + size,
+        # new bytes): stale tier state must never serve for new weights
+        os.utime(p, ns=(1, 1))
+        after = tiers.dir_pairs(str(tmp_path))
+        assert tiers.content_key(before) != tiers.content_key(after)
+
+
+class TestIsResourceExhausted:
+    def test_matches_status_text(self):
+        assert tiers.is_resource_exhausted(
+            RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating"))
+
+    def test_matches_fabricated_xla_error_in_cause_chain(self):
+        XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+        inner = XlaRuntimeError("out of memory while allocating 1g")
+        try:
+            raise RuntimeError("load failed") from inner
+        except RuntimeError as outer:
+            assert tiers.is_resource_exhausted(outer)
+
+    def test_ordinary_errors_do_not_match(self):
+        assert not tiers.is_resource_exhausted(ValueError("bad dtype"))
+        assert not tiers.is_resource_exhausted(None)
+
+
+# -- the store ----------------------------------------------------------------
+
+
+class TestTierStoreUnit:
+    def test_disabled_store_is_inert(self, tmp_path):
+        store = make_store(tmp_path, host=0, disk=0)
+        assert not store.enabled
+        assert not store.offer("k", "m", tiny_params())
+        assert store.promote("k") is None
+
+    def test_offer_promote_round_trip_host(self, tmp_path):
+        store = make_store(tmp_path)
+        params = tiny_params(seed=3)
+        assert store.offer("k1", "m", params)
+        assert store.tier_of("k1") == "host"
+        promo = store.promote("k1")
+        assert promo is not None and promo.tier == "host"
+        restored = jax.tree_util.tree_unflatten(
+            promo.treedef,
+            [jax.device_put(a, s) if s is not None else jax.device_put(a)
+             for a, s in zip(promo.leaves, promo.shardings)],
+        )
+        for k in params:
+            np.testing.assert_array_equal(np.asarray(params[k]),
+                                          np.asarray(restored[k]))
+
+    def test_keep_on_promote_and_free_redemote(self, tmp_path):
+        store = make_store(tmp_path)
+        store.offer("k1", "m", tiny_params())
+        assert store.promote("k1") is not None
+        # the entry STAYS (weights immutable): a second offer of the same
+        # key is a free LRU touch, not another device->host copy
+        assert store.tier_of("k1") == "host"
+        assert store.offer("k1", "m", tiny_params())
+        assert store.snapshot()["host"]["demotions"] == 1
+
+    def test_disk_round_trip_preserves_bfloat16(self, tmp_path):
+        # np.save mangles extension dtypes into void records; the spool
+        # must round-trip them (the raw-bytes + meta.json path)
+        store = make_store(tmp_path, host=0)
+        params = {"w": jnp.asarray(np.arange(24, dtype=np.float32)
+                                   .reshape(6, 4)).astype(jnp.bfloat16)}
+        assert store.offer("k1", "m", params)
+        assert store.tier_of("k1") == "disk"
+        promo = store.promote("k1")
+        assert promo.tier == "disk"
+        assert str(promo.leaves[0].dtype) == "bfloat16"
+        np.testing.assert_array_equal(
+            np.asarray(promo.leaves[0], dtype=np.float32),
+            np.asarray(params["w"], dtype=np.float32))
+
+    def test_host_overflow_spills_lru_to_disk(self, tmp_path):
+        one = params_nbytes(tiny_params())
+        store = make_store(tmp_path, host=int(one * 1.5))
+        store.offer("k1", "m1", tiny_params(1))
+        store.offer("k2", "m2", tiny_params(2))  # k1 (older) spills
+        assert store.tier_of("k1") == "disk"
+        assert store.tier_of("k2") == "host"
+        assert store.snapshot()["spills"] == 1
+
+    def test_disk_overflow_drops_oldest(self, tmp_path):
+        one = params_nbytes(tiny_params())
+        store = make_store(tmp_path, host=0, disk=int(one * 1.5))
+        store.offer("k1", "m1", tiny_params(1))
+        store.offer("k2", "m2", tiny_params(2))  # k1 (older) drops
+        assert store.tier_of("k1") is None
+        assert store.tier_of("k2") == "disk"
+        assert store.snapshot()["demotions_dropped"] == 1
+        # dropped spool artifacts are reaped from disk
+        assert not os.path.exists(os.path.join(store.spool_root, "k1"))
+
+    def test_oversized_offer_is_dropped_whole(self, tmp_path):
+        one = params_nbytes(tiny_params())
+        store = make_store(tmp_path, host=one // 2, disk=one // 2)
+        assert not store.offer("k1", "m", tiny_params())
+        assert store.tier_of("k1") is None
+        assert store.snapshot()["demotions_dropped"] == 1
+
+    def test_spill_host_moves_everything(self, tmp_path):
+        store = make_store(tmp_path)
+        store.offer("k1", "m1", tiny_params(1))
+        store.offer("k2", "m2", tiny_params(2))
+        assert store.spill_host() == 2
+        assert store.tier_of("k1") == "disk"
+        assert store.tier_of("k2") == "disk"
+        assert store.promote("k1").tier == "disk"
+
+    def test_crash_mid_demotion_is_fully_freed(self, tmp_path):
+        """The FaultPlan drill (op ``tiers.demote``): an injected crash
+        mid-copy must leave NO entry and NO partial spool — the model is
+        either fully tiered or fully freed, never half."""
+        plan = FaultPlan(seed=7).add(tiers.OP_DEMOTE, errors_at=[0],
+                                     error=InjectedCrash("died mid-demote"))
+        store = make_store(tmp_path, fault_plan=plan)
+        assert not store.offer("k1", "m", tiny_params())
+        assert store.tier_of("k1") is None
+        assert store.snapshot()["demotion_failures"] == 1
+        assert not os.path.exists(os.path.join(store.spool_root, "k1"))
+        # the NEXT offer of the same key succeeds (entry unregistered)
+        assert store.offer("k1", "m", tiny_params())
+        assert store.tier_of("k1") == "host"
+
+    def test_crash_mid_promotion_returns_miss(self, tmp_path):
+        plan = FaultPlan(seed=7).add(tiers.OP_PROMOTE, errors_at=[0],
+                                     error=InjectedCrash("died mid-promote"))
+        store = make_store(tmp_path, fault_plan=plan)
+        store.offer("k1", "m", tiny_params())
+        assert store.promote("k1") is None  # crashed attempt -> miss
+        assert store.promote("k1") is not None  # entry intact, retry works
+
+
+# -- pool integration ---------------------------------------------------------
+
+
+def tier_sset(model_dirs, tmp_path, names=("a", "b"), **kw):
+    kw.setdefault("host_state_budget_bytes", 1 << 30)
+    kw.setdefault("disk_state_budget_bytes", 1 << 30)
+    kw.setdefault("state_spool_dir", str(tmp_path / "spool"))
+    kw.setdefault("staging_root", str(tmp_path / "staging"))
+    kw.setdefault("allow_admin_load", True)
+    sset = ServerSet({n: make_server(model_dirs[n], name=n) for n in names},
+                     **kw)
+    sset.load_all()
+    return sset
+
+
+class TestPoolTiering:
+    def test_unload_demotes_and_reload_promotes_token_exact(
+            self, model_dirs, tmp_path):
+        """The tier-1 end-to-end representative: unload B (params demote
+        to the host tier), re-load B from the same dir (tier promotion —
+        no safetensors parse), and the promoted server generates
+        TOKEN-EXACTLY what a churn-free baseline does."""
+        sset = tier_sset(model_dirs, tmp_path)
+        baseline = make_server(model_dirs["b"], name="baseline")
+        baseline.load()
+        prompt = np.asarray([[1, 2, 3]], np.int32)
+        expected = baseline.generate(prompt, max_new_tokens=8)
+
+        sset.pool.request_unload("b", wait=True)
+        states = sset.pool.states()
+        assert states["b"]["state"] == UNLOADED
+        assert states["b"]["tier"] == "host"
+        snap = sset.pool.pool_snapshot()["tiers"]
+        assert snap["host"]["entries"] == 1
+        assert snap["host"]["bytes"] > 0
+
+        sset.pool.request_load("b", model_dir=model_dirs["b"], wait=True)
+        states = sset.pool.states()
+        assert states["b"]["state"] == READY
+        assert states["b"]["tier"] == "hbm"
+        assert sset.servers["b"].stats.get("tier") == "host"
+        snap = sset.pool.pool_snapshot()["tiers"]
+        assert snap["host"]["hits"] == 1 and snap["host"]["promotions"] == 1
+        got = sset.servers["b"].generate(prompt, max_new_tokens=8)
+        np.testing.assert_array_equal(got, expected)
+        # promotions/demotions land in the pool flight recorder
+        events = [ev["event"]
+                  for ev in sset.pool.flightrec.summary()["events"]]
+        assert "tier.demote" in events and "tier.promote" in events
+
+    @pytest.mark.slow
+    def test_disk_promotion_after_spill(self, model_dirs, tmp_path):
+        sset = tier_sset(model_dirs, tmp_path)
+        sset.pool.request_unload("b", wait=True)
+        assert sset.pool.tiers.spill_host() == 1
+        assert sset.pool.states()["b"]["tier"] == "disk"
+        sset.pool.request_load("b", model_dir=model_dirs["b"], wait=True)
+        assert sset.pool.states()["b"]["state"] == READY
+        assert sset.servers["b"].stats.get("tier") == "disk"
+
+    @pytest.mark.slow
+    def test_eviction_demotes_instead_of_discarding(
+            self, model_dirs, tmp_path):
+        """The HBM-budget eviction path feeds the tiers: a load that
+        evicts idle B leaves B's params staged, not discarded."""
+        sset = tier_sset(model_dirs, tmp_path, evict_idle=True)
+        est_c = estimate_dir_bytes(model_dirs["c"])
+        # one byte short of fitting C next to A+B: evicting B suffices
+        sset.pool.hbm_budget_bytes = sset.pool.reserved_bytes() + est_c - 1
+        # touch A so B is the LRU victim
+        sset.pool.enter("a"), sset.pool.exit("a")
+        sset.pool.request_load("c", model_dir=model_dirs["c"], wait=True)
+        states = sset.pool.states()
+        assert states["c"]["state"] == READY
+        assert states["b"]["state"] == UNLOADED
+        assert states["b"]["tier"] == "host"
+
+    @pytest.mark.slow
+    def test_disabled_tiers_keep_old_discard_behavior(
+            self, model_dirs, tmp_path):
+        sset = tier_sset(model_dirs, tmp_path,
+                         host_state_budget_bytes=0,
+                         disk_state_budget_bytes=0)
+        sset.pool.request_unload("b", wait=True)
+        states = sset.pool.states()
+        assert states["b"]["state"] == UNLOADED
+        assert "tier" not in states["b"]
+        assert "tiers" not in sset.pool.pool_snapshot()
+
+
+class TestOOMRecovery:
+    def test_injected_resource_exhausted_recovers_via_demotion(
+            self, model_dirs, tmp_path, monkeypatch):
+        """The acceptance drill: the FIRST load attempt of C dies with a
+        fabricated XLA RESOURCE_EXHAUSTED; the pool demotes idle B, the
+        retry succeeds, and live traffic on surviving A drops ZERO
+        requests."""
+        sset = tier_sset(model_dirs, tmp_path)
+        XlaRuntimeError = type("XlaRuntimeError", (RuntimeError,), {})
+        real_load = ModelServer.load
+        fails = {"n": 0}
+
+        def flaky_load(self):
+            if self.name == "c" and fails["n"] == 0:
+                fails["n"] += 1
+                raise XlaRuntimeError(
+                    "RESOURCE_EXHAUSTED: Out of memory while trying to "
+                    "allocate 1073741824 bytes")
+            return real_load(self)
+
+        monkeypatch.setattr(ModelServer, "load", flaky_load)
+
+        stop = threading.Event()
+        counts = {"served": 0, "errors": 0}
+        prompt = np.asarray([[1, 2, 3]], np.int32)
+
+        def traffic():
+            while not stop.is_set():
+                try:
+                    sset.servers["a"].generate(prompt, max_new_tokens=2)
+                    counts["served"] += 1
+                except Exception:
+                    counts["errors"] += 1
+
+        t = threading.Thread(target=traffic, daemon=True)
+        t.start()
+        try:
+            # keep A hot so LRU demotion picks idle B, not the model
+            # carrying traffic
+            sset.pool.enter("a"), sset.pool.exit("a")
+            sset.pool.request_load("c", model_dir=model_dirs["c"], wait=True)
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        states = sset.pool.states()
+        assert fails["n"] == 1
+        assert states["c"]["state"] == READY
+        assert states["a"]["state"] == READY
+        assert states["b"]["state"] == UNLOADED  # demoted to make room
+        assert states["b"]["tier"] == "host"
+        assert counts["errors"] == 0 and counts["served"] > 0
+        events = [ev["event"]
+                  for ev in sset.pool.flightrec.summary()["events"]]
+        assert "pool.oom_retry" in events
+
+    @pytest.mark.slow
+    def test_oom_with_nothing_sheddable_surfaces_failed(
+            self, model_dirs, tmp_path, monkeypatch):
+        """No idle victim (single-tenant pool): the OOM is NOT retried —
+        the load lands FAILED with the original error, slot retryable."""
+        sset = tier_sset(model_dirs, tmp_path, names=("a",))
+
+        def always_oom(self):
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+        monkeypatch.setattr(ModelServer, "load", always_oom)
+        sset.pool.request_load("c", model_dir=model_dirs["c"], wait=True)
+        states = sset.pool.states()
+        assert states["c"]["state"] == "FAILED"
+        assert "RESOURCE_EXHAUSTED" in states["c"]["error"]
+        assert states["a"]["state"] == READY
+
+    def test_507_distinguishes_retryable_from_hard_refusal(
+            self, model_dirs, tmp_path):
+        """The 507 contract (ISSUE 18): busy models whose drain could
+        make room -> Retry-After + 'could free'; a load no demotion can
+        ever fit -> hard refusal, no Retry-After."""
+        sset = tier_sset(model_dirs, tmp_path)  # evict_idle off
+        est_c = estimate_dir_bytes(model_dirs["c"])
+        # one byte short: unloading either tenant would free enough,
+        # so the refusal is RETRYABLE
+        sset.pool.hbm_budget_bytes = sset.pool.reserved_bytes() + est_c - 1
+        with pytest.raises(PoolError) as ei:
+            sset.pool.request_load("c", model_dir=model_dirs["c"])
+        assert ei.value.status == 507
+        assert "could free" in str(ei.value)
+        assert ei.value.headers.get("Retry-After")
+        # a load no demotion can ever fit -> hard refusal
+        sset.pool.hbm_budget_bytes = 1
+        with pytest.raises(PoolError) as ei:
+            sset.pool.request_load("c", model_dir=model_dirs["c"])
+        assert ei.value.status == 507
+        assert "hard refusal" in str(ei.value)
+        assert not ei.value.headers
+
+
+# -- eviction races (make tiers: MODELX_LOCKDEP=1) ----------------------------
+
+
+@pytest.mark.chaos
+class TestEvictionRaces:
+    def test_demote_while_loading(self, model_dirs, tmp_path):
+        """Unload-B (demotion copy off-lock) racing a concurrent load of
+        C: both must land consistent — C READY, B fully tiered."""
+        sset = tier_sset(model_dirs, tmp_path)
+        errs: list = []
+
+        def unload_b():
+            try:
+                sset.pool.request_unload("b", wait=True)
+            except Exception as e:
+                errs.append(e)
+
+        t = threading.Thread(target=unload_b, daemon=True)
+        t.start()
+        sset.pool.request_load("c", model_dir=model_dirs["c"], wait=True)
+        t.join(timeout=60)
+        assert not errs
+        states = sset.pool.states()
+        assert states["c"]["state"] == READY
+        assert states["b"]["state"] == UNLOADED
+        assert states["b"]["tier"] == "host"  # fully tiered, not half
+
+    @pytest.mark.slow
+    def test_promote_while_draining(self, model_dirs, tmp_path):
+        """Re-load of demoted B (tier promotion) racing a drain of A with
+        a request in flight: the drain must not wedge the promotion and
+        both entries must land consistent."""
+        sset = tier_sset(model_dirs, tmp_path, names=("a", "b", "c"))
+        sset.pool.request_unload("b", wait=True)
+        assert sset.pool.states()["b"]["tier"] == "host"
+        sset.pool.enter("a")
+        done = threading.Event()
+
+        def drain_a():
+            sset.pool.request_unload("a", wait=True)
+            done.set()
+
+        t = threading.Thread(target=drain_a, daemon=True)
+        t.start()
+        time.sleep(0.05)  # the drain is now waiting on A's in-flight
+        sset.pool.request_load("b", model_dir=model_dirs["b"], wait=True)
+        sset.pool.exit("a")  # release the drain
+        assert done.wait(timeout=60)
+        t.join(timeout=10)
+        states = sset.pool.states()
+        assert states["b"]["state"] == READY
+        assert sset.servers["b"].stats.get("tier") == "host"
+        assert states["a"]["state"] == UNLOADED
+        assert states["a"]["tier"] == "host"  # the drain demoted A too
+
+    @pytest.mark.slow
+    def test_crash_mid_demotion_leaves_pool_consistent(
+            self, model_dirs, tmp_path):
+        """Seeded FaultPlan crash inside the pool's demotion path: the
+        unload itself must still complete (demotion failure degrades to
+        the old discard), the entry lands UNLOADED with no tier, and a
+        subsequent re-load works via the normal cold path."""
+        sset = tier_sset(model_dirs, tmp_path)
+        sset.pool.tiers.fault_plan = FaultPlan(seed=11).add(
+            tiers.OP_DEMOTE, errors_at=[0],
+            error=InjectedCrash("died mid-demotion"))
+        sset.pool.request_unload("b", wait=True)
+        states = sset.pool.states()
+        assert states["b"]["state"] == UNLOADED
+        assert states["b"]["tier"] == "none"  # fully freed, never half
+        assert sset.pool.tiers.snapshot()["demotion_failures"] == 1
+        sset.pool.request_load("b", model_dir=model_dirs["b"], wait=True)
+        assert sset.pool.states()["b"]["state"] == READY
+        assert sset.servers["b"].stats.get("tier") is None  # cold load

@@ -82,7 +82,26 @@ class TestTrainCLI:
         assert "needs" in res.output
 
     def test_finetune_from_model_dir(self, tmp_path):
+        """Finetune from a local checkpoint dir — run in a SUBPROCESS.
+
+        Root cause of the containment: in-process, this leg intermittently
+        dies with a native SIGABRT ("corrupted double-linked list", glibc
+        malloc arena corruption) when it runs at ~95% of a full tier-1
+        sweep, yet passes in isolation. The trigger is heap state, not
+        this test's logic: by that point the process has created and
+        dropped dozens of ModelServers/engines, and starting a NEW train
+        loop (fresh optimizer buffers + donated-argument jit on the
+        8-device virtual mesh) makes XLA's allocator recycle buffers an
+        earlier free corrupted. The corruption originates upstream of
+        this test — it is merely the first large allocator churn that
+        trips over it — so the containment is process isolation: a fresh
+        interpreter runs the identical CLI invocation and asserts the
+        same contract, and a heap poisoned by the preceding tests can no
+        longer abort the suite runner itself.
+        """
         import dataclasses
+        import subprocess
+        import sys
 
         import jax
         import jax.numpy as jnp
@@ -97,8 +116,16 @@ class TestTrainCLI:
         st.write_safetensors(
             str(d / "model.safetensors"), {k: np.asarray(v) for k, v in params.items()}
         )
-        out = _run("--steps", "2", "--batch", "2", "--seq", "8",
-                   "--model-dir", str(d), "--mesh", "dp=2", "--log-every", "1")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   XLA_FLAGS="--xla_force_host_platform_device_count=8")
+        res = subprocess.run(
+            [sys.executable, "-m", "modelx_tpu.models.train_main",
+             "--steps", "2", "--batch", "2", "--seq", "8",
+             "--model-dir", str(d), "--mesh", "dp=2", "--log-every", "1"],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+        assert res.returncode == 0, res.stdout + res.stderr
+        out = json.loads(res.stdout.strip().splitlines()[-1])
         assert out["steps"] == 2 and out["final_loss"] > 0
 
 
