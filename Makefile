@@ -5,7 +5,7 @@ PY ?= python
 IMAGE ?= modelx-tpu
 TAG ?= $(shell git describe --tags --always 2>/dev/null || echo dev)
 
-.PHONY: all native test chaos slow lifecycle fleet overload programs continuation obs mesh decode tiers lint wheel image image-dl compose-up compose-down clean
+.PHONY: all native test chaos slow lifecycle fleet overload programs continuation obs mesh decode tiers outage lint wheel image image-dl compose-up compose-down clean
 
 all: native lint test wheel
 
@@ -121,6 +121,17 @@ tiers:
 	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tiers.py \
 		"tests/test_bench_smoke.py::TestTierSwapLeg" -q
 	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_tiers.py -q -m chaos
+
+# control-plane brownout drills (ISSUE 19): pinned-manifest cache +
+# health units, multi-endpoint failover / hedging, offline pull +
+# swap-in, durable outbox + drainer, the seeded RegistryKillSwitch
+# brownout matrix, the bench outage leg — then the registry-killed-
+# under-traffic chaos soak. All under runtime lockdep: the outbox
+# drainer and health tracker add locks to the pool's order.
+outage:
+	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_outage.py \
+		tests/test_retry.py "tests/test_bench_smoke.py::TestRegistryOutageLeg" -q
+	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_outage.py -q -m chaos
 
 # two layers: the project-native concurrency/purity gate (always — it is
 # stdlib-only and baseline-governed, see docs/analysis.md), then generic

@@ -1532,6 +1532,153 @@ def measure_tier_swap(base: str, workdir: str, *, target_bytes: int = 16 << 20,
     }
 
 
+def measure_registry_outage(workdir: str, *, target_bytes: int = 16 << 20,
+                            hidden: int = 512, inter: int = 1408,
+                            vocab: int = 8192, prompt_len: int = 8,
+                            new_tokens: int = 4, clients: int = 4) -> dict:
+    """Registry-outage leg (ISSUE 19): kill the registry under live
+    traffic and swap a model in OFFLINE from the pinned manifest + blob
+    cache, then restart the registry and watch the publish outbox drain.
+
+    Runs against its OWN in-process registry (the shared bench registry
+    is a subprocess the leg could not brown out), killed mid-leg by
+    :class:`RegistryKillSwitch` and restarted on the same port over the
+    same store. Reported: ``outage_dropped_requests`` (data-path failures
+    on model A across the whole outage — the acceptance bar is 0),
+    ``swap_offline_ttft_ms`` (admin load of B with the registry dead ->
+    first token), ``outage_swap_source`` (must be ``cache``: the ladder,
+    not a lucky re-pull), and the outbox drain counters after restart."""
+    import threading as _threading
+
+    from modelx_tpu.dl import manifest_cache, program_store
+    from modelx_tpu.dl.blob_cache import BlobCache
+    from modelx_tpu.dl.serve import ModelServer, ServerSet
+    from modelx_tpu.registry.fs import MemoryFSProvider
+    from modelx_tpu.registry.server import Options, RegistryServer, free_port
+    from modelx_tpu.registry.store_fs import FSRegistryStore
+    from modelx_tpu.testing.faults import RegistryKillSwitch
+
+    root = os.path.join(workdir, "outage")
+    port = free_port()
+    store = FSRegistryStore(MemoryFSProvider())
+    srv = RegistryServer(Options(listen=f"127.0.0.1:{port}"), store=store)
+    base = srv.serve_background()
+
+    dirs: dict[str, str] = {}
+    for i, name in enumerate(("a", "b")):
+        d = os.path.join(root, name)
+        os.makedirs(d, exist_ok=True)
+        build_checkpoint(os.path.join(d, "model.safetensors"), target_bytes,
+                         hidden=hidden, inter=inter, vocab=vocab, seed=i + 1)
+        push_checkpoint(base, f"library/outage-{name}",
+                        os.path.join(d, "model.safetensors"))
+        dirs[name] = d
+
+    # a real (tiny) program bundle for the outbox: publish parses bundle
+    # meta before it ever talks to the registry, so the payload must be
+    # wire-true even though its contents are fabricated
+    aot_dir = os.path.join(root, "aot-cache")
+    os.makedirs(aot_dir, exist_ok=True)
+    with open(os.path.join(aot_dir, "aot-" + "ab" * 8 + ".bin"), "wb") as f:
+        f.write(b"export-one")
+    bundle = program_store.build_bundle(aot_dir)
+
+    manifest_cache.configure_default(os.path.join(root, "manifest-cache"))
+    manifest_cache.health().reset()
+    sset = ServerSet({"a": ModelServer(dirs["a"], name="a")}, default="a",
+                     allow_admin_load=True,
+                     staging_root=os.path.join(root, "staging"))
+    sset.pool.blob_cache = BlobCache(os.path.join(root, "blobcache"))
+    sset.pool.attach_outbox(os.path.join(root, "outbox"), backoff_s=0.2)
+    sset.load_all()
+    switch = RegistryKillSwitch(srv)
+
+    stop = _threading.Event()
+    counts = {"served": 0, "errors": 0}
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(1, vocab, (1, prompt_len)).astype(np.int32)
+    bref = f"{base}/library/outage-b@v1"
+
+    def traffic() -> None:
+        while not stop.is_set():
+            try:
+                sset.servers["a"].generate(prompt, max_new_tokens=new_tokens)
+                counts["served"] += 1
+            except Exception:
+                counts["errors"] += 1
+
+    srv2 = None
+    threads: list = []
+    try:
+        # warm the ladder: pull B through the caches once, then drop it
+        sset.pool.request_load("b", ref=bref, wait=True)
+        if sset.pool.states()["b"]["state"] != "READY":
+            raise RuntimeError("outage warm pull of b failed")
+        sset.pool.request_unload("b", wait=True)
+
+        threads = [_threading.Thread(target=traffic, daemon=True)
+                   for _ in range(clients)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 30.0
+        while counts["served"] < clients and time.monotonic() < deadline:
+            time.sleep(0.02)
+        if counts["served"] < clients:
+            raise RuntimeError("outage traffic never established")
+
+        # kill the control plane mid-traffic; a publish lands in the
+        # spool and fails against the dead registry
+        switch.kill()
+        if not sset.pool.outbox.enqueue("programs", bref, bundle):
+            raise RuntimeError("outbox refused the outage-era publish")
+        sset.pool.outbox_drainer.kick()
+
+        # offline swap-in: admin load of B with the registry dead
+        t0 = time.monotonic()
+        sset.pool.request_load("b", ref=bref, wait=True)
+        state = sset.pool.states()["b"]
+        if state["state"] != "READY":
+            raise RuntimeError(f"offline swap of b landed {state}")
+        sset.servers["b"].generate(prompt, max_new_tokens=1)  # first token
+        swap_ms = (time.monotonic() - t0) * 1e3
+        swap_source = state.get("load_source", "")
+        cp_during = manifest_cache.health().state
+
+        # restart the registry (same port, same store); the outbox drains
+        srv2 = RegistryServer(Options(listen=f"127.0.0.1:{port}"),
+                              store=store)
+        srv2.serve_background()
+        sset.pool.outbox_drainer.kick()
+        deadline = time.monotonic() + 60.0
+        while sset.pool.outbox.depth() and time.monotonic() < deadline:
+            time.sleep(0.05)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        switch.kill()
+        sset.pool.stop_outbox()
+        if srv2 is not None:
+            srv2.shutdown()
+        # the leg marched the process-wide control-plane health through
+        # offline; don't leak that state into later in-process legs
+        manifest_cache.health().reset()
+        with manifest_cache._default_lock:
+            manifest_cache._default = None
+            manifest_cache._default_configured = False
+    return {
+        "outage_dropped_requests": counts["errors"],
+        "outage_traffic_served": counts["served"],
+        "swap_offline_ttft_ms": round(swap_ms, 1),
+        "outage_swap_source": swap_source,
+        "outage_control_plane_state": cp_during,
+        "outbox_depth_after_restart": sset.pool.outbox.depth(),
+        "outbox_drained_total": sset.pool.outbox.stats["drained_total"],
+        "outbox_publish_failures": sset.pool.outbox.stats[
+            "publish_failures_total"],
+    }
+
+
 def measure_fleet(model_dir: str, *, pods: int = 3, clients: int = 4,
                   requests_per_client: int = 5, conversations: int = 6,
                   turns: int = 8, new_tokens: int = 8,
@@ -2602,6 +2749,13 @@ def main() -> None:
         # under live traffic to C, cold vs blob-cache-warm (ISSUE 5)
         guard("model_swap", lambda: measure_model_swap(base, workdir), 180.0)
 
+        # registry-outage drill: brown out / kill the control plane under
+        # live traffic; the data path must not drop a request and a swap-in
+        # must still materialize from the pinned-manifest + blob caches
+        # (ISSUE 19 acceptance: outage_dropped_requests == 0)
+        guard("registry_outage",
+              lambda: measure_registry_outage(workdir), 180.0)
+
         # fleet front-door leg: N pods behind the router vs one pod
         # direct (router tax on a one-device rig), sticky-hit ratio on
         # repeated-prefix conversations, pod-kill failover drill (ISSUE 8)
@@ -2765,6 +2919,12 @@ def tiny_main() -> int:
             round(tier["ttft_swap_host_ms"] / tier["ttft_swap_cold_ms"], 3)
             if tier["ttft_swap_cold_ms"] else None
         )
+
+        # registry-outage leg (ISSUE 19): kill the registry under live
+        # traffic, swap a model in offline off the pinned manifest + blob
+        # cache, restart, drain the publish outbox. The acceptance bar:
+        # outage_dropped_requests == 0.
+        out.update(measure_registry_outage(workdir))
 
         from modelx_tpu.dl.blob_cache import BlobCache
         from modelx_tpu.dl.serve import (ModelServer, ServerSet,

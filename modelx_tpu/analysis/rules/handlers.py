@@ -55,6 +55,8 @@ _SERVER_PATH_FILES = (
     "modelx_tpu/ops/paged_attention.py",
     "modelx_tpu/dl/lifecycle.py",
     "modelx_tpu/dl/tiers.py",
+    "modelx_tpu/dl/manifest_cache.py",
+    "modelx_tpu/dl/outbox.py",
     "modelx_tpu/dl/program_store.py",
     "modelx_tpu/dl/loader.py",
     "modelx_tpu/dl/sharding.py",

@@ -44,8 +44,36 @@ class Client:
         return self.remote.get_global_index(search)
 
     def get_config_content(self, repository: str, version: str = "") -> bytes:
-        """Fetch the config blob (modelx.yaml) of a version (info.go:47-65)."""
+        """Fetch the config blob (modelx.yaml) of a version (info.go:47-65).
+
+        The yaml rides in the pinned-manifest cache entry (PR 19): a
+        successful fetch persists it, and when the registry (and the
+        config blob with it) is unreachable the cached copy serves the
+        call — boot config resolution survives a control-plane outage."""
+        from modelx_tpu import errors
+        from modelx_tpu.dl import manifest_cache
+        from modelx_tpu.utils.retry import retriable_status
+
         manifest = self.remote.get_manifest(repository, version)
         if not manifest.config.digest:
             return b""
-        return b"".join(self.remote.get_blob_content(repository, manifest.config.digest))
+        cache = manifest_cache.default_cache()
+        ver = version or "latest"
+        try:
+            data = b"".join(
+                self.remote.get_blob_content(repository, manifest.config.digest))
+        except (errors.ErrorInfo, OSError) as e:
+            # OSError covers requests' mid-body failures (truncation,
+            # reset) — a brownout can die between headers and last byte
+            if isinstance(e, errors.ErrorInfo) and not retriable_status(e.http_status):
+                raise
+            cached = (cache.lookup_config(self.remote.registry, repository, ver)
+                      if cache else None)
+            if cached is None:
+                raise
+            manifest_cache.health().note_offline_serve()
+            return cached
+        if cache is not None:
+            cache.put(self.remote.registry, repository, ver, manifest,
+                      config_yaml=data)
+        return data

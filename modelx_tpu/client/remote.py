@@ -9,14 +9,18 @@ media-type/annotations (registry.go:92-107).
 from __future__ import annotations
 
 import io
+import logging
 from typing import Any, BinaryIO, Iterator
 
 import requests
 
 from modelx_tpu import errors
 from modelx_tpu.types import BlobLocation, Descriptor, Index, Manifest
-from modelx_tpu.utils.retry import RetryPolicy, retriable_status
+from modelx_tpu.utils.retry import (
+    EndpointRotation, RetryPolicy, hedged_call, retriable_status,
+)
 
+logger = logging.getLogger("modelx.client")
 
 _INSECURE = False  # process-wide default, set by the CLI root --insecure
 
@@ -41,6 +45,24 @@ def insecure_default() -> bool:
     return _INSECURE
 
 
+_MIRRORS: list[str] = []  # process-wide default, set by --registry-mirror
+
+
+def set_mirrors(mirrors) -> None:
+    """Set the process-wide read-mirror list (``--registry-mirror``, comma
+    list at the CLI). Mirrors are equivalent read replicas of the primary
+    registry: GET/HEAD fail over to them (and ranged blob GETs hedge
+    across them); writes always go to the primary. Same process-wide
+    stance as ``set_insecure`` — every client built after the call sees
+    them."""
+    global _MIRRORS
+    _MIRRORS = [m.rstrip("/") for m in mirrors if m and m.strip()]
+
+
+def default_mirrors() -> list[str]:
+    return list(_MIRRORS)
+
+
 class RegistryClient:
     # (connect, read) defaults: generous read for blob streams, bounded
     # connect so unreachable hosts fail instead of hanging
@@ -54,9 +76,13 @@ class RegistryClient:
     RETRIES = 3
     RETRY_BACKOFF_S = 0.2
     RETRY_AFTER_CAP_S = 5.0
+    # how long a ranged blob GET waits on the primary before hedging the
+    # same range against a mirror (first byte wins, loser closed)
+    HEDGE_DELAY_S = 0.25
 
     def __init__(self, registry: str, authorization: str = "", timeout=None,
-                 insecure: bool | None = None, retries: int | None = None) -> None:
+                 insecure: bool | None = None, retries: int | None = None,
+                 mirrors: list[str] | None = None) -> None:
         self.registry = registry.rstrip("/")
         self.authorization = authorization
         self.timeout = timeout or self.DEFAULT_TIMEOUT
@@ -66,6 +92,19 @@ class RegistryClient:
         # a REQUESTS_CA_BUNDLE env var in requests' settings merge.
         self._insecure = insecure
         self.retries = self.RETRIES if retries is None else max(1, int(retries))
+        # endpoint 0 is the primary; the rest are read mirrors (PR 19).
+        # None = follow the process-wide --registry-mirror default.
+        if mirrors is None:
+            mirrors = default_mirrors()
+        self.endpoints = [self.registry] + [
+            m.rstrip("/") for m in mirrors
+            if m and m.rstrip("/") != self.registry
+        ]
+        self._rotation = EndpointRotation(len(self.endpoints))
+        # where the last successful fetch came from, for ladder reporting:
+        # "registry" | "mirror" | "cache" (stale-while-revalidate serve)
+        self.last_endpoint = self.registry
+        self.last_source = "registry"
 
     # -- plumbing -------------------------------------------------------------
 
@@ -86,6 +125,83 @@ class RegistryClient:
             retry_after_cap_s=self.RETRY_AFTER_CAP_S,
         ).sleep(attempt, retry_after)
 
+    @staticmethod
+    def _health():
+        # lazy: dl/manifest_cache pulls types at call time only, and the
+        # client package must stay importable without the serving stack
+        from modelx_tpu.dl import manifest_cache
+
+        return manifest_cache.health()
+
+    def _send(
+        self,
+        method: str,
+        url: str,
+        params: dict[str, str] | None = None,
+        data: Any = None,
+        headers: dict[str, str] | None = None,
+        stream: bool = False,
+    ) -> requests.Response:
+        """One HTTP attempt against one absolute URL; every failure raises
+        typed ErrorInfo (transport errors become a synthetic 502, which is
+        retriable by :func:`retriable_status`). A server ``Retry-After``
+        rides on the raised error for the retry loop to honor."""
+        kwargs = {}
+        if self._insecure if self._insecure is not None else _INSECURE:
+            kwargs["verify"] = False
+        try:
+            resp = self.session.request(
+                method, url, params=params, data=data, headers=self._headers(headers),
+                stream=stream, timeout=self.timeout, **kwargs,
+            )
+        except requests.RequestException as e:
+            raise errors.ErrorInfo(502, errors.ErrCodeUnknown, f"request failed: {e}") from e
+        if resp.status_code >= 400:
+            if resp.content:
+                err = errors.ErrorInfo.decode(resp.content, resp.status_code)
+            else:
+                # HEAD responses carry no body — synthesize from status
+                code = {
+                    401: errors.ErrCodeUnauthorized,
+                    403: errors.ErrCodeDenied,
+                    404: errors.ErrCodeUnknown,
+                    405: errors.ErrCodeUnsupported,
+                    429: errors.ErrCodeTooManyRequests,
+                }.get(resp.status_code, errors.ErrCodeUnknown)
+                err = errors.ErrorInfo(resp.status_code, code, f"{method} {url}: HTTP {resp.status_code}")
+            err.retry_after = resp.headers.get("Retry-After")
+            resp.close()
+            raise err
+        return resp
+
+    def _request_endpoint(
+        self,
+        method: str,
+        base: str,
+        path: str,
+        params: dict[str, str] | None = None,
+        data: Any = None,
+        headers: dict[str, str] | None = None,
+        stream: bool = False,
+    ) -> requests.Response:
+        """registry.go:146-191 — the per-endpoint retry loop.
+
+        GET/HEAD retry transparently on connection errors and 5xx/429
+        (idempotent by contract, so a replay is always safe); writes never
+        retry here — their callers own replay semantics (e.g. http_upload's
+        rewind-and-retry)."""
+        attempts = self.retries if method in ("GET", "HEAD") else 1
+        for attempt in range(attempts):
+            try:
+                return self._send(method, base + path, params, data, headers, stream)
+            except errors.ErrorInfo as e:
+                if attempt == attempts - 1 or not retriable_status(e.http_status):
+                    # last attempt, or deterministic trouble (4xx below
+                    # 429: auth/not-found/validation) — never retried
+                    raise
+                self._retry_sleep(attempt, getattr(e, "retry_after", None))
+        raise AssertionError("unreachable")  # every path above returns/raises
+
     def _request(
         self,
         method: str,
@@ -95,52 +211,40 @@ class RegistryClient:
         headers: dict[str, str] | None = None,
         stream: bool = False,
     ) -> requests.Response:
-        """registry.go:146-191 — raise typed ErrorInfo from error bodies.
-
-        GET/HEAD retry transparently on connection errors and 5xx/429
-        (idempotent by contract, so a replay is always safe); writes never
-        retry here — their callers own replay semantics (e.g. http_upload's
-        rewind-and-retry)."""
-        url = self.registry + path
-        kwargs = {}
-        if self._insecure if self._insecure is not None else _INSECURE:
-            kwargs["verify"] = False
-        attempts = self.retries if method in ("GET", "HEAD") else 1
-        for attempt in range(attempts):
-            last = attempt == attempts - 1
+        """Endpoint failover wrapper (PR 19): idempotent reads walk the
+        endpoint rotation (primary first, then mirrors, starting from the
+        last endpoint that worked) with the full per-endpoint retry policy;
+        writes go to the primary only — mirrors are read replicas. A
+        deterministic 4xx raises immediately (the mirrors hold the same
+        content, they would say the same thing); only transient trouble
+        fails over. Every outcome lands on the pod's control-plane health
+        tracker."""
+        read = method in ("GET", "HEAD")
+        order = self._rotation.order() if read and len(self.endpoints) > 1 else [0]
+        last_err: errors.ErrorInfo | None = None
+        for ei in order:
             try:
-                resp = self.session.request(
-                    method, url, params=params, data=data, headers=self._headers(headers),
-                    stream=stream, timeout=self.timeout, **kwargs,
-                )
-            except requests.RequestException as e:
-                if not last:
-                    self._retry_sleep(attempt, None)
-                    continue
-                raise errors.ErrorInfo(502, errors.ErrCodeUnknown, f"request failed: {e}") from e
-            if resp.status_code >= 400:
-                if resp.content:
-                    err = errors.ErrorInfo.decode(resp.content, resp.status_code)
-                else:
-                    # HEAD responses carry no body — synthesize from status
-                    code = {
-                        401: errors.ErrCodeUnauthorized,
-                        403: errors.ErrCodeDenied,
-                        404: errors.ErrCodeUnknown,
-                        405: errors.ErrCodeUnsupported,
-                        429: errors.ErrCodeTooManyRequests,
-                    }.get(resp.status_code, errors.ErrCodeUnknown)
-                    err = errors.ErrorInfo(resp.status_code, code, f"{method} {path}: HTTP {resp.status_code}")
-                retry_after = resp.headers.get("Retry-After")
-                resp.close()
-                if not last and retriable_status(resp.status_code):
-                    # transient server trouble; 4xx below 429 is
-                    # deterministic (auth/not-found) and never retried
-                    self._retry_sleep(attempt, retry_after)
-                    continue
-                raise err
+                resp = self._request_endpoint(
+                    method, self.endpoints[ei], path, params, data, headers, stream)
+            except errors.ErrorInfo as e:
+                last_err = e
+                if not retriable_status(e.http_status):
+                    # the registry answered — control plane is up even
+                    # though this call failed deterministically
+                    self._health().note_ok(mirror=ei != 0)
+                    raise
+                if ei != order[-1]:
+                    logger.warning("registry endpoint %s failed (%s); trying next",
+                                   self.endpoints[ei], e)
+                continue
+            self._rotation.mark_good(ei)
+            self.last_endpoint = self.endpoints[ei]
+            self.last_source = "mirror" if ei else "registry"
+            self._health().note_ok(mirror=ei != 0)
             return resp
-        raise AssertionError("unreachable")  # every path above returns/raises
+        self._health().note_failure()
+        assert last_err is not None
+        raise last_err
 
     # -- index ----------------------------------------------------------------
 
@@ -162,8 +266,36 @@ class RegistryClient:
         return version or "latest"  # registry.go:34-36
 
     def get_manifest(self, repository: str, version: str = "") -> Manifest:
-        r = self._request("GET", f"/{repository}/manifests/{self._version(version)}")
-        return Manifest.from_json(r.json())
+        """Manifest fetch with stale-while-revalidate (PR 19): a success
+        pins the manifest to the local disk cache; when every endpoint is
+        down, the digest-pinned cached copy serves the call instead.
+        Stale is explicitly safe — the manifest names content-addressed
+        blob digests, and every blob verifies against its digest on use —
+        so a registry outage degrades freshness, never correctness."""
+        from modelx_tpu.dl import manifest_cache
+
+        ver = self._version(version)
+        cache = manifest_cache.default_cache()
+        try:
+            r = self._request("GET", f"/{repository}/manifests/{ver}")
+        except errors.ErrorInfo as e:
+            if not retriable_status(e.http_status):
+                raise  # deterministic answer (e.g. 404): the cache must not mask it
+            cached = cache.lookup(self.registry, repository, ver) if cache else None
+            if cached is None:
+                raise
+            cache.note_stale_served()
+            manifest_cache.health().note_offline_serve()
+            self.last_source = "cache"
+            logger.warning(
+                "registry unreachable (%s); serving pinned manifest for %s/%s "
+                "(age %.0fs)", e, repository, ver,
+                cache.age_s(self.registry, repository, ver) or 0)
+            return cached
+        manifest = Manifest.from_json(r.json())
+        if cache is not None:
+            cache.put(self.registry, repository, ver, manifest)
+        return manifest
 
     def put_manifest(self, repository: str, version: str, manifest: Manifest) -> None:
         self._request(
@@ -198,12 +330,42 @@ class RegistryClient:
             raise
 
     def get_blob_content(self, repository: str, digest: str, offset: int = 0, length: int = -1) -> Iterator[bytes]:
-        """Streaming GET; optional Range for ranged/resumed reads."""
+        """Streaming GET; optional Range for ranged/resumed reads.
+
+        With mirrors configured the fetch is HEDGED (PR 19): the preferred
+        endpoint gets :attr:`HEDGE_DELAY_S` of head start, then the same
+        range races against the next replica — first response wins, the
+        loser's stream is closed unread. Ranged reads are idempotent and
+        content-addressed, so racing them is free of consistency risk; a
+        browned-out primary costs one hedge delay instead of a timeout."""
+        path = f"/{repository}/blobs/{digest}"
         headers = {}
         if offset or length >= 0:
             end = "" if length < 0 else str(offset + length - 1)
             headers["Range"] = f"bytes={offset}-{end}"
-        resp = self._request("GET", f"/{repository}/blobs/{digest}", headers=headers, stream=True)
+        if len(self.endpoints) > 1:
+            order = self._rotation.order()
+            calls = [
+                (lambda base=self.endpoints[ei]:
+                 self._send("GET", base + path, headers=headers, stream=True))
+                for ei in order
+            ]
+            try:
+                pos, resp = hedged_call(
+                    calls, self.HEDGE_DELAY_S, on_loser=lambda r: r.close())
+            except errors.ErrorInfo:
+                # every endpoint refused its single hedge shot; the
+                # sequential path below still has the full per-endpoint
+                # retry budget before the outage is declared
+                resp = None
+            if resp is not None:
+                ei = order[pos]
+                self._rotation.mark_good(ei)
+                self.last_endpoint = self.endpoints[ei]
+                self.last_source = "mirror" if ei else "registry"
+                self._health().note_ok(mirror=ei != 0)
+                return resp.iter_content(chunk_size=1024 * 1024)
+        resp = self._request("GET", path, headers=headers, stream=True)
         return resp.iter_content(chunk_size=1024 * 1024)
 
     def get_blob_size(self, repository: str, digest: str) -> int:

@@ -81,6 +81,11 @@ class BlobCache:
         self.stats: dict = {
             "hits": 0, "misses": 0, "admitted": 0, "evicted": 0,
             "corrupt_rejected": 0, "admit_rejected": 0,
+            # the subset of corrupt_rejected where the hit-path DIGEST
+            # re-check failed on a size-plausible resident entry: a
+            # rising value means the cache volume itself is rotting
+            # (bit flips / torn writes), not just truncated spools
+            "cache_corrupt_evictions": 0,
         }
         os.makedirs(root, exist_ok=True)
         self._sweep_stale_spools()
@@ -126,8 +131,12 @@ class BlobCache:
                 self.stats["misses"] += 1
             return None
         ok = expected_size < 0 or size == expected_size
+        digest_bad = False
         if ok and self.verify_on_hit:
-            ok = _file_digest_hex(path, digest) == str(digest).partition(":")[2]
+            digest_bad = (
+                _file_digest_hex(path, digest) != str(digest).partition(":")[2]
+            )
+            ok = not digest_bad
         if not ok:
             logger.warning("blob cache entry %s failed verification; evicting", path)
             try:
@@ -136,6 +145,10 @@ class BlobCache:
                 pass
             with self._lock:
                 self.stats["corrupt_rejected"] += 1
+                if digest_bad:
+                    self.stats["cache_corrupt_evictions"] += 1
+            # returning None routes the caller back to the network: the
+            # next successful fetch re-admits a clean copy
             return None
         try:
             os.utime(path)  # LRU touch

@@ -69,17 +69,32 @@ def _resolve_selected(uri: str, quiet: bool):
     config, selected manifest)."""
     from modelx_tpu.utils import trace
 
+    from modelx_tpu import errors
+    from modelx_tpu.utils.retry import retriable_status
+
     ref = parse_reference(uri)
     client = ref.client(quiet=quiet)
     with trace.span("dl.manifest", uri=uri):
         manifest = client.get_manifest(ref.repository, ref.version)
         config = ModelConfig()
         if manifest.config.digest:
-            raw = client.get_config_content(ref.repository, ref.version)
             try:
-                config = ModelConfig.from_yaml(raw)
-            except ValueError:
-                logger.warning("invalid modelx.yaml in %s; pulling everything", uri)
+                raw = client.get_config_content(ref.repository, ref.version)
+            except errors.ErrorInfo as e:
+                # registry down AND no cached copy of the yaml: the config
+                # only drives the modelFiles filter / mesh default, so a
+                # degraded resolve pulls everything rather than failing a
+                # boot the blob ladder could still serve (PR 19)
+                if not retriable_status(e.http_status):
+                    raise
+                logger.warning(
+                    "modelx.yaml for %s unavailable offline; pulling everything", uri)
+                raw = b""
+            if raw:
+                try:
+                    config = ModelConfig.from_yaml(raw)
+                except ValueError:
+                    logger.warning("invalid modelx.yaml in %s; pulling everything", uri)
     return ref, client, config, filter_blobs(manifest, config.model_files)
 
 
@@ -141,8 +156,17 @@ def pull_model(uri: str, dest: str, cache=None, quiet: bool = True) -> dict:
     reads; the Puller's hash-skip then confirms them up-to-date), and
     freshly pulled blobs are admitted for the next swap — a model the
     node served before reloads blob-cache-warm (``ttft_swap_warm_ms``
-    in bench.py's swap leg)."""
+    in bench.py's swap leg).
+
+    Degradation ladder (PR 19): when the manifest came off the pinned
+    cache because every registry endpoint is down (``last_source ==
+    "cache"``), the pull runs fully OFFLINE — every weight/tokenizer blob
+    must come digest-verified out of the blob cache, program bundles are
+    skipped (a cold compile beats a failed load), and a blob the node
+    doesn't hold raises :class:`~modelx_tpu.dl.manifest_cache.
+    OfflineUnavailableError` for the lifecycle's retryable-507 contract."""
     from modelx_tpu.dl import blob_cache as bc
+    from modelx_tpu.dl.manifest_cache import OfflineUnavailableError
     from modelx_tpu.types import MediaTypeModelDirectoryTarGz
     from modelx_tpu.utils import trace
 
@@ -150,18 +174,31 @@ def pull_model(uri: str, dest: str, cache=None, quiet: bool = True) -> dict:
         cache = bc.default_cache()
     t0 = time.monotonic()
     ref, client, _config, selected = _resolve_selected(uri, quiet)
+    # where the manifest came from: "registry" | "mirror" | "cache" —
+    # "cache" means every endpoint was down and this pull must be offline
+    source = getattr(client.remote, "last_source", "registry")
+    offline = source == "cache"
     os.makedirs(dest, exist_ok=True)
     file_blobs = [
         b for b in selected.blobs
         if b.digest and b.media_type != MediaTypeModelDirectoryTarGz
     ]
     cache_hits = 0
+    offline_skipped_programs = 0
     if cache is not None:
         import shutil as _shutil
 
         for blob in file_blobs:
             hit = cache.lookup(blob.digest, expected_size=blob.size or -1)
             if hit is None:
+                if offline:
+                    if blob.media_type == MediaTypeModelProgram:
+                        # no compiled bundle on hand: boot cold, don't fail
+                        offline_skipped_programs += 1
+                        continue
+                    raise OfflineUnavailableError(
+                        f"registry unreachable and blob {blob.name!r} "
+                        f"({blob.digest}) is not in the local blob cache")
                 continue
             target = os.path.join(dest, blob.name)
             os.makedirs(os.path.dirname(target) or ".", exist_ok=True)
@@ -170,11 +207,31 @@ def pull_model(uri: str, dest: str, cache=None, quiet: bool = True) -> dict:
                 os.chmod(target, blob.mode or 0o644)
                 cache_hits += 1
             except OSError:
+                if offline:
+                    raise OfflineUnavailableError(
+                        f"registry unreachable and cached blob {blob.name!r} "
+                        "vanished mid-copy (concurrent eviction)")
                 # a racing LRU eviction unlinked the entry: the Puller
                 # fetches it over the network like any miss
                 pass
-    with trace.span("dl.pull", blobs=len(selected.blobs)):
-        Puller(client.remote, quiet=quiet).pull_blobs(ref.repository, selected, dest)
+    if offline:
+        missing_dirs = [
+            b.name for b in selected.blobs
+            if b.digest and b.media_type == MediaTypeModelDirectoryTarGz
+        ]
+        if missing_dirs:
+            raise OfflineUnavailableError(
+                "registry unreachable and directory blobs cannot be "
+                f"materialized from the blob cache: {missing_dirs}")
+        if cache is None:
+            raise OfflineUnavailableError(
+                "registry unreachable and no local blob cache is configured")
+        logger.warning("registry unreachable; %s materialized offline from "
+                       "the pinned manifest + blob cache (%d blobs)",
+                       uri, cache_hits)
+    else:
+        with trace.span("dl.pull", blobs=len(selected.blobs)):
+            Puller(client.remote, quiet=quiet).pull_blobs(ref.repository, selected, dest)
     admitted = 0
     if cache is not None:
         import shutil as _shutil
@@ -207,6 +264,8 @@ def pull_model(uri: str, dest: str, cache=None, quiet: bool = True) -> dict:
         ),
         "cache_hits": cache_hits,
         "cache_admitted": admitted,
+        "source": source,
+        "offline_skipped_programs": offline_skipped_programs,
         "pull_seconds": round(time.monotonic() - t0, 3),
     }
 

@@ -102,6 +102,9 @@ class ModelEntry:
         self.last_used = time.monotonic()
         self._staged = False        # model_dir is pool-owned (safe to rm)
         self.tier_key = ""          # content digest into the tier store
+        # which rung of the degradation ladder materialized the bytes:
+        # "registry" | "mirror" | "cache" (offline) | "tier" | "dir"
+        self.load_source = ""
 
     def to(self, state: str, error: str | None = None) -> None:
         self.state = state
@@ -122,6 +125,8 @@ class ModelEntry:
             snap["ref"] = self.ref
         if self.error:
             snap["error"] = self.error
+        if self.load_source:
+            snap["load_source"] = self.load_source
         if self.drain_seconds is not None:
             snap["drain_seconds"] = round(self.drain_seconds, 3)
         return snap
@@ -204,6 +209,16 @@ class ModelPool:
         from modelx_tpu.utils.flightrec import FlightRecorder
 
         self.flightrec = FlightRecorder(capacity=256)
+        # durable publish outbox (PR 19): when attached, program-bundle
+        # publishes spool to disk and a background drainer pushes them —
+        # a registry outage never blocks or fails a load
+        self.outbox = None
+        self.outbox_drainer = None
+        # control-plane transitions (ok|degraded|offline) land on this
+        # pool's recorder — the pod-level view /debug/flightrec serves
+        from modelx_tpu.dl import manifest_cache as _mc
+
+        _mc.health().recorder = self.flightrec
         # multi-tier live state (dl/tiers.py): demoted models' params
         # staged in bounded host RAM / local disk so a re-load is a tier
         # promotion, not a re-pull. Both budgets 0 (the default) keeps
@@ -231,6 +246,39 @@ class ModelPool:
             e.server = server
             e.model_dir = server.model_dir
             self.entries[name] = e
+
+    def attach_outbox(self, spool_dir: str, max_entries: int | None = None,
+                      max_bytes: int | None = None,
+                      backoff_s: float | None = None,
+                      start: bool = True) -> None:
+        """Enable the durable publish outbox (``--publish-outbox-dir``):
+        program publishes enqueue to the on-disk spool and the background
+        drainer replays them through the registry with backoff. Pending
+        entries from a previous process generation drain too — that is
+        the restart-durability the chaos drill asserts."""
+        from modelx_tpu.dl import outbox as outbox_mod
+        from modelx_tpu.dl import program_store
+
+        kwargs = {}
+        if max_entries is not None:
+            kwargs["max_entries"] = max_entries
+        if max_bytes is not None:
+            kwargs["max_bytes"] = max_bytes
+        self.outbox = outbox_mod.Outbox(spool_dir, **kwargs)
+
+        def handler(kind: str, ref: str, data: bytes) -> None:
+            program_store.publish_bundle(ref, data)
+
+        dkwargs = {"recorder": self.flightrec}
+        if backoff_s is not None:
+            dkwargs["backoff_s"] = backoff_s
+        self.outbox_drainer = outbox_mod.Drainer(self.outbox, handler, **dkwargs)
+        if start:
+            self.outbox_drainer.start()
+
+    def stop_outbox(self) -> None:
+        if self.outbox_drainer is not None:
+            self.outbox_drainer.stop()
 
     def _per_device(self, total_bytes: int) -> int:
         """Per-device footprint of ``total_bytes`` of weights on this
@@ -419,6 +467,10 @@ class ModelPool:
         snap["hbm_measured_source"] = dm["source"]
         if self.tiers.enabled:
             snap["tiers"] = self.tiers.snapshot()
+        if self.outbox is not None:
+            snap["outbox"] = (self.outbox_drainer.snapshot()
+                              if self.outbox_drainer is not None
+                              else self.outbox.snapshot())
         return snap
 
     def failed(self) -> dict[str, str]:
@@ -456,6 +508,20 @@ class ModelPool:
         try:
             pairs = tiers_mod.ref_pairs(ref) if ref else tiers_mod.dir_pairs(model_dir)
         except Exception as e:
+            # a registry outage with no pinned manifest is TRANSIENT: the
+            # pressure clears when the control plane recovers, so it gets
+            # the retryable-507 contract (PR 19) rather than the
+            # deterministic 400 a bad ref earns
+            from modelx_tpu import errors as _errors
+            from modelx_tpu.utils.retry import retriable_status as _retriable
+
+            if isinstance(e, _errors.ErrorInfo) and _retriable(e.http_status):
+                raise PoolError(
+                    507,
+                    f"registry unreachable and no pinned manifest for "
+                    f"{ref or model_dir!r}: {e}",
+                    headers={"Retry-After": "5"},
+                )
             raise PoolError(400, f"cannot estimate footprint for "
                                  f"{ref or model_dir!r}: {e}")
         est = sum(p[1] for p in pairs)
@@ -480,6 +546,7 @@ class ModelPool:
                 e.server = None
                 e.ref = ref
                 e.model_dir = model_dir
+                e.load_source = "" if ref else "dir"
                 e.hbm_reserved_bytes = est
                 e.drain_seconds = None
                 e.tier_key = tier_key
@@ -640,7 +707,10 @@ class ModelPool:
                     else:
                         e.model_dir = dest
                         e._staged = True
+                        e.load_source = "tier"
                         e.to(LOADING)
+                self.flightrec.record("ladder.source", model=name,
+                                      source="tier", tier=promo.tier)
                 if stale:
                     shutil.rmtree(dest, ignore_errors=True)
                     return
@@ -650,7 +720,11 @@ class ModelPool:
                 from modelx_tpu.utils import trace
 
                 with trace.span("lifecycle.pull", model=name, ref=e.ref):
-                    pull_model(e.ref, dest, cache=self.blob_cache, quiet=True)
+                    pulled = pull_model(e.ref, dest, cache=self.blob_cache,
+                                        quiet=True)
+                # which ladder rung served the bytes: registry, a read
+                # mirror, or (offline) the pinned manifest + blob cache
+                source = pulled.get("source", "registry")
                 stale = False
                 with self._lock:
                     if e.state != PULLING:  # raced an unload/retry
@@ -658,7 +732,14 @@ class ModelPool:
                     else:
                         e.model_dir = dest
                         e._staged = True
+                        e.load_source = source
                         e.to(LOADING)
+                self.flightrec.record(
+                    "ladder.source", model=name, source=source,
+                    cache_hits=pulled.get("cache_hits", 0))
+                if source == "cache":
+                    logger.warning("model %s materialized OFFLINE from the "
+                                   "pinned manifest + blob cache", name)
                 if stale:
                     # the multi-GB staging rmtree runs OUTSIDE the pool
                     # lock (lint: blocking-under-lock) — other tenants'
@@ -721,17 +802,35 @@ class ModelPool:
             if self.publish_programs and e.ref:
                 # after READY, off the serving path: the model is already
                 # taking traffic — a publish failure only costs the next
-                # puller its warm start
+                # puller its warm start. With an outbox attached the
+                # bundle spools to disk and the drainer pushes it, so a
+                # registry outage costs nothing at all (PR 19).
                 from modelx_tpu.dl import program_store
                 from modelx_tpu.dl.serve import compile_cache_dir
 
                 try:
-                    program_store.publish_for_server(
-                        e.ref, server, compile_cache_dir()
-                    )
+                    if self.outbox is not None:
+                        data = program_store.bundle_for_server(
+                            e.ref, server, compile_cache_dir()
+                        )
+                        if data is not None:
+                            self.outbox.enqueue("programs", e.ref, data)
+                            if self.outbox_drainer is not None:
+                                self.outbox_drainer.kick()
+                    else:
+                        program_store.publish_for_server(
+                            e.ref, server, compile_cache_dir()
+                        )
                 except Exception:
                     logger.exception("program publish for %s failed", name)
         except BaseException as exc:  # FAILED is a state, not a crash
+            from modelx_tpu.dl.manifest_cache import OfflineUnavailableError
+
+            if isinstance(exc, OfflineUnavailableError):
+                # the bottom of the ladder: nothing local can serve this
+                # ref until the registry recovers — FAILED with the reason,
+                # slot retryable (a re-POST after recovery succeeds)
+                self.flightrec.record("ladder.offline_unavailable", model=name)
             logger.warning("runtime load of %s failed: %s", name, exc)
             staged = ""
             with self._lock:

@@ -557,18 +557,26 @@ def plan_from_manifest(client, repository: str, manifest: Manifest,
     return family, cfg, sds, mesh
 
 
-def publish_for_server(ref: str, server, cache_dir: str) -> Descriptor | None:
-    """Best-effort publish of a freshly loaded server's compiled surface —
-    the ``--publish-programs`` hook dl/lifecycle.py runs after mark_ready.
-    Bundles the surface keys this server's shapes map to (only those its
-    AOT cache actually holds) and attaches them to the model version it
-    was loaded from. Returns the descriptor, or None when there is
-    nothing to publish."""
+def bundle_for_server(ref: str, server, cache_dir: str) -> bytes | None:
+    """The LOCAL half of a server publish (PR 19 split): bundle the
+    surface keys this server's shapes map to (only those its AOT cache
+    actually holds) for the model version it was loaded from. No network
+    — the bytes can be published now or spooled to the outbox
+    (dl/outbox.py) for a drainer to push after a registry outage.
+    Returns None when there is nothing to publish or the ref names no
+    version."""
     from modelx_tpu.client.reference import parse_reference
     from modelx_tpu.dl import families as fam
 
     sds = getattr(server, "_param_sds", None)
     if not cache_dir or sds is None or server.family is None:
+        return None
+    parsed = parse_reference(ref)
+    if not parsed.version:
+        # a bare ref resolves "latest" on GET, but publishing must pin the
+        # exact version whose surface this is — refuse rather than mint a
+        # literal "latest" version in the registry
+        logger.warning("programs publish skipped: %s names no version", ref)
         return None
     keys = [
         fam.forward_program_key(server.family, server.cfg, "argmax_all",
@@ -583,20 +591,31 @@ def publish_for_server(ref: str, server, cache_dir: str) -> Descriptor | None:
     from modelx_tpu.dl import aot_cache
 
     keys = [k for k in keys if os.path.isfile(aot_cache.artifact_path(cache_dir, k))]
-    data = build_bundle(cache_dir, keys=keys, mesh=server.mesh)
-    if data is None:
-        return None
+    return build_bundle(cache_dir, keys=keys, mesh=server.mesh)
+
+
+def publish_bundle(ref: str, data: bytes) -> Descriptor:
+    """The NETWORK half of a server publish: attach pre-built bundle
+    bytes to the version ``ref`` names. This is what the outbox drainer
+    replays after a registry outage — the bundle carries its own stamped
+    environment, so publishing later (or from another process) is
+    identical to publishing now."""
+    from modelx_tpu.client.reference import parse_reference
+
     parsed = parse_reference(ref)
-    if not parsed.version:
-        # a bare ref resolves "latest" on GET, but publishing must pin the
-        # exact version whose surface this is — refuse rather than mint a
-        # literal "latest" version in the registry
-        logger.warning("programs publish skipped: %s names no version", ref)
-        return None
     client = parsed.client(quiet=True)
     desc = publish(client.remote, parsed.repository, parsed.version, data)
-    logger.info(
-        "published %d compiled programs for %s (%s, %d bytes)",
-        len(keys), ref, desc.name, desc.size,
-    )
+    logger.info("published compiled programs for %s (%s, %d bytes)",
+                ref, desc.name, desc.size)
     return desc
+
+
+def publish_for_server(ref: str, server, cache_dir: str) -> Descriptor | None:
+    """Best-effort publish of a freshly loaded server's compiled surface —
+    the ``--publish-programs`` hook dl/lifecycle.py runs after mark_ready
+    (directly, or via the outbox when one is attached). Returns the
+    descriptor, or None when there is nothing to publish."""
+    data = bundle_for_server(ref, server, cache_dir)
+    if data is None:
+        return None
+    return publish_bundle(ref, data)

@@ -1974,25 +1974,33 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000",
             self._rid = ""
             self._resp_status = 0
             if self.path == "/healthz":
+                from modelx_tpu.dl import manifest_cache
+
                 engine = sset.engine_health()
                 failed = sset.pool.failed() if sset.pool is not None else {}
+                # registry reachability rides ALONGSIDE readiness, never
+                # into it: a pod serving READY models through a registry
+                # outage stays 200/routable — control_plane is the
+                # operator/rebalancer signal that freshness is degraded
+                cp = manifest_cache.health().status()
                 if engine is not None:
                     # a crash-looping or circuit-broken engine must flip
                     # readiness so load balancers drain instead of routing
                     # every request into a dead engine
-                    self._json(503, {"status": engine})
+                    self._json(503, {"status": engine, "control_plane": cp})
                 elif sset.ready:
                     # degraded: some tenants FAILED to load, the rest are
                     # serving — stay routable but say who is down and why
                     if failed:
-                        self._json(200, {"status": "degraded", "failed": failed})
+                        self._json(200, {"status": "degraded", "failed": failed,
+                                         "control_plane": cp})
                     else:
-                        self._json(200, {"status": "ok"})
+                        self._json(200, {"status": "ok", "control_plane": cp})
                 else:
                     status = "draining" if sset.draining else (
                         "failed" if failed else "loading"
                     )
-                    body = {"status": status}
+                    body = {"status": status, "control_plane": cp}
                     if failed:
                         body["failed"] = failed
                     # loading resolves on its own: tell the LB when to look
@@ -2039,6 +2047,11 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000",
                 # 5xx/s, sheds/s over 1m and 5m — floats, so they
                 # render as gauges in the Prometheus view for free
                 payload["rates"] = sset.rates.snapshot()
+                # registry reachability counters (PR 19); the string
+                # state key is JSON-only, the totals render as gauges
+                from modelx_tpu.dl import manifest_cache as _mc
+
+                payload["control_plane"] = _mc.health().status()
                 if sset.device_telemetry:
                     # measured device memory next to the lifecycle
                     # ESTIMATES (hbm_reserved_bytes): the source key is
@@ -2064,6 +2077,8 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000",
             elif self.path == "/admin/models":
                 if not self._admin_auth():
                     return
+                from modelx_tpu.dl import manifest_cache
+
                 self._json(200, {
                     "models": sset.pool.states(),
                     "pool": sset.pool.pool_snapshot(),
@@ -2072,6 +2087,10 @@ def serve(servers: ModelServer | ServerSet, listen: str = ":8000",
                     # load (queue depth) from THIS one endpoint instead of
                     # scraping /metrics too (PR 8)
                     "serving": sset.serving_stats(),
+                    # registry reachability (PR 19): ok|degraded|offline —
+                    # the rebalancer reads this to go observe-only when
+                    # the whole fleet has lost the control plane
+                    "control_plane": manifest_cache.health().status(),
                 })
             elif self.path == "/v1/models":
                 from modelx_tpu.dl import openai_api as oai

@@ -169,6 +169,26 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
                    "the model version as a program bundle "
                    "(application/vnd.modelx.program.v1) so the next "
                    "puller boots compile-warm")
+@click.option("--registry-mirror", "registry_mirrors", multiple=True,
+              help="read mirror(s) of the registry (comma list; "
+                   "repeatable): manifest/blob GETs fail over to them and "
+                   "ranged blob reads hedge across them — writes (publish) "
+                   "always go to the primary (docs/serving.md outage "
+                   "playbook)")
+@click.option("--manifest-cache-dir", default="",
+              help="pin every fetched manifest to this dir: when the "
+                   "registry AND all mirrors are down, digest-pinned "
+                   "cached manifests + the blob cache serve pulls offline "
+                   "(control_plane: offline on /healthz; readiness is "
+                   "never gated on it)")
+@click.option("--publish-outbox-dir", default="",
+              help="durable publish outbox: --publish-programs bundles "
+                   "spool here and a background drainer pushes them with "
+                   "backoff, so a registry outage never blocks or fails "
+                   "a load (pending entries survive pod restarts)")
+@click.option("--outbox-max-entries", default=0, type=int,
+              help="outbox spool bound; a full spool drops new publishes "
+                   "with a counted warning (0 = default 64)")
 @click.option("--admin-token", "admin_tokens", multiple=True,
               help="bearer token accepted on the /admin surface "
                    "(repeatable; none = anonymous admin — dev pods only)")
@@ -233,6 +253,8 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
          host_state_budget_bytes: int, disk_state_budget_bytes: int,
          state_spool_dir: str, allow_admin_load: bool,
          publish_programs: bool,
+         registry_mirrors: tuple[str, ...], manifest_cache_dir: str,
+         publish_outbox_dir: str, outbox_max_entries: int,
          admin_tokens: tuple[str, ...], staging_dir: str,
          loras: tuple[str, ...], drain_seconds: float,
          drain_grace: float, boundary_watchdog_s: float,
@@ -251,6 +273,20 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
         from modelx_tpu.dl.blob_cache import configure_default
 
         configure_default(blob_cache_dir, max_bytes=blob_cache_max_bytes)
+    if registry_mirrors:
+        # comma lists and repeats both accepted; process-wide so every
+        # registry client this pod builds (pulls, tier keying, outbox
+        # drains) fails over identically
+        from modelx_tpu.client.remote import set_mirrors
+
+        flat: list[str] = []
+        for m in registry_mirrors:
+            flat.extend(p.strip() for p in m.split(","))
+        set_mirrors([m for m in flat if m])
+    if manifest_cache_dir:
+        from modelx_tpu.dl import manifest_cache
+
+        manifest_cache.configure_default(manifest_cache_dir)
     entries: dict[str, str] = {}
     if model_dir:
         entries["default"] = model_dir
@@ -357,6 +393,11 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
     if publish_programs:
         if sset.pool is not None:
             sset.pool.publish_programs = True
+        if publish_outbox_dir and sset.pool is not None:
+            sset.pool.attach_outbox(
+                publish_outbox_dir,
+                max_entries=outbox_max_entries or None,
+            )
         if not allow_admin_load:
             logging.getLogger("modelx.serve").warning(
                 "--publish-programs only fires on runtime (registry-ref) "
@@ -367,6 +408,11 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
         logging.getLogger("modelx.serve").warning(
             "--evict-idle is inert without --hbm-budget-bytes "
             "(eviction only runs to fit a load under the budget)"
+        )
+    if publish_outbox_dir and not publish_programs:
+        logging.getLogger("modelx.serve").warning(
+            "--publish-outbox-dir is inert without --publish-programs "
+            "(only program publishes spool through the outbox)"
         )
     if state_spool_dir and not disk_state_budget_bytes:
         logging.getLogger("modelx.serve").warning(
@@ -422,6 +468,10 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
         batcher.close()
     for cb in list(sset.cbatchers.values()):
         cb.close()
+    if sset.pool is not None:
+        # pending outbox entries stay on disk; the next generation's
+        # drainer picks them up (that persistence is the point)
+        sset.pool.stop_outbox()
     httpd.shutdown()
 
 

@@ -234,7 +234,7 @@ class TierStore:
             "host_hits": 0, "disk_hits": 0, "misses": 0,
             "demotions_host": 0, "demotions_disk": 0, "demotions_dropped": 0,
             "demotion_failures": 0, "promotions_host": 0,
-            "promotions_disk": 0, "spills": 0,
+            "promotions_disk": 0, "spills": 0, "spill_failures": 0,
         }
 
     @property
@@ -332,8 +332,22 @@ class TierStore:
             e.leaves = host
             spill_victims = self._finalize(e, HOST, "demotions_host")
         else:
-            # straight to disk: host tier too small (or disabled)
-            self._spool(e, host)
+            # straight to disk: host tier too small (or disabled). A full
+            # disk (ENOSPC) drops THIS entry — the demotion path itself
+            # must never crash over a spool write.
+            try:
+                self._fire(OP_SPILL)
+                self._spool(e, host)
+            except OSError as exc:
+                logger.warning("disk spool of %s failed (%s); dropping entry",
+                               e.name, exc)
+                self._discard_partial(e)
+                with self._lock:
+                    self.stats["spill_failures"] += 1
+                    self.stats["demotions_dropped"] += 1
+                self._record("tier.spill.failed", model=e.name, bytes=nbytes,
+                             error=str(exc))
+                return False
             spill_victims = self._finalize(e, DISK, "demotions_disk")
         self._resolve_spills(spill_victims)
         self._record(
@@ -406,8 +420,11 @@ class TierStore:
                     with self._lock:
                         victim.busy -= 1
                         victim.dropped = True
+                        self.stats["spill_failures"] += 1
                         more = []
                     self._reap(victim)
+                    self._record("tier.spill.failed", model=victim.name,
+                                 bytes=victim.nbytes, error=str(exc))
                 self._resolve_spills(more)
             else:
                 with self._lock:
@@ -579,7 +596,10 @@ class TierStore:
                 with self._lock:
                     e.busy -= 1
                     e.dropped = True
+                    self.stats["spill_failures"] += 1
                 self._reap(e)
+                self._record("tier.spill.failed", model=e.name,
+                             bytes=e.nbytes, error=str(exc))
 
     def drop(self, key: str) -> bool:
         with self._lock:

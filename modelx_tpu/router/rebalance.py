@@ -149,6 +149,7 @@ class Rebalancer:
         self._last_step = 0.0
         self.actions_total = 0
         self.action_errors_total = 0
+        self.offline_skipped_steps = 0  # steps skipped: registry offline
         self._history: deque = deque(maxlen=history)
 
     # -- signals --------------------------------------------------------------
@@ -186,6 +187,23 @@ class Rebalancer:
         if not self.allow:
             # observe-only: keep the shed counters accumulating so
             # /metrics shows what WOULD rebalance — don't flush them
+            return []
+        # Fleet control-plane gate (PR 19): when every healthy pod that
+        # reports a registry-health view says "offline", a spread load
+        # would point the target at a dead registry — it could only
+        # succeed from a cache the target may not have warmed. Go
+        # observe-only (sheds keep accumulating, no flush, no error
+        # spam) until some pod sees the registry again. Load refs are
+        # not the problem — they come from the placement table's
+        # last-known rows, which survive dead polls — the PULL is.
+        cp_states = {str(p.control_plane.get("state", ""))
+                     for p in self.registry.pods()
+                     if p.healthy and p.control_plane}
+        if cp_states and cp_states <= {"offline"}:
+            with self._lock:
+                self.offline_skipped_steps += 1
+            logger.info("rebalance: fleet reports control plane offline; "
+                        "observing only")
             return []
         with self._lock:
             self._sheds.clear()
@@ -255,6 +273,7 @@ class Rebalancer:
                 "enabled": self.allow,
                 "actions_total": self.actions_total,
                 "action_errors_total": self.action_errors_total,
+                "offline_skipped_steps": self.offline_skipped_steps,
                 "pending_pressure": dict(self._sheds),
                 "recent_actions": list(self._history),
             }

@@ -47,11 +47,12 @@ class PodState:
     than mutating them, so readers never see a half-updated pod)."""
 
     __slots__ = ("url", "healthy", "status", "models", "serving", "pool",
-                 "consecutive_failures", "polled_at", "error")
+                 "control_plane", "consecutive_failures", "polled_at", "error")
 
     def __init__(self, url: str, healthy: bool = False, status: str = "unpolled",
                  models: dict | None = None, serving: dict | None = None,
-                 pool: dict | None = None, consecutive_failures: int = 0,
+                 pool: dict | None = None, control_plane: dict | None = None,
+                 consecutive_failures: int = 0,
                  polled_at: float = 0.0, error: str = "") -> None:
         self.url = url
         self.healthy = healthy
@@ -59,6 +60,7 @@ class PodState:
         self.models = models or {}        # name -> lifecycle snapshot
         self.serving = serving or {}      # name -> {queue_depth, prefix_cache,..}
         self.pool = pool or {}            # pod-level HBM budget accounting
+        self.control_plane = control_plane or {}  # pod's registry health view
         self.consecutive_failures = consecutive_failures
         self.polled_at = polled_at        # monotonic stamp of last attempt
         self.error = error                # last poll failure, for /metrics
@@ -85,6 +87,8 @@ class PodState:
         }
         if self.serving:
             out["serving"] = self.serving
+        if self.control_plane:
+            out["control_plane"] = self.control_plane.get("state", "")
         if self.error:
             out["error"] = self.error
         return out
@@ -226,6 +230,7 @@ class PodRegistry:
             models: dict = {}
             serving: dict = {}
             pool: dict = {}
+            control_plane: dict = {}
             # lifecycle + load detail even while not ready: a LOADING pod's
             # table row lets /metrics (and the rebalancer) see it coming
             a_status, a_body = self._get_json(url + "/admin/models")
@@ -233,6 +238,7 @@ class PodRegistry:
                 models = dict(a_body.get("models", {}))
                 serving = dict(a_body.get("serving", {}))
                 pool = dict(a_body.get("pool", {}))
+                control_plane = dict(a_body.get("control_plane", {}))
             elif a_status == 401:
                 # auth misconfiguration is an operator error, not a dead
                 # pod: say so in the table instead of flapping health
@@ -244,6 +250,7 @@ class PodRegistry:
                 )
             return PodState(url, healthy=healthy, status=health or str(h_status),
                             models=models, serving=serving, pool=pool,
+                            control_plane=control_plane,
                             consecutive_failures=0, polled_at=now)
         except requests.RequestException as e:
             with self._lock:  # poll rounds run one thread per pod now
@@ -255,6 +262,7 @@ class PodRegistry:
             return PodState(
                 url, healthy=False, status="unreachable",
                 models=prev.models, serving=prev.serving, pool=prev.pool,
+                control_plane=prev.control_plane,
                 consecutive_failures=prev.consecutive_failures + 1,
                 polled_at=now, error=str(e)[:200],
             )
@@ -273,6 +281,7 @@ class PodRegistry:
             self._pods[url] = PodState(
                 url, healthy=False, status="quarantined",
                 models=pod.models, serving=pod.serving, pool=pod.pool,
+                control_plane=pod.control_plane,
                 consecutive_failures=pod.consecutive_failures + 1,
                 polled_at=time.monotonic(), error=reason[:200],
             )

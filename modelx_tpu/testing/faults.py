@@ -21,7 +21,11 @@ apply them to the three seams the framework exposes:
   a deterministic test;
 - ``PodKillSwitch`` hard-kills a live serving pod's HTTP server (listener
   closed, live connections RST) — the fleet router's pod-death drills:
-  mid-stream death must surface typed, failover must cover the rest.
+  mid-stream death must surface typed, failover must cover the rest;
+- ``RegistryKillSwitch`` does the same to a RegistryServer and adds
+  brownout modes (503 storms, accept-path hangs, mid-body truncation) —
+  the control-plane outage drills: pods must keep serving from pinned
+  manifests and local blobs while the registry is down.
 
 Determinism: schedules are either explicit call indices (``errors_at``)
 or drawn once per op from ``random.Random(seed ^ crc(op))`` at rule-add
@@ -343,6 +347,111 @@ class PodKillSwitch:
             return False
 
         return hook
+
+
+class RegistryKillSwitch:
+    """Registry death and brownout for control-plane drills (PR 19).
+
+    Hard-down is the PodKillSwitch move applied to a RegistryServer:
+    ``kill()`` closes the listener and severs every live connection, so
+    in-flight manifest/blob requests die mid-stream and new connections
+    are refused. A *restart* is modeled by constructing a fresh
+    RegistryServer over the SAME store on the SAME port (the HTTP server
+    sets ``allow_reuse_address``) — what the chaos soak does to assert
+    the publish outbox drains after recovery.
+
+    Brownout rides a seeded :class:`FaultPlan` fired once per ACCEPTED
+    connection (op ``registry.accept``, 0-based indices):
+
+    - an error schedule answers the connection with a raw ``503`` +
+      ``Retry-After`` and closes it — the 50x storm a dying control
+      plane emits (clients must back off per endpoint, then fail over);
+    - a latency schedule sleeps in the accept path — the hang shape,
+      surfaced to clients at their connect/read timeout
+      (``--request-timeout``) granularity;
+    - a truncation schedule lets the handler start responding, then
+      severs the connection ``truncate_delay_s`` later — mid-body
+      truncation, the torn blob stream digest verification must catch.
+
+    Schedules replay byte-identically (the plan counts accepts under its
+    lock); a switch with no plan is inert until ``kill()``.
+    """
+
+    OP = "registry.accept"
+
+    def __init__(self, server, plan: FaultPlan | None = None,
+                 truncate_delay_s: float = 0.01) -> None:
+        self._httpd = server.httpd if hasattr(server, "httpd") else server
+        self.plan = plan
+        self.truncate_delay_s = float(truncate_delay_s)
+        self._conns: list = []
+        self._lock = threading.Lock()
+        self.killed = False
+        self.storms = 0  # connections answered with the injected 503
+        orig_get_request = self._httpd.get_request
+
+        def get_request():
+            sock, addr = orig_get_request()
+            with self._lock:
+                self._conns.append(sock)
+            if self.plan is not None:
+                act = self.plan.fire(self.OP)
+                if act.latency_s:
+                    # brownout hang: the accept loop stalls, clients wait
+                    # out their own timeouts
+                    time.sleep(act.latency_s)
+                if act.error is not None:
+                    with self._lock:
+                        self.storms += 1
+                    try:
+                        sock.sendall(
+                            b"HTTP/1.1 503 Service Unavailable\r\n"
+                            b"Retry-After: 1\r\nContent-Length: 0\r\n"
+                            b"Connection: close\r\n\r\n"
+                        )
+                    except OSError:
+                        pass  # client already gone; the refusal stands
+                    self._sever(sock)
+                    # swallowed by BaseServer._handle_request_noblock: the
+                    # serve loop continues, this connection never reaches
+                    # a handler
+                    raise OSError("injected 503 storm")
+                if act.keep_bytes >= 0:
+                    t = threading.Timer(self.truncate_delay_s,
+                                        self._sever, args=(sock,))
+                    t.daemon = True
+                    t.start()
+            return sock, addr
+
+        self._httpd.get_request = get_request
+
+    @staticmethod
+    def _sever(sock) -> None:
+        import socket as _socket
+
+        try:
+            sock.shutdown(_socket.SHUT_RDWR)
+        except OSError:
+            pass  # connection already gone
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def kill(self) -> None:
+        """Idempotent hard death: refuse new connections, sever live
+        ones mid-stream."""
+        with self._lock:
+            if self.killed:
+                return
+            self.killed = True
+            conns = list(self._conns)
+        try:
+            self._httpd.socket.close()
+        except OSError:
+            pass  # already closed: the death is what matters
+        for sock in conns:
+            self._sever(sock)
 
 
 def wrap_dispatch(fn, plan: FaultPlan, op: str = "engine.dispatch"):

@@ -20,6 +20,7 @@ top without cost, and the router front door must start in milliseconds.
 from __future__ import annotations
 
 import random
+import threading
 import time
 
 
@@ -81,3 +82,96 @@ def retriable_status(status: int) -> bool:
     agrees on: 5xx and 429. 4xx below 429 is deterministic (auth /
     not-found / validation) and never retried."""
     return status >= 500 or status == 429
+
+
+class EndpointRotation:
+    """Sticky preference order over equivalent endpoints (a primary plus
+    read mirrors, PR 19). ``order()`` yields indices starting from the
+    last endpoint that worked — after a failover the client keeps talking
+    to the live mirror instead of re-timing-out on the dead primary every
+    request — and ``mark_good`` moves the start. Thread-safe: the serving
+    pull path and the outbox drainer share one client."""
+
+    def __init__(self, count: int) -> None:
+        self.count = max(1, int(count))
+        self._start = 0
+        self._lock = threading.Lock()
+
+    def order(self) -> list[int]:
+        with self._lock:
+            start = self._start
+        return [(start + i) % self.count for i in range(self.count)]
+
+    def mark_good(self, index: int) -> None:
+        if 0 <= index < self.count:
+            with self._lock:
+                self._start = index
+
+    @property
+    def preferred(self) -> int:
+        with self._lock:
+            return self._start
+
+
+def hedged_call(calls, hedge_delay_s: float, *, on_loser=None, wait=None):
+    """First-success-wins hedging over equivalent fetches (ranged blob
+    GETs against a primary + mirrors, PR 19). ``calls[0]`` starts
+    immediately; each later call launches only once ``hedge_delay_s``
+    passes with no winner (a healthy primary never costs the mirror a
+    byte) or an earlier call FAILS (fail-fast failover).
+
+    Returns ``(index, result)`` of the winner; any LOSER that completes
+    late gets ``on_loser(result)`` so the caller can close its response.
+    When every call fails, the first error (launch order) raises.
+    ``wait`` overrides the delay primitive (``wait(event, timeout) ->
+    bool``) so tests drive the hedge arithmetic without sleeping."""
+    calls = list(calls)
+    if not calls:
+        raise ValueError("hedged_call needs at least one call")
+    if wait is None:
+        wait = threading.Event.wait
+    tick = threading.Event()  # set on EVERY completion, success or failure
+    lock = threading.Lock()
+    results: list = []    # (index, value) in completion order
+    failures: dict = {}   # index -> exc
+
+    def run(i: int, fn) -> None:
+        try:
+            value = fn()
+        except Exception as e:
+            with lock:
+                failures[i] = e
+            tick.set()
+            return
+        with lock:
+            results.append((i, value))
+            late = len(results) > 1
+        tick.set()
+        if late and on_loser is not None:
+            on_loser(value)
+
+    launched = 0
+
+    def launch() -> None:
+        nonlocal launched
+        i = launched
+        launched += 1
+        threading.Thread(target=run, args=(i, calls[i]), daemon=True,
+                         name=f"hedge-{i}").start()
+
+    launch()
+    while True:
+        with lock:
+            if results:
+                return results[0]
+            if len(failures) >= launched and launched >= len(calls):
+                raise failures[min(failures)]
+            # every launched call already failed: hedge NOW, not at the
+            # delay — waiting out a dead primary's timer helps nobody
+            hedge_now = len(failures) >= launched
+            tick.clear()  # inside the lock: completions after this set it
+        if launched < len(calls):
+            if hedge_now or not wait(tick, hedge_delay_s):
+                launch()
+        else:
+            wait(tick, None)  # all legs in flight: wait for completions

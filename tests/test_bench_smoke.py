@@ -357,6 +357,40 @@ class TestTierSwapLeg:
         assert out["tier_traffic_served"] >= 1
 
 
+class TestRegistryOutageLeg:
+    @pytest.mark.slow
+    def test_measure_registry_outage_schema(self, tmp_path):
+        """The registry-outage leg end to end on tiny models (ISSUE 19):
+        own in-process registry, kill switch mid-traffic, offline swap-in
+        off the pinned manifest + blob cache, restart, outbox drain.
+        Schema-checks the JSON keys and the acceptance contract: zero
+        dropped requests, the swap served from the cache ladder, the
+        outbox empty after restart."""
+        import bench
+
+        out = bench.measure_registry_outage(
+            str(tmp_path), target_bytes=1,
+            hidden=64, inter=176, vocab=256, prompt_len=4, new_tokens=2,
+            clients=2,
+        )
+        for key in ("outage_dropped_requests", "outage_traffic_served",
+                    "swap_offline_ttft_ms", "outage_swap_source",
+                    "outage_control_plane_state",
+                    "outbox_depth_after_restart", "outbox_drained_total",
+                    "outbox_publish_failures"):
+            assert key in out, key
+        # the acceptance bar: the outage never touched the data path
+        assert out["outage_dropped_requests"] == 0
+        assert out["outage_traffic_served"] >= 2
+        assert out["swap_offline_ttft_ms"] > 0
+        # the swap came off the pinned-manifest ladder, not a re-pull
+        assert out["outage_swap_source"] == "cache"
+        assert out["outage_control_plane_state"] == "offline"
+        # the spooled publish survived the outage and landed on restart
+        assert out["outbox_depth_after_restart"] == 0
+        assert out["outbox_drained_total"] >= 1
+
+
 class TestFleetLeg:
     @pytest.mark.slow
     def test_measure_fleet_schema(self, tmp_path):

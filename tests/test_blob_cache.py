@@ -141,6 +141,9 @@ class TestWarmHit:
             f.write(b"\xde\xad\xbe\xef")
         assert cache.lookup(digest, expected_size=size) is None
         assert cache.stats["corrupt_rejected"] == 1
+        # digest-level rot on a size-plausible entry: the dedicated
+        # counter fires too (PR 19's cache-volume-health signal)
+        assert cache.stats["cache_corrupt_evictions"] == 1
         assert not os.path.exists(entry)  # evicted, not served
         # the fallback path repairs the cache from the network
         self_heal = cache.wrap(SpySource(path), digest, size)
@@ -148,6 +151,17 @@ class TestWarmHit:
         self_heal.close()
         assert self_heal.network_reads > 0
         assert cache.lookup(digest, expected_size=size) is not None
+
+    def test_truncated_entry_counts_rejection_not_rot(self, checkpoint, tmp_path):
+        path, _tensors, digest, size = checkpoint
+        cache = BlobCache(str(tmp_path / "cache"))
+        self._fill(cache, path, digest, size)
+        with open(cache.entry_path(digest), "r+b") as f:
+            f.truncate(size - 1)
+        assert cache.lookup(digest, expected_size=size) is None
+        assert cache.stats["corrupt_rejected"] == 1
+        # the size check caught it before the digest pass: not volume rot
+        assert cache.stats["cache_corrupt_evictions"] == 0
 
 
 class TestLRUEviction:

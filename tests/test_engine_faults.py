@@ -456,7 +456,11 @@ class TestServingSurface:
         assert r.status_code == 503  # typed EngineBrokenError on the wire
         r = requests.get(base + "/healthz")
         assert r.status_code == 503
-        assert r.json() == {"status": "engine-restarting"}
+        body = r.json()
+        assert body["status"] == "engine-restarting"
+        # the PR 19 control-plane block rides alongside, never into,
+        # readiness — it must not change the engine-health story
+        assert set(body) == {"status", "control_plane"}
         # liveness stays OK: a supervised restart is recoverable — k8s
         # must drain (readiness), not kill the container
         assert requests.get(base + "/livez").status_code == 200
@@ -483,7 +487,7 @@ class TestServingSurface:
             time.sleep(0.01)
         r = requests.get(base + "/healthz")
         assert r.status_code == 503
-        assert r.json() == {"status": "engine-broken"}
+        assert r.json()["status"] == "engine-broken"
         # ... and ONLY now does liveness fail: the livenessProbe (podspec)
         # restarts the pod out of the unrecoverable state
         r = requests.get(base + "/livez")

@@ -1,0 +1,849 @@
+"""Control-plane brownout resilience tests (ISSUE 19): pods keep serving
+when the registry dies.
+
+Covers the whole degradation ladder — pinned-manifest cache +
+stale-while-revalidate (``RegistryClient.get_manifest``), multi-endpoint
+failover health accounting, fully-offline ``pull_model`` out of the blob
+cache, the lifecycle's retryable-507 contract when the ladder runs dry,
+the ``control_plane: ok|degraded|offline`` surface that readiness does
+NOT gate on, the durable publish outbox + drainer, TierStore ENOSPC
+spill hardening, the rebalancer's fleet-offline observe-only gate, and
+``RegistryKillSwitch`` brownout modes (503 storms, hangs, truncation).
+
+Tier-1 keeps the unit suites and one representative per integration
+seam; the full chaos soak (registry killed under 8-client traffic with a
+concurrent offline swap-in and an outbox drain on restart) carries
+``slow``/``chaos`` and runs under ``make outage`` with MODELX_LOCKDEP=1.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from modelx_tpu import errors
+from modelx_tpu.client.client import Client
+from modelx_tpu.client.remote import RegistryClient
+from modelx_tpu.dl import manifest_cache
+from modelx_tpu.dl.blob_cache import BlobCache
+from modelx_tpu.dl.lifecycle import READY, PoolError
+from modelx_tpu.dl.manifest_cache import (
+    ControlPlaneHealth,
+    ManifestCache,
+    OfflineUnavailableError,
+)
+from modelx_tpu.dl.outbox import Drainer, Outbox
+from modelx_tpu.dl.serve import ServerSet, serve
+from modelx_tpu.registry.fs import MemoryFSProvider
+from modelx_tpu.registry.server import Options, RegistryServer, free_port
+from modelx_tpu.registry.store_fs import FSRegistryStore
+from modelx_tpu.testing.faults import FaultPlan, RegistryKillSwitch
+from modelx_tpu.types import Descriptor, Digest, Manifest
+from modelx_tpu.utils.flightrec import FlightRecorder
+from tests.test_lifecycle import make_server, serve_sset, write_tiny
+
+
+@pytest.fixture(autouse=True)
+def fresh_control_plane(tmp_path):
+    """Every test starts with a clean process-wide health tracker and its
+    OWN manifest-cache dir; the module default is restored to
+    'unconfigured' (env-following) afterward so other test files keep
+    their pre-PR-19 behavior."""
+    manifest_cache.health().reset()
+    manifest_cache.health().recorder = None
+    manifest_cache.configure_default(str(tmp_path / "manifest-cache"))
+    yield
+    manifest_cache.health().reset()
+    manifest_cache.health().recorder = None
+    with manifest_cache._default_lock:
+        manifest_cache._default = None
+        manifest_cache._default_configured = False
+
+
+def start_registry(port: int | None = None, store=None):
+    """A registry on a known port over a reusable store, so tests can
+    kill it and restart 'the same' registry (same address, same
+    content) — the recovery half of the outage drills."""
+    port = port or free_port()
+    store = store or FSRegistryStore(MemoryFSProvider())
+    srv = RegistryServer(Options(listen=f"127.0.0.1:{port}"), store=store)
+    base = srv.serve_background()
+    return srv, base, port, store
+
+
+@pytest.fixture()
+def model_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("outage-model")
+    write_tiny(str(d))
+    return str(d)
+
+
+def tiny_manifest(data: bytes = b"layer-bytes") -> Manifest:
+    return Manifest(blobs=[Descriptor(
+        name="model.safetensors", digest=str(Digest.from_bytes(data)),
+        size=len(data))])
+
+
+# -- pinned-manifest cache ----------------------------------------------------
+
+
+class TestManifestCacheUnit:
+    def test_put_lookup_round_trip(self, tmp_path):
+        cache = ManifestCache(str(tmp_path / "mc"))
+        m = tiny_manifest()
+        cache.put("http://r:1", "library/a", "v1", m)
+        got = cache.lookup("http://r:1", "library/a", "v1")
+        assert got is not None
+        assert got.to_json() == m.to_json()
+        assert cache.stats["puts"] == 1 and cache.stats["hits"] == 1
+
+    def test_ref_identity_includes_registry_and_version(self, tmp_path):
+        cache = ManifestCache(str(tmp_path / "mc"))
+        cache.put("http://r:1", "library/a", "v1", tiny_manifest())
+        assert cache.lookup("http://other:2", "library/a", "v1") is None
+        assert cache.lookup("http://r:1", "library/a", "v2") is None
+        assert cache.stats["misses"] == 2
+
+    def test_config_merges_into_existing_entry(self, tmp_path):
+        cache = ManifestCache(str(tmp_path / "mc"))
+        cache.put("http://r:1", "library/a", "v1", tiny_manifest(),
+                  config_yaml=b"files: ['*']\n")
+        # a later manifest-only refresh must not lose the config sidecar
+        cache.put("http://r:1", "library/a", "v1", tiny_manifest())
+        assert cache.lookup_config("http://r:1", "library/a",
+                                   "v1") == b"files: ['*']\n"
+
+    def test_garbage_entry_reads_as_miss(self, tmp_path):
+        cache = ManifestCache(str(tmp_path / "mc"))
+        cache.put("http://r:1", "library/a", "v1", tiny_manifest())
+        path = cache._path("http://r:1", "library/a", "v1")
+        with open(path, "w") as f:
+            f.write("{torn json")
+        assert cache.lookup("http://r:1", "library/a", "v1") is None
+        assert cache.lookup_config("http://r:1", "library/a", "v1") is None
+
+    def test_age_tracks_fetch_time(self, tmp_path):
+        cache = ManifestCache(str(tmp_path / "mc"))
+        assert cache.age_s("http://r:1", "library/a", "v1") is None
+        cache.put("http://r:1", "library/a", "v1", tiny_manifest())
+        age = cache.age_s("http://r:1", "library/a", "v1")
+        assert age is not None and 0 <= age < 60
+
+    def test_empty_version_is_latest(self, tmp_path):
+        cache = ManifestCache(str(tmp_path / "mc"))
+        cache.put("http://r:1", "library/a", "", tiny_manifest())
+        assert cache.lookup("http://r:1", "library/a", "latest") is not None
+
+
+class TestControlPlaneHealthUnit:
+    def _health(self, start=1000.0):
+        clock = {"t": start}
+        h = ControlPlaneHealth(clock=lambda: clock["t"])
+        return h, clock
+
+    def test_primary_ok_is_ok(self):
+        h, _ = self._health()
+        h.note_ok()
+        assert h.state == "ok"
+
+    def test_failure_is_offline_then_recovery_is_degraded_first(self):
+        h, clock = self._health()
+        h.note_failure()
+        assert h.state == "offline"
+        h.note_ok()
+        # one blip reads as a brownout for the window, not an ok-flap
+        assert h.state == "degraded"
+        clock["t"] += manifest_cache._DEGRADED_WINDOW_S + 1
+        h.note_ok()
+        assert h.state == "ok"
+
+    def test_mirror_ok_is_always_degraded(self):
+        h, _ = self._health()
+        h.note_ok(mirror=True)
+        assert h.state == "degraded"
+        assert h.status()["mirror_ok_total"] == 1
+
+    def test_offline_serve_counts_and_goes_offline(self):
+        h, _ = self._health()
+        h.note_ok()
+        h.note_offline_serve()
+        assert h.state == "offline"
+        assert h.status()["offline_serves_total"] == 1
+
+    def test_transitions_land_on_the_flight_recorder(self):
+        h, _ = self._health()
+        rec = FlightRecorder(capacity=16)
+        h.recorder = rec
+        h.note_failure()
+        h.note_ok()
+        evs = [e for e in rec.events()
+               if e["event"] == "control_plane.transition"]
+        assert [(e["prev"], e["state"]) for e in evs] == [
+            ("ok", "offline"), ("offline", "degraded")]
+
+    def test_status_reports_ages(self):
+        h, clock = self._health()
+        h.note_ok()
+        clock["t"] += 2.0
+        s = h.status()
+        assert s["last_ok_age_s"] == pytest.approx(2.0)
+        assert "last_failure_age_s" not in s
+
+
+# -- durable publish outbox ---------------------------------------------------
+
+
+class TestOutboxUnit:
+    def test_enqueue_peek_remove_fifo(self, tmp_path):
+        ob = Outbox(str(tmp_path / "ob"))
+        assert ob.enqueue("programs", "http://r/library/a@v1", b"one")
+        assert ob.enqueue("programs", "http://r/library/b@v1", b"two")
+        assert ob.depth() == 2 and ob.pending_bytes() == 6
+        seq, meta, data = ob.peek()
+        assert meta["ref"].endswith("a@v1") and data == b"one"
+        ob.remove(seq)
+        _, meta2, data2 = ob.peek()
+        assert data2 == b"two"
+
+    def test_full_spool_drops_not_blocks(self, tmp_path):
+        ob = Outbox(str(tmp_path / "ob"), max_entries=1)
+        assert ob.enqueue("programs", "r1", b"x")
+        assert not ob.enqueue("programs", "r2", b"y")
+        assert ob.stats["drop_full_total"] == 1
+        assert ob.depth() == 1  # the older entry survives
+
+    def test_byte_budget_enforced(self, tmp_path):
+        ob = Outbox(str(tmp_path / "ob"), max_bytes=4)
+        assert not ob.enqueue("programs", "r", b"x" * 8)
+        assert ob.stats["drop_full_total"] == 1
+
+    def test_entries_survive_a_process_generation(self, tmp_path):
+        root = str(tmp_path / "ob")
+        Outbox(root).enqueue("programs", "http://r/library/a@v1", b"bundle")
+        # 'restart': a fresh Outbox over the same spool dir
+        ob2 = Outbox(root)
+        seq, meta, data = ob2.peek()
+        assert data == b"bundle" and meta["kind"] == "programs"
+        # and new enqueues never collide with the previous generation
+        ob2.enqueue("programs", "http://r/library/b@v1", b"next")
+        assert ob2.depth() == 2
+
+    def test_orphan_payload_swept_on_construction(self, tmp_path):
+        root = tmp_path / "ob"
+        root.mkdir()
+        # a crash between payload write and meta commit leaves only .bin
+        (root / "00000007.bin").write_bytes(b"torn")
+        ob = Outbox(str(root))
+        assert ob.depth() == 0
+        assert not (root / "00000007.bin").exists()
+
+    def test_unreadable_entry_removed_on_peek(self, tmp_path):
+        root = tmp_path / "ob"
+        ob = Outbox(str(root))
+        ob.enqueue("programs", "r", b"x")
+        seq, _, _ = ob.peek()
+        os.unlink(root / f"{seq:08d}.bin")  # meta without payload
+        assert ob.peek() is None
+        assert ob.depth() == 0
+
+
+class TestDrainerUnit:
+    def test_drain_once_success_removes_and_counts(self, tmp_path):
+        ob = Outbox(str(tmp_path / "ob"))
+        ob.enqueue("programs", "http://r/library/a@v1", b"bundle")
+        got = []
+        rec = FlightRecorder(capacity=16)
+        d = Drainer(ob, lambda kind, ref, data: got.append((kind, ref, data)),
+                    recorder=rec)
+        assert d.drain_once()
+        assert got == [("programs", "http://r/library/a@v1", b"bundle")]
+        assert ob.depth() == 0 and ob.stats["drained_total"] == 1
+        assert any(e["event"] == "outbox.drained" for e in rec.events())
+
+    def test_failure_keeps_entry_and_backs_off_exponentially(self, tmp_path):
+        ob = Outbox(str(tmp_path / "ob"))
+        ob.enqueue("programs", "r", b"x")
+
+        def handler(kind, ref, data):
+            raise errors.ErrorInfo(http_status=502, message="registry down")
+
+        d = Drainer(ob, handler, backoff_s=0.5, backoff_cap_s=4.0)
+        assert d._delay_s() == 0.0
+        for want in (0.5, 1.0, 2.0, 4.0, 4.0):  # doubles, then caps
+            assert not d.drain_once()
+            assert d._delay_s() == pytest.approx(want)
+        assert ob.depth() == 1
+        assert ob.stats["publish_failures_total"] == 5
+        snap = d.snapshot()
+        assert snap["consecutive_failures"] == 5
+        assert "registry down" in snap["last_error"]
+
+    def test_success_after_failures_resets_backoff(self, tmp_path):
+        ob = Outbox(str(tmp_path / "ob"))
+        ob.enqueue("programs", "r", b"x")
+        live = {"up": False}
+
+        def handler(kind, ref, data):
+            if not live["up"]:
+                raise OSError("connection refused")
+
+        d = Drainer(ob, handler, backoff_s=0.5)
+        assert not d.drain_once()
+        live["up"] = True
+        assert d.drain_once()
+        assert d._delay_s() == 0.0 and ob.depth() == 0
+
+    def test_background_thread_drains_on_kick(self, tmp_path):
+        ob = Outbox(str(tmp_path / "ob"))
+        drained = threading.Event()
+
+        def handler(kind, ref, data):
+            drained.set()
+
+        # injectable sleeper with a short bound keeps the test sleep-free
+        # on the success path (the park resolves on kick)
+        d = Drainer(ob, handler, backoff_s=0.01,
+                    sleeper=lambda ev, t: ev.wait(0.05))
+        d.start()
+        try:
+            ob.enqueue("programs", "r", b"x")
+            d.kick()
+            assert drained.wait(5.0)
+            deadline = time.monotonic() + 5.0
+            while ob.depth() and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert ob.depth() == 0
+        finally:
+            d.stop()
+        assert not d.snapshot()["running"]
+
+
+# -- stale-while-revalidate + offline pull ------------------------------------
+
+
+class TestStaleManifestServe:
+    """THE tentpole seam: get_manifest serves the digest-pinned cached
+    copy when every endpoint is down — tiers.ref_pairs, estimate_ref_bytes
+    and the initializer all ride the same ladder for free."""
+
+    def test_pinned_manifest_serves_through_registry_death(self, model_dir):
+        srv, base, _port, _store = start_registry()
+        try:
+            client = Client(base, quiet=True)
+            client.push("library/m", "v1", model_dir)
+            live = client.get_manifest("library/m", "v1")
+        finally:
+            srv.shutdown()
+        # registry is GONE (keep-alive sockets included — a dead process
+        # severs them; the pooled session would otherwise keep talking to
+        # a lingering handler thread)
+        client.remote.session.close()
+        client.remote._retry_sleep = lambda *a: None
+        cached = client.get_manifest("library/m", "v1")
+        assert cached.to_json() == live.to_json()
+        assert client.remote.last_source == "cache"
+        h = manifest_cache.health().status()
+        assert h["state"] == "offline" and h["offline_serves_total"] >= 1
+        assert manifest_cache.default_cache().stats["stale_served"] >= 1
+
+    def test_unknown_ref_offline_still_fails(self, model_dir):
+        srv, base, _port, _store = start_registry()
+        try:
+            client = Client(base, quiet=True)
+            client.push("library/m", "v1", model_dir)
+        finally:
+            srv.shutdown()
+        client.remote.session.close()
+        client.remote._retry_sleep = lambda *a: None
+        with pytest.raises(errors.ErrorInfo):
+            client.get_manifest("library/never-pulled", "v1")
+        assert manifest_cache.health().state == "offline"
+
+    def test_config_content_served_from_cache_offline(self, tmp_path, model_dir):
+        with open(os.path.join(model_dir, "modelx.yaml"), "w") as f:
+            f.write("files: ['*.safetensors']\n")
+        srv, base, _port, _store = start_registry()
+        try:
+            client = Client(base, quiet=True)
+            client.push("library/m", "v1", model_dir)
+            live = client.get_config_content("library/m", "v1")
+        finally:
+            srv.shutdown()
+        client.remote.session.close()
+        client.remote._retry_sleep = lambda *a: None
+        assert client.get_config_content("library/m", "v1") == live
+
+
+class TestOfflinePull:
+    def test_warm_pull_completes_fully_offline(self, tmp_path, model_dir):
+        import filecmp
+
+        from modelx_tpu.dl.initializer import pull_model
+
+        cache = BlobCache(str(tmp_path / "blobs"))
+        srv, base, _port, _store = start_registry()
+        try:
+            Client(base, quiet=True).push("library/m", "v1", model_dir)
+            s1 = pull_model(f"{base}/library/m@v1", str(tmp_path / "d1"),
+                            cache=cache)
+            assert s1["source"] == "registry"
+        finally:
+            srv.shutdown()
+        # registry dead: manifest from the pinned cache, every blob
+        # digest-verified out of the local blob cache
+        s2 = pull_model(f"{base}/library/m@v1", str(tmp_path / "d2"),
+                        cache=cache)
+        assert s2["source"] == "cache"
+        assert filecmp.cmp(str(tmp_path / "d1" / "model.safetensors"),
+                           str(tmp_path / "d2" / "model.safetensors"),
+                           shallow=False)
+
+    def test_cold_cache_offline_raises_offline_unavailable(
+            self, tmp_path, model_dir):
+        from modelx_tpu.dl.initializer import pull_model
+
+        srv, base, _port, _store = start_registry()
+        try:
+            client = Client(base, quiet=True)
+            client.push("library/m", "v1", model_dir)
+            client.get_manifest("library/m", "v1")  # pin the manifest only
+        finally:
+            srv.shutdown()
+        with pytest.raises(OfflineUnavailableError):
+            pull_model(f"{base}/library/m@v1", str(tmp_path / "dest"),
+                       cache=BlobCache(str(tmp_path / "empty-blobs")))
+
+
+# -- RegistryKillSwitch brownout modes ----------------------------------------
+
+
+class TestRegistryBrownout:
+    def _client(self, base, timeout=None):
+        c = RegistryClient(base, timeout=timeout)
+        c._retry_sleep = lambda *a: None  # injected clock: no real backoff
+        return c
+
+    def test_503_storm_is_retried_through(self, model_dir):
+        srv, base, _port, _store = start_registry()
+        plan = FaultPlan(seed=7).add(RegistryKillSwitch.OP, errors_at=[1, 2],
+                                     error=RuntimeError("storm"))
+        switch = RegistryKillSwitch(srv, plan=plan)
+        try:
+            Client(base, quiet=True).push("library/m", "v1", model_dir)
+            # accepts 1 and 2 answer raw 503 + Retry-After; the client's
+            # per-endpoint retry walks through the storm
+            m = self._client(base).get_manifest("library/m", "v1")
+            assert m.blobs
+            assert switch.storms == 2
+        finally:
+            switch.kill()
+
+    def test_truncated_connection_is_retried(self, model_dir):
+        srv, base, _port, _store = start_registry()
+        plan = FaultPlan(seed=7).add(RegistryKillSwitch.OP, truncate_at=[1],
+                                     keep_bytes=0)
+        switch = RegistryKillSwitch(srv, plan=plan, truncate_delay_s=0.0)
+        try:
+            Client(base, quiet=True).push("library/m", "v1", model_dir)
+            # accept 1 gets severed under the handler; requests surfaces
+            # it as a connection error -> retriable -> the retry lands
+            m = self._client(base).get_manifest("library/m", "v1")
+            assert m.blobs
+        finally:
+            switch.kill()
+
+    def test_hang_surfaces_at_request_timeout_granularity(self, model_dir):
+        srv, base, _port, _store = start_registry()
+        # the hang must be shorter than the retry budget (3 attempts x
+        # 0.25s read timeout), or every retry connects into the stalled
+        # accept queue and times out too — which is itself the brownout
+        # lesson the --request-timeout knob encodes
+        plan = FaultPlan(seed=7).add(RegistryKillSwitch.OP, latency_at=[1],
+                                     latency_s=0.4)
+        switch = RegistryKillSwitch(srv, plan=plan)
+        try:
+            Client(base, quiet=True).push("library/m", "v1", model_dir)
+            c = self._client(base, timeout=(0.25, 0.25))
+            t0 = time.monotonic()
+            m = c.get_manifest("library/m", "v1")
+            # the hung accept cost client timeouts, not the registry's
+            # full sleep stacked onto an unbounded wait
+            assert m.blobs
+            assert time.monotonic() - t0 < 10.0
+        finally:
+            switch.kill()
+
+    def test_kill_then_restart_on_same_address(self, model_dir):
+        srv, base, port, store = start_registry()
+        switch = RegistryKillSwitch(srv)
+        Client(base, quiet=True).push("library/m", "v1", model_dir)
+        switch.kill()
+        c = self._client(base)
+        with pytest.raises(errors.ErrorInfo):
+            c._request("GET", "/library/m/index")
+        assert manifest_cache.health().state == "offline"
+        # recovery: same port, same store — the restart the chaos drill's
+        # outbox-drain assertion depends on
+        srv2, base2, _p, _s = start_registry(port=port, store=store)
+        try:
+            assert base2 == base
+            m = c.get_manifest("library/m", "v1")
+            assert m.blobs
+            assert manifest_cache.health().state in ("ok", "degraded")
+        finally:
+            srv2.shutdown()
+
+
+# -- lifecycle: 507 contract + control_plane surface --------------------------
+
+
+class TestLifecycleOffline:
+    def test_unmaterializable_ref_maps_to_retryable_507(self, model_dir, tmp_path):
+        dead = f"http://127.0.0.1:{free_port()}"
+        # the resident server never loads: the estimate fails first
+        sset = ServerSet({"m": make_server(model_dir, name="m")},
+                         allow_admin_load=True,
+                         staging_root=str(tmp_path / "staging"))
+        with pytest.raises(PoolError) as pe:
+            sset.pool.request_load("ghost", ref=f"{dead}/library/ghost@v1",
+                                   wait=True)
+        # retryable-507: the pressure clears when the registry returns,
+        # so the client is told to come back, not that the ref is bad
+        assert pe.value.status == 507
+        assert "Retry-After" in pe.value.headers
+
+    def test_control_plane_block_never_gates_readiness(self, model_dir, tmp_path):
+        sset = ServerSet({"m": make_server(model_dir, name="m")})
+        sset.load_all()
+        httpd, base = serve_sset(sset)
+        try:
+            r = requests.get(base + "/healthz")
+            assert r.status_code == 200
+            assert r.json()["control_plane"]["state"] == "ok"
+            # registry dies; the pod MUST stay ready and say why it's sad
+            manifest_cache.health().note_failure()
+            r = requests.get(base + "/healthz")
+            assert r.status_code == 200  # THE acceptance line
+            body = r.json()
+            assert body["status"] == "ok"
+            assert body["control_plane"]["state"] == "offline"
+            a = requests.get(base + "/admin/models").json()
+            assert a["control_plane"]["state"] == "offline"
+            m = requests.get(base + "/metrics").json()
+            assert m["control_plane"]["failures_total"] >= 1
+            # the data plane agrees with the readiness claim
+            g = requests.post(base + "/v1/generate",
+                              json={"tokens": [[1, 2, 3]],
+                                    "max_new_tokens": 2})
+            assert g.status_code == 200
+        finally:
+            httpd.shutdown()
+
+
+# -- TierStore ENOSPC hardening -----------------------------------------------
+
+
+class TestTierSpillFailures:
+    def _params(self, seed=0):
+        rng = np.random.RandomState(seed)
+        import jax.numpy as jnp
+
+        return {f"w{i}": jnp.asarray(rng.rand(8, 4).astype(np.float32))
+                for i in range(3)}
+
+    def test_full_disk_drops_entry_never_crashes_demotion(self, tmp_path):
+        from modelx_tpu.dl.tiers import OP_SPILL, TierStore
+
+        plan = FaultPlan(seed=3).add(
+            OP_SPILL, errors_at=[0],
+            error=OSError(28, "No space left on device"))
+        store = TierStore(host_budget_bytes=0, disk_budget_bytes=1 << 30,
+                          spool_root=str(tmp_path / "spool"),
+                          fault_plan=plan)
+        # host tier disabled: the offer goes straight to disk and hits
+        # the injected ENOSPC — it must report failure, not raise
+        assert not store.offer("k1", "m", self._params())
+        assert store.stats["spill_failures"] == 1
+        assert store.stats["demotions_dropped"] == 1
+        assert store.tier_of("k1") is None
+        # the partial spool is gone: fully tiered or fully gone
+        assert not os.path.exists(os.path.join(str(tmp_path / "spool"), "k1"))
+        # the store stays serviceable once the disk clears
+        assert store.offer("k2", "m", self._params(1))
+        assert store.tier_of("k2") == "disk"
+
+    def test_overflow_spill_failure_counts_and_reaps(self, tmp_path):
+        from modelx_tpu.dl.tiers import OP_SPILL, TierStore
+
+        params = self._params()
+        nbytes = sum(int(np.asarray(v).nbytes) for v in params.values())
+        plan = FaultPlan(seed=3).add(
+            OP_SPILL, errors_at=[0],
+            error=OSError(28, "No space left on device"))
+        store = TierStore(host_budget_bytes=nbytes + 8,
+                          disk_budget_bytes=1 << 30,
+                          spool_root=str(tmp_path / "spool"),
+                          fault_plan=plan)
+        assert store.offer("k1", "m", params)
+        # k2 overflows host; the LRU victim k1's spill hits ENOSPC and
+        # is dropped (counted), while k2 lands
+        assert store.offer("k2", "m", self._params(1))
+        assert store.stats["spill_failures"] == 1
+        assert store.tier_of("k1") is None
+        assert store.tier_of("k2") == "host"
+
+
+# -- rebalancer: fleet-offline observe-only gate ------------------------------
+
+
+class _StubFleet:
+    def __init__(self, pods):
+        self._pods = pods
+
+    def pods(self):
+        return self._pods
+
+
+class TestRebalanceOfflineGate:
+    def _pod(self, url, cp_state, healthy=True):
+        from modelx_tpu.router.registry import PodState
+
+        return PodState(
+            url, healthy=healthy, status="ok",
+            models={"m": {"state": "READY", "ref": "http://r/library/m@v1"}},
+            serving={"m": {"queue_depth": 9}},
+            control_plane={"state": cp_state} if cp_state else None,
+        )
+
+    def test_fleet_offline_turns_step_observe_only(self):
+        from modelx_tpu.router.rebalance import Rebalancer
+
+        pods = [self._pod("http://p1", "offline"),
+                self._pod("http://p2", "offline")]
+        rb = Rebalancer(_StubFleet(pods), allow=True)
+        rb.observe_shed("m")
+        assert rb.step() == []
+        assert rb.offline_skipped_steps == 1
+        # sheds keep accumulating (not flushed): the pressure picture
+        # survives the outage for the first post-recovery step
+        assert rb.snapshot()["pending_pressure"] == {"m": 1}
+
+    def test_one_degraded_pod_keeps_rebalance_live(self):
+        from modelx_tpu.router.rebalance import Rebalancer
+
+        pods = [self._pod("http://p1", "offline"),
+                self._pod("http://p2", "degraded")]
+        rb = Rebalancer(_StubFleet(pods), allow=True)
+        rb.step()
+        assert rb.offline_skipped_steps == 0
+
+    def test_no_health_view_means_no_gate(self):
+        from modelx_tpu.router.rebalance import Rebalancer
+
+        # pre-PR-19 pods report no control_plane block: never gate on it
+        pods = [self._pod("http://p1", "")]
+        rb = Rebalancer(_StubFleet(pods), allow=True)
+        rb.step()
+        assert rb.offline_skipped_steps == 0
+
+    def test_pod_snapshot_carries_control_plane(self):
+        assert self._pod("http://p1", "degraded").snapshot()[
+            "control_plane"] == "degraded"
+
+    def test_load_refs_come_from_last_known_table(self):
+        from modelx_tpu.router.rebalance import plan_actions
+
+        # the serving pod is DEAD (unhealthy) but its last-known row still
+        # carries the ref — the spread plan reuses it instead of asking
+        # the (possibly dead) registry
+        dead = self._pod("http://p1", "offline", healthy=False)
+        idle = self._pod("http://p2", "degraded")
+        idle.models = {}
+        idle.serving = {}
+        actions = plan_actions([dead, idle], {"m": 9})
+        assert len(actions) == 1
+        assert actions[0].kind == "load"
+        assert actions[0].ref == "http://r/library/m@v1"
+
+
+# -- outbox against a real registry: fail, restart, drain ---------------------
+
+
+def make_bundle(tmp_path) -> bytes:
+    """A real (tiny) program bundle: publish parses bundle meta before it
+    ever talks to the registry, so spooled payloads must be wire-true."""
+    from modelx_tpu.dl import program_store as ps
+
+    d = str(tmp_path / "aot-cache")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "aot-" + "ab" * 8 + ".bin"), "wb") as f:
+        f.write(b"export-one")
+    data = ps.build_bundle(d)
+    assert data
+    return data
+
+
+class TestOutboxPublishIntegration:
+    def test_drain_lands_within_one_cycle_of_restart(self, tmp_path, model_dir):
+        from modelx_tpu.dl.program_store import MediaTypeModelProgram, publish_bundle
+
+        bundle = make_bundle(tmp_path)
+        srv, base, port, store = start_registry()
+        # the bundle attaches to an existing model version
+        Client(base, quiet=True).push("library/m", "v1", model_dir)
+        srv.shutdown()  # registry dies: every publish attempt must fail
+        ob = Outbox(str(tmp_path / "ob"))
+        ob.enqueue("programs", f"{base}/library/m@v1", bundle)
+        d = Drainer(ob, lambda kind, ref, data: publish_bundle(ref, data),
+                    backoff_s=0.05, backoff_cap_s=0.2)
+        assert not d.drain_once()
+        assert ob.stats["publish_failures_total"] == 1
+        # registry recovers on the same address
+        srv2, _base2, _p, _s = start_registry(port=port, store=store)
+        try:
+            assert d.drain_once()  # one cycle after recovery: drained
+            assert ob.depth() == 0
+            m = RegistryClient(base).get_manifest("library/m", "v1")
+            assert any(b.media_type == MediaTypeModelProgram for b in m.blobs)
+        finally:
+            srv2.shutdown()
+
+
+# -- the chaos soak -----------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestRegistryOutageSoak:
+    def test_fleet_survives_registry_death(self, tmp_path, tmp_path_factory):
+        """THE acceptance drill: 8 clients stream against a pod while the
+        registry dies mid-traffic; a concurrent swap-in materializes
+        OFFLINE from the pinned manifest + blob cache; zero data-path
+        errors; the publish outbox drains within one backoff cycle of the
+        registry's restart; the flight recorder holds the ladder story."""
+        amodel = tmp_path_factory.mktemp("soak-a")
+        bmodel = tmp_path_factory.mktemp("soak-b")
+        write_tiny(str(amodel), seed=0)
+        write_tiny(str(bmodel), seed=1)
+
+        srv, base, port, store = start_registry()
+        client = Client(base, quiet=True)
+        client.push("library/a", "v1", str(amodel))
+        client.push("library/b", "v1", str(bmodel))
+
+        blob_cache = BlobCache(str(tmp_path / "blobs"))
+        sset = ServerSet({"a": make_server(str(amodel))},
+                         allow_admin_load=True,
+                         staging_root=str(tmp_path / "staging"))
+        sset.pool.blob_cache = blob_cache
+        sset.pool.attach_outbox(str(tmp_path / "outbox"), backoff_s=0.1)
+        sset.load_all()
+        httpd, pod = serve_sset(sset)
+        switch = RegistryKillSwitch(srv)
+        try:
+            # warm the ladder: pull b once through the caches, then drop it
+            r = requests.post(pod + "/admin/models",
+                              json={"name": "b",
+                                    "ref": f"{base}/library/b@v1",
+                                    "wait": True}, timeout=300)
+            assert r.status_code == 200, r.text
+            requests.delete(pod + "/admin/models/b?wait=1", timeout=60)
+
+            # 8 clients of sustained traffic on a
+            stop = threading.Event()
+            errors_seen: list = []
+            completed = [0] * 8
+
+            def traffic(i: int) -> None:
+                while not stop.is_set():
+                    try:
+                        g = requests.post(
+                            pod + "/v1/a/generate",
+                            json={"tokens": [[1, 2, 3]],
+                                  "max_new_tokens": 2},
+                            timeout=120)
+                        if g.status_code != 200:
+                            errors_seen.append((i, g.status_code, g.text))
+                            return
+                        completed[i] += 1
+                    except Exception as e:  # any transport failure counts
+                        errors_seen.append((i, type(e).__name__, str(e)))
+                        return
+
+            threads = [threading.Thread(target=traffic, args=(i,),
+                                        daemon=True) for i in range(8)]
+            for t in threads:
+                t.start()
+            # let traffic establish, then kill the control plane
+            deadline = time.monotonic() + 10.0
+            while sum(completed) < 8 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert sum(completed) >= 8, "traffic never established"
+            switch.kill()
+
+            # a publish lands in the spool during the outage and fails
+            assert sset.pool.outbox.enqueue(
+                "programs", f"{base}/library/b@v1", make_bundle(tmp_path))
+            sset.pool.outbox_drainer.kick()
+
+            # concurrent swap-in, fully offline, timed
+            t0 = time.monotonic()
+            r = requests.post(pod + "/admin/models",
+                              json={"name": "b",
+                                    "ref": f"{base}/library/b@v1",
+                                    "wait": True}, timeout=300)
+            swap_offline_ttft_ms = (time.monotonic() - t0) * 1e3
+            assert r.status_code == 200, r.text
+            assert r.json()["b"]["state"] == READY
+            assert r.json()["b"]["load_source"] == "cache"
+            g = requests.post(pod + "/v1/b/generate",
+                              json={"tokens": [[1, 2, 3]],
+                                    "max_new_tokens": 2}, timeout=120)
+            assert g.status_code == 200
+
+            # the pod says degraded truthfully, without dropping readiness
+            hz = requests.get(pod + "/healthz").json()
+            assert hz["status"] == "ok"
+            assert hz["control_plane"]["state"] == "offline"
+
+            # wind down traffic: ZERO data-path errors through the outage
+            stop.set()
+            for t in threads:
+                t.join(30)
+            assert not errors_seen, errors_seen
+            assert all(n > 0 for n in completed)
+
+            # registry restarts; the outbox drains within one backoff
+            # cycle (drainer backoff 0.1s doubling; generous bound)
+            srv2, _b2, _p2, _s2 = start_registry(port=port, store=store)
+            try:
+                sset.pool.outbox_drainer.kick()
+                deadline = time.monotonic() + 30.0
+                while (sset.pool.outbox.depth()
+                       and time.monotonic() < deadline):
+                    time.sleep(0.05)
+                assert sset.pool.outbox.depth() == 0
+                assert sset.pool.outbox.stats["drained_total"] >= 1
+                from modelx_tpu.dl.program_store import MediaTypeModelProgram
+
+                m = RegistryClient(base).get_manifest("library/b", "v1")
+                assert any(b.media_type == MediaTypeModelProgram
+                           for b in m.blobs)
+            finally:
+                srv2.shutdown()
+
+            # the flight recorder tells the whole story
+            events = {e["event"] for e in sset.pool.flightrec.events()}
+            assert "ladder.source" in events
+            assert "control_plane.transition" in events
+            assert "outbox.drained" in events
+            # and the bench-leg metric is a real number
+            assert swap_offline_ttft_ms > 0
+        finally:
+            switch.kill()
+            sset.pool.stop_outbox()
+            httpd.shutdown()

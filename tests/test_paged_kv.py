@@ -116,6 +116,7 @@ class TestPagedExactness:
             engine.generate(tokens, **kw), server.generate(tokens, **kw)
         )
 
+    @pytest.mark.slow  # tier-1 wall: greedy/sampled-matches-plain stay tier-1
     def test_concurrent_mixed_requests_match_solo(self, server, engine):
         import concurrent.futures
 
@@ -252,6 +253,7 @@ class TestPagedPool:
 
 
 class TestPagedBatchedAdmission:
+    @pytest.mark.slow  # tier-1 wall: FIFO admission semantics stay tier-1
     def test_burst_shares_admit_program_and_matches(self, server):
         """Same-bucket burst arrivals under paged KV admit as ONE program
         (page writes scatter all rows per page column) — token-exactly."""
@@ -303,6 +305,7 @@ class TestPagedBatchedAdmission:
 
 
 class TestPagedPrefixCache:
+    @pytest.mark.slow  # tier-1 wall: the prefix-cache suite stays tier-1
     def test_cached_admission_is_byte_exact(self, server):
         """Prefix-cache hits ride the paged cached-admit program: the
         resumed row must match an uncached decode exactly."""
@@ -430,6 +433,7 @@ class TestInPlaceFastPath:
 
 
 class TestMixtralInPlace:
+    @pytest.mark.slow  # tier-1 wall: mixtral family e2e stays tier-1 in test_serve_families
     def test_moe_engine_in_place_exact(self, tmp_path_factory):
         """Mixtral rides the same decoder_layer paged wiring: in-place
         paged decode stays token-exact on the f32 fixture."""

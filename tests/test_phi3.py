@@ -150,6 +150,7 @@ class TestServing:
         server.load()
         return server, params
 
+    @pytest.mark.slow  # tier-1 wall: HF parity stays tier-1; generic serve e2e covers the engine
     def test_serves_end_to_end_with_continuous_engine(self, served):
         from modelx_tpu.dl.continuous import ContinuousBatcher
         from modelx_tpu.models import phi3

@@ -38,6 +38,7 @@ class TestStacking:
 
 
 class TestPipelineForward:
+    @pytest.mark.slow  # tier-1 wall: pp=4,dp=2 jit; stacking invariants stay tier-1 in TestStacking
     def test_qwen2_biases_survive_stack_and_pipeline(self):
         """qwen2's qkv biases must stack, shard, and flow through the
         pipelined forward — dropping them silently would compute bias-free

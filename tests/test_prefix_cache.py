@@ -182,6 +182,7 @@ class TestSuffixPrefill:
 
 
 class TestServeIntegration:
+    @pytest.mark.slow  # tier-1 wall: unit prefix-cache coverage stays tier-1
     def test_stream_and_metrics(self, model, tmp_path):
         from modelx_tpu.dl import safetensors as st
         from modelx_tpu.dl.serve import ModelServer, ServerSet, serve

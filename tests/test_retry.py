@@ -1,11 +1,25 @@
 """utils/retry.py — the shared exponential-backoff + Retry-After policy
 (PR 8 satellite: factored out of RegistryClient._request, now also the
 fleet router's pod-poller stance). The client-side integration tests live
-in test_client.py::TestControlPlaneRetries; these cover the arithmetic."""
+in test_client.py::TestControlPlaneRetries; these cover the arithmetic.
+
+PR 19 adds the multi-endpoint layer on top: ``EndpointRotation`` (sticky
+failover order over primary + mirrors), ``hedged_call`` (first-success
+racing for ranged blob GETs), and the client-level contract that each
+endpoint gets the FULL per-endpoint retry policy — Retry-After included —
+before failover moves on. All on injected clocks/waits: no sleeps."""
+
+import threading
 
 import pytest
 
-from modelx_tpu.utils.retry import RetryPolicy, parse_retry_after, retriable_status
+from modelx_tpu.utils.retry import (
+    EndpointRotation,
+    RetryPolicy,
+    hedged_call,
+    parse_retry_after,
+    retriable_status,
+)
 
 
 class TestParseRetryAfter:
@@ -83,3 +97,197 @@ class TestRetryPolicy:
         # deterministic 4xx never retries (auth / not-found / validation)
         assert not retriable_status(404) and not retriable_status(400)
         assert not retriable_status(409) and not retriable_status(200)
+
+
+class TestEndpointRotation:
+    def test_initial_order_is_primary_first(self):
+        assert EndpointRotation(3).order() == [0, 1, 2]
+
+    def test_mark_good_moves_the_start(self):
+        r = EndpointRotation(3)
+        r.mark_good(1)
+        # sticky: after a failover the live mirror leads, dead primary
+        # is retried LAST instead of re-timing-out first every request
+        assert r.order() == [1, 2, 0]
+        assert r.preferred == 1
+
+    def test_mark_good_out_of_range_ignored(self):
+        r = EndpointRotation(2)
+        r.mark_good(7)
+        r.mark_good(-1)
+        assert r.order() == [0, 1]
+
+    def test_single_endpoint_degenerates(self):
+        r = EndpointRotation(1)
+        r.mark_good(0)
+        assert r.order() == [0]
+
+
+class TestHedgedCall:
+    """hedged_call on injected waits — no wall-clock sleeps. ``wait(ev,
+    timeout)`` is the only delay primitive the loop uses, so an injected
+    one that returns False simulates 'the hedge delay elapsed' and a
+    plain bounded ``ev.wait`` covers everything else."""
+
+    @staticmethod
+    def _wait(ev, timeout):
+        # hedge-delay waits resolve on completion ticks; the bound only
+        # guards a hung test
+        return ev.wait(5.0)
+
+    def test_fast_primary_never_launches_the_hedge(self):
+        hedged = []
+        idx, value = hedged_call(
+            [lambda: "primary", lambda: hedged.append(1) or "mirror"],
+            hedge_delay_s=60.0, wait=self._wait,
+        )
+        assert (idx, value) == (0, "primary")
+        assert hedged == []  # a healthy primary costs the mirror nothing
+
+    def test_hedge_wins_and_loser_is_closed(self):
+        release = threading.Event()
+        loser_done = threading.Event()
+        closed = []
+
+        def slow_primary():
+            release.wait(5.0)
+            return "late-primary"
+
+        def wait(ev, timeout):
+            if timeout is not None:
+                return False  # the hedge delay 'elapses' instantly
+            return ev.wait(5.0)
+
+        idx, value = hedged_call(
+            [slow_primary, lambda: "mirror"], hedge_delay_s=60.0,
+            on_loser=lambda v: (closed.append(v), loser_done.set()),
+            wait=wait,
+        )
+        assert (idx, value) == (1, "mirror")
+        release.set()
+        assert loser_done.wait(5.0)
+        assert closed == ["late-primary"]  # late winner handed back to close
+
+    def test_failure_hedges_immediately(self):
+        def dead_primary():
+            raise RuntimeError("connection refused")
+
+        idx, value = hedged_call(
+            [dead_primary, lambda: "mirror"],
+            hedge_delay_s=60.0, wait=self._wait,
+        )
+        # fail-fast failover: the 60s hedge delay is NOT waited out when
+        # every launched call has already failed
+        assert (idx, value) == (1, "mirror")
+
+    def test_all_failed_raises_first_by_launch_order(self):
+        def a():
+            raise RuntimeError("primary down")
+
+        def b():
+            raise RuntimeError("mirror down")
+
+        with pytest.raises(RuntimeError, match="primary down"):
+            hedged_call([a, b], hedge_delay_s=60.0, wait=self._wait)
+
+    def test_empty_calls_rejected(self):
+        with pytest.raises(ValueError):
+            hedged_call([], hedge_delay_s=0.1)
+
+
+class TestClientEndpointFailover:
+    """RegistryClient._request over [primary, mirror] with _send and
+    _retry_sleep stubbed: the failover ladder and per-endpoint Retry-After
+    contract without HTTP or sleeps."""
+
+    def _client(self):
+        from modelx_tpu.client.remote import RegistryClient
+
+        return RegistryClient("http://primary:1", mirrors=["http://mirror:2"])
+
+    def test_retry_after_respected_per_endpoint(self):
+        from modelx_tpu import errors
+
+        c = self._client()
+        sleeps = []
+        c._retry_sleep = lambda attempt, ra: sleeps.append((attempt, ra))
+        sends = []
+
+        def send(method, url, params=None, data=None, headers=None, stream=False):
+            sends.append(url)
+            if url.startswith("http://primary"):
+                e = errors.ErrorInfo(http_status=503,
+                                     code=errors.ErrCodeInternal,
+                                     message="registry browning out")
+                e.retry_after = "2"
+                raise e
+            return object()
+
+        c._send = send
+        c._request("GET", "/x")
+        # the primary gets its FULL per-endpoint policy (3 attempts, each
+        # backoff honoring the server's Retry-After) before failover
+        assert sends == ["http://primary:1/x"] * 3 + ["http://mirror:2/x"]
+        assert sleeps == [(0, "2"), (1, "2")]
+
+    def test_failover_is_sticky_across_requests(self):
+        from modelx_tpu import errors
+
+        c = self._client()
+        c._retry_sleep = lambda attempt, ra: None
+        sends = []
+
+        def send(method, url, params=None, data=None, headers=None, stream=False):
+            sends.append(url)
+            if url.startswith("http://primary"):
+                raise errors.ErrorInfo(http_status=502,
+                                       code=errors.ErrCodeUnknown,
+                                       message="dead")
+            return object()
+
+        c._send = send
+        c._request("GET", "/a")
+        first = len(sends)
+        c._request("GET", "/b")
+        # request 2 leads with the mirror that worked: no re-timeout tax
+        assert sends[first] == "http://mirror:2/b"
+        assert c.last_source == "mirror"
+
+    def test_deterministic_4xx_never_fails_over(self):
+        from modelx_tpu import errors
+
+        c = self._client()
+        sends = []
+
+        def send(method, url, params=None, data=None, headers=None, stream=False):
+            sends.append(url)
+            raise errors.ErrorInfo(http_status=404,
+                                   code=errors.ErrCodeManifestUnknown,
+                                   message="no such thing")
+
+        c._send = send
+        with pytest.raises(errors.ErrorInfo) as ei:
+            c._request("GET", "/missing")
+        assert ei.value.http_status == 404
+        # mirrors hold the same content: asking them again is pure waste
+        assert sends == ["http://primary:1/missing"]
+
+    def test_writes_never_touch_mirrors(self):
+        from modelx_tpu import errors
+
+        c = self._client()
+        c._retry_sleep = lambda attempt, ra: None
+        sends = []
+
+        def send(method, url, params=None, data=None, headers=None, stream=False):
+            sends.append((method, url))
+            raise errors.ErrorInfo(http_status=503,
+                                   code=errors.ErrCodeInternal,
+                                   message="down")
+
+        c._send = send
+        with pytest.raises(errors.ErrorInfo):
+            c._request("DELETE", "/library/a/manifests/v1")
+        # one attempt, primary only: mirrors are read replicas and writes
+        # own their replay semantics at the caller
+        assert sends == [("DELETE", "http://primary:1/library/a/manifests/v1")]

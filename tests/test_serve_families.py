@@ -448,6 +448,7 @@ class TestGenerateBatching:
         finally:
             httpd.shutdown()
 
+    @pytest.mark.slow  # tier-1 wall: the batching route test stays tier-1
     def test_tokens_generated_counts_requested_only(self, checkpoints):
         """Padded rows and the power-of-two decode bucket must not inflate
         the tokens_generated metric."""
@@ -491,6 +492,7 @@ class TestGenerateBatching:
 
 
 class TestStreamingGenerate:
+    @pytest.mark.slow  # tier-1 wall: stream byte-equality also held by router/openai suites
     def test_stream_chunks_equal_nonstreamed(self, checkpoints):
         """Concatenated stream chunks must reproduce the one-shot result
         exactly, greedy and sampled, including a partial last chunk."""

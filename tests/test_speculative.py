@@ -74,7 +74,10 @@ class TestExactness:
         params, cfg, _fwd, _init = model
         return llama.greedy_generate(params, jnp.asarray(prompt), cfg, max_new_tokens=n)
 
-    @pytest.mark.parametrize("k", [1, 4, 8])
+    # tier-1 wall: k=4 carries tier-1, the k sweep rides `make slow`
+    @pytest.mark.parametrize(
+        "k", [pytest.param(1, marks=pytest.mark.slow), 4,
+              pytest.param(8, marks=pytest.mark.slow)])
     def test_matches_plain_greedy_on_repetitive_prompt(self, model, k):
         params, _cfg, fwd, init = model
         # a looping prompt: the n-gram lookup should fire constantly
@@ -126,6 +129,7 @@ class TestExactness:
 
 
 class TestServeIntegration:
+    @pytest.mark.slow  # tier-1 wall: engine-level TestExactness is the tier-1 representative
     def test_server_with_speculation_matches_without(self, model, tmp_path):
         """--speculative-k changes device-step counts, never tokens."""
         from modelx_tpu.dl import safetensors as st
@@ -272,6 +276,7 @@ class TestSpeculativeSampling:
 
         return fwd, (lambda b, n: {"pad": jnp.zeros((b, n, 1, 1), jnp.float32)})
 
+    @pytest.mark.slow  # tier-1 wall: ~3000-draw statistical soak
     def test_output_distribution_matches_target(self):
         """~3000 draws of the FIRST post-prefill speculative step (whose
         proposal always fires) vs the closed-form target distribution."""

@@ -81,6 +81,7 @@ class TestTrainCLI:
         assert res.exit_code != 0
         assert "needs" in res.output
 
+    @pytest.mark.slow  # tier-1 wall: 32s subprocess leg; the other CLI paths stay tier-1
     def test_finetune_from_model_dir(self, tmp_path):
         """Finetune from a local checkpoint dir — run in a SUBPROCESS.
 
