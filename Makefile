@@ -5,7 +5,7 @@ PY ?= python
 IMAGE ?= modelx-tpu
 TAG ?= $(shell git describe --tags --always 2>/dev/null || echo dev)
 
-.PHONY: all native test chaos slow lifecycle fleet overload programs continuation obs mesh decode tiers outage lint wheel image image-dl compose-up compose-down clean
+.PHONY: all native test chaos slow lifecycle fleet overload programs kv continuation obs mesh decode tiers outage lint wheel image image-dl compose-up compose-down clean
 
 all: native lint test wheel
 
@@ -74,6 +74,16 @@ continuation:
 programs:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_program_store.py -q -m "not slow"
 	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_program_store.py -q -m slow
+
+# content-addressed KV store drills (ISSUE 20): bundle build/install/
+# corruption/skew units + registry round-trips + byte-exact
+# installed-vs-prefilled decode, then the slow set (the dp=2,tp=2 mesh
+# roundtrip and the publish -> pod-kill -> outbox-drain -> reinstall
+# chaos drill) under runtime lockdep — the publisher/fetcher threads
+# ride the prefix cache's and outbox's lock order
+kv:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_kv_store.py -q -m "not slow"
+	MODELX_LOCKDEP=1 JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_kv_store.py -q -m slow
 
 # observability drills (ISSUE 13 + 15): exposition-format round-trips,
 # trace summary/decorator units, request-id propagation over HTTP; the

@@ -375,6 +375,112 @@ def measure_program_store(base: str, repo: str, workdir: str,
     }
 
 
+def measure_kv_store(model_dir: str, base: str, repo: str = "library/kv",
+                     dtype: str = "bfloat16", prompt_len: int = 192,
+                     suffix_len: int = 16, new_tokens: int = 8,
+                     max_seq_len: int = 512) -> dict:
+    """Content-addressed prefix-KV registry leg (ISSUE 20): pod 1 serves a
+    hot shared system prompt H until its prefix KV crosses the publish
+    threshold, builds the bundle and attaches it to the model version; a
+    SECOND fresh pod (its own ModelServer, empty prefix cache) pulls the
+    bundle from the registry at load and answers H + a new suffix from
+    the INSTALLED entry — its TTFT drops from a full prefill to a
+    suffix prefill (``kv_warm_ttft_ratio``, pass < 0.6).
+
+    Compile isolation: both scored streams run against programs the
+    DECOY prompts B / B+S' / D already compiled on pod 2 (same padded
+    shapes, different tokens), so the ratio prices prefill compute, not
+    trace+compile. ``kv_hits_installed`` >= 1 is asserted — a warm number
+    that never touched the installed entry would be a vacuous pass."""
+    from modelx_tpu.client.client import Client
+    from modelx_tpu.dl import kv_store
+    from modelx_tpu.dl.serve import ModelServer
+
+    ckpt = os.path.join(model_dir, "model.safetensors")
+    client, _desc = push_checkpoint(base, repo, ckpt)
+    ref = f"{base}/{repo}@v1"
+
+    def pod() -> ModelServer:
+        srv = ModelServer(model_dir, dtype=dtype, max_seq_len=max_seq_len,
+                          prefix_cache_size=8)
+        srv.load()
+        return srv
+
+    def stream(srv, ids) -> float | None:
+        """Drain one stream fully; returns ms-to-first-piece (TTFT)."""
+        toks = np.asarray([ids], np.int32)
+        t0 = time.monotonic()
+        first_ms = None
+        for _piece in srv.generate_stream(toks, max_new_tokens=new_tokens,
+                                          chunk_size=8):
+            if first_ms is None:
+                first_ms = (time.monotonic() - t0) * 1e3
+        return first_ms
+
+    pod1 = pod()
+    rng = np.random.RandomState(31)
+    vocab = int(pod1.cfg.vocab_size)
+
+    def prompt(n: int) -> list[int]:
+        return rng.randint(1, vocab, n).astype(np.int32).tolist()
+
+    hot = prompt(prompt_len)  # the shared system prompt
+    # turn 1 stores H; two follow-up turns extending H push its hit count
+    # to the publish threshold (an identical re-send is NOT a hit — the
+    # cache serves strict prefixes, like real multi-turn traffic)
+    stream(pod1, hot)
+    stream(pod1, hot + prompt(suffix_len))
+    stream(pod1, hot + prompt(suffix_len))
+    model_key = kv_store.model_key_for_ref(ref)
+    published = 0
+    for key, entry in pod1._prefix_cache.take_publishable(2):
+        data = kv_store.build_bundle(list(key), entry, model_key=model_key,
+                                     mesh=pod1.mesh)
+        if data is not None:
+            kv_store.publish_bundle(ref, data)
+            published += 1
+    if published < 1:
+        raise RuntimeError("kv leg: pod 1 published no bundle "
+                           f"(cache stats {pod1._prefix_cache.stats()})")
+    del pod1
+
+    # pod 2: fresh server + empty prefix cache; the registry is the only
+    # channel the hot prefix can arrive through
+    pod2 = pod()
+    _fwd, init = pod2.family.decode_fns(pod2.cfg, mesh=pod2.mesh)
+    inst = kv_store.pull_and_install(
+        client, repo, client.get_manifest(repo, "v1"), init,
+        pod2._prefix_cache, mesh=pod2.mesh, model_key=model_key)
+    if inst["installed"] < 1:
+        raise RuntimeError(f"kv leg: pod 2 installed nothing: {inst}")
+
+    # decoy prewarm: D compiles the full-prefill program at the scored
+    # total length, B then B+S' compile the suffix-prefill (hit) pair at
+    # the scored shapes — different tokens, so nothing leaks into the
+    # scored prompts' cache keys
+    stream(pod2, prompt(prompt_len + suffix_len))            # D: cold shape
+    decoy = prompt(prompt_len)
+    stream(pod2, decoy)                                      # B: stores B
+    stream(pod2, decoy + prompt(suffix_len))                 # B+S': hit shape
+
+    warm_ms = stream(pod2, hot + prompt(suffix_len))
+    hits_installed = pod2._prefix_cache.stats()["hits_installed"]
+    if hits_installed < 1:
+        raise RuntimeError(
+            "kv leg: the scored warm stream missed the installed entry "
+            f"(cache stats {pod2._prefix_cache.stats()})")
+    cold_ms = stream(pod2, prompt(prompt_len + suffix_len))
+    return {
+        "kv_published": published,
+        "kv_installed": inst["installed"],
+        "kv_install_skipped": inst["skipped"],
+        "kv_hits_installed": hits_installed,
+        "kv_warm_ttft_ms": round(warm_ms, 1),
+        "kv_cold_ttft_ms": round(cold_ms, 1),
+        "kv_warm_ttft_ratio": round(warm_ms / cold_ms, 3) if cold_ms else None,
+    }
+
+
 def cache_split_summary(size: int, cold_rec: dict, warm_rec: dict) -> dict:
     """The multi-tier cache's cold/warm split from two blob-cache legs
     (leg_main kinds "cold"/"warm"). ``warm_hit`` is the zero-network-reads
@@ -2785,6 +2891,20 @@ def main() -> None:
 
         guard("continuation", continuation_leg, 120.0)
 
+        # content-addressed prefix-KV leg (ISSUE 20): pod 1 publishes the
+        # hot shared prompt's prefill KV to the registry; a fresh pod 2
+        # installs it and serves that prompt with a suffix-only prefill
+        def kv_leg() -> dict:
+            kv_dir = os.path.join(workdir, "fleet")
+            if not os.path.exists(os.path.join(kv_dir, "model.safetensors")):
+                os.makedirs(kv_dir, exist_ok=True)
+                build_checkpoint(
+                    os.path.join(kv_dir, "model.safetensors"),
+                    48 * 1024 * 1024, hidden=512, inter=1408, vocab=8192)
+            return measure_kv_store(kv_dir, base)
+
+        guard("kv_store", kv_leg, 120.0)
+
         # int8 weight-only serving: per-step weight reads halve, so decode
         # (HBM-bound) speeds up — the quantize flag the serve sidecar ships
         def int8_serving() -> dict:
@@ -2925,6 +3045,15 @@ def tiny_main() -> int:
         # cache, restart, drain the publish outbox. The acceptance bar:
         # outage_dropped_requests == 0.
         out.update(measure_registry_outage(workdir))
+
+        # content-addressed prefix-KV leg (ISSUE 20): pod 1 streams a hot
+        # shared prompt past the publish threshold and attaches its prefix
+        # KV to the version; a fresh pod 2 installs it from the registry
+        # and answers that prompt with a suffix-only prefill
+        # (kv_warm_ttft_ratio, pass < 0.6)
+        out.update(measure_kv_store(workdir, base, dtype="float32",
+                                    prompt_len=48, suffix_len=8,
+                                    new_tokens=4, max_seq_len=128))
 
         from modelx_tpu.dl.blob_cache import BlobCache
         from modelx_tpu.dl.serve import (ModelServer, ServerSet,

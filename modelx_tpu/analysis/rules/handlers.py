@@ -58,6 +58,7 @@ _SERVER_PATH_FILES = (
     "modelx_tpu/dl/manifest_cache.py",
     "modelx_tpu/dl/outbox.py",
     "modelx_tpu/dl/program_store.py",
+    "modelx_tpu/dl/kv_store.py",
     "modelx_tpu/dl/loader.py",
     "modelx_tpu/dl/sharding.py",
     "modelx_tpu/parallel/mesh.py",

@@ -542,6 +542,116 @@ def cmd_programs_prune(ref: str) -> None:
         _fail(e)
 
 
+# -- kv (prefix-KV bundles, dl/kv_store.py) -----------------------------------
+
+
+@main.group("kv")
+def cmd_kv() -> None:
+    """Prefix-KV bundles: serialized prefill caches shipped with the model."""
+
+
+@cmd_kv.command("list")
+@click.argument("ref", shell_complete=_complete_ref)
+def cmd_kv_list(ref: str) -> None:
+    """List the prefix-KV bundles attached to a version (or, without
+    @version, to every version of the repository)."""
+    from modelx_tpu.types import (
+        AnnotationKVCode,
+        AnnotationKVModel,
+        AnnotationKVPrefix,
+        AnnotationKVTokens,
+        MediaTypeModelKVCache,
+    )
+
+    try:
+        r = parse_reference(ref)
+        if not r.repository:
+            raise ValueError("reference must include a repository")
+        remote = r.client(quiet=True).remote
+        versions = [r.version] if r.version else [
+            m.name for m in remote.get_index(r.repository).manifests
+        ]
+        rows = []
+        for ver in versions:
+            manifest = remote.get_manifest(r.repository, ver)
+            for b in manifest.blobs:
+                if b.media_type != MediaTypeModelKVCache:
+                    continue
+                rows.append([
+                    ver, b.name,
+                    b.annotations.get(AnnotationKVTokens, "?"),
+                    b.annotations.get(AnnotationKVPrefix, "?"),
+                    b.annotations.get(AnnotationKVModel, "?"),
+                    b.annotations.get(AnnotationKVCode, "?"),
+                    human_size(b.size),
+                ])
+        _table(["VERSION", "BUNDLE", "TOKENS", "PREFIX", "MODEL", "CODE", "SIZE"], rows)
+    except (errors.ErrorInfo, ValueError) as e:
+        _fail(e)
+
+
+@cmd_kv.command("push")
+@click.argument("ref", shell_complete=_complete_ref)
+@click.argument("bundle", type=click.Path(exists=True, dir_okay=False))
+def cmd_kv_push(ref: str, bundle: str) -> None:
+    """Attach a pre-built prefix-KV bundle (a ``.kv-*.tar`` a pod wrote,
+    or one salvaged from a model dir) to a version. Pods publish their
+    own hot entries through the outbox; this is the manual escape hatch —
+    the bundle's stamped environment decides its name, so re-pushing the
+    same bytes is an idempotent no-op."""
+    from modelx_tpu.dl import kv_store
+
+    try:
+        r = parse_reference(ref)
+        if not r.repository or not r.version:
+            raise ValueError("kv push needs repo@version "
+                             "(bundles pin the exact version they cache for)")
+        with open(bundle, "rb") as f:
+            data = f.read()
+        meta = kv_store._bundle_meta(data)
+        if meta is None:
+            raise ValueError(f"{bundle} is not a kv bundle (bad tar/meta)")
+        client = r.client(quiet=True)
+        desc = kv_store.publish(client.remote, r.repository, r.version, data)
+        click.echo(json.dumps({
+            "name": desc.name, "digest": str(desc.digest), "size": desc.size,
+            "tokens": meta.get("tokens") and len(meta["tokens"]),
+        }))
+    except (errors.ErrorInfo, ValueError, OSError) as e:
+        _fail(e)
+
+
+@cmd_kv.command("prune")
+@click.argument("ref", shell_complete=_complete_ref)
+def cmd_kv_prune(ref: str) -> None:
+    """Detach prefix-KV bundles from a version (or every version without
+    @version). The blobs become unreferenced — the next gc sweep collects
+    them; weights, tokenizer files and program bundles are untouched."""
+    from modelx_tpu.types import MediaTypeModelKVCache
+
+    try:
+        r = parse_reference(ref)
+        if not r.repository:
+            raise ValueError("reference must include a repository")
+        remote = r.client(quiet=True).remote
+        versions = [r.version] if r.version else [
+            m.name for m in remote.get_index(r.repository).manifests
+        ]
+        removed = 0
+        for ver in versions:
+            manifest = remote.get_manifest(r.repository, ver)
+            keep = [b for b in manifest.blobs
+                    if b.media_type != MediaTypeModelKVCache]
+            if len(keep) == len(manifest.blobs):
+                continue
+            removed += len(manifest.blobs) - len(keep)
+            manifest.blobs = keep
+            remote.put_manifest(r.repository, ver, manifest)
+        click.echo(json.dumps({"removed": removed, "versions": len(versions)}))
+    except (errors.ErrorInfo, ValueError) as e:
+        _fail(e)
+
+
 # -- serve (modelxd) ----------------------------------------------------------
 
 

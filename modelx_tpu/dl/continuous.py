@@ -577,6 +577,9 @@ class ContinuousBatcher:
         self.stats = {"chunks": 0, "admitted": 0, "active_peak": 0,
                       "prefill_pieces": 0, "stall_ms_max": 0.0,
                       "engine_restarts": 0, "shed": 0, "expired": 0,
+                      # admissions decoded from registry-installed prefix
+                      # KV (dl/kv_store.py) rather than local prefill
+                      "prefix_hits_installed": 0,
                       # pipelined dispatch: device programs launched
                       # ("chunks" stays chunk-EQUIVALENTS — a depth-D
                       # program counts D), the deepest program used, the
@@ -1597,9 +1600,21 @@ class ContinuousBatcher:
         # counts against its poison-quarantine budget
         self._suspect_fp = prep["fp"]
         self._suspect_rid = prep["ticket"].request_id
+        # registry-installed prefix KV (dl/kv_store.py): count and mark the
+        # dispatch when this admit decodes from fleet-shared state — the
+        # observable proof a fresh pod skipped a shared-prefix prefill
+        installed = False
+        if prep["hit"] is not None and self.prefix_cache is not None:
+            installed = (
+                self.prefix_cache.entry_origin(ids[: prep["hit"][0]])
+                == "installed"
+            )
+            if installed:
+                self.stats["prefix_hits_installed"] += 1
         self._rec("dispatch_admit", slot=slot,
                   request_id=prep["ticket"].request_id,
-                  prompt_len=s, cached=prep["hit"] is not None)
+                  prompt_len=s, cached=prep["hit"] is not None,
+                  installed_kv=installed)
         hit = prep["hit"]
         prompt_pages = (
             jnp.asarray(prep["prompt_pages"])

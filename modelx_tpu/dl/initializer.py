@@ -25,6 +25,7 @@ from modelx_tpu.types import (
     AnnotationTensorIndex,
     BlobLocationPurposeDownload,
     Manifest,
+    MediaTypeModelKVCache,
     MediaTypeModelProgram,
 )
 
@@ -50,7 +51,8 @@ def filter_blobs(manifest: Manifest, model_files: list[str]) -> Manifest:
             wanted.add(entry.split("/", 1)[0])  # top-level dir blob
     blobs = [
         b for b in manifest.blobs
-        if b.name in wanted or b.media_type == MediaTypeModelProgram
+        if b.name in wanted
+        or b.media_type in (MediaTypeModelProgram, MediaTypeModelKVCache)
     ]
     return Manifest(
         schema_version=manifest.schema_version,
@@ -192,8 +194,9 @@ def pull_model(uri: str, dest: str, cache=None, quiet: bool = True) -> dict:
             hit = cache.lookup(blob.digest, expected_size=blob.size or -1)
             if hit is None:
                 if offline:
-                    if blob.media_type == MediaTypeModelProgram:
-                        # no compiled bundle on hand: boot cold, don't fail
+                    if blob.media_type in (MediaTypeModelProgram,
+                                           MediaTypeModelKVCache):
+                        # no derived bundle on hand: boot cold, don't fail
                         offline_skipped_programs += 1
                         continue
                     raise OfflineUnavailableError(
@@ -261,6 +264,9 @@ def pull_model(uri: str, dest: str, cache=None, quiet: bool = True) -> dict:
         "bytes": sum(b.size for b in selected.blobs),
         "program_blobs": sum(
             1 for b in selected.blobs if b.media_type == MediaTypeModelProgram
+        ),
+        "kv_blobs": sum(
+            1 for b in selected.blobs if b.media_type == MediaTypeModelKVCache
         ),
         "cache_hits": cache_hits,
         "cache_admitted": admitted,

@@ -1,0 +1,773 @@
+"""Content-addressed prefix-KV store: ship hot prefix caches with the model.
+
+The fleet already fingerprints prompt prefixes at the router (sticky
+routing) and caches prefix KV per pod (models/decode.py PrefixKVCache) —
+but that cache dies with the pod, and a popular shared system prompt gets
+re-prefilled once per replica. This module generalizes dl/program_store.py
+to a SECOND derived-artifact kind: a hot PrefixKVCache entry serializes
+into one deterministic tar (``meta.json`` first, then raw little-endian
+leaf buffers) attached to the model version as a real manifest descriptor
+under ``application/vnd.modelx.kvcache.v1`` — so sha256 verification,
+scrub/quarantine, upload markers and GC referenced-digest tracking apply
+to serving state with zero new registry code.
+
+Keying: a bundle is named ``.kv-<env_key>-<prefix_hash>.tar`` where
+``env_key`` is program_store's environment digest (jax version, backend,
+package-source digest, GSPMD mesh shape — KV layouts never cross-install
+between topologies or code versions) and ``prefix_hash`` is
+sha256(model content key x env_key x the exact tokenized prompt head).
+Same prefix, same weights, same world => same name => republish is a
+registry no-op; anything else coexists.
+
+Flow: pods count per-key hits and publish entries crossing a threshold
+through the PR 19 outbox (kind ``"kvcache"``; durable across registry
+brownouts); pulls drop ``.kv-*.tar`` next to the weights and the server
+installs them at load; a prefix-cache MISS can fetch through to the
+registry on demand (KVFetcher), bounded by the existing
+``--prefix-cache-max-bytes``. Installed leaves ``device_put`` to their
+recorded shardings the way tier promotion does (dl/tiers.py). Because KV
+is a deterministic function of the token prefix, a decode resumed from
+installed KV is byte-identical to a locally-prefilled one — greedy and
+sampled alike; tests/test_kv_store.py holds that contract.
+
+Trust boundary (mirrors program_store): member names must match
+``leaf-NNNNN.bin``, every member is re-hashed against the bundle's own
+meta.json, leaf shapes/dtypes must match what the model family's
+``init_kv_cache`` says a cache of that length looks like, and installs
+never overwrite local entries. The store is an optimization, never
+load-bearing: any miss, skew, truncation or corruption is logged,
+counted, and skipped — the caller simply prefills cold.
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import io
+import json
+import logging
+import os
+import re
+import tarfile
+import threading
+
+from modelx_tpu.dl import program_store as _ps
+from modelx_tpu.types import (
+    AnnotationKVCode,
+    AnnotationKVMesh,
+    AnnotationKVModel,
+    AnnotationKVPrefix,
+    AnnotationKVTokens,
+    Descriptor,
+    Digest,
+    Manifest,
+    MediaTypeModelKVCache,
+)
+
+logger = logging.getLogger("modelx.kv")
+
+BUNDLE_FORMAT = 1
+META_MEMBER = "meta.json"
+OUTBOX_KIND = "kvcache"
+# the only member shape a kv bundle may carry: a raw leaf buffer. Paths,
+# traversal, stray files are rejected at install.
+_LEAF_RE = re.compile(r"^leaf-\d{5}\.bin$")
+
+# program_store owns the environment fingerprint (PR 16): same quadruple,
+# same digest — a KV layout's compatibility domain IS the compiled
+# surface's
+env_key = _ps.env_key
+
+
+def _env_key_of(jx: str, backend: str, code: str, mesh_s: str) -> str:
+    """env_key recomputed from a bundle's OWN stamped quadruple (publish
+    may run in another process/epoch than the capture — never re-derive
+    the name from the local environment)."""
+    h = hashlib.sha256(f"{jx}\x00{backend}\x00{code}\x00{mesh_s}".encode())
+    return h.hexdigest()[:12]
+
+
+def prefix_hash(model_key: str, envk: str, ids) -> str:
+    """Digest naming one cached prefix within one (weights, environment)
+    world: the exact token ids are the content, the model key scopes
+    equal prompts across different weights, the env key scopes equal
+    prompts across meshes/code versions."""
+    payload = json.dumps([int(t) for t in ids], separators=(",", ":"))
+    h = hashlib.sha256(f"{model_key}\x00{envk}\x00{payload}".encode())
+    return h.hexdigest()[:16]
+
+
+def bundle_name(envk: str, phash: str) -> str:
+    """Dotfile on purpose (same reason as program_store.bundle_name): a
+    model dir holding pulled kv bundles re-pushes cleanly."""
+    return f".kv-{envk}-{phash}.tar"
+
+
+def model_key_for_ref(ref: str) -> str:
+    """Content key of the weights a registry ref names — manifest-digest
+    salted (dl/tiers.ref_pairs), so every pod serving the same version
+    derives the SAME key (a dir mtime salt would not survive a re-pull).
+    Empty string when the manifest is unreachable: publishing retries
+    later, installing skips the check (descriptors are already scoped to
+    the model version)."""
+    from modelx_tpu.dl import tiers as tiers_mod
+
+    try:
+        return tiers_mod.content_key(tiers_mod.ref_pairs(ref))
+    except Exception as e:
+        logger.warning("kv model key for %s unavailable: %s", ref, e)
+        return ""
+
+
+# --- bundle build -------------------------------------------------------------
+
+
+def _spec_of(leaf):
+    """JSON-able PartitionSpec of a leaf's NamedSharding (None for
+    single-device / fully replicated layouts): each axis entry is null, a
+    mesh-axis name, or a list of names — exactly what PartitionSpec(*...)
+    rebuilds on install."""
+    import jax
+
+    sharding = getattr(leaf, "sharding", None)
+    if not isinstance(sharding, jax.sharding.NamedSharding):
+        return None
+    out = []
+    for entry in tuple(sharding.spec):
+        if entry is None or isinstance(entry, str):
+            out.append(entry)
+        else:
+            out.append([str(a) for a in entry])
+    return out
+
+
+def build_bundle(ids, entry, model_key: str = "", mesh=None) -> bytes | None:
+    """Pack one PrefixKVCache entry into a deterministic tar (sorted
+    members, zeroed mtimes/owners): same tokens + same KV bytes => same
+    content address. Leaves serialize in pytree order as raw buffers with
+    dtype/shape/sharding recorded in meta.json — bf16 and friends ride as
+    bytes, the install side resolves the dtype via ml_dtypes. Returns
+    None when the entry has nothing to ship or a leaf refuses to
+    materialize (device OOM on the transfer — never let publishing break
+    serving)."""
+    import jax
+    import numpy as np
+
+    ids = [int(t) for t in ids]
+    if not ids:
+        return None
+    leaves = jax.tree_util.tree_leaves(entry)
+    if not leaves:
+        return None
+    jx, backend, code, mesh_s = _ps._env(mesh)
+    envk = _env_key_of(jx, backend, code, mesh_s)
+    members = []
+    recorded = []
+    for i, leaf in enumerate(leaves):
+        name = f"leaf-{i:05d}.bin"
+        try:
+            host = np.asarray(jax.device_get(leaf))
+            data = host.tobytes()
+        except Exception as e:
+            logger.warning("kv bundle: leaf %d refused to materialize: %s", i, e)
+            return None
+        recorded.append({
+            "name": name,
+            "dtype": str(host.dtype),
+            "shape": [int(d) for d in host.shape],
+            "spec": _spec_of(leaf),
+            "sha256": hashlib.sha256(data).hexdigest(),
+            "size": len(data),
+        })
+        members.append((name, data))
+    try:
+        stored_len = int(recorded[0]["shape"][1])
+    except IndexError:
+        logger.warning("kv bundle: leaf 0 has no sequence axis; not bundling")
+        return None
+    meta = {
+        "formatVersion": BUNDLE_FORMAT,
+        "jax": jx,
+        "backend": backend,
+        "codeVersion": code,
+        "mesh": mesh_s,
+        "modelKey": model_key,
+        "prefixHash": prefix_hash(model_key, envk, ids),
+        "tokens": ids,
+        "storedLen": stored_len,
+        "leaves": recorded,
+    }
+    meta_bytes = json.dumps(meta, sort_keys=True, separators=(",", ":")).encode()
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w", format=tarfile.USTAR_FORMAT) as tar:
+        for name, data in [(META_MEMBER, meta_bytes)] + members:
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mtime = 0
+            info.uid = info.gid = 0
+            info.uname = info.gname = ""
+            info.mode = 0o644
+            tar.addfile(info, io.BytesIO(data))
+    return buf.getvalue()
+
+
+def _bundle_meta(data: bytes) -> dict:
+    with tarfile.open(fileobj=io.BytesIO(data), mode="r:") as tar:
+        meta = json.loads(tar.extractfile(tar.getmember(META_MEMBER)).read())
+    if not isinstance(meta, dict) or not isinstance(meta.get("leaves"), list):
+        raise ValueError("kv bundle meta.json is not a kv bundle manifest")
+    return meta
+
+
+# --- bundle install -----------------------------------------------------------
+
+
+def install_bundle(data: bytes, init_kv_cache, cache, mesh=None,
+                   model_key: str = "") -> dict:
+    """Install one bundle into a live PrefixKVCache.
+
+    Never raises: every failure mode — undecodable tar, missing/invalid
+    meta, environment/mesh/model skew, tampered or truncated leaf, a
+    leaf layout the model family's ``init_kv_cache`` disowns, an entry
+    that alone busts the cache's byte cap — is logged, counted, and
+    skipped; the caller simply prefills cold. Existing cache entries are
+    never overwritten (a pod's own prefill is at least as fresh), and
+    installed entries land with ``origin="installed"`` so they are
+    never re-published and their hits are separately countable."""
+    import jax
+    import numpy as np
+
+    from modelx_tpu.dl.tiers import _np_dtype
+
+    stats = {"installed": 0, "present": 0, "skipped": 0, "reasons": []}
+
+    def _skip(reason: str, n: int = 1) -> dict:
+        stats["skipped"] += n
+        stats["reasons"].append(reason)
+        logger.warning("kv install: %s", reason)
+        return stats
+
+    try:
+        tar = tarfile.open(fileobj=io.BytesIO(data), mode="r:")
+    except (tarfile.TarError, ValueError, EOFError) as e:
+        return _skip(f"unreadable bundle: {e}")
+    with tar:
+        try:
+            meta = json.loads(tar.extractfile(tar.getmember(META_MEMBER)).read())
+        except (KeyError, tarfile.TarError, ValueError, AttributeError, OSError) as e:
+            return _skip(f"bundle meta unreadable: {e}")
+        if not isinstance(meta, dict) or meta.get("formatVersion") != BUNDLE_FORMAT:
+            return _skip(f"unsupported bundle format {meta.get('formatVersion')!r}"
+                         if isinstance(meta, dict) else "bundle meta is not an object")
+        jx, backend, code, mesh_s = _ps._env(mesh)
+        got = (meta.get("jax"), meta.get("backend"), meta.get("codeVersion"))
+        if got != (jx, backend, code):
+            # KV layout (dtype promotion, cache geometry) follows the
+            # code that produced it: another world's bytes never land
+            return _skip(
+                "version skew: bundle built for jax=%s backend=%s code=%s, "
+                "local jax=%s backend=%s code=%s" % (*got, jx, backend, code))
+        if meta.get("mesh") != mesh_s:
+            # unlike programs there is no pre-mesh generation to grandfather:
+            # the mesh stamp is load-bearing from bundle format 1
+            return _skip(f"mesh skew: bundle built for mesh={meta.get('mesh')!r}, "
+                         f"local mesh={mesh_s}")
+        got_model = meta.get("modelKey") or ""
+        if model_key and got_model and got_model != model_key:
+            return _skip(f"model skew: bundle keyed {got_model}, local {model_key}")
+        ids = meta.get("tokens")
+        if (not isinstance(ids, list) or not ids
+                or not all(isinstance(t, int) for t in ids)):
+            return _skip("bundle tokens missing or malformed")
+        recorded = meta.get("leaves")
+        if not isinstance(recorded, list) or not recorded:
+            return _skip("bundle has no leaves")
+        if cache.entry_origin(ids) is not None:
+            stats["present"] += 1
+            return stats
+        stored_len = meta.get("storedLen")
+        if not isinstance(stored_len, int) or stored_len < 1:
+            return _skip(f"bundle storedLen {stored_len!r} invalid")
+        # the model family is the shape oracle: a cache of this length has
+        # exactly these leaves, in this order, with these shapes/dtypes.
+        # eval_shape costs no device memory; batch/length close over the
+        # call because init fns use them as static python shapes
+        try:
+            want = jax.eval_shape(lambda: init_kv_cache(1, stored_len))
+        except Exception as e:
+            return _skip(f"init_kv_cache refused length {stored_len}: {e}")
+        want_leaves, treedef = jax.tree_util.tree_flatten(want)
+        if len(want_leaves) != len(recorded):
+            return _skip(f"bundle has {len(recorded)} leaves, model wants "
+                         f"{len(want_leaves)}")
+        total = sum(int(a.get("size", 0)) for a in recorded
+                    if isinstance(a, dict))
+        if cache.max_bytes and total > cache.max_bytes:
+            return _skip(f"entry ({total} bytes) exceeds prefix-cache byte cap "
+                         f"({cache.max_bytes})")
+        host_leaves = []
+        for art, want_leaf in zip(recorded, want_leaves):
+            name = art.get("name", "") if isinstance(art, dict) else ""
+            if not _LEAF_RE.match(name):
+                return _skip(f"leaf name {name!r} rejected")
+            try:
+                blob = tar.extractfile(tar.getmember(name)).read()
+            except (KeyError, tarfile.TarError, AttributeError, OSError) as e:
+                return _skip(f"leaf {name} unreadable: {e}")
+            if (len(blob) != art.get("size")
+                    or hashlib.sha256(blob).hexdigest() != art.get("sha256")):
+                return _skip(f"leaf {name} fails hash/size check; not installing")
+            try:
+                dtype = _np_dtype(str(art.get("dtype")))
+                shape = tuple(int(d) for d in art.get("shape") or ())
+                arr = np.frombuffer(blob, dtype=dtype).reshape(shape)
+            except (TypeError, ValueError, AttributeError) as e:
+                return _skip(f"leaf {name} undecodable: {e}")
+            if shape != tuple(want_leaf.shape) or dtype != want_leaf.dtype:
+                return _skip(f"leaf {name} shape/dtype {shape}/{dtype} does not "
+                             f"match model cache layout "
+                             f"{tuple(want_leaf.shape)}/{want_leaf.dtype}")
+            host_leaves.append((arr, art.get("spec")))
+        try:
+            placed = [_place(arr, spec, mesh) for arr, spec in host_leaves]
+            entry = jax.tree_util.tree_unflatten(treedef, placed)
+        except Exception as e:
+            return _skip(f"device placement failed: {e}")
+        cache.put(ids, entry, origin="installed")
+        stats["installed"] += 1
+        logger.info("kv install: %d-token prefix installed (%d leaves, %d bytes)",
+                    len(ids), len(recorded), total)
+    return stats
+
+
+def _place(arr, spec, mesh):
+    """device_put a host leaf to its recorded sharding — the tier
+    promotion discipline (dl/tiers.py): the layout the capture ran under
+    is the layout decode expects."""
+    import jax
+
+    if spec is not None and mesh is not None and not isinstance(mesh, str):
+        parts = [tuple(e) if isinstance(e, list) else e for e in spec]
+        sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(*parts))
+        return jax.device_put(arr, sharding)
+    return jax.device_put(arr)
+
+
+def install_from_dir(model_dir: str, init_kv_cache, cache, mesh=None,
+                     model_key: str = "") -> dict:
+    """Install every pulled kv bundle found in a model dir (the
+    lifecycle/boot path: pull_model drops ``.kv-*.tar`` next to the
+    weights). Aggregated stats; never raises."""
+    total = {"bundles": 0, "installed": 0, "present": 0, "skipped": 0,
+             "reasons": []}
+    for path in sorted(glob.glob(os.path.join(model_dir, ".kv-*.tar"))):
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            total["reasons"].append(f"{os.path.basename(path)}: {e}")
+            logger.warning("kv install: cannot read %s: %s", path, e)
+            continue
+        total["bundles"] += 1
+        stats = install_bundle(data, init_kv_cache, cache, mesh=mesh,
+                               model_key=model_key)
+        for k in ("installed", "present", "skipped"):
+            total[k] += stats[k]
+        total["reasons"].extend(stats["reasons"])
+    return total
+
+
+# --- registry plumbing --------------------------------------------------------
+
+
+def kv_descriptors(manifest: Manifest) -> list[Descriptor]:
+    return [b for b in manifest.blobs if b.media_type == MediaTypeModelKVCache]
+
+
+def publish(remote, repository: str, version: str, data: bytes) -> Descriptor:
+    """Attach a kv bundle to an existing model version as a real
+    descriptor — blob first (content-addressed HEAD dedup), then the
+    manifest re-PUT with the descriptor merged in by name: a republished
+    identical prefix replaces itself, different prefixes/environments
+    coexist. Same commit-delta retry discipline as program_store."""
+    from modelx_tpu import errors
+    from modelx_tpu.client.push import commit_delta_digests
+
+    meta = _bundle_meta(data)
+    envk = _env_key_of(str(meta.get("jax")), str(meta.get("backend")),
+                       str(meta.get("codeVersion")), str(meta.get("mesh")))
+    name = bundle_name(envk, str(meta.get("prefixHash")))
+    desc = Descriptor(
+        name=name,
+        media_type=MediaTypeModelKVCache,
+        digest=Digest.from_bytes(data),
+        size=len(data),
+        annotations={
+            AnnotationKVCode: str(meta.get("codeVersion")),
+            AnnotationKVMesh: str(meta.get("mesh")),
+            AnnotationKVModel: str(meta.get("modelKey") or ""),
+            AnnotationKVTokens: str(len(meta.get("tokens") or ())),
+            AnnotationKVPrefix: str(meta.get("prefixHash")),
+        },
+    )
+    if not remote.head_blob(repository, desc.digest):
+        remote.upload_blob_content(repository, desc, data)
+    manifest = remote.get_manifest(repository, version)
+    manifest.blobs = [b for b in manifest.blobs if b.name != name] + [desc]
+    try:
+        remote.put_manifest(repository, version, manifest)
+    except errors.ErrorInfo as e:
+        if str(desc.digest) not in commit_delta_digests(e):
+            raise
+        remote.upload_blob_content(repository, desc, data)
+        remote.put_manifest(repository, version, manifest)
+    return desc
+
+
+def publish_bundle(ref: str, data: bytes) -> Descriptor:
+    """The NETWORK half of a kv publish — what the outbox drainer replays
+    for kind ``"kvcache"`` after a registry outage. The bundle carries
+    its own stamped environment and prefix hash, so publishing later (or
+    from another process) is identical to publishing now."""
+    from modelx_tpu.client.reference import parse_reference
+
+    parsed = parse_reference(ref)
+    if not parsed.version:
+        raise ValueError(f"kv publish needs an exact version, got {ref!r}")
+    client = parsed.client(quiet=True)
+    desc = publish(client.remote, parsed.repository, parsed.version, data)
+    logger.info("published prefix KV for %s (%s, %d bytes)",
+                ref, desc.name, desc.size)
+    return desc
+
+
+def pull_and_install(client, repository: str, manifest: Manifest,
+                     init_kv_cache, cache, blob_cache=None, mesh=None,
+                     model_key: str = "") -> dict:
+    """Fetch the manifest's kv bundles (blob cache first) and install
+    them into a live PrefixKVCache. Annotation-level skew (code / mesh)
+    skips without moving blob bytes; corrupt bytes are discarded.
+    Never raises."""
+    total = {"bundles": 0, "installed": 0, "present": 0, "skipped": 0,
+             "reasons": []}
+    env = _ps._env(mesh)
+    for desc in kv_descriptors(manifest):
+        code = desc.annotations.get(AnnotationKVCode)
+        if code is not None and code != env[2]:
+            total["skipped"] += 1
+            total["reasons"].append(f"{desc.name}: version skew (annotation)")
+            continue
+        bundle_mesh = desc.annotations.get(AnnotationKVMesh)
+        if bundle_mesh is not None and bundle_mesh != env[3]:
+            total["skipped"] += 1
+            total["reasons"].append(f"{desc.name}: mesh skew (annotation)")
+            continue
+        try:
+            data = _ps._read_blob(client, repository, desc, cache=blob_cache)
+        except Exception as e:
+            total["reasons"].append(f"{desc.name}: {e}")
+            logger.warning("kv pull: %s unavailable: %s", desc.name, e)
+            continue
+        if data is None:
+            total["reasons"].append(f"{desc.name}: digest mismatch")
+            continue
+        total["bundles"] += 1
+        stats = install_bundle(data, init_kv_cache, cache, mesh=mesh,
+                               model_key=model_key)
+        for k in ("installed", "present", "skipped"):
+            total[k] += stats[k]
+        total["reasons"].extend(stats["reasons"])
+    return total
+
+
+# --- publisher (threshold -> outbox) ------------------------------------------
+
+
+class KVPublisher:
+    """Periodic sweep of live prefix caches for entries hot enough to
+    ship. ``targets()`` yields ``(ref, server)`` pairs for ref-loaded
+    READY models; each swept entry builds a bundle and hands the bytes to
+    ``sink(ref, data)`` — the lifecycle wires that to the PR 19 outbox
+    (kind ``"kvcache"``), so durability, backoff and brownout recovery
+    are the drainer's problem, not this thread's. ``flush()`` runs one
+    sweep synchronously (the drain path's last call before an unload
+    frees the cache)."""
+
+    def __init__(self, targets, sink, threshold: int = 2,
+                 interval_s: float = 5.0, sleeper=None) -> None:
+        self.targets = targets  # () -> iterable of (ref, server)
+        self.sink = sink        # (ref, data) -> None, may raise
+        self.threshold = max(1, int(threshold))
+        self.interval_s = float(interval_s)
+        self._sleeper = sleeper or threading.Event.wait
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._keys: dict[str, str] = {}  # ref -> memoized model key
+        self._lock = threading.Lock()
+        self.stats = {"published_total": 0, "build_failures_total": 0,
+                      "sink_failures_total": 0}
+
+    def _model_key(self, ref: str) -> str:
+        key = self._keys.get(ref)
+        if not key:
+            key = model_key_for_ref(ref)
+            if key:
+                self._keys[ref] = key
+        return key
+
+    def flush(self) -> int:
+        """One synchronous sweep; returns how many bundles left here."""
+        shipped = 0
+        for ref, server in list(self.targets()):
+            cache = getattr(server, "_prefix_cache", None)
+            mesh = getattr(server, "mesh", None)
+            if cache is None or not ref:
+                continue
+            for ids, entry in cache.take_publishable(self.threshold):
+                data = build_bundle(ids, entry, model_key=self._model_key(ref),
+                                    mesh=mesh)
+                if data is None:
+                    with self._lock:
+                        self.stats["build_failures_total"] += 1
+                    continue
+                try:
+                    self.sink(ref, data)
+                except Exception as e:
+                    with self._lock:
+                        self.stats["sink_failures_total"] += 1
+                    logger.warning("kv publish sink for %s failed: %s", ref, e)
+                    continue
+                shipped += 1
+                with self._lock:
+                    self.stats["published_total"] += 1
+        return shipped
+
+    def kick(self) -> None:
+        self._wake.set()
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="kv-publisher")
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.flush()
+            except Exception:
+                # the sweep must never die quietly mid-flight: log and
+                # keep the cadence — next interval retakes nothing (keys
+                # were marked published) but new heat still ships
+                logger.exception("kv publisher sweep failed")
+            self._wake.clear()
+            self._sleeper(self._wake, self.interval_s)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self.stats)
+        out["running"] = self._thread is not None
+        out["threshold"] = self.threshold
+        return out
+
+
+# --- fetch-through (miss -> registry) -----------------------------------------
+
+
+class KVFetcher:
+    """On-demand install of published prefix KV at prefix-cache miss.
+
+    ``PrefixKVCache.lookup`` calls ``on_miss(ids)`` (outside its lock):
+    the miss enqueues into a small dedup ring and a worker matches it
+    against the model version's kv descriptors — annotation-only until a
+    hash matches, so a miss costs one cached manifest read and a few
+    sha256s, not blob bytes. A matched bundle pulls digest-verified
+    through the blob cache and installs under the normal trust boundary;
+    the NEXT lookup of that prompt hits. Tried digests are negatively
+    cached so a mismatched or corrupt bundle is not refetched per miss.
+    Bounded by the prefix cache's own byte cap — fetch-through can never
+    admit more than ``--prefix-cache-max-bytes``."""
+
+    MAX_QUEUE = 16
+    MANIFEST_TTL_S = 5.0
+
+    def __init__(self, ref: str, init_kv_cache, cache, mesh=None,
+                 model_key: str = "", blob_cache=None, sleeper=None) -> None:
+        self.ref = ref
+        self.init_kv_cache = init_kv_cache
+        self.cache = cache
+        self.mesh = mesh
+        self.model_key = model_key
+        self.blob_cache = blob_cache
+        self._sleeper = sleeper or threading.Event.wait
+        self._lock = threading.Lock()
+        self._pending: list[tuple] = []
+        self._tried: set[str] = set()   # descriptor digests already pulled
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._manifest = None
+        self._manifest_at = 0.0
+        self.stats = {"misses_seen_total": 0, "fetched_total": 0,
+                      "installed_total": 0, "errors_total": 0}
+
+    def on_miss(self, ids) -> None:
+        """O(1) bounded dedup enqueue — PrefixKVCache calls this on its
+        miss path, so it must never block or raise."""
+        key = tuple(int(t) for t in ids)
+        with self._lock:
+            self.stats["misses_seen_total"] += 1
+            if key in self._pending or len(self._pending) >= self.MAX_QUEUE:
+                return
+            self._pending.append(key)
+        self._wake.set()
+
+    def _get_manifest(self, client, repository: str, version: str):
+        import time
+
+        now = time.monotonic()
+        if self._manifest is not None and now - self._manifest_at < self.MANIFEST_TTL_S:
+            return self._manifest
+        self._manifest = client.get_manifest(repository, version)
+        self._manifest_at = now
+        return self._manifest
+
+    def drain_once(self) -> bool:
+        """Process one queued miss; True when one was consumed. Public so
+        tests drive the fetch deterministically without the thread."""
+        with self._lock:
+            if not self._pending:
+                return False
+            ids = self._pending.pop(0)
+        try:
+            self._fetch_for(ids)
+        except Exception as e:
+            with self._lock:
+                self.stats["errors_total"] += 1
+            logger.warning("kv fetch-through for %s failed: %s", self.ref, e)
+        return True
+
+    def _fetch_for(self, ids: tuple) -> None:
+        from modelx_tpu.client.reference import parse_reference
+
+        parsed = parse_reference(self.ref)
+        if not parsed.version:
+            return
+        client = parsed.client(quiet=True)
+        manifest = self._get_manifest(client, parsed.repository, parsed.version)
+        env = _ps._env(self.mesh)
+        envk = _env_key_of(*env)
+        for desc in kv_descriptors(manifest):
+            code = desc.annotations.get(AnnotationKVCode)
+            if code is not None and code != env[2]:
+                continue
+            bundle_mesh = desc.annotations.get(AnnotationKVMesh)
+            if bundle_mesh is not None and bundle_mesh != env[3]:
+                continue
+            try:
+                length = int(desc.annotations.get(AnnotationKVTokens, "0"))
+            except ValueError:
+                continue
+            # strict prefix: the suffix prefill needs >= 1 real token
+            if length < 1 or length >= len(ids):
+                continue
+            want = desc.annotations.get(AnnotationKVPrefix, "")
+            got = prefix_hash(desc.annotations.get(AnnotationKVModel, ""),
+                              envk, ids[:length])
+            if not want or want != got:
+                continue
+            digest = str(desc.digest)
+            with self._lock:
+                if digest in self._tried:
+                    continue
+                self._tried.add(digest)
+            data = _ps._read_blob(client, parsed.repository, desc,
+                                  cache=self.blob_cache)
+            if data is None:
+                continue
+            with self._lock:
+                self.stats["fetched_total"] += 1
+            stats = install_bundle(data, self.init_kv_cache, self.cache,
+                                   mesh=self.mesh, model_key=self.model_key)
+            with self._lock:
+                self.stats["installed_total"] += stats["installed"]
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="kv-fetcher")
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self.drain_once():
+                continue
+            self._wake.clear()
+            self._sleeper(self._wake, None)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self.stats)
+            out["pending"] = len(self._pending)
+        out["running"] = self._thread is not None
+        return out
+
+
+# --- server glue --------------------------------------------------------------
+
+
+def install_for_server(server, model_dir: str, model_key: str = "") -> dict | None:
+    """Install every pulled kv bundle in ``model_dir`` into a freshly
+    loaded server's prefix cache — the tail of ModelServer.load(), after
+    the family is known (``init_kv_cache`` is the family's). Never
+    raises; None when the server has no prefix cache or no decode fns."""
+    cache = getattr(server, "_prefix_cache", None)
+    if cache is None or server.family is None:
+        return None
+    try:
+        _fwd, init = server.family.decode_fns(server.cfg, mesh=server.mesh)
+    except Exception as e:
+        logger.warning("kv install: decode fns unavailable: %s", e)
+        return None
+    return install_from_dir(model_dir, init, cache, mesh=server.mesh,
+                            model_key=model_key)
+
+
+def fetcher_for_server(ref: str, server, blob_cache=None,
+                       model_key: str = "") -> KVFetcher | None:
+    """Build (and attach) a fetch-through worker for a ref-loaded
+    server: subsequent prefix-cache misses consult the registry. Returns
+    the started fetcher (the lifecycle stops it at unload), or None."""
+    cache = getattr(server, "_prefix_cache", None)
+    if cache is None or server.family is None or not ref:
+        return None
+    try:
+        _fwd, init = server.family.decode_fns(server.cfg, mesh=server.mesh)
+    except Exception as e:
+        logger.warning("kv fetcher: decode fns unavailable: %s", e)
+        return None
+    fetcher = KVFetcher(ref, init, cache, mesh=server.mesh,
+                        model_key=model_key, blob_cache=blob_cache)
+    cache.fetcher = fetcher
+    fetcher.start()
+    return fetcher

@@ -202,6 +202,14 @@ class ModelPool:
         # this pod's compiled surface to the model's registry version so
         # the next puller boots warm (dl/program_store.py)
         self.publish_programs = False
+        # --publish-kv (ISSUE 20): sweep live prefix caches for entries
+        # hot enough to ship to the registry as kv bundles; --kv-fetch-
+        # through consults the registry on a prefix-cache miss
+        self.publish_kv = False
+        self.kv_publish_threshold = 2
+        self.kv_fetch_through = False
+        self.kv_publisher = None
+        self._kv_fetchers: dict = {}
         self.drain_timeout_s = float(drain_timeout_s)
         # pool-level flight recorder (ISSUE 18): tier promotions and
         # demotions, OOM shed-and-retry — the lifecycle counterpart of the
@@ -256,6 +264,7 @@ class ModelPool:
         drainer replays them through the registry with backoff. Pending
         entries from a previous process generation drain too — that is
         the restart-durability the chaos drill asserts."""
+        from modelx_tpu.dl import kv_store
         from modelx_tpu.dl import outbox as outbox_mod
         from modelx_tpu.dl import program_store
 
@@ -266,19 +275,72 @@ class ModelPool:
             kwargs["max_bytes"] = max_bytes
         self.outbox = outbox_mod.Outbox(spool_dir, **kwargs)
 
-        def handler(kind: str, ref: str, data: bytes) -> None:
-            program_store.publish_bundle(ref, data)
-
         dkwargs = {"recorder": self.flightrec}
         if backoff_s is not None:
             dkwargs["backoff_s"] = backoff_s
-        self.outbox_drainer = outbox_mod.Drainer(self.outbox, handler, **dkwargs)
+        self.outbox_drainer = outbox_mod.Drainer(self.outbox, **dkwargs)
+        # one spool, two artifact kinds: each entry replays through its
+        # own publisher (meta-less pre-upgrade entries default "programs")
+        self.outbox_drainer.register_handler(
+            "programs", lambda _k, ref, data: program_store.publish_bundle(ref, data))
+        self.outbox_drainer.register_handler(
+            kv_store.OUTBOX_KIND,
+            lambda _k, ref, data: kv_store.publish_bundle(ref, data))
         if start:
             self.outbox_drainer.start()
 
     def stop_outbox(self) -> None:
         if self.outbox_drainer is not None:
             self.outbox_drainer.stop()
+
+    # -- prefix-KV publish / fetch-through (ISSUE 20) -------------------------
+
+    def attach_kv_publisher(self, threshold: int | None = None,
+                            interval_s: float = 5.0, start: bool = True) -> None:
+        """Enable the prefix-KV publisher (``--publish-kv``): a background
+        sweep bundles hot PrefixKVCache entries of every ref-loaded model
+        and hands them to the outbox (kind ``"kvcache"``) when one is
+        attached, or publishes directly otherwise."""
+        from modelx_tpu.dl import kv_store
+
+        if threshold is not None:
+            self.kv_publish_threshold = max(1, int(threshold))
+        self.publish_kv = True
+
+        def targets():
+            with self._lock:
+                return [
+                    (e.ref, e.server) for e in self.entries.values()
+                    if e.ref and e.server is not None
+                    and self._effective_state(e) in (READY, DRAINING)
+                ]
+
+        def sink(ref: str, data: bytes) -> None:
+            if self.outbox is not None:
+                if not self.outbox.enqueue(kv_store.OUTBOX_KIND, ref, data):
+                    raise RuntimeError("outbox refused kv bundle")
+                if self.outbox_drainer is not None:
+                    self.outbox_drainer.kick()
+            else:
+                kv_store.publish_bundle(ref, data)
+            self.flightrec.record("kv.publish_enqueued", ref=ref,
+                                  bytes=len(data))
+
+        self.kv_publisher = kv_store.KVPublisher(
+            targets, sink, threshold=self.kv_publish_threshold,
+            interval_s=interval_s,
+        )
+        if start:
+            self.kv_publisher.start()
+
+    def stop_kv(self) -> None:
+        if self.kv_publisher is not None:
+            self.kv_publisher.stop()
+        with self._lock:
+            fetchers = list(self._kv_fetchers.values())
+            self._kv_fetchers.clear()
+        for f in fetchers:
+            f.stop()
 
     def _per_device(self, total_bytes: int) -> int:
         """Per-device footprint of ``total_bytes`` of weights on this
@@ -471,6 +533,8 @@ class ModelPool:
             snap["outbox"] = (self.outbox_drainer.snapshot()
                               if self.outbox_drainer is not None
                               else self.outbox.snapshot())
+        if self.kv_publisher is not None:
+            snap["kv_publisher"] = self.kv_publisher.snapshot()
         return snap
 
     def failed(self) -> dict[str, str]:
@@ -823,6 +887,26 @@ class ModelPool:
                         )
                 except Exception:
                     logger.exception("program publish for %s failed", name)
+            if self.kv_fetch_through and e.ref:
+                # prefix-cache misses on this model now consult the
+                # registry for published KV bundles (dl/kv_store.py) —
+                # off the serving path, bounded by the cache's byte cap
+                from modelx_tpu.dl import kv_store
+
+                try:
+                    fetcher = kv_store.fetcher_for_server(
+                        e.ref, server, blob_cache=self.blob_cache
+                    )
+                    if fetcher is not None:
+                        with self._lock:
+                            self._kv_fetchers[name] = fetcher
+                        self.flightrec.record("kv.fetch_through_attached",
+                                              model=name)
+                except Exception:
+                    logger.exception("kv fetch-through attach for %s failed",
+                                     name)
+            if self.kv_publisher is not None:
+                self.kv_publisher.kick()
         except BaseException as exc:  # FAILED is a state, not a crash
             from modelx_tpu.dl.manifest_cache import OfflineUnavailableError
 
@@ -884,6 +968,15 @@ class ModelPool:
             return {name: {"state": "DELETED"}}
 
         def _drain() -> None:
+            if self.kv_publisher is not None:
+                # last call before the prefix cache frees: any entry that
+                # crossed the publish threshold ships now (the outbox owns
+                # it from here, so a dead registry still can't block the
+                # drain) — hot shared prefixes survive the pod
+                try:
+                    self.kv_publisher.flush()
+                except Exception:
+                    logger.exception("kv flush on drain of %s failed", name)
             with self._lock:
                 deadline = time.monotonic() + timeout
                 while e.inflight > 0 and time.monotonic() < deadline:
@@ -949,6 +1042,10 @@ class ModelPool:
         called under the pool lock: one tenant's teardown must not stall
         admission for the others."""
         name, server, batcher, cb, staged, tier_key, sidecar_src = art
+        with self._lock:
+            fetcher = self._kv_fetchers.pop(name, None)
+        if fetcher is not None:
+            fetcher.stop()
         if batcher is not None:
             batcher.close()
         if cb is not None:

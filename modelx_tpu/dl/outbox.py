@@ -48,7 +48,8 @@ class Outbox:
         os.makedirs(root, exist_ok=True)
         self._lock = threading.Lock()
         self.stats = {"enqueued_total": 0, "drained_total": 0,
-                      "drop_full_total": 0, "publish_failures_total": 0}
+                      "drop_full_total": 0, "publish_failures_total": 0,
+                      "dropped_unknown_kind_total": 0}
         self._seq = 0
         for seq, meta_path, _bin_path in self._scan():
             self._seq = max(self._seq, seq + 1)
@@ -184,11 +185,20 @@ class Drainer:
     BACKOFF_S = 0.5
     BACKOFF_CAP_S = 30.0
 
-    def __init__(self, outbox: Outbox, handler, backoff_s: float = BACKOFF_S,
+    # spool entries written before the meta carried a kind are compiled
+    # programs by construction (the only artifact the outbox shipped then):
+    # a restart over an old spool must drain them through the right
+    # publisher, not drop them
+    DEFAULT_KIND = "programs"
+
+    def __init__(self, outbox: Outbox, handler=None, backoff_s: float = BACKOFF_S,
                  backoff_cap_s: float = BACKOFF_CAP_S, recorder=None,
                  sleeper=None) -> None:
         self.outbox = outbox
-        self.handler = handler  # (kind, ref, data) -> None, raises on failure
+        self.handler = handler  # (kind, ref, data) -> None; fallback for any kind
+        # per-kind dispatch (ISSUE 20): an entry routes to its kind's
+        # registered publisher first, the legacy fallback second
+        self.handlers: dict = {}
         self.backoff_s = float(backoff_s)
         self.backoff_cap_s = float(backoff_cap_s)
         self.recorder = recorder  # flight recorder (or None)
@@ -217,6 +227,10 @@ class Drainer:
     def kick(self) -> None:
         self._wake.set()
 
+    def register_handler(self, kind: str, fn) -> None:
+        """Route spool entries of ``kind`` to ``fn(kind, ref, data)``."""
+        self.handlers[kind] = fn
+
     def _record(self, event: str, **fields) -> None:
         rec = self.recorder
         if rec is not None:
@@ -230,24 +244,40 @@ class Drainer:
         if item is None:
             return False
         seq, meta, data = item
-        kind, ref = meta.get("kind", ""), meta.get("ref", "")
+        kind = meta.get("kind") or self.DEFAULT_KIND
+        ref = meta.get("ref", "")
+        handler = self.handlers.get(kind, self.handler)
+        if handler is None:
+            # an artifact kind nobody registered must not wedge the FIFO
+            # behind it forever: drop it, counted and recorded
+            self.outbox.remove(seq)
+            with self.outbox._lock:
+                self.outbox.stats["dropped_unknown_kind_total"] += 1
+            self._record("outbox.dropped_unknown_kind", ref=ref, kind=kind)
+            logger.warning("outbox dropping %s entry for %s: no handler "
+                           "registered", kind, ref)
+            return True
         try:
-            self.handler(kind, ref, data)
+            handler(kind, ref, data)
         except Exception as e:
             self._failures += 1
             self.last_error = str(e)
             with self.outbox._lock:
                 self.outbox.stats["publish_failures_total"] += 1
-            self._record("outbox.publish_failed", ref=ref,
+                key = f"publish_failures_{kind}_total"
+                self.outbox.stats[key] = self.outbox.stats.get(key, 0) + 1
+            self._record("outbox.publish_failed", ref=ref, kind=kind,
                          failures=self._failures)
-            logger.warning("outbox publish of %s failed (attempt %d): %s",
-                           ref, self._failures, e)
+            logger.warning("outbox publish of %s %s failed (attempt %d): %s",
+                           kind, ref, self._failures, e)
             return False
         self.outbox.remove(seq)
         self._failures = 0
         self.last_error = ""
         with self.outbox._lock:
             self.outbox.stats["drained_total"] += 1
+            key = f"drained_{kind}_total"
+            self.outbox.stats[key] = self.outbox.stats.get(key, 0) + 1
         self._record("outbox.drained", ref=ref, kind=kind,
                      depth=self.outbox.depth())
         logger.info("outbox drained %s publish for %s (%d pending)",

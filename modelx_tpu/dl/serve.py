@@ -406,7 +406,28 @@ class ModelServer:
                 compile_thread.join()
             self.stats["ready_seconds"] = round(time.monotonic() - t0, 3)
             self.ready = True
+            self._install_kv_bundles()
         return dict(self.stats)
+
+    def _install_kv_bundles(self) -> None:
+        """Install prefix-KV bundles pulled next to the weights
+        (``.kv-*.tar``, dl/kv_store.py) into the prefix cache — AFTER the
+        family/compile so ``decode_fns`` can validate the leaf layout.
+        Purely an optimization: any failure just prefills cold."""
+        if self._prefix_cache is None:
+            return
+        from modelx_tpu.dl import kv_store
+
+        try:
+            kstats = kv_store.install_for_server(self, self.model_dir)
+        except Exception as e:
+            logger.warning("kv bundle install failed: %s", e)
+            return
+        if kstats and (kstats["bundles"] or kstats["skipped"]):
+            self.stats["kv"] = {
+                k: kstats[k]
+                for k in ("bundles", "installed", "present", "skipped")
+            }
 
     def load_from_tier(self, promo) -> dict:
         """Materialize a demoted model from a tier promotion
@@ -452,6 +473,7 @@ class ModelServer:
                 compile_thread.join()
             self.stats["ready_seconds"] = round(time.monotonic() - t0, 3)
             self.ready = True
+            self._install_kv_bundles()
         return dict(self.stats)
 
     def _precompile_warmup(self, sds: dict) -> None:
@@ -1172,6 +1194,14 @@ class ServerSet:
             "quantize": first.quantize,
             "speculative_k": first.speculative_k,
         }
+        if first._prefix_cache is not None:
+            # a runtime-loaded tenant must not silently lose the boot
+            # set's prefix cache: its serving block would then have no
+            # hit-rate signal for the router and no KV to publish
+            self.server_defaults.update(
+                prefix_cache_size=first._prefix_cache.capacity,
+                prefix_cache_max_bytes=first._prefix_cache.max_bytes,
+            )
         # bearer tokens gating the /admin surface (the registry auth
         # model's static-token tier; empty = anonymous admin, for
         # single-tenant dev pods and tests)

@@ -181,11 +181,27 @@ from modelx_tpu.dl.serve import ModelServer, ServerSet, enable_compile_cache, se
                    "cached manifests + the blob cache serve pulls offline "
                    "(control_plane: offline on /healthz; readiness is "
                    "never gated on it)")
+@click.option("--publish-kv", is_flag=True,
+              help="sweep the prefix caches of runtime (registry-ref) "
+                   "loaded models for entries hit at least "
+                   "--kv-publish-threshold times and attach them to the "
+                   "model version as kv bundles "
+                   "(application/vnd.modelx.kvcache.v1) so replicas skip "
+                   "re-prefilling shared prompt prefixes (docs/kv.md)")
+@click.option("--kv-publish-threshold", default=2, type=int,
+              help="prefix-cache hit count at which an entry becomes hot "
+                   "enough to publish (with --publish-kv)")
+@click.option("--kv-fetch-through", is_flag=True,
+              help="on a prefix-cache miss, consult the model version's "
+                   "published kv bundles and install a matching prefix "
+                   "(bounded by --prefix-cache-max-bytes; runtime loads "
+                   "only)")
 @click.option("--publish-outbox-dir", default="",
-              help="durable publish outbox: --publish-programs bundles "
-                   "spool here and a background drainer pushes them with "
-                   "backoff, so a registry outage never blocks or fails "
-                   "a load (pending entries survive pod restarts)")
+              help="durable publish outbox: --publish-programs and "
+                   "--publish-kv bundles spool here and a background "
+                   "drainer pushes them with backoff, so a registry outage "
+                   "never blocks or fails a load (pending entries survive "
+                   "pod restarts)")
 @click.option("--outbox-max-entries", default=0, type=int,
               help="outbox spool bound; a full spool drops new publishes "
                    "with a counted warning (0 = default 64)")
@@ -252,7 +268,8 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
          hbm_budget_bytes: int, evict_idle: bool,
          host_state_budget_bytes: int, disk_state_budget_bytes: int,
          state_spool_dir: str, allow_admin_load: bool,
-         publish_programs: bool,
+         publish_programs: bool, publish_kv: bool,
+         kv_publish_threshold: int, kv_fetch_through: bool,
          registry_mirrors: tuple[str, ...], manifest_cache_dir: str,
          publish_outbox_dir: str, outbox_max_entries: int,
          admin_tokens: tuple[str, ...], staging_dir: str,
@@ -390,29 +407,45 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
         prefix_cache_size=prefix_cache,
         prefix_cache_max_bytes=prefix_cache_max_bytes,
     )
+    if (publish_programs or publish_kv) and publish_outbox_dir \
+            and sset.pool is not None:
+        sset.pool.attach_outbox(
+            publish_outbox_dir,
+            max_entries=outbox_max_entries or None,
+        )
     if publish_programs:
         if sset.pool is not None:
             sset.pool.publish_programs = True
-        if publish_outbox_dir and sset.pool is not None:
-            sset.pool.attach_outbox(
-                publish_outbox_dir,
-                max_entries=outbox_max_entries or None,
-            )
         if not allow_admin_load:
             logging.getLogger("modelx.serve").warning(
                 "--publish-programs only fires on runtime (registry-ref) "
                 "loads; without --allow-admin-load none happen — use "
                 "`modelx programs push` to publish for boot-loaded models"
             )
+    if publish_kv and sset.pool is not None:
+        sset.pool.attach_kv_publisher(threshold=kv_publish_threshold)
+        if not prefix_cache:
+            logging.getLogger("modelx.serve").warning(
+                "--publish-kv is inert without --prefix-cache "
+                "(there is no prefix KV to publish)"
+            )
+    if kv_fetch_through and sset.pool is not None:
+        sset.pool.kv_fetch_through = True
+        if not prefix_cache:
+            logging.getLogger("modelx.serve").warning(
+                "--kv-fetch-through is inert without --prefix-cache "
+                "(there is no prefix cache to install into)"
+            )
     if evict_idle and not hbm_budget_bytes:
         logging.getLogger("modelx.serve").warning(
             "--evict-idle is inert without --hbm-budget-bytes "
             "(eviction only runs to fit a load under the budget)"
         )
-    if publish_outbox_dir and not publish_programs:
+    if publish_outbox_dir and not (publish_programs or publish_kv):
         logging.getLogger("modelx.serve").warning(
-            "--publish-outbox-dir is inert without --publish-programs "
-            "(only program publishes spool through the outbox)"
+            "--publish-outbox-dir is inert without --publish-programs or "
+            "--publish-kv (only derived-artifact publishes spool through "
+            "the outbox)"
         )
     if state_spool_dir and not disk_state_budget_bytes:
         logging.getLogger("modelx.serve").warning(
@@ -471,6 +504,7 @@ def main(model_dir: str, models: tuple[str, ...], mesh: str, dtype: str, listen:
     if sset.pool is not None:
         # pending outbox entries stay on disk; the next generation's
         # drainer picks them up (that persistence is the point)
+        sset.pool.stop_kv()
         sset.pool.stop_outbox()
     httpd.shutdown()
 

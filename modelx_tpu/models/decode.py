@@ -4,9 +4,13 @@ the offset/scan logic can't drift between families."""
 
 from __future__ import annotations
 
+import logging
+
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+logger = logging.getLogger("modelx.models")
 
 SEQ_BUCKET = 16
 
@@ -164,6 +168,8 @@ class PrefixKVCache:
         import collections
         import threading
 
+        from modelx_tpu.utils.tswheel import RateSet
+
         self.capacity = max(1, int(capacity))
         self.max_bytes = max(0, int(max_bytes))
         self._od: "collections.OrderedDict[tuple, object]" = collections.OrderedDict()
@@ -174,6 +180,22 @@ class PrefixKVCache:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        # kv_store plumbing (ISSUE 20): per-key hit counts drive the
+        # publish threshold; origin ("local" vs "installed") keeps a
+        # registry-installed entry from being re-published and lets the
+        # engine count decodes served from fleet-shared KV
+        self._hits_by_key: dict[tuple, int] = {}
+        self._origin: dict[tuple, str] = {}
+        self._published: set[tuple] = set()
+        self.hits_installed = 0
+        self.installed_total = 0
+        self.published_total = 0
+        # 1m/5m-windowed hit/miss rates: the lifetime totals above can't
+        # tell the router a model is hot NOW (see utils/tswheel.py)
+        self._rates = RateSet(("hit", "miss"))
+        # optional kv_store.KVFetcher: notified (outside the lock, O(1)
+        # enqueue) on every miss so published bundles fetch through
+        self.fetcher = None
 
     def lookup(self, ids, max_total: int | None = None) -> tuple[int, object] | None:
         """Longest stored key that is a STRICT prefix of ``ids`` (the
@@ -199,12 +221,26 @@ class PrefixKVCache:
                         continue
                 if best_key is None or len(key) > len(best_key):
                     best_key = key
-            if best_key is None:
-                self.misses += 1
-                return None
-            self._od.move_to_end(best_key)
-            self.hits += 1
-            return len(best_key), self._od[best_key]
+            if best_key is not None:
+                self._od.move_to_end(best_key)
+                self.hits += 1
+                self._hits_by_key[best_key] = self._hits_by_key.get(best_key, 0) + 1
+                if self._origin.get(best_key) == "installed":
+                    self.hits_installed += 1
+                self._rates.mark("hit")
+                return len(best_key), self._od[best_key]
+            self.misses += 1
+            self._rates.mark("miss")
+            fetcher = self.fetcher
+        # outside the lock: the fetcher contract is an O(1) bounded
+        # enqueue, but even that must not extend the lookup critical
+        # section every admission scan shares
+        if fetcher is not None:
+            try:
+                fetcher.on_miss(ids)
+            except Exception:
+                logger.debug("kv fetcher on_miss failed", exc_info=True)
+        return None
 
     @staticmethod
     def _entry_meta(cache) -> tuple[int, int | None]:
@@ -223,15 +259,28 @@ class PrefixKVCache:
     def _pop_lru(self) -> None:
         key, _ = self._od.popitem(last=False)
         self._bytes -= self._meta.pop(key)[0]
+        self._hits_by_key.pop(key, None)
+        self._origin.pop(key, None)
+        self._published.discard(key)
 
-    def put(self, ids, cache) -> None:
+    def put(self, ids, cache, origin: str = "local") -> None:
         key = tuple(int(t) for t in ids)
         meta = self._entry_meta(cache)
         with self._lock:
             if key in self._od:
                 self._bytes -= self._meta[key][0]
+                # a re-put of an existing key (the engine refreshes entries
+                # after every flip) must not demote an installed entry back
+                # to "local" — that would re-publish registry KV as ours
+                if origin == "local":
+                    origin = self._origin.get(key, "local")
             self._od[key] = cache
             self._meta[key] = meta
+            self._origin[key] = origin
+            if origin == "installed":
+                self.installed_total += 1
+                # installed entries are already in the registry
+                self._published.add(key)
             self._bytes += meta[0]
             self._od.move_to_end(key)
             while len(self._od) > self.capacity:
@@ -242,10 +291,40 @@ class PrefixKVCache:
                    and len(self._od) > 1):
                 self._pop_lru()
 
+    def entry_origin(self, ids) -> str | None:
+        """"local" / "installed" for a stored key, None when absent."""
+        key = tuple(int(t) for t in ids)
+        with self._lock:
+            return self._origin.get(key)
+
+    def take_publishable(self, threshold: int = 2) -> list[tuple[tuple, object]]:
+        """Hot local entries worth shipping to the registry: hit at least
+        ``threshold`` times, origin "local", not yet taken. Marks the
+        returned keys published (the outbox owns durability from here —
+        a failed publish retries the spooled BYTES, not the entry)."""
+        out = []
+        with self._lock:
+            for key, entry in self._od.items():
+                if key in self._published:
+                    continue
+                if self._origin.get(key, "local") != "local":
+                    continue
+                if self._hits_by_key.get(key, 0) < max(1, int(threshold)):
+                    continue
+                self._published.add(key)
+                self.published_total += 1
+                out.append((key, entry))
+        return out
+
     def stats(self) -> dict:
         with self._lock:
-            return {"hits": self.hits, "misses": self.misses,
-                    "entries": len(self._od), "bytes": self._bytes}
+            out = {"hits": self.hits, "misses": self.misses,
+                   "entries": len(self._od), "bytes": self._bytes,
+                   "hits_installed": self.hits_installed,
+                   "installed_total": self.installed_total,
+                   "published_total": self.published_total}
+        out.update(self._rates.snapshot())
+        return out
 
     def clear(self) -> None:
         """Drop every stored entry (the model-unload path: the cached KV
@@ -254,6 +333,9 @@ class PrefixKVCache:
             self._od.clear()
             self._meta.clear()
             self._bytes = 0
+            self._hits_by_key.clear()
+            self._origin.clear()
+            self._published.clear()
 
 
 class ChunkedDecoder:

@@ -138,6 +138,10 @@ class StickyTable:
         self._lock = threading.Lock()
         self.hits = 0
         self.misses = 0
+        # forgets whose model had registry-published prefix KV at the
+        # time (ISSUE 20): the next pod installs the shared prefix
+        # instead of re-prefilling, so these losses are absorbed
+        self.forgets_recoverable = 0
 
     def lookup(self, keys: list[tuple], candidate_urls) -> str | None:
         """The remembered pod for the LONGEST assigned window that is
@@ -165,14 +169,25 @@ class StickyTable:
             while len(self._od) > self.max_entries:
                 self._od.popitem(last=False)
 
-    def forget_pod(self, url: str) -> None:
+    def forget_pod(self, url: str, recoverable_models=None) -> int:
         """Drop every assignment to ``url`` (pod quarantined: its prefix
         cache is gone with it, so the next turn should re-assign by load
-        instead of missing against a dead entry)."""
+        instead of missing against a dead entry). ``recoverable_models``
+        names models with registry-published prefix KV (dl/kv_store.py):
+        forgotten assignments for those count recoverable — the next pod
+        installs the shared prefix instead of re-prefilling it. Returns
+        the recoverable count."""
+        recoverable_models = recoverable_models or ()
+        recovered = 0
         with self._lock:
             stale = [k for k, v in self._od.items() if v == url]
             for k in stale:
                 del self._od[k]
+                # sticky keys are (model, kind, bucket, crc)
+                if k[0] in recoverable_models:
+                    recovered += 1
+            self.forgets_recoverable += recovered
+        return recovered
 
     def stats(self) -> dict:
         with self._lock:
@@ -182,6 +197,7 @@ class StickyTable:
                 "sticky_hits": self.hits,
                 "sticky_misses": self.misses,
                 "sticky_hit_ratio": round(self.hits / total, 4) if total else None,
+                "sticky_forgets_recoverable_total": self.forgets_recoverable,
             }
 
 

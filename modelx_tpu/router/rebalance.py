@@ -40,21 +40,27 @@ READY = "READY"
 class Action:
     """One planned lifecycle mutation."""
 
-    __slots__ = ("kind", "pod", "model", "ref", "reason")
+    __slots__ = ("kind", "pod", "model", "ref", "reason", "kv_prewarm")
 
     def __init__(self, kind: str, pod: str, model: str, ref: str = "",
-                 reason: str = "") -> None:
+                 reason: str = "", kv_prewarm: bool = False) -> None:
         self.kind = kind      # "load" | "unload"
         self.pod = pod        # target pod base URL
         self.model = model
         self.ref = ref        # registry uri (load only)
         self.reason = reason
+        # the model has registry-published prefix KV (dl/kv_store.py):
+        # the new replica installs the shared prefix at load instead of
+        # serving its first hot prompts cold
+        self.kv_prewarm = bool(kv_prewarm)
 
     def snapshot(self) -> dict:
         out = {"action": self.kind, "pod": self.pod, "model": self.model,
                "reason": self.reason}
         if self.ref:
             out["ref"] = self.ref
+        if self.kv_prewarm:
+            out["kv_prewarm"] = True
         return out
 
 
@@ -72,14 +78,39 @@ def _pod_load(pod) -> int:
     return sum(pod.queue_depth(m) for m in pod.models)
 
 
+def fleet_kv_signals(pods) -> tuple[dict[str, float], set[str]]:
+    """Per-model prefix-cache signals aggregated across the fleet: the
+    summed 1m hit rate (how much prefix reuse the model sees RIGHT NOW)
+    and the set of models with registry-published KV bundles (a spread
+    replica of those pre-installs the shared prefix at load)."""
+    rates: dict[str, float] = {}
+    published: set[str] = set()
+    for pod in pods:
+        for model in pod.serving:
+            rate = pod.prefix_hit_rate(model)
+            if rate:
+                rates[model] = rates.get(model, 0.0) + rate
+            if pod.kv_published(model):
+                published.add(model)
+    return rates, published
+
+
 def plan_actions(pods, pressure: dict[str, int], *, queue_high: int = 4,
-                 make_room_on: dict[str, str] | None = None) -> list[Action]:
+                 make_room_on: dict[str, str] | None = None,
+                 hit_rates: dict[str, float] | None = None,
+                 kv_published: set[str] | None = None) -> list[Action]:
     """Decide at most one load (and the unloads that make room for it).
 
     ``pods``: PodState list (the placement table). ``pressure``: per-model
     hotness — relayed sheds plus aggregate queue depth since the last
     step. ``make_room_on``: pod URL -> model whose load that pod refused
     with 507 last step; an idle READY model there gets unloaded first.
+    ``hit_rates``: per-model fleet prefix-cache hit rate (ISSUE 20) — a
+    tiebreak among equally-pressured models: between two models at the
+    same backlog, spreading the one whose traffic actually reuses
+    prefixes buys more (its replica starts with the shared KV
+    installed). ``kv_published``: models whose prefix KV is in the
+    registry; their spread actions are marked ``kv_prewarm``.
     """
     actions: list[Action] = []
     # make room where a previous spread attempt was refused for space
@@ -102,9 +133,11 @@ def plan_actions(pods, pressure: dict[str, int], *, queue_high: int = 4,
                 reason=f"make room for hot model {wanted!r} (507 last step)",
             ))
     # spread the hottest model that has somewhere to go
+    hit_rates = hit_rates or {}
+    kv_published = kv_published or set()
     hot = sorted(
         (m for m, n in pressure.items() if n >= queue_high),
-        key=lambda m: (-pressure[m], m),
+        key=lambda m: (-pressure[m], -hit_rates.get(m, 0.0), m),
     )
     for model in hot:
         ref = model_ref(pods, model)
@@ -115,9 +148,13 @@ def plan_actions(pods, pressure: dict[str, int], *, queue_high: int = 4,
         if not targets:
             continue
         target = min(targets, key=lambda p: (_pod_load(p), p.url))
+        reason = f"pressure {pressure[model]} >= {queue_high}"
+        prewarm = model in kv_published
+        if prewarm:
+            reason += "; shared prefix KV published, replica pre-installs it"
         actions.append(Action(
             "load", target.url, model, ref=ref,
-            reason=f"pressure {pressure[model]} >= {queue_high}",
+            reason=reason, kv_prewarm=prewarm,
         ))
         break  # one spread per step: no load storms
     return actions
@@ -150,6 +187,7 @@ class Rebalancer:
         self.actions_total = 0
         self.action_errors_total = 0
         self.offline_skipped_steps = 0  # steps skipped: registry offline
+        self.kv_prewarm_spreads_total = 0  # spreads of KV-published models
         self._history: deque = deque(maxlen=history)
 
     # -- signals --------------------------------------------------------------
@@ -211,10 +249,13 @@ class Rebalancer:
             self._room.clear()
             now = time.monotonic()
             cooled = {k for k, until in self._cooldown.items() if until > now}
+        fleet = self.registry.pods()
+        hit_rates, kv_published = fleet_kv_signals(fleet)
         plan = [
             a for a in plan_actions(
-                self.registry.pods(), pressure,
+                fleet, pressure,
                 queue_high=self.queue_high, make_room_on=room,
+                hit_rates=hit_rates, kv_published=kv_published,
             )
             if (a.pod, a.model) not in cooled
         ]
@@ -229,6 +270,9 @@ class Rebalancer:
                     self._cooldown[(action.pod, action.model)] = (
                         time.monotonic() + self.cooldown_s
                     )
+                if (action.kv_prewarm and action.kind == "load"
+                        and int(snap.get("status", 599)) < 400):
+                    self.kv_prewarm_spreads_total += 1
                 self._history.append(snap)
             done.append(snap)
         return done
@@ -274,6 +318,7 @@ class Rebalancer:
                 "actions_total": self.actions_total,
                 "action_errors_total": self.action_errors_total,
                 "offline_skipped_steps": self.offline_skipped_steps,
+                "kv_prewarm_spreads_total": self.kv_prewarm_spreads_total,
                 "pending_pressure": dict(self._sheds),
                 "recent_actions": list(self._history),
             }

@@ -77,6 +77,27 @@ class PodState:
         return int(d.get("queue_depth", 0)) + int(d.get("active", 0)) \
             + int(d.get("waiting", 0))
 
+    def prefix_hit_rate(self, model: str) -> float:
+        """This pod's 1m-windowed prefix-cache hit rate for ``model``
+        (hits/s from the serving block's tswheel export) — the rebalance
+        heat signal: a model hitting its prefix cache NOW has a shared
+        prompt worth pre-installing on any replica spread."""
+        pc = self.serving.get(model, {}).get("prefix_cache", {})
+        try:
+            return float(pc.get("hit_per_s_1m", 0.0))
+        except (TypeError, ValueError):
+            return 0.0
+
+    def kv_published(self, model: str) -> bool:
+        """Has this pod shipped prefix KV for ``model`` to the registry
+        (published_total in the serving block)? Used to judge whether a
+        quarantined pod's sticky-cache loss is recoverable."""
+        pc = self.serving.get(model, {}).get("prefix_cache", {})
+        try:
+            return int(pc.get("published_total", 0)) > 0
+        except (TypeError, ValueError):
+            return False
+
     def snapshot(self) -> dict:
         """JSON-safe view for the router's /metrics."""
         out = {

@@ -236,9 +236,22 @@ class FleetRouter:
         """Data-path death: quarantine + drop sticky assignments (the
         pod's prefix cache died with it). The breaker entry resets too —
         quarantine owns recovery now, and a stale OPEN state must not
-        block the pod's first routed request after the poll restores it."""
+        block the pod's first routed request after the poll restores it.
+
+        Forgets are classified before dropping: a model whose prefix KV
+        is registry-published (any pod's serving block shows
+        published_total > 0, the dying pod's last row included) loses
+        only placement, not state — the next pod installs the shared
+        prefix from the registry (dl/kv_store.py) instead of
+        re-prefilling it."""
+        recoverable = {
+            model
+            for pod in self.registry.pods()
+            for model in pod.serving
+            if pod.kv_published(model)
+        }
         self.registry.quarantine(pod_url, reason)
-        self.sticky.forget_pod(pod_url)
+        self.sticky.forget_pod(pod_url, recoverable_models=recoverable)
         self.breakers.forget(pod_url)
 
     def budget_for(self, headers) -> float:

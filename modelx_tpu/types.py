@@ -35,6 +35,11 @@ MediaTypeModelDirectoryTarGz = "application/vnd.modelx.model.directory.v1.tar+gz
 # blob descriptor — sha256 verification, scrub/quarantine, upload markers
 # and GC reference tracking all apply to it unchanged
 MediaTypeModelProgram = "application/vnd.modelx.program.v1"
+# prefix-KV bundle (dl/kv_store.py): a deterministic tar of a hot
+# PrefixKVCache entry's leaves, attached to a model version the same way —
+# the registry's verification/scrub/GC machinery applies to derived
+# serving state without any kvcache-specific registry code
+MediaTypeModelKVCache = "application/vnd.modelx.kvcache.v1"
 
 # --- annotation keys ---------------------------------------------------------
 
@@ -54,6 +59,16 @@ AnnotationProgramCount = "modelx.program.artifacts"
 # part of the bundle compatibility domain — a dp=1 surface must never
 # warm-install on a tp=4 pod
 AnnotationProgramMesh = "modelx.program.mesh"
+# kv-bundle compatibility stamp: code/mesh mirror the program annotations
+# (a KV layout is only loadable under the exact code version + GSPMD mesh
+# it was captured under); model is the weight content key, tokens the
+# prefix length, prefix the keying hash — enough for a puller to match a
+# missed prompt against the manifest without fetching any blob bytes
+AnnotationKVCode = "modelx.kv.code"
+AnnotationKVMesh = "modelx.kv.mesh"
+AnnotationKVModel = "modelx.kv.model"
+AnnotationKVTokens = "modelx.kv.tokens"
+AnnotationKVPrefix = "modelx.kv.prefix"
 
 # --- blob location purposes (types.go:16-19) ---------------------------------
 

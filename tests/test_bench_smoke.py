@@ -391,6 +391,61 @@ class TestRegistryOutageLeg:
         assert out["outbox_drained_total"] >= 1
 
 
+class TestKVStoreLeg:
+    @pytest.mark.slow
+    def test_measure_kv_store_schema(self, tmp_path):
+        """The content-addressed prefix-KV leg end to end on a tiny model
+        (ISSUE 20): pod 1 publishes its hot-prefix bundle to the registry,
+        a FRESH pod 2 installs it at load and serves the warm stream from
+        the installed entry — schema-checks the JSON keys and that the
+        scored stream really hit the installed KV (the leg raises on a
+        vacuous warm number)."""
+        import dataclasses
+
+        import jax
+        import jax.numpy as jnp
+
+        import bench
+        from modelx_tpu.dl import safetensors as st
+        from modelx_tpu.models import llama
+        from modelx_tpu.registry.fs import MemoryFSProvider
+        from modelx_tpu.registry.server import (
+            Options, RegistryServer, free_port,
+        )
+        from modelx_tpu.registry.store_fs import FSRegistryStore
+
+        cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64),
+                                  dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        st.write_safetensors(
+            str(tmp_path / "model.safetensors"),
+            {k: np.asarray(v) for k, v in params.items()},
+        )
+        srv = RegistryServer(
+            Options(listen=f"127.0.0.1:{free_port()}"),
+            store=FSRegistryStore(MemoryFSProvider()),
+        )
+        base = srv.serve_background()
+        try:
+            out = bench.measure_kv_store(
+                str(tmp_path), base, dtype="float32", prompt_len=48,
+                suffix_len=8, new_tokens=4, max_seq_len=128,
+            )
+        finally:
+            srv.shutdown()
+        for key in ("kv_published", "kv_installed", "kv_install_skipped",
+                    "kv_hits_installed", "kv_warm_ttft_ms",
+                    "kv_cold_ttft_ms", "kv_warm_ttft_ratio"):
+            assert key in out, key
+        assert out["kv_published"] >= 1
+        assert out["kv_installed"] >= 1
+        assert out["kv_hits_installed"] >= 1
+        assert out["kv_warm_ttft_ms"] > 0 and out["kv_cold_ttft_ms"] > 0
+        # the < 0.6 acceptance bar is a hardware number; the CPU smoke
+        # only proves the ratio is wired to the two scored streams
+        assert out["kv_warm_ttft_ratio"] is not None
+
+
 class TestFleetLeg:
     @pytest.mark.slow
     def test_measure_fleet_schema(self, tmp_path):

@@ -1,0 +1,892 @@
+"""Content-addressed prefix-KV store (ISSUE 20, dl/kv_store.py).
+
+Three layers, mirroring test_program_store.py's trust boundary:
+
+- **bundle units** (fake pytrees, no model): deterministic build, install
+  round-trip into a live PrefixKVCache, and the corruption / skew /
+  truncation / traversal ladder — every bad input installs nothing and
+  never raises.
+- **registry round-trip** (hermetic in-process RegistryServer): a kv
+  bundle is a *real descriptor*, so publish/pull, annotation-level skew
+  skips, GC referenced-digest tracking, scrub/quarantine and the CLI get
+  the same invariants weights and programs get. Plus the outbox kind
+  routing, the threshold publisher and the miss-driven fetch-through.
+- **real decodes**: byte-exactness of a stream resumed from a
+  registry-installed bundle vs a locally-prefilled one — the acceptance
+  contract. One tier-1 representative per axis pair (greedy dense,
+  sampled paged); the full matrix, the dp=2,tp=2 mesh and the
+  publish -> pod-kill -> reinstall drill ride `make kv`.
+"""
+
+import dataclasses
+import io
+import json
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from modelx_tpu.client.client import Client
+from modelx_tpu.dl import aot_cache
+from modelx_tpu.dl import kv_store as kv
+from modelx_tpu.dl import program_store as ps
+from modelx_tpu.dl.outbox import Drainer, Outbox
+from modelx_tpu.models.decode import ChunkedDecoder, PrefixKVCache
+from modelx_tpu.registry.fs import MemoryFSProvider
+from modelx_tpu.registry.server import Options, RegistryServer, free_port
+from modelx_tpu.registry.store_fs import FSRegistryStore
+from modelx_tpu.types import (
+    AnnotationKVPrefix,
+    AnnotationKVTokens,
+    Digest,
+    MediaTypeModelKVCache,
+)
+
+IDS = [3, 1, 4, 1, 5]
+
+
+def fake_init(b, n):
+    """Stand-in for a family's init_kv_cache: the shape oracle installs
+    validate against."""
+    return {"k": jnp.zeros((b, n, 2, 4), jnp.float32),
+            "v": jnp.zeros((b, n, 2, 4), jnp.float32)}
+
+
+def fake_entry(n: int = 16, seed: int = 0):
+    rng = np.random.RandomState(seed)
+    return {"k": jnp.asarray(rng.randn(1, n, 2, 4).astype(np.float32)),
+            "v": jnp.asarray(rng.randn(1, n, 2, 4).astype(np.float32))}
+
+
+# --- bundle units -------------------------------------------------------------
+
+
+class TestBundle:
+    def test_build_is_deterministic(self):
+        a = kv.build_bundle(IDS, fake_entry())
+        b = kv.build_bundle(IDS, fake_entry())
+        assert a == b and a is not None
+        with tarfile.open(fileobj=io.BytesIO(a), mode="r:") as tar:
+            names = tar.getnames()
+        assert names == [kv.META_MEMBER, "leaf-00000.bin", "leaf-00001.bin"]
+        meta = kv._bundle_meta(a)
+        assert meta["formatVersion"] == kv.BUNDLE_FORMAT
+        assert meta["tokens"] == IDS
+        assert meta["storedLen"] == 16
+        envk = kv.env_key()
+        assert meta["prefixHash"] == kv.prefix_hash("", envk, IDS)
+
+    def test_empty_inputs_build_nothing(self):
+        assert kv.build_bundle([], fake_entry()) is None
+        assert kv.build_bundle(IDS, {}) is None
+
+    def test_prefix_hash_scopes_model_env_and_tokens(self):
+        envk = kv.env_key()
+        h = kv.prefix_hash("m1", envk, IDS)
+        assert h != kv.prefix_hash("m2", envk, IDS)
+        assert h != kv.prefix_hash("m1", "0" * 12, IDS)
+        assert h != kv.prefix_hash("m1", envk, IDS + [9])
+        assert kv.bundle_name(envk, h) == f".kv-{envk}-{h}.tar"
+        assert kv.bundle_name(envk, h).startswith(".")  # push skips dotfiles
+
+    def test_install_roundtrip_and_origin(self):
+        data = kv.build_bundle(IDS, fake_entry())
+        cache = PrefixKVCache(4)
+        stats = kv.install_bundle(data, fake_init, cache)
+        assert stats["installed"] == 1 and stats["skipped"] == 0
+        assert cache.entry_origin(IDS) == "installed"
+        hit = cache.lookup(IDS + [9])
+        assert hit is not None and hit[0] == len(IDS)
+        np.testing.assert_array_equal(
+            np.asarray(hit[1]["k"]), np.asarray(fake_entry()["k"]))
+        assert cache.hits_installed == 1
+        # installed entries are already in the registry: never re-published
+        assert cache.take_publishable(1) == []
+        again = kv.install_bundle(data, fake_init, cache)
+        assert again["installed"] == 0 and again["present"] == 1
+
+    def test_install_never_overwrites_local_entries(self):
+        cache = PrefixKVCache(4)
+        local = fake_entry(seed=7)
+        cache.put(IDS, local)
+        stats = kv.install_bundle(kv.build_bundle(IDS, fake_entry()), fake_init,
+                                  cache)
+        assert stats["present"] == 1 and stats["installed"] == 0
+        assert cache.entry_origin(IDS) == "local"
+        hit = cache.lookup(IDS + [9])
+        np.testing.assert_array_equal(np.asarray(hit[1]["k"]),
+                                      np.asarray(local["k"]))
+
+    def test_install_from_dir_aggregates(self, tmp_path):
+        d = str(tmp_path / "model")
+        os.makedirs(d)
+        meta = kv._bundle_meta(kv.build_bundle(IDS, fake_entry()))
+        name = kv.bundle_name(kv.env_key(), meta["prefixHash"])
+        with open(os.path.join(d, name), "wb") as f:
+            f.write(kv.build_bundle(IDS, fake_entry()))
+        with open(os.path.join(d, ".kv-deadbeef0000-" + "0" * 16 + ".tar"),
+                  "wb") as f:
+            f.write(b"junk bundle from another pod")
+        cache = PrefixKVCache(4)
+        total = kv.install_from_dir(d, fake_init, cache)
+        assert total["bundles"] == 2
+        assert total["installed"] == 1
+        assert total["reasons"]  # the junk one logged, not raised
+
+    def test_install_for_server_uses_family_decode_fns(self, tmp_path):
+        class Fam:
+            @staticmethod
+            def decode_fns(cfg, mesh=None):
+                return None, fake_init
+
+        class Srv:
+            family = Fam()
+            cfg = None
+            mesh = None
+
+            def __init__(self):
+                self._prefix_cache = PrefixKVCache(4)
+
+        d = str(tmp_path / "model")
+        os.makedirs(d)
+        with open(os.path.join(d, ".kv-" + "a" * 12 + "-" + "b" * 16 + ".tar"),
+                  "wb") as f:
+            f.write(kv.build_bundle(IDS, fake_entry()))
+        srv = Srv()
+        total = kv.install_for_server(srv, d)
+        assert total["installed"] == 1
+        assert srv._prefix_cache.entry_origin(IDS) == "installed"
+
+
+class TestBundleHardening:
+    """The fallback ladder: every bad input is logged + skipped, never
+    raised, and never lands in the cache."""
+
+    def test_garbage_bytes_install_nothing(self):
+        cache = PrefixKVCache(4)
+        stats = kv.install_bundle(b"this is not a tar archive", fake_init, cache)
+        assert stats["installed"] == 0 and stats["skipped"] >= 1
+        assert cache.stats()["entries"] == 0
+
+    def test_truncated_bundle_installs_nothing(self):
+        data = kv.build_bundle(IDS, fake_entry())
+        cache = PrefixKVCache(4)
+        # cuts chosen to bite real content (the tail of a small tar is
+        # record padding a naive len-based cut would miss): mid-meta,
+        # mid-leaf-header, mid-leaf-data
+        for cut in (100, 700, 1800, 3000):
+            stats = kv.install_bundle(data[:cut], fake_init, cache)
+            assert stats["installed"] == 0, cut
+        assert cache.stats()["entries"] == 0
+
+    def test_version_skew_skips_wholesale(self, monkeypatch):
+        data = kv.build_bundle(IDS, fake_entry())
+        monkeypatch.setattr(aot_cache, "_code_version", "f" * 16)
+        cache = PrefixKVCache(4)
+        stats = kv.install_bundle(data, fake_init, cache)
+        assert stats["installed"] == 0
+        assert any("version skew" in r for r in stats["reasons"])
+        assert cache.stats()["entries"] == 0
+
+    def test_mesh_skew_skips_wholesale(self):
+        data = kv.build_bundle(IDS, fake_entry(), mesh="dp=2,tp=4")
+        cache = PrefixKVCache(4)
+        stats = kv.install_bundle(data, fake_init, cache)  # local mesh differs
+        assert stats["installed"] == 0
+        assert any("mesh skew" in r for r in stats["reasons"])
+        # unlike programs there is no pre-mesh generation to grandfather
+        same = kv.install_bundle(data, fake_init, cache, mesh="dp=2,tp=4")
+        assert same["installed"] == 1
+
+    def test_model_skew_skips_but_empty_key_installs(self):
+        data = kv.build_bundle(IDS, fake_entry(), model_key="m-one")
+        cache = PrefixKVCache(4)
+        stats = kv.install_bundle(data, fake_init, cache, model_key="m-two")
+        assert stats["installed"] == 0
+        assert any("model skew" in r for r in stats["reasons"])
+        # an unreachable manifest yields an empty local key: the check is
+        # skipped (descriptors already scope bundles to the model version)
+        assert kv.install_bundle(data, fake_init, cache)["installed"] == 1
+
+    def test_tampered_leaf_fails_rehash(self):
+        data = kv.build_bundle(IDS, fake_entry())
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r:") as tar:
+            off = tar.getmember("leaf-00000.bin").offset_data
+        tampered = bytearray(data)
+        tampered[off + 8] ^= 0xFF  # same length: only the sha256 can catch it
+        cache = PrefixKVCache(4)
+        stats = kv.install_bundle(bytes(tampered), fake_init, cache)
+        assert stats["installed"] == 0
+        assert any("hash/size" in r for r in stats["reasons"])
+        assert cache.stats()["entries"] == 0
+
+    def test_traversal_and_stray_leaf_names_rejected(self):
+        import hashlib
+
+        jx, backend, code, mesh_s = ps._env(None)
+        blob = np.zeros((1, 16, 2, 4), np.float32).tobytes()
+        for evil in ("../evil.bin", "leaf-00000.bin.atime", "LEAF-00000.bin"):
+            meta = {
+                "formatVersion": kv.BUNDLE_FORMAT,
+                "jax": jx, "backend": backend, "codeVersion": code,
+                "mesh": mesh_s, "modelKey": "", "prefixHash": "x",
+                "tokens": IDS, "storedLen": 16,
+                "leaves": [{"name": evil, "dtype": "float32",
+                            "shape": [1, 16, 2, 4], "spec": None,
+                            "sha256": hashlib.sha256(blob).hexdigest(),
+                            "size": len(blob)}] * 2,
+            }
+            buf = io.BytesIO()
+            with tarfile.open(fileobj=buf, mode="w",
+                              format=tarfile.USTAR_FORMAT) as tar:
+                for name, payload in [
+                        (kv.META_MEMBER, json.dumps(meta).encode()),
+                        (evil.replace("..", "dot"), blob)]:
+                    info = tarfile.TarInfo(name)
+                    info.size = len(payload)
+                    tar.addfile(info, io.BytesIO(payload))
+            cache = PrefixKVCache(4)
+            stats = kv.install_bundle(buf.getvalue(), fake_init, cache)
+            assert stats["installed"] == 0, evil
+            assert any("rejected" in r for r in stats["reasons"])
+
+    def test_wrong_format_version_rejected(self):
+        data = kv.build_bundle(IDS, fake_entry())
+        mutated = data.replace(b'"formatVersion":1', b'"formatVersion":9')
+        stats = kv.install_bundle(mutated, fake_init, PrefixKVCache(4))
+        assert stats["installed"] == 0
+
+    def test_leaf_layout_must_match_model_oracle(self):
+        data = kv.build_bundle(IDS, fake_entry())
+
+        def other_init(b, n):  # a different family geometry
+            return {"k": jnp.zeros((b, n, 4, 8), jnp.float32),
+                    "v": jnp.zeros((b, n, 4, 8), jnp.float32)}
+
+        stats = kv.install_bundle(data, other_init, PrefixKVCache(4))
+        assert stats["installed"] == 0
+        assert any("does not match model cache layout" in r
+                   for r in stats["reasons"])
+
+    def test_entry_over_byte_cap_refused(self):
+        data = kv.build_bundle(IDS, fake_entry())
+        cache = PrefixKVCache(4, max_bytes=64)
+        stats = kv.install_bundle(data, fake_init, cache)
+        assert stats["installed"] == 0
+        assert any("byte cap" in r for r in stats["reasons"])
+
+
+# --- registry round-trip ------------------------------------------------------
+
+
+REPO = "library/kv"
+
+
+@pytest.fixture
+def server_store():
+    store = FSRegistryStore(MemoryFSProvider())
+    srv = RegistryServer(Options(listen=f"127.0.0.1:{free_port()}"), store=store)
+    base = srv.serve_background()
+    yield base, store
+    srv.shutdown()
+
+
+@pytest.fixture
+def pushed(server_store, tmp_path):
+    base, store = server_store
+    d = tmp_path / "m"
+    d.mkdir()
+    (d / "modelx.yaml").write_text("description: kv-test\nframework: jax\n")
+    (d / "weights.bin").write_bytes(b"W" * 2048)
+    client = Client(base, quiet=True)
+    client.push(REPO, "v1", str(d))
+    return base, store, client
+
+
+@pytest.fixture
+def bundle():
+    return kv.build_bundle(IDS, fake_entry())
+
+
+class TestRegistry:
+    def test_publish_is_a_real_descriptor(self, pushed, bundle):
+        base, store, client = pushed
+        desc = kv.publish(client.remote, REPO, "v1", bundle)
+        manifest = client.get_manifest(REPO, "v1")
+        (got,) = kv.kv_descriptors(manifest)
+        assert got.media_type == MediaTypeModelKVCache
+        envk = kv.env_key()
+        assert got.name == kv.bundle_name(envk, kv.prefix_hash("", envk, IDS))
+        assert str(got.digest) == str(Digest.from_bytes(bundle))
+        assert got.annotations[AnnotationKVTokens] == str(len(IDS))
+        assert got.annotations[AnnotationKVPrefix] == \
+            kv.prefix_hash("", envk, IDS)
+        assert desc.size == len(bundle)
+        assert any(b.name == "weights.bin" for b in manifest.blobs)
+
+    def test_republish_replaces_other_prefix_coexists(self, pushed, bundle):
+        base, store, client = pushed
+        kv.publish(client.remote, REPO, "v1", bundle)
+        kv.publish(client.remote, REPO, "v1", bundle)
+        assert len(kv.kv_descriptors(client.get_manifest(REPO, "v1"))) == 1
+        kv.publish(client.remote, REPO, "v1",
+                   kv.build_bundle([9, 9, 9], fake_entry(seed=3)))
+        assert len(kv.kv_descriptors(client.get_manifest(REPO, "v1"))) == 2
+
+    def test_pull_and_install_through_blob_cache(self, pushed, bundle, tmp_path):
+        from modelx_tpu.dl.blob_cache import BlobCache
+
+        base, store, client = pushed
+        kv.publish(client.remote, REPO, "v1", bundle)
+        bc = BlobCache(str(tmp_path / "bc"))
+        manifest = client.get_manifest(REPO, "v1")
+        cache = PrefixKVCache(4)
+        s1 = kv.pull_and_install(client, REPO, manifest, fake_init, cache,
+                                 blob_cache=bc)
+        assert s1["installed"] == 1 and s1["bundles"] == 1
+        assert bc.stats["admitted"] >= 1
+        s2 = kv.pull_and_install(client, REPO, manifest, fake_init,
+                                 PrefixKVCache(4), blob_cache=bc)
+        assert s2["installed"] == 1
+        assert bc.stats["hits"] >= 1  # second pod is disk-warm
+
+    def test_skew_annotations_skip_without_fetching(self, pushed, bundle,
+                                                    tmp_path, monkeypatch):
+        base, store, client = pushed
+        kv.publish(client.remote, REPO, "v1", bundle)
+        kv.publish(client.remote, REPO, "v1",
+                   kv.build_bundle([7, 7], fake_entry(seed=2), mesh="dp=2,tp=4"))
+        manifest = client.get_manifest(REPO, "v1")
+        fetches = []
+        monkeypatch.setattr(
+            client.remote, "get_blob_content",
+            lambda *a, **k: fetches.append(a) or iter(()),
+        )
+        stats = kv.pull_and_install(client, REPO, manifest, fake_init,
+                                    PrefixKVCache(4), mesh="dp=8,tp=1")
+        assert stats["installed"] == 0
+        assert sum("skew (annotation)" in r for r in stats["reasons"]) == 2
+        assert not fetches  # no bytes spent on bundles we cannot use
+
+    def test_gc_keeps_referenced_collects_pruned(self, pushed, bundle):
+        from modelx_tpu.registry.gc import gc_blobs
+
+        base, store, client = pushed
+        desc = kv.publish(client.remote, REPO, "v1", bundle)
+        assert gc_blobs(store, REPO, grace_s=0).deleted == 0
+        assert store.exists_blob(REPO, str(desc.digest))
+        manifest = client.get_manifest(REPO, "v1")
+        manifest.blobs = [b for b in manifest.blobs
+                          if b.media_type != MediaTypeModelKVCache]
+        client.remote.put_manifest(REPO, "v1", manifest)
+        result = gc_blobs(store, REPO, grace_s=0)
+        assert result.deleted == 1
+        assert not store.exists_blob(REPO, str(desc.digest))
+
+    def test_scrub_quarantines_tampered_bundle_pull_degrades(self, pushed,
+                                                             bundle):
+        from modelx_tpu.registry import scrub
+        from modelx_tpu.registry.store import blob_digest_path
+
+        base, store, client = pushed
+        desc = kv.publish(client.remote, REPO, "v1", bundle)
+        junk = b"Z" * len(bundle)
+        store.fs.put(blob_digest_path(REPO, str(desc.digest)),
+                     io.BytesIO(junk), len(junk), "application/octet-stream")
+        manifest = client.get_manifest(REPO, "v1")
+        # before the scrub notices: the puller's own digest check discards
+        stats = kv.pull_and_install(client, REPO, manifest, fake_init,
+                                    PrefixKVCache(4))
+        assert stats["installed"] == 0
+        assert any("mismatch" in r for r in stats["reasons"])
+        result = scrub.scrub_repository(store, REPO)
+        assert str(desc.digest) in result.quarantined
+        # after quarantine the read 404s; still no raise, prefill stays cold
+        stats = kv.pull_and_install(client, REPO, manifest, fake_init,
+                                    PrefixKVCache(4))
+        assert stats["installed"] == 0 and stats["reasons"]
+
+    def test_pull_model_lands_bundle_next_to_weights(self, pushed, bundle,
+                                                     tmp_path):
+        from modelx_tpu.dl.initializer import pull_model
+
+        base, store, client = pushed
+        desc = kv.publish(client.remote, REPO, "v1", bundle)
+        dest = str(tmp_path / "dest")
+        stats = pull_model(f"{base}/{REPO}@v1", dest)
+        assert stats["kv_blobs"] == 1
+        assert os.path.isfile(os.path.join(dest, desc.name))
+
+    def test_cli_list_push_and_prune(self, pushed, bundle, tmp_path):
+        from click.testing import CliRunner
+
+        from modelx_tpu.cli import main as cli_main
+
+        base, store, client = pushed
+        path = str(tmp_path / "hot.tar")
+        with open(path, "wb") as f:
+            f.write(bundle)
+        ref = f"{base}/{REPO}@v1"
+        r = CliRunner().invoke(cli_main, ["kv", "push", ref, path])
+        assert r.exit_code == 0, r.output
+        assert json.loads(r.output)["tokens"] == len(IDS)
+        r = CliRunner().invoke(cli_main, ["kv", "list", ref])
+        assert r.exit_code == 0 and ".kv-" in r.output
+        r = CliRunner().invoke(cli_main, ["kv", "prune", ref])
+        assert r.exit_code == 0 and json.loads(r.output)["removed"] == 1
+        assert kv.kv_descriptors(client.get_manifest(REPO, "v1")) == []
+
+
+def test_filter_blobs_keeps_kv_bundles():
+    from modelx_tpu.dl.initializer import filter_blobs
+    from modelx_tpu.types import Descriptor, Manifest
+
+    manifest = Manifest(blobs=[
+        Descriptor(name="model.safetensors", digest="sha256:" + "a" * 64, size=1),
+        Descriptor(name="tokenizer.json", digest="sha256:" + "b" * 64, size=1),
+        Descriptor(name=".kv-" + "a" * 12 + "-" + "b" * 16 + ".tar",
+                   digest="sha256:" + "c" * 64, size=1,
+                   media_type=MediaTypeModelKVCache),
+    ])
+    kept = filter_blobs(manifest, ["model.safetensors"])
+    names = [b.name for b in kept.blobs]
+    assert names == ["model.safetensors", ".kv-" + "a" * 12 + "-" + "b" * 16 + ".tar"]
+
+
+# --- outbox kind routing ------------------------------------------------------
+
+
+class TestOutboxKinds:
+    def test_kind_routes_to_registered_handler(self, tmp_path):
+        ob = Outbox(str(tmp_path / "ob"))
+        assert ob.enqueue(kv.OUTBOX_KIND, "reg/m@v1", b"kv-bytes")
+        got = []
+        dr = Drainer(ob, handler=lambda k, r, d: got.append(("fallback", k)))
+        dr.register_handler(kv.OUTBOX_KIND,
+                            lambda k, r, d: got.append(("kv", k, r, d)))
+        assert dr.drain_once()
+        assert got == [("kv", "kvcache", "reg/m@v1", b"kv-bytes")]
+        snap = ob.snapshot()
+        assert snap["drained_kvcache_total"] == 1
+        assert snap["drained_total"] == 1
+
+    def test_legacy_entry_without_kind_drains_as_programs(self, tmp_path):
+        ob = Outbox(str(tmp_path / "ob"))
+        assert ob.enqueue("placeholder", "reg/m@v1", b"old-bytes")
+        # simulate a pre-upgrade spool: strip the kind from the meta file
+        (seq, meta_path, _bin) = ob._scan()[0]
+        with open(meta_path) as f:
+            meta = json.load(f)
+        del meta["kind"]
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+        got = []
+        dr = Drainer(ob, handler=None)
+        dr.register_handler("programs", lambda k, r, d: got.append((k, d)))
+        assert dr.drain_once()
+        assert got == [("programs", b"old-bytes")]
+
+    def test_unknown_kind_dropped_not_wedged(self, tmp_path):
+        ob = Outbox(str(tmp_path / "ob"))
+        assert ob.enqueue("weird-artifact", "reg/m@v1", b"x")
+        assert ob.enqueue(kv.OUTBOX_KIND, "reg/m@v1", b"y")
+        got = []
+        dr = Drainer(ob, handler=None)
+        dr.register_handler(kv.OUTBOX_KIND, lambda k, r, d: got.append(d))
+        assert dr.drain_once()  # the weird one: removed, counted
+        assert ob.snapshot()["dropped_unknown_kind_total"] == 1
+        assert dr.drain_once()  # the kv one behind it still drains
+        assert got == [b"y"]
+        assert ob.depth() == 0
+
+    def test_kind_failure_counters_are_per_kind(self, tmp_path):
+        ob = Outbox(str(tmp_path / "ob"))
+        assert ob.enqueue(kv.OUTBOX_KIND, "reg/m@v1", b"x")
+        dr = Drainer(ob, handler=None)
+        dr.register_handler(
+            kv.OUTBOX_KIND,
+            lambda k, r, d: (_ for _ in ()).throw(RuntimeError("registry down")))
+        assert not dr.drain_once()
+        snap = ob.snapshot()
+        assert snap["publish_failures_kvcache_total"] == 1
+        assert ob.depth() == 1  # entry kept for the retry
+
+
+# --- threshold publisher ------------------------------------------------------
+
+
+class _FakeSrv:
+    def __init__(self, cache):
+        self._prefix_cache = cache
+        self.mesh = None
+
+
+class TestKVPublisher:
+    REF = "http://127.0.0.1:9/library/x@v1"  # model key lookup fails -> ""
+
+    def _hot_cache(self, hits: int) -> PrefixKVCache:
+        cache = PrefixKVCache(4)
+        cache.put(IDS, fake_entry())
+        for i in range(hits):
+            assert cache.lookup(IDS + [9 + i]) is not None
+        return cache
+
+    def test_threshold_ships_once(self):
+        cache = self._hot_cache(2)
+        shipped = []
+        pub = kv.KVPublisher(lambda: [(self.REF, _FakeSrv(cache))],
+                             lambda ref, data: shipped.append((ref, data)),
+                             threshold=2)
+        assert pub.flush() == 1
+        assert shipped[0][0] == self.REF
+        assert kv._bundle_meta(shipped[0][1])["tokens"] == IDS
+        assert cache.stats()["published_total"] == 1
+        # marked at take: the next sweep re-ships nothing
+        assert cache.lookup(IDS + [77]) is not None
+        assert pub.flush() == 0
+        assert pub.snapshot()["published_total"] == 1
+
+    def test_below_threshold_ships_nothing(self):
+        cache = self._hot_cache(1)
+        pub = kv.KVPublisher(lambda: [(self.REF, _FakeSrv(cache))],
+                             lambda ref, data: pytest.fail("shipped cold entry"),
+                             threshold=2)
+        assert pub.flush() == 0
+
+    def test_sink_failure_counted_not_raised(self):
+        cache = self._hot_cache(2)
+
+        def sink(ref, data):
+            raise RuntimeError("outbox disk full")
+
+        pub = kv.KVPublisher(lambda: [(self.REF, _FakeSrv(cache))], sink,
+                             threshold=2)
+        assert pub.flush() == 0
+        assert pub.snapshot()["sink_failures_total"] == 1
+
+
+# --- fetch-through ------------------------------------------------------------
+
+
+class TestKVFetcher:
+    def test_miss_fetches_and_next_lookup_hits(self, pushed, bundle):
+        base, store, client = pushed
+        kv.publish(client.remote, REPO, "v1", bundle)
+        cache = PrefixKVCache(4)
+        fetcher = kv.KVFetcher(f"{base}/{REPO}@v1", fake_init, cache)
+        cache.fetcher = fetcher
+        assert cache.lookup(IDS + [9, 9]) is None  # miss enqueues
+        assert fetcher.drain_once() is True
+        assert fetcher.snapshot()["installed_total"] == 1
+        hit = cache.lookup(IDS + [9, 9])
+        assert hit is not None and hit[0] == len(IDS)
+        assert cache.hits_installed == 1
+
+    def test_identical_prompt_is_not_a_usable_prefix(self, pushed, bundle):
+        """Strict prefix: the stored bundle covers the WHOLE prompt, so
+        the suffix prefill would have zero real tokens — skip."""
+        base, store, client = pushed
+        kv.publish(client.remote, REPO, "v1", bundle)
+        cache = PrefixKVCache(4)
+        fetcher = kv.KVFetcher(f"{base}/{REPO}@v1", fake_init, cache)
+        cache.fetcher = fetcher
+        assert cache.lookup(IDS) is None
+        assert fetcher.drain_once() is True
+        assert fetcher.snapshot()["fetched_total"] == 0
+
+    def test_failed_install_digest_not_refetched(self, pushed):
+        base, store, client = pushed
+        # geometry the local fake_init disowns: fetch once, install 0,
+        # negative-cache the digest
+        bad = kv.build_bundle(IDS, {"k": jnp.zeros((1, 16, 4, 8), jnp.float32),
+                                    "v": jnp.zeros((1, 16, 4, 8), jnp.float32)})
+        kv.publish(client.remote, REPO, "v1", bad)
+        cache = PrefixKVCache(4)
+        fetcher = kv.KVFetcher(f"{base}/{REPO}@v1", fake_init, cache)
+        fetcher.MANIFEST_TTL_S = 0.0
+        cache.fetcher = fetcher
+        assert cache.lookup(IDS + [9]) is None
+        assert fetcher.drain_once()
+        assert fetcher.snapshot()["fetched_total"] == 1
+        assert fetcher.snapshot()["installed_total"] == 0
+        assert cache.lookup(IDS + [9, 9]) is None
+        assert fetcher.drain_once()
+        assert fetcher.snapshot()["fetched_total"] == 1  # tried: no refetch
+
+    def test_on_miss_is_bounded(self):
+        cache = PrefixKVCache(4)
+        fetcher = kv.KVFetcher("reg/m@v1", fake_init, cache)
+        for i in range(kv.KVFetcher.MAX_QUEUE * 3):
+            fetcher.on_miss([i])
+        assert fetcher.snapshot()["pending"] == kv.KVFetcher.MAX_QUEUE
+
+
+# --- real decodes: the byte-exactness contract --------------------------------
+
+
+@pytest.fixture(scope="module")
+def model():
+    from modelx_tpu.models import llama
+
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64),
+                              dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    def fwd(p, t, kv_cache, cache_offset=0, mesh=None):
+        return llama.forward(p, t, cfg, kv_cache=kv_cache,
+                             cache_offset=cache_offset)
+
+    return params, cfg, fwd, (lambda b, n: llama.init_kv_cache(cfg, b, n))
+
+
+def _stream_all(dec, params, ids, n, **samp):
+    from modelx_tpu.models.decode import pad_seq_len
+
+    s = len(ids)
+    prompt = np.zeros((1, pad_seq_len(s)), np.int32)
+    prompt[0, :s] = ids
+    kw = {}
+    for key, val in samp.items():
+        key = "seeds" if key == "seed" else key
+        kw[key] = np.asarray(
+            [val], np.float32 if key in ("temperature", "top_p") else np.int32)
+    pieces = list(dec.stream(params, jnp.asarray(prompt),
+                             np.asarray([s], np.int32), n, **kw))
+    return np.concatenate(pieces, axis=1)[0].tolist()
+
+
+def _captured_bundle(model, turn1, turn2, mesh=None, **samp):
+    """Heat a capture decoder (turn1 then turn2 extending it), take the
+    hot entry and serialize it — the publisher side of the contract."""
+    params, cfg, fwd, init = model
+    cap = ChunkedDecoder(fwd, init, 4, prefix_cache=PrefixKVCache(4))
+    _stream_all(cap, params, turn1, 8, **samp)
+    _stream_all(cap, params, turn2, 8, **samp)  # strict-prefix hit on turn1
+    taken = dict(cap.prefix_cache.take_publishable(1))
+    return kv.build_bundle(turn1, taken[tuple(turn1)], mesh=mesh)
+
+
+class TestByteExactDense:
+    def test_greedy_installed_equals_local_prefill(self, model):
+        """Tier-1 representative: a greedy dense stream resumed from a
+        registry-shaped bundle is byte-identical to the cold stream."""
+        params, cfg, fwd, init = model
+        turn1 = [3, 4, 5, 6, 7]
+        turn2 = turn1 + [8, 8, 8]
+        cold = ChunkedDecoder(fwd, init, 4)
+        expect = _stream_all(cold, params, turn2, 8)
+        data = _captured_bundle(model, turn1, turn2)
+        pc = PrefixKVCache(4)
+        stats = kv.install_bundle(data, init, pc)
+        assert stats["installed"] == 1
+        warm = ChunkedDecoder(fwd, init, 4, prefix_cache=pc)
+        assert _stream_all(warm, params, turn2, 8) == expect
+        assert pc.hits_installed == 1
+
+    @pytest.mark.slow
+    def test_sampled_installed_equals_local_prefill(self, model):
+        params, cfg, fwd, init = model
+        samp = dict(temperature=0.9, seed=11)
+        turn1 = [3, 4, 5, 6, 7]
+        turn2 = turn1 + [8, 8, 8]
+        cold = ChunkedDecoder(fwd, init, 4)
+        expect = _stream_all(cold, params, turn2, 8, **samp)
+        data = _captured_bundle(model, turn1, turn2, **samp)
+        pc = PrefixKVCache(4)
+        assert kv.install_bundle(data, init, pc)["installed"] == 1
+        warm = ChunkedDecoder(fwd, init, 4, prefix_cache=pc)
+        assert _stream_all(warm, params, turn2, 8, **samp) == expect
+        assert pc.hits_installed == 1
+
+
+@pytest.fixture(scope="module")
+def live_server(tmp_path_factory):
+    from modelx_tpu.dl import safetensors as st
+    from modelx_tpu.dl.serve import ModelServer
+    from modelx_tpu.models import llama
+
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64),
+                              dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    d = tmp_path_factory.mktemp("kv-live")
+    st.write_safetensors(str(d / "model.safetensors"),
+                         {k: np.asarray(v) for k, v in params.items()})
+    srv = ModelServer(str(d), mesh_spec="dp=1", dtype="float32", max_seq_len=96)
+    srv.load()
+    return srv
+
+
+def _engine_roundtrip(server, samp):
+    """Capture on one paged engine, install into a second, score both
+    against the server's plain decode."""
+    from modelx_tpu.dl.continuous import ContinuousBatcher
+
+    history = [7, 3, 9, 1]
+    t2 = history + [4, 4, 2]
+    pc1 = PrefixKVCache(4)
+    cb1 = ContinuousBatcher(server, max_slots=4, chunk_size=4, page_size=16,
+                            prefix_cache=pc1)
+    try:
+        cb1.generate(np.array([history], np.int32), max_new_tokens=4, **samp)
+        cb1.generate(np.array([t2], np.int32), max_new_tokens=4, **samp)
+    finally:
+        cb1.close()
+    entry = dict(pc1.take_publishable(1))[tuple(history)]
+    _fwd, init = server.family.decode_fns(server.cfg, mesh=server.mesh)
+    data = kv.build_bundle(history, entry, mesh=server.mesh)
+    pc2 = PrefixKVCache(4)
+    stats = kv.install_bundle(data, init, pc2, mesh=server.mesh)
+    assert stats["installed"] == 1, stats["reasons"]
+    cb2 = ContinuousBatcher(server, max_slots=4, chunk_size=4, page_size=16,
+                            prefix_cache=pc2)
+    try:
+        got = cb2.generate(np.array([t2], np.int32), max_new_tokens=7, **samp)
+        installed_hits = cb2.stats["prefix_hits_installed"]
+    finally:
+        cb2.close()
+    np.testing.assert_array_equal(
+        got, server.generate(np.array([t2], np.int32), max_new_tokens=7, **samp))
+    assert installed_hits == 1
+    assert pc2.hits_installed >= 1
+
+
+class TestByteExactPaged:
+    def test_sampled_installed_equals_local_prefill(self, live_server):
+        """Tier-1 representative: a SAMPLED decode on the PAGED engine
+        resumed from installed KV matches the plain path — and the engine
+        counts the dispatch as served from fleet-shared state."""
+        _engine_roundtrip(live_server, dict(temperature=0.9, top_k=8, seed=11))
+
+    @pytest.mark.slow
+    def test_greedy_installed_equals_local_prefill(self, live_server):
+        _engine_roundtrip(live_server, {})
+
+
+@pytest.mark.slow
+class TestByteExactMesh:
+    def test_dp2_tp2_roundtrip_with_recorded_shardings(self, tmp_path):
+        """The mesh leg of the matrix: capture on a dp=2,tp=2 GSPMD mesh,
+        install into a second pod on the SAME mesh spec (leaves device_put
+        to their recorded shardings), byte-identical stream; and the
+        bundle refuses a dp=1 install (mesh skew)."""
+        from modelx_tpu.dl import safetensors as st
+        from modelx_tpu.dl.serve import ModelServer
+        from modelx_tpu.models import llama
+
+        cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64),
+                                  dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        d = tmp_path / "m"
+        d.mkdir()
+        st.write_safetensors(str(d / "model.safetensors"),
+                             {k: np.asarray(v) for k, v in params.items()})
+
+        def stream(srv, ids, n=6):
+            pieces = list(srv.generate_stream(np.asarray([ids], np.int32),
+                                              max_new_tokens=n, chunk_size=4))
+            return np.concatenate(pieces, axis=1)[0].tolist()
+
+        pod1 = ModelServer(str(d), mesh_spec="dp=2,tp=2", dtype="float32",
+                           max_seq_len=64, prefix_cache_size=4, name="pod1")
+        pod1.load()
+        hot = [5, 6, 7, 8, 9]
+        stream(pod1, hot)
+        expect = stream(pod1, hot + [4, 2])  # local strict-prefix hit
+        assert pod1._prefix_cache.hits >= 1
+        (key, entry), = pod1._prefix_cache.take_publishable(1)
+        assert key == tuple(hot)
+        data = kv.build_bundle(hot, entry, mesh=pod1.mesh)
+        meta = kv._bundle_meta(data)
+        assert any(leaf["spec"] is not None for leaf in meta["leaves"])
+
+        pod2 = ModelServer(str(d), mesh_spec="dp=2,tp=2", dtype="float32",
+                           max_seq_len=64, prefix_cache_size=4, name="pod2")
+        pod2.load()
+        _fwd, init = pod2.family.decode_fns(pod2.cfg, mesh=pod2.mesh)
+        stats = kv.install_bundle(data, init, pod2._prefix_cache,
+                                  mesh=pod2.mesh)
+        assert stats["installed"] == 1, stats["reasons"]
+        assert stream(pod2, hot + [4, 2]) == expect
+        assert pod2._prefix_cache.hits_installed == 1
+        # the same bytes never land on a different topology
+        skew = kv.install_bundle(data, init, PrefixKVCache(4), mesh="dp=1")
+        assert skew["installed"] == 0
+        assert any("mesh skew" in r for r in skew["reasons"])
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestKillDrill:
+    def test_publish_pod_kill_reinstall(self, tmp_path):
+        """The fleet drill end to end: pod 1 heats a shared prefix,
+        ships it threshold->outbox, and DIES before the registry publish
+        lands; the drainer (spool = files) replays the publish; a
+        replacement pod pulls the model, installs the bundle at load, and
+        serves the hot prompt byte-identically WITHOUT re-prefilling it."""
+        from modelx_tpu.dl import safetensors as st
+        from modelx_tpu.dl.initializer import pull_model
+        from modelx_tpu.dl.serve import ModelServer
+        from modelx_tpu.models import llama
+
+        cfg = dataclasses.replace(llama.LlamaConfig.tiny(vocab_size=64),
+                                  dtype=jnp.float32)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        d = tmp_path / "m"
+        d.mkdir()
+        st.write_safetensors(str(d / "model.safetensors"),
+                             {k: np.asarray(v) for k, v in params.items()})
+
+        store = FSRegistryStore(MemoryFSProvider())
+        srv = RegistryServer(Options(listen=f"127.0.0.1:{free_port()}"),
+                             store=store)
+        base = srv.serve_background()
+        try:
+            client = Client(base, quiet=True)
+            client.push("library/drill", "v1", str(d))
+            ref = f"{base}/library/drill@v1"
+
+            def stream(pod, ids, n=6):
+                pieces = list(pod.generate_stream(
+                    np.asarray([ids], np.int32), max_new_tokens=n,
+                    chunk_size=4))
+                return np.concatenate(pieces, axis=1)[0].tolist()
+
+            pod1 = ModelServer(str(d), mesh_spec="dp=1", dtype="float32",
+                               max_seq_len=64, prefix_cache_size=4,
+                               name="pod1")
+            pod1.load()
+            hot = [5, 6, 7, 8, 9]
+            stream(pod1, hot)
+            expect = stream(pod1, hot + [4])   # hit 1
+            stream(pod1, hot + [2])            # hit 2: crosses threshold
+            ob = Outbox(str(tmp_path / "outbox"))
+            pub = kv.KVPublisher(
+                lambda: [(ref, pod1)],
+                lambda r, b: None if ob.enqueue(kv.OUTBOX_KIND, r, b)
+                else (_ for _ in ()).throw(RuntimeError("spool full")),
+                threshold=2)
+            assert pub.flush() == 1
+            del pod1  # the pod dies; the spool survives as files
+            dr = Drainer(Outbox(str(tmp_path / "outbox")), handler=None)
+            dr.register_handler(kv.OUTBOX_KIND,
+                                lambda k, r, data: kv.publish_bundle(r, data))
+            assert dr.drain_once()
+            assert len(kv.kv_descriptors(
+                client.get_manifest("library/drill", "v1"))) == 1
+
+            dest = str(tmp_path / "pulled")
+            stats = pull_model(ref, dest)
+            assert stats["kv_blobs"] == 1
+            pod2 = ModelServer(dest, mesh_spec="dp=1", dtype="float32",
+                               max_seq_len=64, prefix_cache_size=4,
+                               name="pod2")
+            pod2.load()  # installs the pulled bundle at the load tail
+            assert pod2._prefix_cache.stats()["installed_total"] == 1
+            assert stream(pod2, hot + [4]) == expect
+            assert pod2._prefix_cache.hits_installed == 1
+        finally:
+            srv.shutdown()

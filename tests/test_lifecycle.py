@@ -91,7 +91,9 @@ def pushed(registry, model_dirs):
 
 
 def make_server(model_dir: str, name: str = "a") -> ModelServer:
-    return ModelServer(model_dir, mesh_spec="dp=1", max_seq_len=64, name=name)
+    # prefix cache on: the serving block must carry its windowed rates
+    return ModelServer(model_dir, mesh_spec="dp=1", max_seq_len=64, name=name,
+                       prefix_cache_size=4)
 
 
 def serve_sset(sset):
@@ -210,6 +212,11 @@ class TestEndToEndLifecycle:
             for stats in admin["serving"].values():
                 assert stats["queue_depth"] == 0  # nothing in flight now
                 assert "active" in stats and "waiting" in stats
+                # windowed prefix hit/miss rates ride the same block —
+                # the router's rebalance heat signal (ISSUE 20)
+                pc = stats["prefix_cache"]
+                assert "hit_per_s_1m" in pc and "miss_per_s_1m" in pc
+                assert isinstance(pc["hit_per_s_1m"], float)
 
             # DELETE A with a request in flight: drain waits, new requests
             # 409, completion flips to 404

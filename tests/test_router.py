@@ -45,7 +45,11 @@ from modelx_tpu.router.policy import (
     plan_route,
     sticky_keys,
 )
-from modelx_tpu.router.rebalance import Rebalancer, plan_actions
+from modelx_tpu.router.rebalance import (
+    Rebalancer,
+    fleet_kv_signals,
+    plan_actions,
+)
 from modelx_tpu.router.registry import PodRegistry, PodState
 from modelx_tpu.router.server import FleetRouter, route_serve
 from modelx_tpu.testing.faults import FaultPlan, PodKillSwitch
@@ -346,6 +350,18 @@ class TestStickyTable:
         assert t.lookup(self._keys(3), {"p1", "p2"}) is None
         assert t.lookup(self._keys(4), {"p1", "p2"}) == "p2"
 
+    def test_forget_pod_counts_recoverable(self):
+        # keys whose model shipped prefix KV to the registry lose only
+        # placement, not state — the count feeds the router's
+        # sticky_forgets_recoverable_total metric
+        t = StickyTable()
+        t.assign(self._keys(3), "p1")
+        t.assign([("other", "tok", 4, 1)], "p1")
+        n = t.forget_pod("p1", recoverable_models={"m"})
+        assert n == 3  # the three "m" buckets; "other" has no published KV
+        assert t.stats()["sticky_forgets_recoverable_total"] == 3
+        assert t.forget_pod("p1") == 0  # idempotent, nothing left
+
     def test_lru_bound(self):
         t = StickyTable(max_entries=4)
         for i in range(10):
@@ -404,6 +420,21 @@ class TestPodRegistry:
             assert reg.known_state("warming") == "LOADING"
             assert [p.url for p in reg.candidates("default")] == [fp.url]
             assert reg.candidates("warming") == []
+        finally:
+            fp.close()
+
+    def test_poll_carries_prefix_cache_signals(self):
+        # the kv-store heat signal (ISSUE 20) flows from the pod's
+        # serving block through a real poll to the rebalance readers
+        fp = FakePod(models={"default": {"state": "READY"}})
+        fp.serving = {"default": {"queue_depth": 0, "prefix_cache": {
+            "hit_per_s_1m": 1.5, "published_total": 2}}}
+        try:
+            reg = PodRegistry([fp.url], poll_interval_s=60.0)
+            reg.poll_once()
+            pod = reg.pod(fp.url)
+            assert pod.prefix_hit_rate("default") == 1.5
+            assert pod.kv_published("default") is True
         finally:
             fp.close()
 
@@ -576,6 +607,61 @@ class TestPlanActions:
         a.models["hot2"] = {"state": "READY", "ref": "lib/hot2@v1"}
         acts = plan_actions([a, b], {"hot": 9, "hot2": 8}, queue_high=4)
         assert len(acts) == 1 and acts[0].model == "hot"  # hottest first
+
+    def test_hit_rate_breaks_pressure_tie(self):
+        a, b = self._pods()
+        a.models["hot2"] = {"state": "READY", "ref": "lib/hot2@v1"}
+        acts = plan_actions([a, b], {"hot": 9, "hot2": 9}, queue_high=4,
+                            hit_rates={"hot2": 3.0})
+        # equal backlog: the model whose traffic reuses prefixes spreads
+        # first — its replica starts with the shared KV installed
+        assert len(acts) == 1 and acts[0].model == "hot2"
+
+    def test_kv_published_spread_marked_prewarm(self):
+        a, b = self._pods()
+        acts = plan_actions([a, b], {"hot": 9}, queue_high=4,
+                            kv_published={"hot"})
+        assert acts[0].kv_prewarm is True
+        assert "prefix KV published" in acts[0].reason
+        assert acts[0].snapshot()["kv_prewarm"] is True
+        # without published KV the flag stays off the snapshot entirely
+        plain = plan_actions([a, b], {"hot": 9}, queue_high=4)[0]
+        assert plain.kv_prewarm is False
+        assert "kv_prewarm" not in plain.snapshot()
+
+
+class TestPodKVSignals:
+    def _pod(self, url, rate=0.0, published=0):
+        return PodState(url, healthy=True,
+                        models={"m": {"state": "READY"}},
+                        serving={"m": {"queue_depth": 0, "prefix_cache": {
+                            "hit_per_s_1m": rate,
+                            "published_total": published}}})
+
+    def test_reads_serving_block(self):
+        p = self._pod("http://a", rate=2.5, published=1)
+        assert p.prefix_hit_rate("m") == 2.5
+        assert p.kv_published("m") is True
+
+    def test_missing_block_defaults(self):
+        p = PodState("http://b", healthy=True, models={},
+                     serving={"m": {"queue_depth": 0}})
+        assert p.prefix_hit_rate("m") == 0.0
+        assert p.kv_published("m") is False
+
+    def test_garbage_values_default(self):
+        p = PodState("http://c", healthy=True, models={},
+                     serving={"m": {"prefix_cache": {
+                         "hit_per_s_1m": "nan-ish", "published_total": []}}})
+        assert p.prefix_hit_rate("m") == 0.0
+        assert p.kv_published("m") is False
+
+    def test_fleet_signals_aggregate(self):
+        pods = [self._pod("http://a", rate=1.0, published=0),
+                self._pod("http://b", rate=2.0, published=3)]
+        rates, published = fleet_kv_signals(pods)
+        assert rates == {"m": 3.0}
+        assert published == {"m"}
 
 
 class TestRebalancerE2E:

@@ -291,9 +291,12 @@ def _stream_ids(cb, ids, n, samp, resume_step=0):
 
 
 class TestFusedEngineResume:
+    # tier-1 wall: paged carries tier-1, the engine-mode sweep rides
+    # `make slow`
     @pytest.mark.parametrize(
         "page_size,prefill_chunk",
-        [(0, 0), (16, 0), (0, 16)],
+        [pytest.param(0, 0, marks=pytest.mark.slow), (16, 0),
+         pytest.param(0, 16, marks=pytest.mark.slow)],
         ids=["dense", "paged", "chunked-prefill"],
     )
     def test_sampled_resume_is_token_exact(self, wide_server, page_size,
